@@ -1,0 +1,21 @@
+"""hotstuff_tpu — TPU-native HotStuff BFT framework with device-accelerated
+digital-signature verification.
+
+A brand-new framework with the capabilities of
+`mwaurawakati/hotstuff-digital-signature-benchmarking` (reference mounted at
+/root/reference), redesigned TPU-first:
+
+- ``ops/``      — JAX/Pallas finite-field + curve primitives (the TPU compute path).
+- ``crypto/``   — scheme-level signature API (Ed25519 sign/verify/batch-verify),
+                  mirroring the reference's ``crypto`` crate boundary
+                  (reference: crypto/src/lib.rs).
+- ``parallel/`` — device-mesh sharding of large verification batches
+                  (shard_map + psum validity masks over ICI).
+- ``sidecar/``  — the long-lived verification service the C++ consensus node
+                  talks to (reference analogue: crypto/src/lib.rs:226-254
+                  SignatureService, made batch-first and device-backed).
+- ``harness/``  — benchmark orchestration + log mining
+                  (reference: benchmark/benchmark/*.py).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,117 @@
+"""Pure-python Ed25519 (RFC 8032) — host reference implementation.
+
+Roles (mirroring the reference repo's split):
+* signing + keygen for the node/sidecar (the reference signs on the CPU via
+  ed25519-dalek, crypto/src/lib.rs:177-202; signing is cheap and stays on
+  host in the TPU build too),
+* ground truth for the device verifier's tests, replacing the role of the
+  reference's off-chain python implementations
+  (off-chain-benchmarking/eddsa.py).
+
+Not constant-time; verification-side use only handles public data, and the
+signing path is a benchmarking/testing facility like the reference's.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..utils.intmath import BX, BY, D, L, P, recover_x
+
+B = (BX, BY, 1, BX * BY % P)
+IDENT = (0, 1, 1, 0)
+
+
+def pt_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * D % P
+    dd = 2 * z1 * z2 % P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def pt_dbl(p):
+    return pt_add(p, p)
+
+
+def scalar_mult(s: int, p):
+    q = IDENT
+    while s > 0:
+        if s & 1:
+            q = pt_add(q, p)
+        p = pt_dbl(p)
+        s >>= 1
+    return q
+
+
+def pt_equal(p, q) -> bool:
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return (x1 * z2 - x2 * z1) % P == 0 and (y1 * z2 - y2 * z1) % P == 0
+
+
+def encode_point(p) -> bytes:
+    x, y, z, _ = p
+    zi = pow(z, P - 2, P)
+    x, y = x * zi % P, y * zi % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def decode_point(s: bytes):
+    val = int.from_bytes(s, "little")
+    y = val & ((1 << 255) - 1)
+    sign = val >> 255
+    x = recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def _h(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha512(data).digest(), "little")
+
+
+def _clamp(a: int) -> int:
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+def public_key(seed: bytes) -> bytes:
+    a = _clamp(int.from_bytes(hashlib.sha512(seed).digest()[:32], "little"))
+    return encode_point(scalar_mult(a, B))
+
+
+def generate_keypair(seed: bytes) -> tuple[bytes, bytes]:
+    """seed (32 bytes) -> (seed, public_key).  Analogue of the reference's
+    generate_keypair (crypto/src/lib.rs:169-175)."""
+    assert len(seed) == 32
+    return seed, public_key(seed)
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(int.from_bytes(h[:32], "little"))
+    prefix = h[32:]
+    pk = encode_point(scalar_mult(a, B))
+    r = _h(prefix + msg) % L
+    r_enc = encode_point(scalar_mult(r, B))
+    k = _h(r_enc + pk + msg) % L
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")
+
+
+def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """Host reference verifier: [S]B == R + [k]A (cofactorless, strict)."""
+    if len(sig) != 64 or len(pk) != 32:
+        return False
+    a_pt = decode_point(pk)
+    r_pt = decode_point(sig[:32])
+    s = int.from_bytes(sig[32:], "little")
+    if a_pt is None or r_pt is None or s >= L:
+        return False
+    k = _h(sig[:32] + pk + msg) % L
+    return pt_equal(scalar_mult(s, B), pt_add(r_pt, scalar_mult(k, a_pt)))

@@ -1,0 +1,251 @@
+"""GF(2^255 - 19) arithmetic, batched, in JAX — the TPU compute substrate for
+Ed25519 verification.
+
+Design (TPU-first, not a port):
+
+* A field element is 32 radix-2^8 limbs stored as ``int32``, shape ``(..., 32)``,
+  little-endian.  8-bit limbs give huge accumulation headroom in int32 and make
+  every op a static-shape vector op on the VPU.
+* Polynomial (schoolbook) multiplication is expressed as one outer product plus
+  a constant 0/1 matmul ``(..., 1024) @ (1024, 63)`` — partial-product sums are
+  < 2^23 so they are exact in float32, which puts the inner loop of the whole
+  signature-verification workload on the MXU.
+* Carry propagation is a *parallel* carry: every limb simultaneously keeps its
+  low byte and passes its high bits one limb up (the carry out of limb 31 wraps
+  to limb 0 multiplied by 38, since 2^256 ≡ 38 (mod p)).  A fixed, statically
+  bounded number of such steps restores the "weak" invariant limbs < 2^9.
+  No data-dependent control flow anywhere — everything jits and vmaps.
+
+Weak-normal form invariant: limbs in [0, 2^9); the represented value is only
+meaningful mod p.  Canonical form (limbs < 2^8 and value < p) is produced once
+at the end of a computation by :func:`canonical`.
+
+Reference parity: this module underpins the TPU equivalent of
+``Signature::verify_batch`` (reference: crypto/src/lib.rs:210-223), the hot
+primitive of quorum-certificate verification (consensus/src/messages.rs:197).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NLIMBS = 32
+LIMB_BITS = 8
+LIMB_MASK = (1 << LIMB_BITS) - 1
+P = 2**255 - 19
+
+# Canonical base-256 digits of p (little-endian): [237, 255*30, 127].
+_P_DIGITS = [(P >> (8 * i)) & 0xFF for i in range(NLIMBS)]
+
+# Subtraction bias: 8*p spread over limbs so every limb dominates any weak
+# limb (< 2^9).  8p = 2^258 - 152 -> limbs [8*237, 8*255 x30, 8*127]
+# = [1896, 2040 x30, 1016]; all >= 511.
+_SUB_BIAS = [8 * d for d in _P_DIGITS]
+
+# One-hot "convolution" matrix: flattens the (32, 32) outer product of limbs
+# into the 63 coefficients of the product polynomial.  Constant, so XLA folds
+# it into a single (..., 1024) @ (1024, 63) matmul.
+_CONV = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS - 1), dtype=np.float32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _CONV[_i * NLIMBS + _j, _i + _j] = 1.0
+
+
+def _conv_mat() -> jnp.ndarray:
+    return jnp.asarray(_CONV)
+
+
+# ---------------------------------------------------------------------------
+# Host <-> limb conversion helpers (numpy / python ints; not jitted)
+# ---------------------------------------------------------------------------
+
+def to_limbs(x: int) -> np.ndarray:
+    """Python int (mod p not required) -> (32,) int32 canonical byte limbs."""
+    x = int(x) % (1 << 256)
+    return np.array([(x >> (8 * i)) & 0xFF for i in range(NLIMBS)], dtype=np.int32)
+
+
+def from_limbs(limbs) -> int:
+    """(32,) limbs (any magnitude) -> python int value."""
+    limbs = np.asarray(limbs).reshape(NLIMBS)
+    return sum(int(v) << (8 * i) for i, v in enumerate(limbs))
+
+
+def batch_to_limbs(xs) -> np.ndarray:
+    """Iterable of python ints -> (N, 32) int32 limbs."""
+    return np.stack([to_limbs(x) for x in xs])
+
+
+def batch_from_limbs(limbs) -> list[int]:
+    limbs = np.asarray(limbs, dtype=np.int64)
+    out = []
+    for row in limbs.reshape(-1, NLIMBS):
+        out.append(sum(int(v) << (8 * i) for i, v in enumerate(row)))
+    return out
+
+
+def constant(x: int) -> jnp.ndarray:
+    """Module-load-time constant as (32,) int32 limbs."""
+    return jnp.asarray(to_limbs(x % P))
+
+
+# ---------------------------------------------------------------------------
+# Carry propagation
+# ---------------------------------------------------------------------------
+
+def _carry_step(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry step.
+
+    Every limb keeps its low 8 bits; its high bits move one limb up.  The
+    carry out of limb 31 wraps around to limb 0 scaled by 38 (2^256 ≡ 38 mod p).
+    Value is preserved mod p.  Carry magnitudes shrink ~8 bits per step.
+    """
+    lo = x & LIMB_MASK
+    hi = x >> LIMB_BITS
+    wrapped = jnp.roll(hi, 1, axis=-1)
+    scale = jnp.ones((NLIMBS,), dtype=jnp.int32).at[0].set(38)
+    return lo + wrapped * scale
+
+
+def weak_normalize(x: jnp.ndarray, steps: int) -> jnp.ndarray:
+    for _ in range(steps):
+        x = _carry_step(x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Field ops (weak-normal in, weak-normal out; shapes (..., 32) int32)
+# ---------------------------------------------------------------------------
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b.  Inputs limbs < 2^9 -> sum < 2^10 -> one carry step -> < 2^9.
+
+    (carry <= 3; limb0 <= 255 + 38*3 = 369 < 512.)
+    """
+    return _carry_step(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b (mod p) without negative intermediates: adds the 8p bias whose
+    every limb (>= 1016) dominates any weak limb of b.  Result limbs < 2^12
+    -> two carry steps restore < 2^9."""
+    bias = jnp.asarray(_SUB_BIAS, dtype=jnp.int32)
+    x = a + bias - b
+    return _carry_step(_carry_step(x))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return sub(jnp.zeros_like(a), a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a * b mod p (weak).  Partial-product sums < 32 * (2^9)^2 = 2^23: exact
+    in float32, so the schoolbook product is a single MXU matmul.  The 38-fold
+    keeps coefficients < 39 * 2^23 < 2^28.6 (int32-safe); four parallel carry
+    steps restore limbs < 2^9."""
+    outer = (a[..., :, None] * b[..., None, :]).astype(jnp.float32)
+    flat = outer.reshape(*outer.shape[:-2], NLIMBS * NLIMBS)
+    coeffs = jax.lax.dot_general(
+        flat, _conv_mat(),
+        dimension_numbers=(((flat.ndim - 1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.int32)
+    lo, hi = coeffs[..., :NLIMBS], coeffs[..., NLIMBS:]
+    folded = lo + 38 * jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
+    return weak_normalize(folded, 4)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+# ---------------------------------------------------------------------------
+# Canonicalization and comparison
+# ---------------------------------------------------------------------------
+
+def _sequential_carry(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact ripple carry over the 32 limbs (unrolled; used only at the ends
+    of a computation).  Returns (limbs in [0,256), carry_out)."""
+    limbs = []
+    carry = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        t = x[..., i] + carry
+        limbs.append(t & LIMB_MASK)
+        carry = t >> LIMB_BITS
+    return jnp.stack(limbs, axis=-1), carry
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """If x >= p (x < 2^256, limbs canonical bytes), subtract p."""
+    p_digits = jnp.asarray(_P_DIGITS, dtype=jnp.int32)
+    limbs = []
+    borrow = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        d = x[..., i] - p_digits[i] - borrow
+        borrow = (d < 0).astype(jnp.int32)
+        limbs.append(d + (borrow << LIMB_BITS))
+    sub_res = jnp.stack(limbs, axis=-1)
+    keep = (borrow > 0)[..., None]  # borrow out => x < p => keep x
+    return jnp.where(keep, x, sub_res)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Weak element -> canonical limbs (bytes, value in [0, p))."""
+    # Value < 2^9 * (2^256-1)/255 < 2^257.01 -> first carry_out <= 2.
+    x, carry = _sequential_carry(x)
+    x = x.at[..., 0].add(38 * carry)
+    # Now value < 2^256 + 77; second pass carry_out <= 1 with residue <= 76.
+    x, carry = _sequential_carry(x)
+    x = x.at[..., 0].add(38 * carry)  # limb0 <= 76 + 38 < 256: no more carries
+    x = _cond_sub_p(x)
+    return _cond_sub_p(x)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field equality of weak elements -> bool shape (...,)."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Low bit of the canonical value (the Ed25519 'sign' of x)."""
+    return canonical(a)[..., 0] & 1
+
+
+# ---------------------------------------------------------------------------
+# Exponentiation by fixed public exponents (scan over constant bit schedule)
+# ---------------------------------------------------------------------------
+
+def pow_const(x: jnp.ndarray, exponent: int) -> jnp.ndarray:
+    """x ** exponent mod p for a static python-int exponent.
+
+    Left-to-right square-and-multiply driven by a *constant* bit array via
+    lax.scan: the loop body is one field squaring + one masked multiply, so
+    the whole chain stays one compiled loop regardless of exponent length.
+    """
+    bits = [int(b) for b in bin(exponent)[2:]]
+    bits_arr = jnp.asarray(bits, dtype=jnp.int32)
+
+    def body(acc, bit):
+        acc = sqr(acc)
+        acc = jnp.where(bit > 0, mul(acc, x), acc)
+        return acc, None
+
+    one = jnp.broadcast_to(constant(1), x.shape).astype(jnp.int32)
+    acc, _ = jax.lax.scan(body, one, bits_arr)
+    return acc
+
+
+def inv(x: jnp.ndarray) -> jnp.ndarray:
+    """x^(p-2) — Fermat inverse (x=0 -> 0)."""
+    return pow_const(x, P - 2)
+
+
+def pow_p58(x: jnp.ndarray) -> jnp.ndarray:
+    """x^((p-5)/8), the core of the square-root used in point decompression."""
+    return pow_const(x, (P - 5) // 8)

@@ -1,0 +1,28 @@
+"""Device-mesh helpers for sharded signature verification.
+
+The reference scales quorum-certificate verification only as far as one CPU
+core's `verify_batch` (crypto/src/lib.rs:210-223).  The TPU build treats
+committee size as the scaling axis (SURVEY.md §5.7): vote batches shard
+across chips along the batch dimension, and validity reduces over ICI with a
+psum.  These helpers give the rest of the framework one place that knows how
+meshes are built.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+BATCH_AXIS = "batch"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))

@@ -1,0 +1,32 @@
+"""Pure-python-int Ed25519 curve constants + x-recovery, shared by the host
+reference implementation (crypto/ref_ed25519) and the device module's
+compile-time constant setup (ops/ed25519).  No JAX imports."""
+
+from __future__ import annotations
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+BY = (4 * pow(5, P - 2, P)) % P
+
+
+def recover_x(y: int, sign: int) -> int | None:
+    """RFC 8032 §5.1.3 x-recovery; None when y is not on the curve or the
+    encoding is invalid."""
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRT_M1 % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x == 0 and sign:
+        return None
+    if x % 2 != sign:
+        x = P - x
+    return x
+
+
+BX = recover_x(BY, 0)  # canonical basepoint x (even)

@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device virtual CPU mesh so all sharding /
+multi-chip code paths run (and are validated) without TPU hardware, per the
+framework's multi-chip design (hotstuff_tpu/parallel/).
+
+Note: this image's sitecustomize imports jax and registers the TPU ("axon")
+PJRT plugin at interpreter startup, so env vars set here are too late —
+instead we flip the platform through jax.config before any backend is
+initialized.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,123 @@
+"""Property tests for GF(2^255-19) limb arithmetic against python-int ground
+truth, including adversarial all-max-limb values.
+
+Mirrors the role of the reference's crypto unit tests
+(crypto/src/tests/crypto_tests.rs) at the field-arithmetic layer the TPU
+build introduces.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from hotstuff_tpu.ops import field25519 as F
+
+P = F.P
+rng = np.random.default_rng(1234)
+
+
+def rand_ints(n):
+    return [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+
+
+def weak_rand_limbs(n):
+    """Adversarial weak-form inputs: limbs anywhere in [0, 512)."""
+    return np.asarray(rng.integers(0, 512, size=(n, F.NLIMBS)), dtype=np.int32)
+
+
+def limb_value(arr):
+    return [v % P for v in F.batch_from_limbs(arr)]
+
+
+def test_limb_roundtrip():
+    xs = rand_ints(16)
+    limbs = F.batch_to_limbs(xs)
+    assert F.batch_from_limbs(limbs) == xs
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (F.add, lambda a, b: (a + b) % P),
+    (F.sub, lambda a, b: (a - b) % P),
+    (F.mul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops_random(op, pyop):
+    a, b = rand_ints(64), rand_ints(64)
+    got = limb_value(np.asarray(op(jnp.asarray(F.batch_to_limbs(a)),
+                                   jnp.asarray(F.batch_to_limbs(b)))))
+    assert got == [pyop(x, y) for x, y in zip(a, b)]
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (F.add, lambda a, b: (a + b) % P),
+    (F.sub, lambda a, b: (a - b) % P),
+    (F.mul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops_weak_adversarial(op, pyop):
+    """Ops must be correct AND restore the weak invariant for any weak input."""
+    a, b = weak_rand_limbs(64), weak_rand_limbs(64)
+    # include the all-max corner
+    a[0, :] = 511
+    b[0, :] = 511
+    av, bv = limb_value(a), limb_value(b)
+    out = np.asarray(op(jnp.asarray(a), jnp.asarray(b)))
+    assert out.min() >= 0 and out.max() < 512, "weak invariant violated"
+    assert limb_value(out) == [pyop(x, y) for x, y in zip(av, bv)]
+
+
+def test_mul_chain_stays_correct():
+    """Long chains of muls/adds/subs (like a scalar ladder) stay exact."""
+    a, b = rand_ints(8), rand_ints(8)
+    la, lb = jnp.asarray(F.batch_to_limbs(a)), jnp.asarray(F.batch_to_limbs(b))
+    pa, pb = list(a), list(b)
+    for i in range(50):
+        la, lb = F.mul(la, lb), F.add(F.sub(la, lb), la)
+        pa, pb = [x * y % P for x, y in zip(pa, pb)], \
+                 [((x - y) + x) % P for x, y in zip(pa, pb)]
+    assert limb_value(np.asarray(la)) == pa
+    assert limb_value(np.asarray(lb)) == pb
+
+
+def test_canonical_and_eq():
+    xs = rand_ints(32)
+    limbs = jnp.asarray(F.batch_to_limbs(xs))
+    # x + p and x must compare equal; x and x+1 must not.
+    xp = jnp.asarray(F.batch_to_limbs([x + P for x in xs]))
+    one = jnp.broadcast_to(F.constant(1), limbs.shape)
+    assert bool(jnp.all(F.eq(limbs, xp)))
+    assert not bool(jnp.any(F.eq(limbs, F.add(limbs, one))))
+    canon = np.asarray(F.canonical(xp))
+    assert canon.max() < 256
+    assert F.batch_from_limbs(canon) == xs
+
+
+def test_canonical_edges():
+    for v in [0, 1, 19, P - 1, P, P + 1, 2 * P - 1, 2 * P, 2**255 - 1, 2**256 - 1]:
+        limbs = jnp.asarray(F.to_limbs(v))[None, :]
+        got = F.batch_from_limbs(np.asarray(F.canonical(limbs)))[0]
+        assert got == v % P, v
+
+
+def test_parity_and_zero():
+    xs = [0, 1, 2, P - 1, P, 12345]
+    limbs = jnp.asarray(F.batch_to_limbs(xs))
+    assert list(np.asarray(F.parity(limbs))) == [x % P % 2 for x in xs]
+    assert list(np.asarray(F.is_zero(limbs))) == [x % P == 0 for x in xs]
+
+
+def test_inv_and_pow():
+    xs = rand_ints(8)
+    limbs = jnp.asarray(F.batch_to_limbs(xs))
+    got = limb_value(np.asarray(F.inv(limbs)))
+    assert got == [pow(x, P - 2, P) for x in xs]
+    got58 = limb_value(np.asarray(F.pow_p58(limbs)))
+    assert got58 == [pow(x, (P - 5) // 8, P) for x in xs]
+
+
+def test_ops_jit_and_vmap():
+    a, b = rand_ints(16), rand_ints(16)
+    la, lb = jnp.asarray(F.batch_to_limbs(a)), jnp.asarray(F.batch_to_limbs(b))
+    jitted = jax.jit(lambda x, y: F.mul(x, y))
+    assert limb_value(np.asarray(jitted(la, lb))) == [x * y % P for x, y in zip(a, b)]
+    vmapped = jax.vmap(F.mul)
+    assert limb_value(np.asarray(vmapped(la, lb))) == [x * y % P for x, y in zip(a, b)]

@@ -1,0 +1,2 @@
+from .client import SidecarClient  # noqa: F401
+from .service import VerifyEngine, SidecarServer, serve  # noqa: F401

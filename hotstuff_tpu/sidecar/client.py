@@ -1,0 +1,87 @@
+"""Python client for the verify sidecar (test + harness use; the node's
+production client is the C++ implementation in native/crypto)."""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from . import protocol as proto
+
+
+class SidecarClient:
+    """Blocking, thread-safe client with request pipelining."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7100,
+                 timeout: float | None = 60.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+        self._next_id = 0
+        self._results: dict[int, list] = {}
+        self._cond = threading.Condition()
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def ping(self) -> bool:
+        rid = self._send(proto.encode_ping)
+        self._await(rid)
+        return True
+
+    def verify_batch(self, msgs, pks, sigs) -> list:
+        """Returns per-signature validity list of bools."""
+        if not msgs:
+            return []
+        rid = self._send(lambda r: proto.encode_request(r, msgs, pks, sigs))
+        return self._await(rid)
+
+    # -- internals ---------------------------------------------------------
+
+    def _send(self, make_frame):
+        with self._send_lock:
+            rid = self._next_id
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            frame = make_frame(rid)
+            self._sock.sendall(frame)
+            return rid
+
+    def _await(self, rid):
+        try:
+            while True:
+                with self._cond:
+                    if rid in self._results:
+                        return self._results.pop(rid)
+                # one thread at a time drains the socket; results are
+                # published under the condition so pipelined waiters wake up
+                if self._recv_lock.acquire(timeout=0.05):
+                    try:
+                        with self._cond:
+                            if rid in self._results:
+                                return self._results.pop(rid)
+                        payload = proto.read_frame(self._sock)
+                        _, got_rid, mask = proto.decode_reply(payload)
+                        with self._cond:
+                            self._results[got_rid] = mask
+                            self._cond.notify_all()
+                    finally:
+                        self._recv_lock.release()
+                else:
+                    with self._cond:
+                        self._cond.wait(timeout=0.05)
+        except BaseException:
+            # abandoned request: reap any already/later-published result so
+            # long-lived pipelined clients don't leak masks in _results
+            with self._cond:
+                self._results.pop(rid, None)
+            raise

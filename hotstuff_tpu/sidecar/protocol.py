@@ -1,0 +1,121 @@
+"""Wire protocol between consensus nodes (C++) and the TPU verify sidecar.
+
+The sidecar plays the role the reference gives its in-process
+``SignatureService`` + ``Signature::verify_batch`` (crypto/src/lib.rs:210-254):
+a node ships the votes of a quorum certificate to a long-lived process that
+owns the accelerator, and gets back a per-signature validity mask.  Because
+the node data plane is C++ and the device engine is JAX, the boundary is a
+localhost TCP socket with length-delimited frames — the same framing idiom
+the reference uses between replicas (4-byte length prefix,
+network/src/receiver.rs:70).
+
+Frame layout (all integers little-endian unless noted):
+
+    [u32 BIG-endian frame length][payload]
+
+Request payload:
+    u8  opcode      1 = VERIFY_BATCH, 2 = PING
+    u32 request id  echoed in the reply (lets a client pipeline requests)
+    u32 count N     number of signature records (0 for PING)
+    u16 msg_len M   byte length of each message (digests: 32)
+    N * (M bytes msg | 32 bytes pubkey | 64 bytes signature)
+
+Reply payload:
+    u8  opcode echo
+    u32 request id echo
+    u32 count N
+    N bytes of 0/1 validity
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+OP_VERIFY_BATCH = 1
+OP_PING = 2
+
+_HDR = struct.Struct("<BIIH")  # opcode, request id, count, msg_len
+_REPLY_HDR = struct.Struct("<BII")
+
+MAX_FRAME = 64 * 1024 * 1024
+
+
+@dataclass
+class VerifyRequest:
+    request_id: int
+    msgs: list
+    pks: list
+    sigs: list
+
+
+def encode_request(request_id: int, msgs, pks, sigs) -> bytes:
+    n = len(msgs)
+    msg_len = len(msgs[0]) if n else 0
+    parts = [_HDR.pack(OP_VERIFY_BATCH, request_id, n, msg_len)]
+    for m, p, s in zip(msgs, pks, sigs):
+        assert len(m) == msg_len and len(p) == 32 and len(s) == 64
+        parts.append(m)
+        parts.append(p)
+        parts.append(s)
+    payload = b"".join(parts)
+    return struct.pack(">I", len(payload)) + payload
+
+
+def encode_ping(request_id: int = 0) -> bytes:
+    payload = _HDR.pack(OP_PING, request_id, 0, 0)
+    return struct.pack(">I", len(payload)) + payload
+
+
+def decode_request(payload: bytes):
+    """payload (no length prefix) -> (opcode, VerifyRequest)."""
+    opcode, request_id, n, msg_len = _HDR.unpack_from(payload, 0)
+    if opcode not in (OP_VERIFY_BATCH, OP_PING):
+        raise ValueError(f"unknown opcode {opcode}")
+    if opcode == OP_PING:
+        return opcode, VerifyRequest(request_id, [], [], [])
+    rec = msg_len + 32 + 64
+    off = _HDR.size
+    if len(payload) != off + n * rec:
+        raise ValueError(
+            f"bad frame: expected {off + n * rec} bytes, got {len(payload)}")
+    msgs, pks, sigs = [], [], []
+    for _ in range(n):
+        msgs.append(payload[off:off + msg_len])
+        off += msg_len
+        pks.append(payload[off:off + 32])
+        off += 32
+        sigs.append(payload[off:off + 64])
+        off += 64
+    return opcode, VerifyRequest(request_id, msgs, pks, sigs)
+
+
+def encode_reply(opcode: int, request_id: int, mask) -> bytes:
+    body = bytes(bytearray(int(bool(b)) for b in mask))
+    payload = _REPLY_HDR.pack(opcode, request_id, len(body)) + body
+    return struct.pack(">I", len(payload)) + payload
+
+
+def decode_reply(payload: bytes):
+    opcode, request_id, n = _REPLY_HDR.unpack_from(payload, 0)
+    mask = [bool(b) for b in payload[_REPLY_HDR.size:_REPLY_HDR.size + n]]
+    return opcode, request_id, mask
+
+
+def read_frame(sock) -> bytes:
+    """Blocking read of one length-delimited frame from a socket."""
+    hdr = _read_exact(sock, 4)
+    (length,) = struct.unpack(">I", hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return _read_exact(sock, length)
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)

@@ -1,0 +1,243 @@
+"""TPU verify sidecar: a long-lived JAX process owning the accelerator.
+
+Architecture mirrors the reference's ``SignatureService`` actor
+(crypto/src/lib.rs:226-254) scaled to a process boundary: connection threads
+feed a bounded request queue; a single device thread drains it, coalesces
+pending requests into one padded device batch (so concurrent QC
+verifications from the consensus core and the vote aggregator share a single
+ladder launch), and fans replies back out.  Request/response framing in
+``protocol.py``.
+
+Run:  python -m hotstuff_tpu.sidecar --port 7100 [--mesh N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import queue
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from . import protocol as proto
+
+log = logging.getLogger("sidecar")
+
+# One coalesced device launch covers at most this many signatures; requests
+# beyond it wait for the next launch (keeps compile-shape buckets small).
+MAX_COALESCED = 4096
+
+
+class _Pending:
+    __slots__ = ("request", "reply_fn")
+
+    def __init__(self, request, reply_fn):
+        self.request = request
+        self.reply_fn = reply_fn
+
+
+class VerifyEngine:
+    """Owns the device; single consumer thread coalescing request batches."""
+
+    def __init__(self, mesh_devices: int | None = None, use_host: bool = False):
+        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=1024)
+        self._carry: _Pending | None = None  # over-budget request held over
+        self._use_host = use_host
+        self._mesh = None
+        if mesh_devices and mesh_devices > 1:
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(mesh_devices)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="verify-engine")
+        self._stopped = threading.Event()
+        self._thread.start()
+
+    def submit(self, request, reply_fn):
+        self._queue.put(_Pending(request, reply_fn))
+
+    def stop(self):
+        self._stopped.set()
+        self._queue.put(None)  # wake consumer
+
+    # -- consumer ----------------------------------------------------------
+
+    def _run(self):
+        while not self._stopped.is_set():
+            if self._carry is not None:
+                item, self._carry = self._carry, None
+            else:
+                item = self._queue.get()
+            if item is None:
+                continue
+            batch = [item]
+            total = len(item.request.msgs)
+            # coalesce whatever else is already waiting, up to the launch cap
+            while total < MAX_COALESCED:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    continue
+                if total + len(nxt.request.msgs) > MAX_COALESCED:
+                    self._carry = nxt  # runs first in the next launch
+                    break
+                batch.append(nxt)
+                total += len(nxt.request.msgs)
+            try:
+                self._execute(batch)
+            except Exception:
+                log.exception("verify batch failed")
+                for p in batch:
+                    p.reply_fn([False] * len(p.request.msgs))
+
+    def _execute(self, batch):
+        msgs, pks, sigs = [], [], []
+        for p in batch:
+            msgs += p.request.msgs
+            pks += p.request.pks
+            sigs += p.request.sigs
+        mask = self._verify(msgs, pks, sigs)
+        off = 0
+        for p in batch:
+            n = len(p.request.msgs)
+            p.reply_fn([bool(b) for b in mask[off:off + n]])
+            off += n
+
+    def _verify(self, msgs, pks, sigs) -> np.ndarray:
+        if not msgs:
+            return np.zeros((0,), bool)
+        if self._use_host:
+            from ..crypto import ref_ed25519 as ref
+
+            return np.array([ref.verify(p, m, s)
+                             for m, p, s in zip(msgs, pks, sigs)])
+        if self._mesh is not None:
+            from ..crypto.eddsa import prepare_batch
+            from ..parallel.sharded_verify import verify_batch_sharded
+
+            return verify_batch_sharded(self._mesh, prepare_batch(
+                msgs, pks, sigs))
+        from ..crypto import eddsa
+
+        return eddsa.verify_batch(msgs, pks, sigs)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    """Reader loop per connection; replies go through a dedicated writer
+    thread so a client that stops draining its socket stalls only its own
+    connection, never the shared verify-engine thread."""
+
+    def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        engine: VerifyEngine = self.server.engine  # type: ignore[attr-defined]
+        outbox: "queue.Queue[bytes | None]" = queue.Queue(maxsize=1024)
+
+        def writer():
+            while True:
+                frame = outbox.get()
+                if frame is None:
+                    return
+                try:
+                    sock.sendall(frame)
+                except OSError:
+                    return
+
+        wt = threading.Thread(target=writer, daemon=True,
+                              name="sidecar-conn-writer")
+        wt.start()
+        try:
+            while True:
+                try:
+                    payload = proto.read_frame(sock)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    opcode, req = proto.decode_request(payload)
+                except Exception:
+                    log.exception("bad frame; closing connection")
+                    return
+                if opcode == proto.OP_PING:
+                    outbox.put(proto.encode_reply(
+                        proto.OP_PING, req.request_id, []))
+                    continue
+
+                def reply(mask, _rid=req.request_id):
+                    frame = proto.encode_reply(
+                        proto.OP_VERIFY_BATCH, _rid, mask)
+                    try:
+                        outbox.put_nowait(frame)
+                    except queue.Full:
+                        pass  # connection is wedged; drop, reader will reap
+
+                engine.submit(req, reply)
+        finally:
+            outbox.put(None)
+
+
+class SidecarServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, engine: VerifyEngine):
+        super().__init__(addr, _Handler)
+        self.engine = engine
+
+
+def serve(host: str = "127.0.0.1", port: int = 7100,
+          mesh_devices: int | None = None, use_host: bool = False,
+          ready_event: threading.Event | None = None):
+    engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host)
+    server = SidecarServer((host, port), engine)  # bind first: fail fast
+    # Warm the jit cache so the first QC verify doesn't pay compilation.
+    if not use_host:
+        _warmup(engine)
+    log.info("sidecar listening on %s:%d", host, server.server_address[1])
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        engine.stop()
+        server.server_close()
+    return server
+
+
+def _warmup(engine):
+    from ..crypto import ref_ed25519 as ref
+
+    sk = bytes(range(32))
+    _, pk = ref.generate_keypair(sk)
+    msg = b"\x00" * 32
+    sig = ref.sign(sk, msg)
+    done = threading.Event()
+    req = proto.VerifyRequest(0, [msg], [pk], [sig])
+    engine.submit(req, lambda mask: done.set())
+    done.wait(timeout=300)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7100)
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard verify over an N-device mesh (0 = single)")
+    ap.add_argument("--host-crypto", action="store_true",
+                    help="pure-host verification (debug/fallback)")
+    ap.add_argument("-v", "--verbose", action="count", default=0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s.%(msecs)03dZ %(levelname)s [%(name)s] %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S")
+    serve(args.host, args.port, mesh_devices=args.mesh or None,
+          use_host=args.host_crypto)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Sidecar service tests: protocol round-trip, server end-to-end, coalescing.
+
+Analogue of the reference's SignatureService tests
+(crypto/src/tests/crypto_tests.rs:118-132) at the process boundary.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from hotstuff_tpu.crypto import ref_ed25519 as ref
+from hotstuff_tpu.sidecar import protocol as proto
+from hotstuff_tpu.sidecar.client import SidecarClient
+from hotstuff_tpu.sidecar.service import SidecarServer, VerifyEngine
+
+
+def _sigs(n, tamper=()):
+    rng = np.random.default_rng(7)
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msg = rng.bytes(32)
+        sig = ref.sign(sk, msg)
+        if i in tamper:
+            sig = sig[:1] + bytes([sig[1] ^ 0xFF]) + sig[2:]
+        msgs.append(msg)
+        pks.append(pk)
+        sigs.append(sig)
+    return msgs, pks, sigs
+
+
+def test_protocol_roundtrip():
+    msgs, pks, sigs = _sigs(3)
+    frame = proto.encode_request(42, msgs, pks, sigs)
+    opcode, req = proto.decode_request(frame[4:])
+    assert opcode == proto.OP_VERIFY_BATCH
+    assert req.request_id == 42
+    assert req.msgs == msgs and req.pks == pks and req.sigs == sigs
+
+    reply = proto.encode_reply(proto.OP_VERIFY_BATCH, 42, [True, False, True])
+    opcode, rid, mask = proto.decode_reply(reply[4:])
+    assert (opcode, rid, mask) == (proto.OP_VERIFY_BATCH, 42,
+                                   [True, False, True])
+
+
+@pytest.fixture(scope="module")
+def server():
+    engine = VerifyEngine()
+    srv = SidecarServer(("127.0.0.1", 0), engine)
+    t = threading.Thread(target=srv.serve_forever,
+                         kwargs=dict(poll_interval=0.1), daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    engine.stop()
+    srv.server_close()
+
+
+def test_sidecar_end_to_end(server):
+    port = server.server_address[1]
+    with SidecarClient(port=port) as client:
+        assert client.ping()
+        msgs, pks, sigs = _sigs(10, tamper={3, 7})
+        mask = client.verify_batch(msgs, pks, sigs)
+        assert mask == [i not in {3, 7} for i in range(10)]
+
+
+def test_sidecar_concurrent_clients(server):
+    port = server.server_address[1]
+    results = {}
+
+    def worker(idx):
+        with SidecarClient(port=port) as client:
+            tamper = {idx}
+            msgs, pks, sigs = _sigs(5, tamper=tamper)
+            results[idx] = client.verify_batch(msgs, pks, sigs)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for idx, mask in results.items():
+        assert mask == [i != idx for i in range(5)]
+
+
+def test_sidecar_empty_batch(server):
+    port = server.server_address[1]
+    with SidecarClient(port=port) as client:
+        assert client.verify_batch([], [], []) == []

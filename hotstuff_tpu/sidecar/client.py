@@ -20,6 +20,7 @@ class SidecarClient:
         self._recv_lock = threading.Lock()
         self._next_id = 0
         self._results: dict[int, list] = {}
+        self._abandoned: set[int] = set()
         self._cond = threading.Condition()
 
     def close(self):
@@ -72,16 +73,21 @@ class SidecarClient:
                         payload = proto.read_frame(self._sock)
                         _, got_rid, mask = proto.decode_reply(payload)
                         with self._cond:
-                            self._results[got_rid] = mask
-                            self._cond.notify_all()
+                            if got_rid in self._abandoned:
+                                self._abandoned.discard(got_rid)
+                            else:
+                                self._results[got_rid] = mask
+                                self._cond.notify_all()
                     finally:
                         self._recv_lock.release()
                 else:
                     with self._cond:
                         self._cond.wait(timeout=0.05)
         except BaseException:
-            # abandoned request: reap any already/later-published result so
-            # long-lived pipelined clients don't leak masks in _results
+            # Abandoned request: reap a published result, or mark the rid so
+            # the drainer drops its reply when it later arrives — either way
+            # long-lived pipelined clients don't leak masks in _results.
             with self._cond:
-                self._results.pop(rid, None)
+                if self._results.pop(rid, None) is None:
+                    self._abandoned.add(rid)
             raise

@@ -51,6 +51,7 @@ class VerifyRequest:
 
 def encode_request(request_id: int, msgs, pks, sigs) -> bytes:
     n = len(msgs)
+    assert len(pks) == n and len(sigs) == n
     msg_len = len(msgs[0]) if n else 0
     parts = [_HDR.pack(OP_VERIFY_BATCH, request_id, n, msg_len)]
     for m, p, s in zip(msgs, pks, sigs):

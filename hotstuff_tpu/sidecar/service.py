@@ -101,7 +101,12 @@ class VerifyEngine:
             msgs += p.request.msgs
             pks += p.request.pks
             sigs += p.request.sigs
-        mask = self._verify(msgs, pks, sigs)
+        # Chunk the launch so a single oversized request can't force a giant
+        # compile shape or device OOM; MAX_COALESCED stays the true cap.
+        mask = []
+        for i in range(0, len(msgs), MAX_COALESCED):
+            j = i + MAX_COALESCED
+            mask.extend(self._verify(msgs[i:j], pks[i:j], sigs[i:j]))
         off = 0
         for p in batch:
             n = len(p.request.msgs)

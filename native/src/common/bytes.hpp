@@ -1,0 +1,108 @@
+// Byte-buffer primitives shared across the node: hex/base64 codecs and a
+// hash functor so Bytes and fixed arrays key unordered containers.
+// (Capability parity: the reference's Digest/keys serialize as base64 via
+// serde, crypto/src/lib.rs:33-56.)
+#pragma once
+
+#include <array>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace hotstuff {
+
+using Bytes = std::vector<uint8_t>;
+
+inline Bytes to_bytes(const std::string& s) {
+  return Bytes(s.begin(), s.end());
+}
+
+inline std::string to_string(const Bytes& b) {
+  return std::string(b.begin(), b.end());
+}
+
+// ---------------------------------------------------------------------------
+// base64 (standard alphabet, padded) — matches the reference's serde encoding
+// ---------------------------------------------------------------------------
+
+inline std::string base64_encode(const uint8_t* data, size_t len) {
+  static const char tab[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((len + 2) / 3 * 4);
+  size_t i = 0;
+  for (; i + 3 <= len; i += 3) {
+    uint32_t v = (data[i] << 16) | (data[i + 1] << 8) | data[i + 2];
+    out.push_back(tab[(v >> 18) & 63]);
+    out.push_back(tab[(v >> 12) & 63]);
+    out.push_back(tab[(v >> 6) & 63]);
+    out.push_back(tab[v & 63]);
+  }
+  if (i + 1 == len) {
+    uint32_t v = data[i] << 16;
+    out.push_back(tab[(v >> 18) & 63]);
+    out.push_back(tab[(v >> 12) & 63]);
+    out += "==";
+  } else if (i + 2 == len) {
+    uint32_t v = (data[i] << 16) | (data[i + 1] << 8);
+    out.push_back(tab[(v >> 18) & 63]);
+    out.push_back(tab[(v >> 12) & 63]);
+    out.push_back(tab[(v >> 6) & 63]);
+    out.push_back('=');
+  }
+  return out;
+}
+
+template <size_t N>
+std::string base64_encode(const std::array<uint8_t, N>& a) {
+  return base64_encode(a.data(), N);
+}
+
+inline std::string base64_encode(const Bytes& b) {
+  return base64_encode(b.data(), b.size());
+}
+
+inline bool base64_decode(const std::string& in, Bytes* out) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  out->clear();
+  uint32_t acc = 0;
+  int bits = 0;
+  for (char c : in) {
+    if (c == '=') break;
+    int v = val(c);
+    if (v < 0) return false;
+    acc = (acc << 6) | static_cast<uint32_t>(v);
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out->push_back(static_cast<uint8_t>((acc >> bits) & 0xFF));
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// hashing for container keys
+// ---------------------------------------------------------------------------
+
+struct BytesHash {
+  size_t operator()(const Bytes& b) const {
+    // FNV-1a
+    size_t h = 1469598103934665603ull;
+    for (uint8_t c : b) {
+      h ^= c;
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+};
+
+}  // namespace hotstuff

@@ -1,0 +1,167 @@
+// Actor plumbing: bounded MPSC channel + oneshot, the C++ equivalents of the
+// tokio primitives that carry all inter-component traffic in the reference
+// (bounded mpsc of capacity 1000, consensus/src/consensus.rs:27; oneshot
+// CancelHandler, network/src/reliable_sender.rs:25).  Oneshot additionally
+// supports on_ready callbacks, which is how quorum waiting and notify_read
+// obligations compose without a thread per pending future.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <optional>
+
+namespace hotstuff {
+
+inline constexpr size_t kChannelCapacity = 1000;
+
+enum class RecvStatus { kOk, kTimeout, kClosed };
+
+template <typename T>
+class Channel {
+ public:
+  explicit Channel(size_t capacity = kChannelCapacity)
+      : capacity_(capacity) {}
+
+  // Blocks while full. Returns false if the channel is closed.
+  bool send(T value) {
+    std::unique_lock<std::mutex> lk(m_);
+    cv_send_.wait(lk, [&] { return q_.size() < capacity_ || closed_; });
+    if (closed_) return false;
+    q_.push_back(std::move(value));
+    cv_recv_.notify_one();
+    return true;
+  }
+
+  bool try_send(T value) {
+    std::lock_guard<std::mutex> lk(m_);
+    if (closed_ || q_.size() >= capacity_) return false;
+    q_.push_back(std::move(value));
+    cv_recv_.notify_one();
+    return true;
+  }
+
+  // Blocks while empty. nullopt once closed and drained.
+  std::optional<T> recv() {
+    std::unique_lock<std::mutex> lk(m_);
+    cv_recv_.wait(lk, [&] { return !q_.empty() || closed_; });
+    return pop_locked();
+  }
+
+  RecvStatus recv_until(T* out, std::chrono::steady_clock::time_point deadline) {
+    std::unique_lock<std::mutex> lk(m_);
+    if (!cv_recv_.wait_until(lk, deadline,
+                             [&] { return !q_.empty() || closed_; })) {
+      return RecvStatus::kTimeout;
+    }
+    auto v = pop_locked();
+    if (!v) return RecvStatus::kClosed;
+    *out = std::move(*v);
+    return RecvStatus::kOk;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(m_);
+    closed_ = true;
+    cv_recv_.notify_all();
+    cv_send_.notify_all();
+  }
+
+ private:
+  std::optional<T> pop_locked() {
+    if (q_.empty()) return std::nullopt;  // closed
+    T v = std::move(q_.front());
+    q_.pop_front();
+    cv_send_.notify_one();
+    return v;
+  }
+
+  std::mutex m_;
+  std::condition_variable cv_recv_, cv_send_;
+  std::deque<T> q_;
+  size_t capacity_;
+  bool closed_ = false;
+};
+
+// Clonable handle pair around a shared channel (actors hold SenderHandle
+// copies the way reference components clone tokio Senders).
+template <typename T>
+using ChannelPtr = std::shared_ptr<Channel<T>>;
+
+template <typename T>
+ChannelPtr<T> make_channel(size_t capacity = kChannelCapacity) {
+  return std::make_shared<Channel<T>>(capacity);
+}
+
+// ---------------------------------------------------------------------------
+// Oneshot: single value, many-waiter, optional callback on fulfilment.
+// ---------------------------------------------------------------------------
+
+template <typename T>
+class Oneshot {
+ public:
+  Oneshot() : s_(std::make_shared<State>()) {}
+
+  void set(T value) const {
+    std::function<void(const T&)> cb;
+    {
+      std::lock_guard<std::mutex> lk(s_->m);
+      if (s_->value) return;  // first write wins
+      s_->value = std::move(value);
+      cb = std::move(s_->cb);
+      s_->cb = nullptr;
+      s_->cv.notify_all();
+    }
+    if (cb) cb(*value_ref());
+  }
+
+  // Blocks until set. (No cancellation path: senders in this codebase always
+  // fulfil or the process is going down.)
+  const T& wait() const {
+    std::unique_lock<std::mutex> lk(s_->m);
+    s_->cv.wait(lk, [&] { return s_->value.has_value(); });
+    return *s_->value;
+  }
+
+  bool wait_for(std::chrono::milliseconds timeout) const {
+    std::unique_lock<std::mutex> lk(s_->m);
+    return s_->cv.wait_for(lk, timeout,
+                           [&] { return s_->value.has_value(); });
+  }
+
+  bool ready() const {
+    std::lock_guard<std::mutex> lk(s_->m);
+    return s_->value.has_value();
+  }
+
+  // Runs f(value) when set; immediately if already set. At most one callback.
+  // Callbacks execute on the setter's thread — keep them tiny (channel push,
+  // counter decrement).
+  void on_ready(std::function<void(const T&)> f) const {
+    {
+      std::lock_guard<std::mutex> lk(s_->m);
+      if (!s_->value) {
+        s_->cb = std::move(f);
+        return;
+      }
+    }
+    f(*s_->value);
+  }
+
+ private:
+  struct State {
+    std::mutex m;
+    std::condition_variable cv;
+    std::optional<T> value;
+    std::function<void(const T&)> cb;
+  };
+
+  const T* value_ref() const { return &*s_->value; }
+
+  std::shared_ptr<State> s_;
+};
+
+}  // namespace hotstuff

@@ -1,0 +1,295 @@
+#include "common/json.hpp"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+
+namespace hotstuff {
+
+const Json& Json::at(const std::string& key) const {
+  const Json* j = find(key);
+  if (!j) throw JsonError("missing key: " + key);
+  return *j;
+}
+
+const Json* Json::find(const std::string& key) const {
+  expect(Type::kObject);
+  for (const auto& [k, v] : obj_) {
+    if (k == key) return &v;
+  }
+  return nullptr;
+}
+
+void Json::set(const std::string& key, Json value) {
+  expect(Type::kObject);
+  for (auto& [k, v] : obj_) {
+    if (k == key) {
+      v = std::move(value);
+      return;
+    }
+  }
+  obj_.emplace_back(key, std::move(value));
+}
+
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Json parse() {
+    Json j = value();
+    skip_ws();
+    if (pos_ != s_.size()) throw JsonError("trailing characters");
+    return j;
+  }
+
+ private:
+  Json value() {
+    skip_ws();
+    if (pos_ >= s_.size()) throw JsonError("unexpected end");
+    char c = s_[pos_];
+    switch (c) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return Json(string());
+      case 't': literal("true"); return Json(true);
+      case 'f': literal("false"); return Json(false);
+      case 'n': literal("null"); return Json();
+      default: return number();
+    }
+  }
+
+  Json object() {
+    Json j = Json::object();
+    pos_++;  // {
+    skip_ws();
+    if (peek() == '}') { pos_++; return j; }
+    while (true) {
+      skip_ws();
+      std::string key = string();
+      skip_ws();
+      require(':');
+      j.set(key, value());
+      skip_ws();
+      char c = next();
+      if (c == '}') return j;
+      if (c != ',') throw JsonError("expected , or }");
+    }
+  }
+
+  Json array() {
+    Json j = Json::array();
+    pos_++;  // [
+    skip_ws();
+    if (peek() == ']') { pos_++; return j; }
+    while (true) {
+      j.push_back(value());
+      skip_ws();
+      char c = next();
+      if (c == ']') return j;
+      if (c != ',') throw JsonError("expected , or ]");
+    }
+  }
+
+  std::string string() {
+    require('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) throw JsonError("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            unsigned code = 0;
+            for (int i = 0; i < 4; i++) {
+              char h = next();
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else throw JsonError("bad \\u escape");
+            }
+            // UTF-8 encode (BMP only — config files are ASCII in practice)
+            if (code < 0x80) {
+              out += char(code);
+            } else if (code < 0x800) {
+              out += char(0xC0 | (code >> 6));
+              out += char(0x80 | (code & 0x3F));
+            } else {
+              out += char(0xE0 | (code >> 12));
+              out += char(0x80 | ((code >> 6) & 0x3F));
+              out += char(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw JsonError("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+  }
+
+  Json number() {
+    size_t start = pos_;
+    if (peek() == '-') pos_++;
+    while (pos_ < s_.size() &&
+           (std::isdigit(static_cast<unsigned char>(s_[pos_])) ||
+            s_[pos_] == '.' || s_[pos_] == 'e' || s_[pos_] == 'E' ||
+            s_[pos_] == '+' || s_[pos_] == '-')) {
+      pos_++;
+    }
+    try {
+      return Json(std::stod(s_.substr(start, pos_ - start)));
+    } catch (const std::exception&) {
+      throw JsonError("bad number");
+    }
+  }
+
+  void literal(const char* lit) {
+    for (const char* p = lit; *p; ++p) {
+      if (pos_ >= s_.size() || s_[pos_++] != *p) throw JsonError("bad literal");
+    }
+  }
+
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           std::isspace(static_cast<unsigned char>(s_[pos_]))) {
+      pos_++;
+    }
+  }
+
+  char peek() {
+    if (pos_ >= s_.size()) throw JsonError("unexpected end");
+    return s_[pos_];
+  }
+
+  char next() {
+    if (pos_ >= s_.size()) throw JsonError("unexpected end");
+    return s_[pos_++];
+  }
+
+  void require(char c) {
+    if (next() != c) throw JsonError(std::string("expected ") + c);
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+void escape_string(const std::string& in, std::string* out) {
+  out->push_back('"');
+  for (char c : in) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(c);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void format_number(double n, std::string* out) {
+  if (n == std::floor(n) && std::fabs(n) < 9.2e18) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(n));
+    *out += buf;
+  } else {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "%.17g", n);
+    *out += buf;
+  }
+}
+
+}  // namespace
+
+void Json::dump_to(std::string* out, int indent, int depth) const {
+  auto newline = [&](int d) {
+    if (indent > 0) {
+      out->push_back('\n');
+      out->append(size_t(indent) * d, ' ');
+    }
+  };
+  switch (type_) {
+    case Type::kNull: *out += "null"; break;
+    case Type::kBool: *out += bool_ ? "true" : "false"; break;
+    case Type::kNumber: format_number(num_, out); break;
+    case Type::kString: escape_string(str_, out); break;
+    case Type::kArray: {
+      out->push_back('[');
+      bool first = true;
+      for (const auto& v : arr_) {
+        if (!first) out->push_back(',');
+        first = false;
+        newline(depth + 1);
+        v.dump_to(out, indent, depth + 1);
+      }
+      if (!arr_.empty()) newline(depth);
+      out->push_back(']');
+      break;
+    }
+    case Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& [k, v] : obj_) {
+        if (!first) out->push_back(',');
+        first = false;
+        newline(depth + 1);
+        escape_string(k, out);
+        out->push_back(':');
+        if (indent > 0) out->push_back(' ');
+        v.dump_to(out, indent, depth + 1);
+      }
+      if (!obj_.empty()) newline(depth);
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+std::string Json::dump(int indent) const {
+  std::string out;
+  dump_to(&out, indent, 0);
+  return out;
+}
+
+Json Json::parse(const std::string& text) { return Parser(text).parse(); }
+
+Json Json::read_file(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw JsonError("cannot open " + path);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return parse(ss.str());
+}
+
+void Json::write_file(const std::string& path) const {
+  std::ofstream f(path);
+  if (!f) throw JsonError("cannot write " + path);
+  f << dump(2) << "\n";
+}
+
+}  // namespace hotstuff

@@ -1,0 +1,78 @@
+// Minimal JSON for config files only (committee / parameters / keys, the
+// three files the harness generates — node/src/config.rs:22-87 in the
+// reference). Objects preserve insertion order so round-trips are stable.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace hotstuff {
+
+struct JsonError : std::runtime_error {
+  explicit JsonError(const std::string& what) : std::runtime_error(what) {}
+};
+
+class Json {
+ public:
+  enum class Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Json() : type_(Type::kNull) {}
+  explicit Json(bool b) : type_(Type::kBool), bool_(b) {}
+  explicit Json(double n) : type_(Type::kNumber), num_(n) {}
+  explicit Json(int64_t n) : type_(Type::kNumber), num_(double(n)) {}
+  explicit Json(std::string s) : type_(Type::kString), str_(std::move(s)) {}
+
+  static Json array() {
+    Json j;
+    j.type_ = Type::kArray;
+    return j;
+  }
+  static Json object() {
+    Json j;
+    j.type_ = Type::kObject;
+    return j;
+  }
+
+  Type type() const { return type_; }
+  bool is_object() const { return type_ == Type::kObject; }
+
+  bool as_bool() const { expect(Type::kBool); return bool_; }
+  double as_number() const { expect(Type::kNumber); return num_; }
+  uint64_t as_u64() const { expect(Type::kNumber); return uint64_t(num_); }
+  const std::string& as_string() const { expect(Type::kString); return str_; }
+  const std::vector<Json>& items() const { expect(Type::kArray); return arr_; }
+
+  // object access
+  const Json& at(const std::string& key) const;
+  const Json* find(const std::string& key) const;
+  const std::vector<std::pair<std::string, Json>>& members() const {
+    expect(Type::kObject);
+    return obj_;
+  }
+  void set(const std::string& key, Json value);
+  void push_back(Json value) { expect(Type::kArray); arr_.push_back(std::move(value)); }
+
+  std::string dump(int indent = 0) const;
+
+  static Json parse(const std::string& text);
+  static Json read_file(const std::string& path);
+  void write_file(const std::string& path) const;
+
+ private:
+  void expect(Type t) const {
+    if (type_ != t) throw JsonError("wrong JSON type access");
+  }
+  void dump_to(std::string* out, int indent, int depth) const;
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::vector<Json> arr_;
+  std::vector<std::pair<std::string, Json>> obj_;
+};
+
+}  // namespace hotstuff

@@ -1,0 +1,49 @@
+#include "common/log.hpp"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+
+namespace hotstuff {
+
+namespace {
+std::atomic<int> g_level{static_cast<int>(LogLevel::kInfo)};
+std::mutex g_sink_mutex;
+
+const char* level_name(LogLevel l) {
+  switch (l) {
+    case LogLevel::kError: return "ERROR";
+    case LogLevel::kWarn: return "WARN";
+    case LogLevel::kInfo: return "INFO";
+    case LogLevel::kDebug: return "DEBUG";
+  }
+  return "?";
+}
+}  // namespace
+
+void log_set_level(LogLevel level) {
+  g_level.store(static_cast<int>(level));
+}
+
+LogLevel log_level() { return static_cast<LogLevel>(g_level.load()); }
+
+void log_write(LogLevel level, const std::string& module,
+               const std::string& message) {
+  using namespace std::chrono;
+  auto now = system_clock::now();
+  auto ms = duration_cast<milliseconds>(now.time_since_epoch()) % 1000;
+  std::time_t t = system_clock::to_time_t(now);
+  std::tm tm{};
+  gmtime_r(&t, &tm);
+  char ts[40];
+  std::snprintf(ts, sizeof(ts), "%04d-%02d-%02dT%02d:%02d:%02d.%03dZ",
+                tm.tm_year + 1900, tm.tm_mon + 1, tm.tm_mday, tm.tm_hour,
+                tm.tm_min, tm.tm_sec, static_cast<int>(ms.count()));
+  std::lock_guard<std::mutex> lk(g_sink_mutex);
+  std::fprintf(stderr, "[%s %s %s] %s\n", ts, level_name(level),
+               module.c_str(), message.c_str());
+  std::fflush(stderr);
+}
+
+}  // namespace hotstuff

@@ -1,0 +1,42 @@
+// Logger with the exact line grammar the benchmark harness mines
+// (SURVEY.md section 5.1): "[<RFC3339 ms>Z <LEVEL> <module>] <message>".
+// The reference gets this from env_logger under the benchmark feature
+// (node/src/main.rs:43-53); the TPS/latency parser regexes over it, so the
+// format is frozen — see hotstuff_tpu/harness/logs.py.
+#pragma once
+
+#include <sstream>
+#include <string>
+
+namespace hotstuff {
+
+enum class LogLevel { kError = 1, kWarn, kInfo, kDebug };
+
+// Global verbosity (default Info). Thread-safe writes to the sink.
+void log_set_level(LogLevel level);
+LogLevel log_level();
+
+// Sink is stderr by default (the harness redirects per-process to
+// logs/node-i.log, matching benchmark/local.py:25-28).
+void log_write(LogLevel level, const std::string& module,
+               const std::string& message);
+
+struct LogLine {
+  LogLevel level;
+  std::string module;
+  std::ostringstream os;
+
+  LogLine(LogLevel l, std::string m) : level(l), module(std::move(m)) {}
+  ~LogLine() { log_write(level, module, os.str()); }
+};
+
+}  // namespace hotstuff
+
+#define HS_LOG(lvl, module)                           \
+  if (static_cast<int>(lvl) <= static_cast<int>(::hotstuff::log_level())) \
+  ::hotstuff::LogLine(lvl, module).os
+
+#define LOG_ERROR(module) HS_LOG(::hotstuff::LogLevel::kError, module)
+#define LOG_WARN(module) HS_LOG(::hotstuff::LogLevel::kWarn, module)
+#define LOG_INFO(module) HS_LOG(::hotstuff::LogLevel::kInfo, module)
+#define LOG_DEBUG(module) HS_LOG(::hotstuff::LogLevel::kDebug, module)

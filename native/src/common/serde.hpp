@@ -1,0 +1,116 @@
+// Binary serialization for wire messages: little-endian fixed ints, u64
+// length-prefixed sequences, u32 enum tags, u8 option flags — the same data
+// model the reference gets from bincode (consensus/src/core.rs:222 etc.),
+// reimplemented as explicit Writer/Reader so the C++ node controls its own
+// wire format.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <stdexcept>
+
+#include "common/bytes.hpp"
+
+namespace hotstuff {
+
+struct SerdeError : std::runtime_error {
+  explicit SerdeError(const std::string& what) : std::runtime_error(what) {}
+};
+
+class Writer {
+ public:
+  Bytes out;
+
+  void u8(uint8_t v) { out.push_back(v); }
+
+  void u32(uint32_t v) {
+    for (int i = 0; i < 4; i++) out.push_back((v >> (8 * i)) & 0xFF);
+  }
+
+  void u64(uint64_t v) {
+    for (int i = 0; i < 8; i++) out.push_back((v >> (8 * i)) & 0xFF);
+  }
+
+  void raw(const uint8_t* data, size_t len) {
+    out.insert(out.end(), data, data + len);
+  }
+
+  template <size_t N>
+  void fixed(const std::array<uint8_t, N>& a) {
+    raw(a.data(), N);
+  }
+
+  void bytes(const Bytes& b) {
+    u64(b.size());
+    raw(b.data(), b.size());
+  }
+
+  void tag(uint32_t variant) { u32(variant); }
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : data_(data), len_(len) {}
+  explicit Reader(const Bytes& b) : Reader(b.data(), b.size()) {}
+
+  uint8_t u8() {
+    need(1);
+    return data_[pos_++];
+  }
+
+  uint32_t u32() {
+    need(4);
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++) v |= uint32_t(data_[pos_++]) << (8 * i);
+    return v;
+  }
+
+  uint64_t u64() {
+    need(8);
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v |= uint64_t(data_[pos_++]) << (8 * i);
+    return v;
+  }
+
+  template <size_t N>
+  void fixed(std::array<uint8_t, N>* a) {
+    need(N);
+    std::memcpy(a->data(), data_ + pos_, N);
+    pos_ += N;
+  }
+
+  Bytes bytes() {
+    uint64_t n = u64();
+    need(n);
+    Bytes b(data_ + pos_, data_ + pos_ + n);
+    pos_ += n;
+    return b;
+  }
+
+  // Sequence length guarded by the minimum wire size of one element, so a
+  // hostile length prefix can't amplify into a huge reserve/allocation.
+  uint64_t seq_len(size_t min_element_bytes = 1) {
+    uint64_t n = u64();
+    if (min_element_bytes == 0) min_element_bytes = 1;
+    if (n > remaining() / min_element_bytes) {
+      throw SerdeError("sequence length exceeds buffer");
+    }
+    return n;
+  }
+
+  uint32_t tag() { return u32(); }
+
+  bool done() const { return pos_ == len_; }
+  size_t remaining() const { return len_ - pos_; }
+
+ private:
+  void need(size_t n) {
+    if (len_ - pos_ < n) throw SerdeError("unexpected end of buffer");
+  }
+
+  const uint8_t* data_;
+  size_t len_;
+  size_t pos_ = 0;
+};
+
+}  // namespace hotstuff

@@ -1,0 +1,54 @@
+#include "consensus/aggregator.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+Aggregator::AddResult Aggregator::add_vote(const Vote& vote) {
+  QCMaker& maker = votes_aggregators_[vote.round][vote.digest()];
+  AddResult result;
+  if (!maker.used.insert(vote.author).second) {
+    result.error = "authority reuse: " + vote.author.to_base64();
+    return result;
+  }
+  maker.votes.emplace_back(vote.author, vote.signature);
+  maker.weight += committee_.stake(vote.author);
+  if (maker.weight >= committee_.quorum_threshold()) {
+    maker.weight = 0;  // ensures the QC is only made once
+    QC qc;
+    qc.hash = vote.hash;
+    qc.round = vote.round;
+    qc.votes = maker.votes;
+    result.qc = std::move(qc);
+  }
+  return result;
+}
+
+Aggregator::AddTimeoutResult Aggregator::add_timeout(const Timeout& timeout) {
+  TCMaker& maker = timeouts_aggregators_[timeout.round];
+  AddTimeoutResult result;
+  if (!maker.used.insert(timeout.author).second) {
+    result.error = "authority reuse: " + timeout.author.to_base64();
+    return result;
+  }
+  maker.votes.emplace_back(timeout.author, timeout.signature,
+                           timeout.high_qc.round);
+  maker.weight += committee_.stake(timeout.author);
+  if (maker.weight >= committee_.quorum_threshold()) {
+    maker.weight = 0;  // ensures the TC is only made once
+    TC tc;
+    tc.round = timeout.round;
+    tc.votes = maker.votes;
+    result.tc = std::move(tc);
+  }
+  return result;
+}
+
+void Aggregator::cleanup(Round round) {
+  votes_aggregators_.erase(votes_aggregators_.begin(),
+                           votes_aggregators_.lower_bound(round));
+  timeouts_aggregators_.erase(timeouts_aggregators_.begin(),
+                              timeouts_aggregators_.lower_bound(round));
+}
+
+}  // namespace consensus
+}  // namespace hotstuff

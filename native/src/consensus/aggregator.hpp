@@ -1,0 +1,55 @@
+// Vote/timeout aggregation into QCs/TCs at 2f+1 stake, with authority-reuse
+// rejection and per-round garbage collection
+// (consensus/src/aggregator.rs:13-139 in the reference).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <set>
+
+#include "consensus/messages.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+class Aggregator {
+ public:
+  explicit Aggregator(Committee committee)
+      : committee_(std::move(committee)) {}
+
+  // Returns a QC when this vote completes a quorum; error when the
+  // authority already voted for this (round, digest).
+  struct AddResult {
+    std::string error;  // authority reuse
+    std::optional<QC> qc;
+  };
+  AddResult add_vote(const Vote& vote);
+
+  struct AddTimeoutResult {
+    std::string error;
+    std::optional<TC> tc;
+  };
+  AddTimeoutResult add_timeout(const Timeout& timeout);
+
+  // Drop aggregation state for rounds < round.
+  void cleanup(Round round);
+
+ private:
+  struct QCMaker {
+    Stake weight = 0;
+    std::vector<std::pair<PublicKey, Signature>> votes;
+    std::set<PublicKey> used;
+  };
+  struct TCMaker {
+    Stake weight = 0;
+    std::vector<std::tuple<PublicKey, Signature, Round>> votes;
+    std::set<PublicKey> used;
+  };
+
+  Committee committee_;
+  std::map<Round, std::map<Digest, QCMaker>> votes_aggregators_;
+  std::map<Round, TCMaker> timeouts_aggregators_;
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

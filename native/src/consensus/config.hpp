@@ -1,0 +1,99 @@
+// Consensus configuration: protocol tunables + committee with stake/address
+// book (consensus/src/config.rs:10-85 in the reference).
+#pragma once
+
+#include <map>
+#include <optional>
+#include <vector>
+
+#include "common/json.hpp"
+#include "common/log.hpp"
+#include "crypto/crypto.hpp"
+#include "network/socket.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+using Stake = uint32_t;
+using Round = uint64_t;
+
+struct Parameters {
+  uint64_t timeout_delay = 5'000;      // ms
+  uint64_t sync_retry_delay = 10'000;  // ms
+
+  static Parameters from_json(const Json& j) {
+    Parameters p;
+    if (auto* v = j.find("timeout_delay")) p.timeout_delay = v->as_u64();
+    if (auto* v = j.find("sync_retry_delay")) p.sync_retry_delay = v->as_u64();
+    return p;
+  }
+
+  void log() const {
+    // NOTE: These log entries are used to compute performance
+    // (hotstuff_tpu/harness/logs.py config regexes).
+    LOG_INFO("consensus::config")
+        << "Timeout delay set to " << timeout_delay << " ms";
+    LOG_INFO("consensus::config")
+        << "Sync retry delay set to " << sync_retry_delay << " ms";
+  }
+};
+
+struct Authority {
+  Stake stake = 1;
+  Address address;
+};
+
+class Committee {
+ public:
+  Committee() = default;
+  Committee(std::map<PublicKey, Authority> authorities, uint64_t epoch)
+      : authorities_(std::move(authorities)), epoch_(epoch) {}
+
+  static Committee from_json(const Json& j);
+  Json to_json() const;
+
+  size_t size() const { return authorities_.size(); }
+
+  Stake stake(const PublicKey& name) const {
+    auto it = authorities_.find(name);
+    return it == authorities_.end() ? 0 : it->second.stake;
+  }
+
+  Stake total_stake() const {
+    Stake total = 0;
+    for (const auto& [_, a] : authorities_) total += a.stake;
+    return total;
+  }
+
+  Stake quorum_threshold() const { return 2 * total_stake() / 3 + 1; }
+
+  std::optional<Address> address(const PublicKey& name) const {
+    auto it = authorities_.find(name);
+    if (it == authorities_.end()) return std::nullopt;
+    return it->second.address;
+  }
+
+  std::vector<std::pair<PublicKey, Address>> broadcast_addresses(
+      const PublicKey& myself) const {
+    std::vector<std::pair<PublicKey, Address>> out;
+    for (const auto& [name, a] : authorities_) {
+      if (name != myself) out.emplace_back(name, a.address);
+    }
+    return out;
+  }
+
+  // Sorted keys (std::map iteration order) — the leader-election domain.
+  std::vector<PublicKey> sorted_keys() const {
+    std::vector<PublicKey> keys;
+    keys.reserve(authorities_.size());
+    for (const auto& [name, _] : authorities_) keys.push_back(name);
+    return keys;
+  }
+
+ private:
+  std::map<PublicKey, Authority> authorities_;
+  uint64_t epoch_ = 1;
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

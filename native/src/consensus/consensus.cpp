@@ -1,0 +1,102 @@
+#include "consensus/consensus.hpp"
+
+#include "common/log.hpp"
+#include "consensus/helper.hpp"
+#include "consensus/mempool_driver.hpp"
+#include "consensus/synchronizer.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+std::unique_ptr<Consensus> Consensus::spawn(
+    PublicKey name, Committee committee, Parameters parameters,
+    SignatureService signature_service, Store store,
+    ChannelPtr<Digest> rx_mempool,
+    ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
+    ChannelPtr<Block> tx_commit) {
+  parameters.log();
+
+  auto c = std::unique_ptr<Consensus>(new Consensus());
+
+  auto tx_core = make_channel<CoreEvent>();
+  auto tx_proposer = make_channel<ProposerEvent>();
+  auto tx_helper = make_channel<std::pair<Digest, PublicKey>>();
+
+  // Proposer command channel: Core sends ProposerMessage, adapted into the
+  // proposer's unified event stream.
+  auto tx_proposer_cmd = make_channel<ProposerMessage>();
+  std::thread([tx_proposer_cmd, tx_proposer] {
+    while (auto cmd = tx_proposer_cmd->recv()) {
+      ProposerEvent e;
+      e.kind = ProposerEvent::Kind::kCommand;
+      e.command = std::move(*cmd);
+      tx_proposer->send(std::move(e));
+    }
+  }).detach();
+
+  // Mempool digests pump into the proposer buffer.
+  c->digest_pump_ = std::make_shared<std::thread>(
+      [rx_mempool, tx_proposer] {
+        while (auto digest = rx_mempool->recv()) {
+          ProposerEvent e;
+          e.kind = ProposerEvent::Kind::kDigest;
+          e.digest = *digest;
+          tx_proposer->send(std::move(e));
+        }
+      });
+  c->digest_pump_->detach();
+
+  // Network ingress: ACK only proposals, route sync requests to the helper
+  // (consensus.rs:126-162).
+  auto address = committee.address(name);
+  if (!address) throw std::runtime_error("our key is not in the committee");
+  if (!c->receiver_.spawn(
+          *address,
+          [tx_core, tx_helper](ConnectionWriter& writer, Bytes msg) {
+            try {
+              ConsensusMessage m = ConsensusMessage::deserialize(msg);
+              if (m.kind == ConsensusMessage::Kind::kSyncRequest) {
+                tx_helper->send({m.sync_digest, m.sync_from});
+              } else {
+                if (m.kind == ConsensusMessage::Kind::kPropose) {
+                  writer.send(std::string("Ack"));
+                }
+                tx_core->send(CoreEvent::msg(std::move(m)));
+              }
+            } catch (const std::exception& e) {
+              // Anything thrown while parsing attacker-controlled bytes
+              // (SerdeError, bad_alloc from a hostile length, ...) must not
+              // escape this connection thread.
+              LOG_WARN("consensus::consensus")
+                  << "Serialization failure: " << e.what();
+            }
+            return true;
+          },
+          "consensus::receiver")) {
+    throw std::runtime_error("failed to bind " + address->str());
+  }
+  LOG_INFO("consensus::consensus")
+      << "Node " << name.to_base64() << " listening to consensus messages on "
+      << address->str();
+
+  auto leader_elector = std::make_shared<LeaderElector>(committee);
+  auto mempool_driver =
+      std::make_shared<MempoolDriver>(store, tx_mempool, tx_core);
+  auto synchronizer = std::make_shared<Synchronizer>(
+      name, committee, store, tx_core, parameters.sync_retry_delay);
+
+  Core::spawn(name, committee, signature_service, store, leader_elector,
+              mempool_driver, synchronizer, parameters.timeout_delay, tx_core,
+              tx_proposer_cmd, tx_commit);
+
+  Proposer::spawn(name, committee, signature_service, tx_proposer, tx_core);
+
+  Helper::spawn(committee, store, tx_helper);
+
+  return c;
+}
+
+Consensus::~Consensus() = default;
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,40 @@
+// Consensus facade: wires the channels and spawns Receiver / Core /
+// Proposer / Helper plus the synchronizer and mempool driver
+// (consensus/src/consensus.rs:41-162 in the reference).
+#pragma once
+
+#include <memory>
+
+#include "common/channel.hpp"
+#include "consensus/core.hpp"
+#include "consensus/proposer.hpp"
+#include "mempool/messages.hpp"
+#include "network/receiver.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+class Consensus {
+ public:
+  // rx_mempool: batch digests from the mempool processors;
+  // tx_mempool: Synchronize/Cleanup commands to the mempool;
+  // tx_commit: committed blocks out to the application layer.
+  static std::unique_ptr<Consensus> spawn(
+      PublicKey name, Committee committee, Parameters parameters,
+      SignatureService signature_service, Store store,
+      ChannelPtr<Digest> rx_mempool,
+      ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
+      ChannelPtr<Block> tx_commit);
+
+  ~Consensus();
+
+ private:
+  Consensus() = default;
+
+  NetworkReceiver receiver_;
+  std::shared_ptr<std::thread> digest_pump_;
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,363 @@
+#include "consensus/core.hpp"
+
+#include <algorithm>
+#include <deque>
+#include <thread>
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+namespace {
+
+// The replica state machine (one instance on one thread).
+class CoreImpl {
+ public:
+  CoreImpl(PublicKey name, Committee committee,
+           SignatureService signature_service, Store store,
+           std::shared_ptr<LeaderElector> leader_elector,
+           std::shared_ptr<MempoolDriver> mempool_driver,
+           std::shared_ptr<Synchronizer> synchronizer, uint64_t timeout_delay,
+           ChannelPtr<CoreEvent> rx_event,
+           ChannelPtr<ProposerMessage> tx_proposer,
+           ChannelPtr<Block> tx_commit)
+      : name_(name),
+        committee_(std::move(committee)),
+        signature_service_(std::move(signature_service)),
+        store_(std::move(store)),
+        leader_elector_(std::move(leader_elector)),
+        mempool_driver_(std::move(mempool_driver)),
+        synchronizer_(std::move(synchronizer)),
+        timeout_delay_(timeout_delay),
+        rx_event_(std::move(rx_event)),
+        tx_proposer_(std::move(tx_proposer)),
+        tx_commit_(std::move(tx_commit)),
+        aggregator_(committee_) {}
+
+  void run() {
+    // Bootstrap: timer armed; leader of round 1 proposes immediately
+    // (core.rs:438-444).
+    reset_timer();
+    if (name_ == leader_elector_->get_leader(round_)) {
+      generate_proposal(std::nullopt);
+    }
+    while (true) {
+      CoreEvent event;
+      auto status = rx_event_->recv_until(&event, timer_deadline_);
+      if (status == RecvStatus::kClosed) return;
+      if (status == RecvStatus::kTimeout) {
+        local_timeout_round();
+        continue;
+      }
+      VerifyResult result = VerifyResult::good();
+      if (event.kind == CoreEvent::Kind::kLoopback) {
+        result = process_block(event.block);
+      } else {
+        switch (event.message.kind) {
+          case ConsensusMessage::Kind::kPropose:
+            result = handle_proposal(event.message.block);
+            break;
+          case ConsensusMessage::Kind::kVote:
+            result = handle_vote(event.message.vote);
+            break;
+          case ConsensusMessage::Kind::kTimeout:
+            result = handle_timeout(event.message.timeout);
+            break;
+          case ConsensusMessage::Kind::kTC:
+            result = handle_tc(event.message.tc);
+            break;
+          default:
+            LOG_WARN("consensus::core") << "unexpected protocol message";
+        }
+      }
+      if (!result.ok()) {
+        LOG_WARN("consensus::core") << result.error;
+      }
+    }
+  }
+
+ private:
+  // -- timer ---------------------------------------------------------------
+
+  void reset_timer() {
+    timer_deadline_ = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(timeout_delay_);
+  }
+
+  // -- persistence ---------------------------------------------------------
+
+  void store_block(const Block& block) {
+    store_.write(block.digest().to_bytes(), block.to_bytes());
+  }
+
+  // -- voting safety (core.rs:99-146) --------------------------------------
+
+  void increase_last_voted_round(Round target) {
+    last_voted_round_ = std::max(last_voted_round_, target);
+  }
+
+  std::optional<Vote> make_vote(const Block& block) {
+    bool safety_rule_1 = block.round > last_voted_round_;
+    bool safety_rule_2 = block.qc.round + 1 == block.round;
+    if (block.tc) {
+      bool can_extend = block.tc->round + 1 == block.round;
+      auto rounds = block.tc->high_qc_rounds();
+      can_extend &= block.qc.round >=
+                    *std::max_element(rounds.begin(), rounds.end());
+      safety_rule_2 |= can_extend;
+    }
+    if (!(safety_rule_1 && safety_rule_2)) return std::nullopt;
+    increase_last_voted_round(block.round);
+    return Vote::make(block, name_, signature_service_);
+  }
+
+  // -- commit (core.rs:148-187) --------------------------------------------
+
+  VerifyResult commit(const Block& block) {
+    if (last_committed_round_ >= block.round) return VerifyResult::good();
+
+    // Commit the full chain up to this block (needed after view changes).
+    std::deque<Block> to_commit;
+    Block parent = block;
+    while (last_committed_round_ + 1 < parent.round) {
+      auto ancestor = synchronizer_->get_parent_block(parent);
+      if (!ancestor) {
+        return VerifyResult::bad("missing ancestor during commit");
+      }
+      to_commit.push_front(*ancestor);
+      parent = std::move(*ancestor);
+    }
+    to_commit.push_back(block);
+    // Oldest first; `block` last (matches the reference's pop_back order
+    // after push_front of ancestors, core.rs:155-166).
+    std::sort(to_commit.begin(), to_commit.end(),
+              [](const Block& a, const Block& b) { return a.round < b.round; });
+
+    last_committed_round_ = block.round;
+
+    for (const Block& b : to_commit) {
+      if (!b.payload.empty()) {
+        LOG_INFO("consensus::core") << "Committed B" << b.round;
+        // NOTE: These log entries are used to compute performance
+        // (hotstuff_tpu/harness/logs.py commit regex).
+        for (const Digest& x : b.payload) {
+          LOG_INFO("consensus::core")
+              << "Committed B" << b.round << " -> " << x.to_base64();
+        }
+      }
+      tx_commit_->send(b);
+    }
+    return VerifyResult::good();
+  }
+
+  // -- round advancement ---------------------------------------------------
+
+  void update_high_qc(const QC& qc) {
+    if (qc.round > high_qc_.round) high_qc_ = qc;
+  }
+
+  void advance_round(Round round) {
+    if (round < round_) return;
+    reset_timer();
+    round_ = round + 1;
+    LOG_DEBUG("consensus::core") << "Moved to round " << round_;
+    aggregator_.cleanup(round_);
+  }
+
+  void process_qc(const QC& qc) {
+    advance_round(qc.round);
+    update_high_qc(qc);
+  }
+
+  void generate_proposal(std::optional<TC> tc) {
+    ProposerMessage msg;
+    msg.kind = ProposerMessage::Kind::kMake;
+    msg.round = round_;
+    msg.qc = high_qc_;
+    msg.tc = std::move(tc);
+    tx_proposer_->send(std::move(msg));
+  }
+
+  void cleanup_proposer(const Block& b0, const Block& b1, const Block& block) {
+    ProposerMessage msg;
+    msg.kind = ProposerMessage::Kind::kCleanup;
+    for (const auto* b : {&b0, &b1, &block}) {
+      msg.digests.insert(msg.digests.end(), b->payload.begin(),
+                         b->payload.end());
+    }
+    tx_proposer_->send(std::move(msg));
+  }
+
+  // -- timeouts / view change (core.rs:195-296) ----------------------------
+
+  void local_timeout_round() {
+    LOG_WARN("consensus::core") << "Timeout reached for round " << round_;
+    increase_last_voted_round(round_);
+    Timeout timeout =
+        Timeout::make(high_qc_, round_, name_, signature_service_);
+    reset_timer();
+    std::vector<Address> addresses;
+    for (const auto& [_, addr] : committee_.broadcast_addresses(name_)) {
+      addresses.push_back(addr);
+    }
+    network_.broadcast(addresses, ConsensusMessage::timeout_msg(timeout));
+    VerifyResult r = handle_timeout(timeout);
+    if (!r.ok()) LOG_WARN("consensus::core") << r.error;
+  }
+
+  VerifyResult handle_timeout(const Timeout& timeout) {
+    if (timeout.round < round_) return VerifyResult::good();
+    VerifyResult valid = timeout.verify(committee_);
+    if (!valid.ok()) return valid;
+
+    process_qc(timeout.high_qc);
+
+    auto added = aggregator_.add_timeout(timeout);
+    if (!added.error.empty()) return VerifyResult::bad(added.error);
+    if (added.tc) {
+      advance_round(added.tc->round);
+      std::vector<Address> addresses;
+      for (const auto& [_, addr] : committee_.broadcast_addresses(name_)) {
+        addresses.push_back(addr);
+      }
+      network_.broadcast(addresses, ConsensusMessage::tc_msg(*added.tc));
+      if (name_ == leader_elector_->get_leader(round_)) {
+        generate_proposal(std::move(added.tc));
+      }
+    }
+    return VerifyResult::good();
+  }
+
+  VerifyResult handle_tc(const TC& tc) {
+    advance_round(tc.round);
+    if (name_ == leader_elector_->get_leader(round_)) {
+      generate_proposal(tc);
+    }
+    return VerifyResult::good();
+  }
+
+  // -- votes → QC (core.rs:232-255) ----------------------------------------
+
+  VerifyResult handle_vote(const Vote& vote) {
+    if (vote.round < round_) return VerifyResult::good();
+    VerifyResult valid = vote.verify(committee_);
+    if (!valid.ok()) return valid;
+
+    auto added = aggregator_.add_vote(vote);
+    if (!added.error.empty()) return VerifyResult::bad(added.error);
+    if (added.qc) {
+      process_qc(*added.qc);
+      if (name_ == leader_elector_->get_leader(round_)) {
+        generate_proposal(std::nullopt);
+      }
+    }
+    return VerifyResult::good();
+  }
+
+  // -- block processing (core.rs:339-428) ----------------------------------
+
+  VerifyResult process_block(const Block& block) {
+    // Require the two ancestors: b0 <- |qc0; b1| <- |qc1; block|.
+    auto ancestors = synchronizer_->get_ancestors(block);
+    if (!ancestors) {
+      LOG_DEBUG("consensus::core")
+          << "Processing of " << block.digest().to_base64()
+          << " suspended: missing parent";
+      return VerifyResult::good();
+    }
+    auto& [b0, b1] = *ancestors;
+
+    store_block(block);
+    cleanup_proposer(b0, b1, block);
+
+    // 2-chain commit rule (core.rs:363-366).
+    if (b0.round + 1 == b1.round) {
+      mempool_driver_->cleanup(b0.round);
+      VerifyResult r = commit(b0);
+      if (!r.ok()) return r;
+    }
+
+    // Bad leaders could send blocks from the far future.
+    if (block.round != round_) return VerifyResult::good();
+
+    if (auto vote = make_vote(block)) {
+      PublicKey next_leader = leader_elector_->get_leader(round_ + 1);
+      if (next_leader == name_) {
+        return handle_vote(*vote);
+      }
+      auto address = committee_.address(next_leader);
+      if (address) {
+        network_.send(*address, ConsensusMessage::vote_msg(*vote));
+      }
+    }
+    return VerifyResult::good();
+  }
+
+  VerifyResult handle_proposal(const Block& block) {
+    // Leader check (core.rs:399-406).
+    if (block.author != leader_elector_->get_leader(block.round)) {
+      return VerifyResult::bad("wrong leader for round " +
+                               std::to_string(block.round));
+    }
+    VerifyResult valid = block.verify(committee_);
+    if (!valid.ok()) return valid;
+
+    process_qc(block.qc);
+    if (block.tc) advance_round(block.tc->round);
+
+    // Payload availability; suspends the block if batches are missing.
+    if (!mempool_driver_->verify(block)) {
+      LOG_DEBUG("consensus::core")
+          << "Processing of " << block.digest().to_base64()
+          << " suspended: missing payload";
+      return VerifyResult::good();
+    }
+    return process_block(block);
+  }
+
+  // -- state ---------------------------------------------------------------
+
+  PublicKey name_;
+  Committee committee_;
+  SignatureService signature_service_;
+  Store store_;
+  std::shared_ptr<LeaderElector> leader_elector_;
+  std::shared_ptr<MempoolDriver> mempool_driver_;
+  std::shared_ptr<Synchronizer> synchronizer_;
+  uint64_t timeout_delay_;
+  ChannelPtr<CoreEvent> rx_event_;
+  ChannelPtr<ProposerMessage> tx_proposer_;
+  ChannelPtr<Block> tx_commit_;
+
+  Round round_ = 1;
+  Round last_voted_round_ = 0;
+  Round last_committed_round_ = 0;
+  QC high_qc_;
+  Aggregator aggregator_;
+  SimpleSender network_;
+  std::chrono::steady_clock::time_point timer_deadline_;
+};
+
+}  // namespace
+
+void Core::spawn(PublicKey name, Committee committee,
+                 SignatureService signature_service, Store store,
+                 std::shared_ptr<LeaderElector> leader_elector,
+                 std::shared_ptr<MempoolDriver> mempool_driver,
+                 std::shared_ptr<Synchronizer> synchronizer,
+                 uint64_t timeout_delay, ChannelPtr<CoreEvent> rx_event,
+                 ChannelPtr<ProposerMessage> tx_proposer,
+                 ChannelPtr<Block> tx_commit) {
+  std::thread([=] {
+    CoreImpl core(name, std::move(committee), std::move(signature_service),
+                  std::move(store), std::move(leader_elector),
+                  std::move(mempool_driver), std::move(synchronizer),
+                  timeout_delay, std::move(rx_event), std::move(tx_proposer),
+                  std::move(tx_commit));
+    core.run();
+  }).detach();
+}
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,64 @@
+// Consensus core: the 2-chain HotStuff replica state machine — proposal
+// handling, voting safety rules, QC/TC aggregation, the 2-chain commit rule,
+// and timeout/view-change (consensus/src/core.rs:26-468 in the reference).
+#pragma once
+
+#include <memory>
+
+#include "common/channel.hpp"
+#include "consensus/aggregator.hpp"
+#include "consensus/leader.hpp"
+#include "consensus/mempool_driver.hpp"
+#include "consensus/messages.hpp"
+#include "consensus/synchronizer.hpp"
+#include "network/simple_sender.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+// Unified input event for the core's select loop (rx_message + rx_loopback
+// of the reference, core.rs:438-467).
+struct CoreEvent {
+  enum class Kind { kMessage, kLoopback };
+  Kind kind = Kind::kMessage;
+  ConsensusMessage message;  // kMessage
+  Block block;               // kLoopback
+
+  static CoreEvent loopback(Block b) {
+    CoreEvent e;
+    e.kind = Kind::kLoopback;
+    e.block = std::move(b);
+    return e;
+  }
+  static CoreEvent msg(ConsensusMessage m) {
+    CoreEvent e;
+    e.kind = Kind::kMessage;
+    e.message = std::move(m);
+    return e;
+  }
+};
+
+struct ProposerMessage {
+  enum class Kind { kMake, kCleanup };
+  Kind kind = Kind::kMake;
+  Round round = 0;                // kMake
+  QC qc;                          // kMake
+  std::optional<TC> tc;           // kMake
+  std::vector<Digest> digests;    // kCleanup
+};
+
+class Core {
+ public:
+  static void spawn(PublicKey name, Committee committee,
+                    SignatureService signature_service, Store store,
+                    std::shared_ptr<LeaderElector> leader_elector,
+                    std::shared_ptr<MempoolDriver> mempool_driver,
+                    std::shared_ptr<Synchronizer> synchronizer,
+                    uint64_t timeout_delay, ChannelPtr<CoreEvent> rx_event,
+                    ChannelPtr<ProposerMessage> tx_proposer,
+                    ChannelPtr<Block> tx_commit);
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

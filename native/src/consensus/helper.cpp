@@ -1,0 +1,35 @@
+#include "consensus/helper.hpp"
+
+#include <thread>
+
+#include "common/log.hpp"
+#include "network/simple_sender.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+void Helper::spawn(Committee committee, Store store,
+                   ChannelPtr<std::pair<Digest, PublicKey>> rx_request) {
+  std::thread([committee = std::move(committee), store,
+               rx_request]() mutable {
+    SimpleSender network;
+    while (auto req = rx_request->recv()) {
+      const auto& [digest, origin] = *req;
+      auto address = committee.address(origin);
+      if (!address) {
+        LOG_WARN("consensus::helper")
+            << "Received sync request from unknown authority: "
+            << origin.to_base64();
+        continue;
+      }
+      auto bytes = store.read(digest.to_bytes());
+      if (bytes) {
+        Block block = Block::from_bytes(*bytes);
+        network.send(*address, ConsensusMessage::propose(block));
+      }
+    }
+  }).detach();
+}
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,20 @@
+// Consensus helper: serves SyncRequest messages by reading the block from
+// storage and replying with a Propose message
+// (consensus/src/helper.rs:15-68 in the reference).
+#pragma once
+
+#include "common/channel.hpp"
+#include "consensus/messages.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+class Helper {
+ public:
+  static void spawn(Committee committee, Store store,
+                    ChannelPtr<std::pair<Digest, PublicKey>> rx_request);
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

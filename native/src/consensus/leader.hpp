@@ -1,0 +1,24 @@
+// Round-robin leader election over the sorted public keys
+// (consensus/src/leader.rs:7-21 in the reference).
+#pragma once
+
+#include "consensus/config.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+class LeaderElector {
+ public:
+  explicit LeaderElector(const Committee& committee)
+      : keys_(committee.sorted_keys()) {}
+
+  PublicKey get_leader(Round round) const {
+    return keys_[round % keys_.size()];
+  }
+
+ private:
+  std::vector<PublicKey> keys_;
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

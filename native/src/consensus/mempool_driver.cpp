@@ -1,0 +1,117 @@
+#include "consensus/mempool_driver.hpp"
+
+#include <atomic>
+#include <map>
+#include <thread>
+
+#include "common/log.hpp"
+#include "consensus/core.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+MempoolDriver::MempoolDriver(
+    Store store, ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
+    ChannelPtr<CoreEvent> tx_loopback)
+    : store_(store),
+      tx_mempool_(tx_mempool),
+      // Unbounded: kComplete loopbacks come from store-thread callbacks and
+      // must neither block nor be dropped (a lost completion wedges the
+      // block; the pending map dedups future kWaits).
+      tx_payload_waiter_(make_channel<WaiterMessage>(SIZE_MAX)) {
+  auto rx = tx_payload_waiter_;
+  std::thread([store, rx, tx_loopback]() mutable {
+    struct Pending {
+      Round round;
+      Block block;
+      std::shared_ptr<std::atomic<int>> remaining;
+    };
+    std::map<Digest, Pending> pending;
+
+    while (true) {
+      auto msg = rx->recv();
+      if (!msg) return;
+      switch (msg->kind) {
+        case WaiterMessage::Kind::kWait: {
+          Digest block_digest = msg->block.digest();
+          if (pending.count(block_digest)) break;
+          Pending p;
+          p.round = msg->block.round;
+          p.remaining =
+              std::make_shared<std::atomic<int>>(int(msg->missing.size()));
+          p.block = std::move(msg->block);
+          auto remaining = p.remaining;
+          pending.emplace(block_digest, std::move(p));
+          for (const auto& digest : msg->missing) {
+            // notify_read callbacks run on the store thread; the last one
+            // loops a kComplete command back into this channel
+            // (consensus/src/mempool.rs:110-125 try_join_all analogue).
+            store.notify_read(digest.to_bytes())
+                .on_ready([rx, remaining, block_digest](const Bytes&) {
+                  if (remaining->fetch_sub(1) == 1) {
+                    WaiterMessage done;
+                    done.kind = WaiterMessage::Kind::kComplete;
+                    done.completed = block_digest;
+                    rx->send(std::move(done));  // unbounded: never blocks
+                  }
+                });
+          }
+          break;
+        }
+        case WaiterMessage::Kind::kComplete: {
+          auto it = pending.find(msg->completed);
+          if (it == pending.end()) break;  // cancelled by cleanup
+          tx_loopback->send(CoreEvent::loopback(std::move(it->second.block)));
+          pending.erase(it);
+          break;
+        }
+        case WaiterMessage::Kind::kCleanup: {
+          for (auto it = pending.begin(); it != pending.end();) {
+            if (it->second.round <= msg->round) {
+              it = pending.erase(it);
+            } else {
+              ++it;
+            }
+          }
+          break;
+        }
+      }
+    }
+  }).detach();
+}
+
+bool MempoolDriver::verify(const Block& block) {
+  std::vector<Digest> missing;
+  for (const auto& digest : block.payload) {
+    if (!store_.read(digest.to_bytes())) missing.push_back(digest);
+  }
+  if (missing.empty()) return true;
+
+  mempool::ConsensusMempoolMessage sync;
+  sync.kind = mempool::ConsensusMempoolMessage::Kind::kSynchronize;
+  sync.digests = missing;
+  sync.target = block.author;
+  tx_mempool_->send(std::move(sync));
+
+  WaiterMessage wait;
+  wait.kind = WaiterMessage::Kind::kWait;
+  wait.missing = std::move(missing);
+  wait.block = block;
+  tx_payload_waiter_->send(std::move(wait));
+  return false;
+}
+
+void MempoolDriver::cleanup(Round round) {
+  mempool::ConsensusMempoolMessage msg;
+  msg.kind = mempool::ConsensusMempoolMessage::Kind::kCleanup;
+  msg.round = round;
+  tx_mempool_->send(std::move(msg));
+
+  WaiterMessage wait;
+  wait.kind = WaiterMessage::Kind::kCleanup;
+  wait.round = round;
+  tx_payload_waiter_->send(std::move(wait));
+}
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,46 @@
+// MempoolDriver + PayloadWaiter: checks a block's payload batches are in
+// storage; missing payloads trigger a mempool Synchronize command and
+// suspend the block on notify_read of every missing digest, looping it back
+// to the core once complete (consensus/src/mempool.rs:15-170 in the
+// reference).
+#pragma once
+
+#include <memory>
+
+#include "common/channel.hpp"
+#include "consensus/messages.hpp"
+#include "mempool/messages.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+struct CoreEvent;
+
+class MempoolDriver {
+ public:
+  MempoolDriver(Store store,
+                ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
+                ChannelPtr<CoreEvent> tx_loopback);
+
+  // Called from the core thread: true when all payload batches are local.
+  bool verify(const Block& block);
+
+  void cleanup(Round round);
+
+ private:
+  struct WaiterMessage {
+    enum class Kind { kWait, kCleanup, kComplete } kind;
+    std::vector<Digest> missing;  // kWait
+    Block block;                  // kWait
+    Round round = 0;              // kCleanup
+    Digest completed;             // kComplete (internal: payload arrived)
+  };
+
+  Store store_;
+  ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool_;
+  ChannelPtr<WaiterMessage> tx_payload_waiter_;
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,428 @@
+#include "consensus/messages.hpp"
+
+#include <set>
+
+namespace hotstuff {
+namespace consensus {
+
+// ---------------------------------------------------------------------------
+// QC
+// ---------------------------------------------------------------------------
+
+const QC& QC::genesis() {
+  static const QC g{};
+  return g;
+}
+
+Digest QC::digest() const {
+  // hash || round LE, SHA-512/32 (messages.rs:202-208).
+  return DigestBuilder().update(hash.data).update_u64_le(round).finalize();
+}
+
+VerifyResult QC::verify(const Committee& committee) const {
+  Stake weight = 0;
+  std::set<PublicKey> used;
+  for (const auto& [name, _] : votes) {
+    if (used.count(name)) {
+      return VerifyResult::bad("authority reuse in QC: " + name.to_base64());
+    }
+    Stake stake = committee.stake(name);
+    if (stake == 0) {
+      return VerifyResult::bad("unknown authority in QC: " + name.to_base64());
+    }
+    used.insert(name);
+    weight += stake;
+  }
+  if (weight < committee.quorum_threshold()) {
+    return VerifyResult::bad("QC requires a quorum");
+  }
+  // The TPU kernel target: batch-verify the quorum's signatures over the
+  // vote digest (crypto/src/lib.rs:210-223 analogue; device dispatch in
+  // Signature::verify_batch).
+  if (!Signature::verify_batch(digest(), votes)) {
+    return VerifyResult::bad("invalid signature in QC");
+  }
+  return VerifyResult::good();
+}
+
+void QC::serialize(Writer* w) const {
+  hash.serialize(w);
+  w->u64(round);
+  w->u64(votes.size());
+  for (const auto& [pk, sig] : votes) {
+    pk.serialize(w);
+    sig.serialize(w);
+  }
+}
+
+QC QC::deserialize(Reader* r) {
+  QC qc;
+  qc.hash = Digest::deserialize(r);
+  qc.round = r->u64();
+  uint64_t n = r->seq_len(96);
+  qc.votes.reserve(n);
+  for (uint64_t i = 0; i < n; i++) {
+    PublicKey pk = PublicKey::deserialize(r);
+    Signature sig = Signature::deserialize(r);
+    qc.votes.emplace_back(pk, sig);
+  }
+  return qc;
+}
+
+// ---------------------------------------------------------------------------
+// TC
+// ---------------------------------------------------------------------------
+
+std::vector<Round> TC::high_qc_rounds() const {
+  std::vector<Round> rounds;
+  rounds.reserve(votes.size());
+  for (const auto& [pk, sig, r] : votes) {
+    (void)pk;
+    (void)sig;
+    rounds.push_back(r);
+  }
+  return rounds;
+}
+
+VerifyResult TC::verify(const Committee& committee) const {
+  Stake weight = 0;
+  std::set<PublicKey> used;
+  for (const auto& [name, sig, hqr] : votes) {
+    (void)sig;
+    (void)hqr;
+    if (used.count(name)) {
+      return VerifyResult::bad("authority reuse in TC: " + name.to_base64());
+    }
+    Stake stake = committee.stake(name);
+    if (stake == 0) {
+      return VerifyResult::bad("unknown authority in TC: " + name.to_base64());
+    }
+    used.insert(name);
+    weight += stake;
+  }
+  if (weight < committee.quorum_threshold()) {
+    return VerifyResult::bad("TC requires a quorum");
+  }
+  // Each timeout vote signed (round, its own high_qc round) — distinct
+  // digests per vote, verified host-side (TCs only form on view change, off
+  // the throughput path; reference does the same sequential loop,
+  // messages.rs:307-313).
+  for (const auto& [author, sig, high_qc_round] : votes) {
+    Digest d = DigestBuilder()
+                   .update_u64_le(round)
+                   .update_u64_le(high_qc_round)
+                   .finalize();
+    if (!sig.verify(d, author)) {
+      return VerifyResult::bad("invalid signature in TC");
+    }
+  }
+  return VerifyResult::good();
+}
+
+void TC::serialize(Writer* w) const {
+  w->u64(round);
+  w->u64(votes.size());
+  for (const auto& [pk, sig, r] : votes) {
+    pk.serialize(w);
+    sig.serialize(w);
+    w->u64(r);
+  }
+}
+
+TC TC::deserialize(Reader* r) {
+  TC tc;
+  tc.round = r->u64();
+  uint64_t n = r->seq_len(104);
+  tc.votes.reserve(n);
+  for (uint64_t i = 0; i < n; i++) {
+    PublicKey pk = PublicKey::deserialize(r);
+    Signature sig = Signature::deserialize(r);
+    Round round = r->u64();
+    tc.votes.emplace_back(pk, sig, round);
+  }
+  return tc;
+}
+
+// ---------------------------------------------------------------------------
+// Block
+// ---------------------------------------------------------------------------
+
+const Block& Block::genesis() {
+  static const Block g{};
+  return g;
+}
+
+Digest Block::digest() const {
+  // author || round LE || payload digests || qc.hash (messages.rs:78-90).
+  DigestBuilder b;
+  b.update(author.data).update_u64_le(round);
+  for (const auto& d : payload) b.update(d.data);
+  b.update(qc.hash.data);
+  return b.finalize();
+}
+
+VerifyResult Block::verify(const Committee& committee) const {
+  if (committee.stake(author) == 0) {
+    return VerifyResult::bad("unknown block author: " + author.to_base64());
+  }
+  if (!signature.verify(digest(), author)) {
+    return VerifyResult::bad("invalid block signature");
+  }
+  if (!qc.is_genesis()) {
+    VerifyResult r = qc.verify(committee);
+    if (!r.ok()) return r;
+  }
+  if (tc) {
+    VerifyResult r = tc->verify(committee);
+    if (!r.ok()) return r;
+  }
+  return VerifyResult::good();
+}
+
+void Block::serialize(Writer* w) const {
+  qc.serialize(w);
+  w->u8(tc ? 1 : 0);
+  if (tc) tc->serialize(w);
+  author.serialize(w);
+  w->u64(round);
+  w->u64(payload.size());
+  for (const auto& d : payload) d.serialize(w);
+  signature.serialize(w);
+}
+
+Block Block::deserialize(Reader* r) {
+  Block b;
+  b.qc = QC::deserialize(r);
+  if (r->u8()) b.tc = TC::deserialize(r);
+  b.author = PublicKey::deserialize(r);
+  b.round = r->u64();
+  uint64_t n = r->seq_len(32);
+  b.payload.reserve(n);
+  for (uint64_t i = 0; i < n; i++) b.payload.push_back(Digest::deserialize(r));
+  b.signature = Signature::deserialize(r);
+  return b;
+}
+
+// ---------------------------------------------------------------------------
+// Vote
+// ---------------------------------------------------------------------------
+
+Vote Vote::make(const Block& block, const PublicKey& author,
+                const SignatureService& service) {
+  Vote v;
+  v.hash = block.digest();
+  v.round = block.round;
+  v.author = author;
+  v.signature = service.request_signature(v.digest());
+  return v;
+}
+
+Digest Vote::digest() const {
+  return DigestBuilder().update(hash.data).update_u64_le(round).finalize();
+}
+
+VerifyResult Vote::verify(const Committee& committee) const {
+  if (committee.stake(author) == 0) {
+    return VerifyResult::bad("unknown vote author: " + author.to_base64());
+  }
+  if (!signature.verify(digest(), author)) {
+    return VerifyResult::bad("invalid vote signature");
+  }
+  return VerifyResult::good();
+}
+
+void Vote::serialize(Writer* w) const {
+  hash.serialize(w);
+  w->u64(round);
+  author.serialize(w);
+  signature.serialize(w);
+}
+
+Vote Vote::deserialize(Reader* r) {
+  Vote v;
+  v.hash = Digest::deserialize(r);
+  v.round = r->u64();
+  v.author = PublicKey::deserialize(r);
+  v.signature = Signature::deserialize(r);
+  return v;
+}
+
+// ---------------------------------------------------------------------------
+// Timeout
+// ---------------------------------------------------------------------------
+
+Timeout Timeout::make(QC high_qc, Round round, const PublicKey& author,
+                      const SignatureService& service) {
+  Timeout t;
+  t.high_qc = std::move(high_qc);
+  t.round = round;
+  t.author = author;
+  t.signature = service.request_signature(t.digest());
+  return t;
+}
+
+Digest Timeout::digest() const {
+  // round LE || high_qc.round LE (messages.rs:267-273).
+  return DigestBuilder()
+      .update_u64_le(round)
+      .update_u64_le(high_qc.round)
+      .finalize();
+}
+
+VerifyResult Timeout::verify(const Committee& committee) const {
+  if (committee.stake(author) == 0) {
+    return VerifyResult::bad("unknown timeout author: " + author.to_base64());
+  }
+  if (!signature.verify(digest(), author)) {
+    return VerifyResult::bad("invalid timeout signature");
+  }
+  if (!high_qc.is_genesis()) {
+    VerifyResult r = high_qc.verify(committee);
+    if (!r.ok()) return r;
+  }
+  return VerifyResult::good();
+}
+
+void Timeout::serialize(Writer* w) const {
+  high_qc.serialize(w);
+  w->u64(round);
+  author.serialize(w);
+  signature.serialize(w);
+}
+
+Timeout Timeout::deserialize(Reader* r) {
+  Timeout t;
+  t.high_qc = QC::deserialize(r);
+  t.round = r->u64();
+  t.author = PublicKey::deserialize(r);
+  t.signature = Signature::deserialize(r);
+  return t;
+}
+
+// ---------------------------------------------------------------------------
+// ConsensusMessage envelope
+// ---------------------------------------------------------------------------
+
+Bytes ConsensusMessage::serialize() const {
+  Writer w;
+  w.tag(static_cast<uint32_t>(kind));
+  switch (kind) {
+    case Kind::kPropose: block.serialize(&w); break;
+    case Kind::kVote: vote.serialize(&w); break;
+    case Kind::kTimeout: timeout.serialize(&w); break;
+    case Kind::kTC: tc.serialize(&w); break;
+    case Kind::kSyncRequest:
+      sync_digest.serialize(&w);
+      sync_from.serialize(&w);
+      break;
+  }
+  return std::move(w.out);
+}
+
+ConsensusMessage ConsensusMessage::deserialize(const Bytes& data) {
+  Reader r(data);
+  ConsensusMessage m;
+  uint32_t tag = r.tag();
+  switch (tag) {
+    case 0:
+      m.kind = Kind::kPropose;
+      m.block = Block::deserialize(&r);
+      break;
+    case 1:
+      m.kind = Kind::kVote;
+      m.vote = Vote::deserialize(&r);
+      break;
+    case 2:
+      m.kind = Kind::kTimeout;
+      m.timeout = Timeout::deserialize(&r);
+      break;
+    case 3:
+      m.kind = Kind::kTC;
+      m.tc = TC::deserialize(&r);
+      break;
+    case 4:
+      m.kind = Kind::kSyncRequest;
+      m.sync_digest = Digest::deserialize(&r);
+      m.sync_from = PublicKey::deserialize(&r);
+      break;
+    default:
+      throw SerdeError("bad ConsensusMessage tag");
+  }
+  return m;
+}
+
+Bytes ConsensusMessage::propose(const Block& b) {
+  ConsensusMessage m;
+  m.kind = Kind::kPropose;
+  m.block = b;
+  return m.serialize();
+}
+
+Bytes ConsensusMessage::vote_msg(const Vote& v) {
+  ConsensusMessage m;
+  m.kind = Kind::kVote;
+  m.vote = v;
+  return m.serialize();
+}
+
+Bytes ConsensusMessage::timeout_msg(const Timeout& t) {
+  ConsensusMessage m;
+  m.kind = Kind::kTimeout;
+  m.timeout = t;
+  return m.serialize();
+}
+
+Bytes ConsensusMessage::tc_msg(const TC& tc) {
+  ConsensusMessage m;
+  m.kind = Kind::kTC;
+  m.tc = tc;
+  return m.serialize();
+}
+
+Bytes ConsensusMessage::sync_request(const Digest& digest,
+                                     const PublicKey& from) {
+  ConsensusMessage m;
+  m.kind = Kind::kSyncRequest;
+  m.sync_digest = digest;
+  m.sync_from = from;
+  return m.serialize();
+}
+
+// ---------------------------------------------------------------------------
+// Committee JSON
+// ---------------------------------------------------------------------------
+
+Json Committee::to_json() const {
+  Json auths = Json::object();
+  for (const auto& [name, a] : authorities_) {
+    Json entry = Json::object();
+    entry.set("stake", Json(int64_t(a.stake)));
+    entry.set("address", Json(a.address.str()));
+    auths.set(name.to_base64(), std::move(entry));
+  }
+  Json j = Json::object();
+  j.set("authorities", std::move(auths));
+  j.set("epoch", Json(int64_t(epoch_)));
+  return j;
+}
+
+Committee Committee::from_json(const Json& j) {
+  std::map<PublicKey, Authority> authorities;
+  for (const auto& [name_b64, entry] : j.at("authorities").members()) {
+    PublicKey name;
+    if (!PublicKey::from_base64(name_b64, &name)) {
+      throw JsonError("bad public key in consensus committee: " + name_b64);
+    }
+    Authority a;
+    a.stake = static_cast<Stake>(entry.at("stake").as_u64());
+    auto addr = Address::parse(entry.at("address").as_string());
+    if (!addr) throw JsonError("bad address in consensus committee");
+    a.address = *addr;
+    authorities.emplace(name, std::move(a));
+  }
+  uint64_t epoch = j.find("epoch") ? j.at("epoch").as_u64() : 1;
+  return Committee(std::move(authorities), epoch);
+}
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,148 @@
+// Consensus message types: Block, Vote, QC, Timeout, TC and the network
+// envelope ConsensusMessage (consensus/src/messages.rs:16-326 and
+// consensus/src/consensus.rs:32-39 in the reference). QC verification is
+// the TPU hot path: it stake-checks the vote set then calls
+// Signature::verify_batch, which dispatches to the verify sidecar.
+#pragma once
+
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "common/serde.hpp"
+#include "consensus/config.hpp"
+#include "crypto/crypto.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+// Verification outcome; `ok()` false carries a reason (the reference's
+// ConsensusError variants, consensus/src/error.rs:22-65).
+struct VerifyResult {
+  std::string error;  // empty = ok
+  bool ok() const { return error.empty(); }
+  static VerifyResult good() { return {}; }
+  static VerifyResult bad(std::string why) { return {std::move(why)}; }
+};
+
+struct QC {
+  Digest hash;  // digest of the certified block
+  Round round = 0;
+  std::vector<std::pair<PublicKey, Signature>> votes;
+
+  static const QC& genesis();
+  bool is_genesis() const { return *this == genesis(); }
+
+  // Equality on (hash, round) as in the reference (messages.rs:219-222).
+  bool operator==(const QC& o) const {
+    return hash == o.hash && round == o.round;
+  }
+  bool operator!=(const QC& o) const { return !(*this == o); }
+
+  Digest digest() const;  // what each vote signed
+  VerifyResult verify(const Committee& committee) const;
+
+  void serialize(Writer* w) const;
+  static QC deserialize(Reader* r);
+};
+
+struct TC {
+  Round round = 0;
+  std::vector<std::tuple<PublicKey, Signature, Round>> votes;
+
+  std::vector<Round> high_qc_rounds() const;
+  VerifyResult verify(const Committee& committee) const;
+
+  void serialize(Writer* w) const;
+  static TC deserialize(Reader* r);
+};
+
+struct Block {
+  QC qc;
+  std::optional<TC> tc;
+  PublicKey author;
+  Round round = 0;
+  std::vector<Digest> payload;
+  Signature signature;
+
+  static const Block& genesis();
+
+  Digest digest() const;
+  const Digest& parent() const { return qc.hash; }
+  VerifyResult verify(const Committee& committee) const;
+
+  void serialize(Writer* w) const;
+  static Block deserialize(Reader* r);
+  Bytes to_bytes() const {
+    Writer w;
+    serialize(&w);
+    return std::move(w.out);
+  }
+  static Block from_bytes(const Bytes& b) {
+    Reader r(b);
+    return deserialize(&r);
+  }
+};
+
+struct Vote {
+  Digest hash;  // block digest
+  Round round = 0;
+  PublicKey author;
+  Signature signature;
+
+  static Vote make(const Block& block, const PublicKey& author,
+                   const SignatureService& service);
+
+  Digest digest() const;
+  VerifyResult verify(const Committee& committee) const;
+
+  void serialize(Writer* w) const;
+  static Vote deserialize(Reader* r);
+};
+
+struct Timeout {
+  QC high_qc;
+  Round round = 0;
+  PublicKey author;
+  Signature signature;
+
+  static Timeout make(QC high_qc, Round round, const PublicKey& author,
+                      const SignatureService& service);
+
+  Digest digest() const;
+  VerifyResult verify(const Committee& committee) const;
+
+  void serialize(Writer* w) const;
+  static Timeout deserialize(Reader* r);
+};
+
+// Network envelope (consensus/src/consensus.rs:32-39).
+struct ConsensusMessage {
+  enum class Kind : uint32_t {
+    kPropose = 0,
+    kVote = 1,
+    kTimeout = 2,
+    kTC = 3,
+    kSyncRequest = 4,
+  };
+
+  Kind kind;
+  Block block;          // kPropose
+  Vote vote;            // kVote
+  Timeout timeout;      // kTimeout
+  TC tc;                // kTC
+  Digest sync_digest;   // kSyncRequest
+  PublicKey sync_from;  // kSyncRequest
+
+  Bytes serialize() const;
+  static ConsensusMessage deserialize(const Bytes& data);
+
+  static Bytes propose(const Block& b);
+  static Bytes vote_msg(const Vote& v);
+  static Bytes timeout_msg(const Timeout& t);
+  static Bytes tc_msg(const TC& tc);
+  static Bytes sync_request(const Digest& digest, const PublicKey& from);
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,98 @@
+#include "consensus/proposer.hpp"
+
+#include <condition_variable>
+#include <mutex>
+#include <set>
+#include <thread>
+
+#include "common/log.hpp"
+#include "network/reliable_sender.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+namespace {
+
+void make_block(const PublicKey& name, const Committee& committee,
+                const SignatureService& signature_service,
+                ReliableSender* network, std::set<Digest>* buffer,
+                Round round, QC qc, std::optional<TC> tc,
+                Channel<CoreEvent>* tx_loopback) {
+  Block block;
+  block.qc = std::move(qc);
+  block.tc = std::move(tc);
+  block.author = name;
+  block.round = round;
+  block.payload.assign(buffer->begin(), buffer->end());
+  buffer->clear();
+  block.signature = signature_service.request_signature(block.digest());
+
+  if (!block.payload.empty()) {
+    LOG_INFO("consensus::proposer") << "Created B" << block.round;
+    // NOTE: These log entries are used to compute performance
+    // (hotstuff_tpu/harness/logs.py proposal regex).
+    for (const Digest& x : block.payload) {
+      LOG_INFO("consensus::proposer")
+          << "Created B" << block.round << " -> " << x.to_base64();
+    }
+  }
+
+  // Reliable-broadcast the proposal, loop it back, then wait for 2f+1
+  // cumulative stake of ACKs (proposer.rs:85-121).
+  auto peers = committee.broadcast_addresses(name);
+  std::vector<Address> addresses;
+  addresses.reserve(peers.size());
+  for (const auto& [_, addr] : peers) addresses.push_back(addr);
+  Bytes message = ConsensusMessage::propose(block);
+  auto handlers = network->broadcast(addresses, message);
+
+  tx_loopback->send(CoreEvent::loopback(block));
+
+  auto m = std::make_shared<std::mutex>();
+  auto cv = std::make_shared<std::condition_variable>();
+  auto total = std::make_shared<Stake>(committee.stake(name));
+  for (size_t i = 0; i < peers.size(); i++) {
+    Stake stake = committee.stake(peers[i].first);
+    handlers[i].on_ready([m, cv, total, stake](const Bytes&) {
+      std::lock_guard<std::mutex> lk(*m);
+      *total += stake;
+      cv->notify_one();
+    });
+  }
+  Stake quorum = committee.quorum_threshold();
+  std::unique_lock<std::mutex> lk(*m);
+  cv->wait(lk, [&] { return *total >= quorum; });
+}
+
+}  // namespace
+
+void Proposer::spawn(PublicKey name, Committee committee,
+                     SignatureService signature_service,
+                     ChannelPtr<ProposerEvent> rx_event,
+                     ChannelPtr<CoreEvent> tx_loopback) {
+  std::thread([name, committee = std::move(committee),
+               signature_service = std::move(signature_service), rx_event,
+               tx_loopback]() mutable {
+    ReliableSender network;
+    std::set<Digest> buffer;
+    while (auto event = rx_event->recv()) {
+      switch (event->kind) {
+        case ProposerEvent::Kind::kDigest:
+          buffer.insert(event->digest);
+          break;
+        case ProposerEvent::Kind::kCommand:
+          if (event->command.kind == ProposerMessage::Kind::kMake) {
+            make_block(name, committee, signature_service, &network, &buffer,
+                       event->command.round, std::move(event->command.qc),
+                       std::move(event->command.tc), tx_loopback.get());
+          } else {
+            for (const Digest& d : event->command.digests) buffer.erase(d);
+          }
+          break;
+      }
+    }
+  }).detach();
+}
+
+}  // namespace consensus
+}  // namespace hotstuff

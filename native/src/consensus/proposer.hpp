@@ -1,0 +1,30 @@
+// Proposer: buffers payload digests from the mempool; on Make it builds and
+// signs a block, reliably broadcasts it, loops it back to the core, and
+// blocks until 2f+1 stake has ACKed the proposal (the reference's control
+// system, consensus/src/proposer.rs:19-143).
+#pragma once
+
+#include "common/channel.hpp"
+#include "consensus/core.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+// Unified input: mempool digests + core commands (the reference selects
+// over rx_mempool and rx_message, proposer.rs:125-141).
+struct ProposerEvent {
+  enum class Kind { kDigest, kCommand } kind = Kind::kDigest;
+  Digest digest;            // kDigest
+  ProposerMessage command;  // kCommand
+};
+
+class Proposer {
+ public:
+  static void spawn(PublicKey name, Committee committee,
+                    SignatureService signature_service,
+                    ChannelPtr<ProposerEvent> rx_event,
+                    ChannelPtr<CoreEvent> tx_loopback);
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

@@ -1,0 +1,127 @@
+#include "consensus/synchronizer.hpp"
+
+#include <chrono>
+#include <map>
+#include <set>
+#include <thread>
+
+#include "common/log.hpp"
+#include "consensus/core.hpp"
+#include "network/simple_sender.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+namespace {
+constexpr auto kTimerAccuracy = std::chrono::milliseconds(5000);
+
+uint64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+Synchronizer::Synchronizer(PublicKey name, Committee committee, Store store,
+                           ChannelPtr<CoreEvent> tx_loopback,
+                           uint64_t sync_retry_delay)
+    : store_(store),
+      // Unbounded: store-thread completion callbacks must never block, and a
+      // dropped kDelivered would wedge its block forever (the pending-set
+      // dedup prevents re-registration). Size is bounded in practice by the
+      // number of distinct suspended blocks.
+      inner_(make_channel<SyncCommand>(SIZE_MAX)) {
+  auto inner = inner_;
+  std::thread([name, committee = std::move(committee), store, tx_loopback,
+               sync_retry_delay, inner]() mutable {
+    SimpleSender network;
+    std::set<Digest> pending;              // block digests being resolved
+    std::map<Digest, uint64_t> requests;   // parent digest -> request ts
+    auto deadline = std::chrono::steady_clock::now() + kTimerAccuracy;
+
+    while (true) {
+      SyncCommand cmd;
+      auto status = inner->recv_until(&cmd, deadline);
+      if (status == RecvStatus::kClosed) return;
+      if (status == RecvStatus::kTimeout) {
+        // 'Perfect point-to-point link': rebroadcast stale requests to all
+        // (synchronizer.rs:84-105).
+        uint64_t now = now_ms();
+        for (const auto& [digest, ts] : requests) {
+          if (ts + sync_retry_delay < now) {
+            LOG_DEBUG("consensus::synchronizer")
+                << "Requesting sync for block " << digest.to_base64()
+                << " (retry)";
+            std::vector<Address> addresses;
+            for (const auto& [_, addr] : committee.broadcast_addresses(name)) {
+              addresses.push_back(addr);
+            }
+            network.broadcast(addresses,
+                              ConsensusMessage::sync_request(digest, name));
+          }
+        }
+        deadline = std::chrono::steady_clock::now() + kTimerAccuracy;
+        continue;
+      }
+
+      if (cmd.kind == SyncCommand::Kind::kDelivered) {
+        pending.erase(cmd.block.digest());
+        requests.erase(cmd.block.parent());
+        tx_loopback->send(CoreEvent::loopback(std::move(cmd.block)));
+        continue;
+      }
+
+      const Block& block = cmd.block;
+      if (!pending.insert(block.digest()).second) continue;
+      Digest parent = block.parent();
+      // Waiter: when the parent appears in storage, the store-thread
+      // callback loops the suspended block back through this channel
+      // (synchronizer.rs:110-118 analogue).
+      store.notify_read(parent.to_bytes())
+          .on_ready([inner, block](const Bytes&) {
+            SyncCommand done;
+            done.kind = SyncCommand::Kind::kDelivered;
+            done.block = block;
+            inner->send(std::move(done));  // unbounded: never blocks
+          });
+      if (!requests.count(parent)) {
+        LOG_DEBUG("consensus::synchronizer")
+            << "Requesting sync for block " << parent.to_base64();
+        requests[parent] = now_ms();
+        auto address = committee.address(block.author);
+        if (address) {
+          network.send(*address,
+                       ConsensusMessage::sync_request(parent, name));
+        }
+      }
+    }
+  }).detach();
+}
+
+std::optional<Block> Synchronizer::get_parent_block(const Block& block) {
+  if (block.qc.is_genesis()) return Block::genesis();
+  auto bytes = store_.read(block.parent().to_bytes());
+  if (bytes) return Block::from_bytes(*bytes);
+  SyncCommand cmd;
+  cmd.block = block;
+  inner_->send(std::move(cmd));
+  return std::nullopt;
+}
+
+std::optional<std::pair<Block, Block>> Synchronizer::get_ancestors(
+    const Block& block) {
+  auto b1 = get_parent_block(block);
+  if (!b1) return std::nullopt;
+  auto b0 = get_parent_block(*b1);
+  if (!b0) {
+    // Invariant from the reference (synchronizer.rs:136-149): delivered
+    // blocks have all ancestors; a miss here means the store lost data.
+    LOG_ERROR("consensus::synchronizer")
+        << "missing grandparent of delivered block";
+    return std::nullopt;
+  }
+  return std::make_pair(std::move(*b0), std::move(*b1));
+}
+
+}  // namespace consensus
+}  // namespace hotstuff

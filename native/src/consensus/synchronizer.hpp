@@ -1,0 +1,42 @@
+// Consensus synchronizer: resolves a block's ancestors from storage; on a
+// miss it registers a notify_read waiter, sends a SyncRequest to the block
+// author, and re-broadcasts stale requests on a 5 s timer; delivered blocks
+// loop back into the core (consensus/src/synchronizer.rs:24-150 in the
+// reference).
+#pragma once
+
+#include <optional>
+#include <utility>
+
+#include "common/channel.hpp"
+#include "consensus/messages.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace consensus {
+
+struct CoreEvent;
+
+class Synchronizer {
+ public:
+  Synchronizer(PublicKey name, Committee committee, Store store,
+               ChannelPtr<CoreEvent> tx_loopback, uint64_t sync_retry_delay);
+
+  // Called from the core thread. nullopt = missing, sync requested, the
+  // block will loop back when its parent is available.
+  std::optional<Block> get_parent_block(const Block& block);
+  std::optional<std::pair<Block, Block>> get_ancestors(const Block& block);
+
+ private:
+  struct SyncCommand {
+    enum class Kind { kRequest, kDelivered } kind = Kind::kRequest;
+    Block block;  // kRequest: block whose parent is missing;
+                  // kDelivered: suspended block whose parent arrived
+  };
+
+  Store store_;
+  ChannelPtr<SyncCommand> inner_;
+};
+
+}  // namespace consensus
+}  // namespace hotstuff

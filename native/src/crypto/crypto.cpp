@@ -1,0 +1,185 @@
+#include "crypto/crypto.hpp"
+
+#include <cstring>
+#include <stdexcept>
+
+#include "crypto/openssl_shim.hpp"
+#include "crypto/sidecar_client.hpp"
+
+namespace hotstuff {
+
+Digest sha512_digest(const uint8_t* data, size_t len) {
+  unsigned char md[64];
+  unsigned int mdlen = 0;
+  if (EVP_Digest(data, len, md, &mdlen, EVP_sha512(), nullptr) != 1 ||
+      mdlen != 64) {
+    throw std::runtime_error("sha512 failed");
+  }
+  Digest d;
+  std::memcpy(d.data.data(), md, 32);
+  return d;
+}
+
+DigestBuilder::DigestBuilder() : ctx_(EVP_MD_CTX_new()) {
+  if (!ctx_ || EVP_DigestInit_ex(static_cast<EVP_MD_CTX*>(ctx_), EVP_sha512(),
+                                 nullptr) != 1) {
+    throw std::runtime_error("sha512 init failed");
+  }
+}
+
+DigestBuilder::~DigestBuilder() {
+  EVP_MD_CTX_free(static_cast<EVP_MD_CTX*>(ctx_));
+}
+
+DigestBuilder& DigestBuilder::update(const uint8_t* data, size_t len) {
+  if (EVP_DigestUpdate(static_cast<EVP_MD_CTX*>(ctx_), data, len) != 1) {
+    throw std::runtime_error("sha512 update failed");
+  }
+  return *this;
+}
+
+DigestBuilder& DigestBuilder::update_u64_le(uint64_t v) {
+  uint8_t buf[8];
+  for (int i = 0; i < 8; i++) buf[i] = (v >> (8 * i)) & 0xFF;
+  return update(buf, 8);
+}
+
+Digest DigestBuilder::finalize() {
+  unsigned char md[64];
+  unsigned int mdlen = 0;
+  if (EVP_DigestFinal_ex(static_cast<EVP_MD_CTX*>(ctx_), md, &mdlen) != 1 ||
+      mdlen != 64) {
+    throw std::runtime_error("sha512 final failed");
+  }
+  Digest d;
+  std::memcpy(d.data.data(), md, 32);
+  return d;
+}
+
+bool PublicKey::from_base64(const std::string& s, PublicKey* out) {
+  Bytes b;
+  if (!base64_decode(s, &b) || b.size() != 32) return false;
+  std::memcpy(out->data.data(), b.data(), 32);
+  return true;
+}
+
+bool SecretKey::from_base64(const std::string& s, SecretKey* out) {
+  Bytes b;
+  if (!base64_decode(s, &b) || b.size() != 64) return false;
+  std::memcpy(out->data.data(), b.data(), 64);
+  return true;
+}
+
+namespace {
+
+struct PkeyGuard {
+  EVP_PKEY* p;
+  ~PkeyGuard() { EVP_PKEY_free(p); }
+};
+
+struct CtxGuard {
+  EVP_MD_CTX* c;
+  ~CtxGuard() { EVP_MD_CTX_free(c); }
+};
+
+}  // namespace
+
+Signature Signature::sign(const Digest& digest, const SecretKey& sk) {
+  PkeyGuard key{EVP_PKEY_new_raw_private_key(kEvpPkeyEd25519, nullptr,
+                                             sk.seed(), 32)};
+  if (!key.p) throw std::runtime_error("bad secret key");
+  CtxGuard ctx{EVP_MD_CTX_new()};
+  Signature sig;
+  size_t siglen = sig.data.size();
+  if (EVP_DigestSignInit(ctx.c, nullptr, nullptr, nullptr, key.p) != 1 ||
+      EVP_DigestSign(ctx.c, sig.data.data(), &siglen, digest.data.data(),
+                     digest.data.size()) != 1 ||
+      siglen != 64) {
+    throw std::runtime_error("ed25519 sign failed");
+  }
+  return sig;
+}
+
+bool Signature::verify(const Digest& digest, const PublicKey& pk) const {
+  PkeyGuard key{EVP_PKEY_new_raw_public_key(kEvpPkeyEd25519, nullptr,
+                                            pk.data.data(), 32)};
+  if (!key.p) return false;
+  CtxGuard ctx{EVP_MD_CTX_new()};
+  if (EVP_DigestVerifyInit(ctx.c, nullptr, nullptr, nullptr, key.p) != 1) {
+    return false;
+  }
+  return EVP_DigestVerify(ctx.c, data.data(), data.size(),
+                          digest.data.data(), digest.data.size()) == 1;
+}
+
+bool Signature::verify_batch(
+    const Digest& digest,
+    const std::vector<std::pair<PublicKey, Signature>>& votes) {
+  TpuVerifier* tpu = TpuVerifier::instance();
+  if (tpu && tpu->connected()) {
+    auto mask = tpu->verify_batch(digest, votes);
+    if (mask) {
+      for (bool ok : *mask) {
+        if (!ok) return false;
+      }
+      return true;
+    }
+    // fall through to host loop on sidecar failure
+  }
+  for (const auto& [pk, sig] : votes) {
+    if (!sig.verify(digest, pk)) return false;
+  }
+  return true;
+}
+
+KeyPair generate_keypair() {
+  std::array<uint8_t, 32> seed;
+  if (RAND_bytes(seed.data(), seed.size()) != 1) {
+    throw std::runtime_error("RAND_bytes failed");
+  }
+  return keypair_from_seed(seed);
+}
+
+KeyPair keypair_from_seed(const std::array<uint8_t, 32>& seed) {
+  PkeyGuard key{EVP_PKEY_new_raw_private_key(kEvpPkeyEd25519, nullptr,
+                                             seed.data(), 32)};
+  if (!key.p) throw std::runtime_error("bad seed");
+  KeyPair kp;
+  size_t publen = 32;
+  if (EVP_PKEY_get_raw_public_key(key.p, kp.name.data.data(), &publen) != 1 ||
+      publen != 32) {
+    throw std::runtime_error("pubkey derivation failed");
+  }
+  std::memcpy(kp.secret.data.data(), seed.data(), 32);
+  std::memcpy(kp.secret.data.data() + 32, kp.name.data.data(), 32);
+  return kp;
+}
+
+SignatureService::SignatureService(const SecretKey& sk)
+    : ch_(make_channel<Request>()) {
+  auto ch = ch_;
+  SecretKey key = sk;
+  worker_ = std::shared_ptr<std::thread>(
+      new std::thread([ch, key] {
+        while (auto req = ch->recv()) {
+          req->reply.set(Signature::sign(req->digest, key));
+        }
+      }),
+      [ch](std::thread* t) {
+        ch->close();
+        t->join();
+        delete t;
+      });
+}
+
+Signature SignatureService::request_signature(const Digest& digest) const {
+  Request req;
+  req.digest = digest;
+  Oneshot<Signature> reply = req.reply;
+  if (!ch_->send(std::move(req))) {
+    throw std::runtime_error("signature service stopped");
+  }
+  return reply.wait();
+}
+
+}  // namespace hotstuff

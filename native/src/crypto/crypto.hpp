@@ -1,0 +1,151 @@
+// Crypto layer: Digest / PublicKey / SecretKey / Signature / KeyPair /
+// SignatureService — the same narrow surface as the reference's crypto crate
+// (crypto/src/lib.rs:21-254).  Host signing + single verification run on
+// OpenSSL's Ed25519; quorum batch verification routes to the TPU sidecar
+// through TpuVerifier (sidecar_client.hpp) with a host fallback, which is
+// exactly where the reference calls dalek's verify_batch
+// (crypto/src/lib.rs:210-223).
+#pragma once
+
+#include <array>
+#include <memory>
+#include <thread>
+#include <utility>
+#include <vector>
+
+#include "common/bytes.hpp"
+#include "common/channel.hpp"
+#include "common/serde.hpp"
+
+namespace hotstuff {
+
+struct Digest {
+  std::array<uint8_t, 32> data{};
+
+  bool operator==(const Digest& o) const { return data == o.data; }
+  bool operator!=(const Digest& o) const { return data != o.data; }
+  bool operator<(const Digest& o) const { return data < o.data; }
+
+  std::string to_base64() const { return base64_encode(data); }
+  Bytes to_bytes() const { return Bytes(data.begin(), data.end()); }
+
+  void serialize(Writer* w) const { w->fixed(data); }
+  static Digest deserialize(Reader* r) {
+    Digest d;
+    r->fixed(&d.data);
+    return d;
+  }
+};
+
+// SHA-512 truncated to 32 bytes — the digest function used for every hash in
+// the reference (e.g. consensus/src/messages.rs:80-89).
+Digest sha512_digest(const uint8_t* data, size_t len);
+inline Digest sha512_digest(const Bytes& b) {
+  return sha512_digest(b.data(), b.size());
+}
+
+// Incremental SHA-512/32 for multi-part message digests.
+class DigestBuilder {
+ public:
+  DigestBuilder();
+  ~DigestBuilder();
+  DigestBuilder(const DigestBuilder&) = delete;
+  DigestBuilder& operator=(const DigestBuilder&) = delete;
+
+  DigestBuilder& update(const uint8_t* data, size_t len);
+  DigestBuilder& update(const Bytes& b) { return update(b.data(), b.size()); }
+  template <size_t N>
+  DigestBuilder& update(const std::array<uint8_t, N>& a) {
+    return update(a.data(), N);
+  }
+  DigestBuilder& update_u64_le(uint64_t v);
+  Digest finalize();
+
+ private:
+  void* ctx_;
+};
+
+struct PublicKey {
+  std::array<uint8_t, 32> data{};
+
+  bool operator==(const PublicKey& o) const { return data == o.data; }
+  bool operator!=(const PublicKey& o) const { return data != o.data; }
+  bool operator<(const PublicKey& o) const { return data < o.data; }
+
+  std::string to_base64() const { return base64_encode(data); }
+  static bool from_base64(const std::string& s, PublicKey* out);
+
+  void serialize(Writer* w) const { w->fixed(data); }
+  static PublicKey deserialize(Reader* r) {
+    PublicKey p;
+    r->fixed(&p.data);
+    return p;
+  }
+};
+
+// 64 bytes = 32-byte seed || 32-byte public key (the layout the reference
+// serializes for its dalek keypair, crypto/src/lib.rs:120-155).
+struct SecretKey {
+  std::array<uint8_t, 64> data{};
+
+  const uint8_t* seed() const { return data.data(); }
+  std::string to_base64() const { return base64_encode(data); }
+  static bool from_base64(const std::string& s, SecretKey* out);
+};
+
+struct Signature {
+  std::array<uint8_t, 64> data{};
+
+  bool operator==(const Signature& o) const { return data == o.data; }
+
+  void serialize(Writer* w) const { w->fixed(data); }
+  static Signature deserialize(Reader* r) {
+    Signature s;
+    r->fixed(&s.data);
+    return s;
+  }
+
+  // Sign a 32-byte digest (the message is always a Digest in this protocol).
+  static Signature sign(const Digest& digest, const SecretKey& sk);
+
+  bool verify(const Digest& digest, const PublicKey& pk) const;
+
+  // Batch verification over a QC's votes. Uses the process-wide TpuVerifier
+  // if one is installed (see sidecar_client.hpp), else a host loop.
+  static bool verify_batch(
+      const Digest& digest,
+      const std::vector<std::pair<PublicKey, Signature>>& votes);
+};
+
+struct KeyPair {
+  PublicKey name;
+  SecretKey secret;
+};
+
+// Fresh keypair from the system RNG; deterministic variant from a seed for
+// test fixtures (mirrors the reference's seeded-RNG test keys,
+// consensus/src/tests/common.rs:17-20).
+KeyPair generate_keypair();
+KeyPair keypair_from_seed(const std::array<uint8_t, 32>& seed);
+
+// ---------------------------------------------------------------------------
+// SignatureService: dedicated signing actor (crypto/src/lib.rs:226-254).
+// ---------------------------------------------------------------------------
+
+class SignatureService {
+ public:
+  explicit SignatureService(const SecretKey& sk);
+
+  // Clonable handle; the background thread lives as long as any copy.
+  Signature request_signature(const Digest& digest) const;
+
+ private:
+  struct Request {
+    Digest digest;
+    Oneshot<Signature> reply;
+  };
+  ChannelPtr<Request> ch_;
+  std::shared_ptr<std::thread> worker_;
+};
+
+}  // namespace hotstuff

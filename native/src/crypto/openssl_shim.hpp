@@ -1,0 +1,49 @@
+// Hand-declared subset of the stable libcrypto 3.x C ABI (this image ships
+// /lib/x86_64-linux-gnu/libcrypto.so.3 but no dev headers). Only the
+// documented, ABI-stable EVP entry points for SHA-512 and Ed25519 raw-key
+// sign/verify plus RAND_bytes are declared; CMake links the versioned .so
+// directly.
+#pragma once
+
+#include <cstddef>
+
+extern "C" {
+
+typedef struct evp_md_ctx_st EVP_MD_CTX;
+typedef struct evp_md_st EVP_MD;
+typedef struct evp_pkey_st EVP_PKEY;
+typedef struct evp_pkey_ctx_st EVP_PKEY_CTX;
+typedef struct engine_st ENGINE;
+
+const EVP_MD* EVP_sha512(void);
+int EVP_Digest(const void* data, size_t count, unsigned char* md,
+               unsigned int* size, const EVP_MD* type, ENGINE* impl);
+
+EVP_MD_CTX* EVP_MD_CTX_new(void);
+void EVP_MD_CTX_free(EVP_MD_CTX* ctx);
+int EVP_DigestInit_ex(EVP_MD_CTX* ctx, const EVP_MD* type, ENGINE* impl);
+int EVP_DigestUpdate(EVP_MD_CTX* ctx, const void* d, size_t cnt);
+int EVP_DigestFinal_ex(EVP_MD_CTX* ctx, unsigned char* md, unsigned int* s);
+
+EVP_PKEY* EVP_PKEY_new_raw_private_key(int type, ENGINE* e,
+                                       const unsigned char* priv, size_t len);
+EVP_PKEY* EVP_PKEY_new_raw_public_key(int type, ENGINE* e,
+                                      const unsigned char* pub, size_t len);
+int EVP_PKEY_get_raw_public_key(const EVP_PKEY* pkey, unsigned char* pub,
+                                size_t* len);
+void EVP_PKEY_free(EVP_PKEY* pkey);
+
+int EVP_DigestSignInit(EVP_MD_CTX* ctx, EVP_PKEY_CTX** pctx,
+                       const EVP_MD* type, ENGINE* e, EVP_PKEY* pkey);
+int EVP_DigestSign(EVP_MD_CTX* ctx, unsigned char* sigret, size_t* siglen,
+                   const unsigned char* tbs, size_t tbslen);
+int EVP_DigestVerifyInit(EVP_MD_CTX* ctx, EVP_PKEY_CTX** pctx,
+                         const EVP_MD* type, ENGINE* e, EVP_PKEY* pkey);
+int EVP_DigestVerify(EVP_MD_CTX* ctx, const unsigned char* sigret,
+                     size_t siglen, const unsigned char* tbs, size_t tbslen);
+
+int RAND_bytes(unsigned char* buf, int num);
+
+}  // extern "C"
+
+inline constexpr int kEvpPkeyEd25519 = 1087;  // NID_ED25519

@@ -1,0 +1,94 @@
+#include "crypto/sidecar_client.hpp"
+
+#include "common/log.hpp"
+#include "common/serde.hpp"
+#include "crypto/crypto.hpp"
+
+namespace hotstuff {
+
+namespace {
+constexpr uint8_t kOpVerifyBatch = 1;
+std::unique_ptr<TpuVerifier> g_instance;
+}  // namespace
+
+TpuVerifier::TpuVerifier(const Address& addr) : addr_(addr) {}
+
+TpuVerifier* TpuVerifier::instance() { return g_instance.get(); }
+
+void TpuVerifier::install(std::unique_ptr<TpuVerifier> v) {
+  g_instance = std::move(v);
+}
+
+bool TpuVerifier::connected() {
+  std::lock_guard<std::mutex> lk(m_);
+  return ensure_connected_locked();
+}
+
+bool TpuVerifier::ensure_connected_locked() {
+  if (sock_.valid()) return true;
+  auto s = Socket::connect(addr_);
+  if (!s) {
+    if (!ever_connected_) return false;
+    LOG_WARN("crypto::sidecar") << "lost connection to verify sidecar "
+                                << addr_.str();
+    ever_connected_ = false;
+    return false;
+  }
+  sock_ = std::move(*s);
+  if (!ever_connected_) {
+    LOG_INFO("crypto::sidecar") << "connected to verify sidecar "
+                                << addr_.str();
+  }
+  ever_connected_ = true;
+  return true;
+}
+
+std::optional<std::vector<bool>> TpuVerifier::verify_batch(
+    const Digest& digest,
+    const std::vector<std::pair<PublicKey, Signature>>& votes) {
+  std::lock_guard<std::mutex> lk(m_);
+  if (!ensure_connected_locked()) return std::nullopt;
+
+  // Request: u8 opcode | u32 rid | u32 count | u16 msg_len | records.
+  Writer w;
+  uint32_t rid = next_id_++;
+  w.u8(kOpVerifyBatch);
+  w.u32(rid);
+  w.u32(static_cast<uint32_t>(votes.size()));
+  w.u8(32);  // msg_len lo (u16 LE)
+  w.u8(0);   // msg_len hi
+  for (const auto& [pk, sig] : votes) {
+    w.fixed(digest.data);
+    w.fixed(pk.data);
+    w.fixed(sig.data);
+  }
+  if (!sock_.write_frame(w.out)) {
+    sock_.close();
+    return std::nullopt;
+  }
+
+  Bytes reply;
+  if (!sock_.read_frame(&reply)) {
+    sock_.close();
+    return std::nullopt;
+  }
+  try {
+    Reader r(reply);
+    uint8_t opcode = r.u8();
+    uint32_t got_rid = r.u32();
+    uint32_t n = r.u32();
+    if (opcode != kOpVerifyBatch || got_rid != rid || n != votes.size()) {
+      LOG_WARN("crypto::sidecar") << "protocol mismatch from sidecar";
+      sock_.close();
+      return std::nullopt;
+    }
+    std::vector<bool> mask(n);
+    for (uint32_t i = 0; i < n; i++) mask[i] = r.u8() != 0;
+    return mask;
+  } catch (const SerdeError&) {
+    sock_.close();
+    return std::nullopt;
+  }
+}
+
+}  // namespace hotstuff

@@ -1,0 +1,48 @@
+// C++ client for the TPU verify sidecar (hotstuff_tpu/sidecar/service.py).
+// This is the device-dispatch half of the crypto boundary: QC batch
+// verification ships (digest, pk, sig) records to the JAX process over
+// localhost TCP and gets back a validity mask — replacing the in-process
+// dalek::verify_batch call of the reference (crypto/src/lib.rs:210-223).
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <optional>
+#include <utility>
+#include <vector>
+
+#include "network/socket.hpp"
+
+namespace hotstuff {
+
+struct Digest;
+struct PublicKey;
+struct Signature;
+
+class TpuVerifier {
+ public:
+  explicit TpuVerifier(const Address& addr);
+
+  // Process-wide instance used by Signature::verify_batch. Install once at
+  // node startup (Node::new does when parameters carry a sidecar address).
+  static TpuVerifier* instance();
+  static void install(std::unique_ptr<TpuVerifier> v);
+
+  bool connected();
+
+  // Returns nullopt on transport failure (caller falls back to host verify).
+  std::optional<std::vector<bool>> verify_batch(
+      const Digest& digest,
+      const std::vector<std::pair<PublicKey, Signature>>& votes);
+
+ private:
+  bool ensure_connected_locked();
+
+  Address addr_;
+  std::mutex m_;
+  Socket sock_;
+  uint32_t next_id_ = 0;
+  bool ever_connected_ = false;
+};
+
+}  // namespace hotstuff

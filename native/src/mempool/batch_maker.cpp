@@ -1,0 +1,94 @@
+#include "mempool/batch_maker.hpp"
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+namespace {
+
+void seal_and_send(Batch* current, size_t* current_size,
+                   ReliableSender* network,
+                   const std::vector<std::pair<PublicKey, Address>>& peers,
+                   Channel<QuorumWaiterMessage>* tx_message) {
+  size_t size = *current_size;
+
+  // Sample txs start with 0; their id is the next 8 bytes big-endian
+  // (node/src/client.rs:126-133 convention, kept for the log parser).
+  std::vector<uint64_t> tx_ids;
+  for (const auto& tx : *current) {
+    if (!tx.empty() && tx[0] == 0 && tx.size() > 8) {
+      uint64_t id = 0;
+      for (int i = 0; i < 8; i++) id = (id << 8) | tx[1 + i];
+      tx_ids.push_back(id);
+    }
+  }
+
+  Batch batch;
+  batch.swap(*current);
+  *current_size = 0;
+  Bytes serialized = MempoolMessage::make_batch(std::move(batch)).serialize();
+
+  // NOTE: These log entries are used to compute performance
+  // (hotstuff_tpu/harness/logs.py mines them; format frozen).
+  Digest digest = sha512_digest(serialized);
+  for (uint64_t id : tx_ids) {
+    LOG_INFO("mempool::batch_maker")
+        << "Batch " << digest.to_base64() << " contains sample tx " << id;
+  }
+  LOG_INFO("mempool::batch_maker")
+      << "Batch " << digest.to_base64() << " contains " << size << " B";
+
+  std::vector<Address> addresses;
+  addresses.reserve(peers.size());
+  for (const auto& [_, addr] : peers) addresses.push_back(addr);
+  auto handlers = network->broadcast(addresses, serialized);
+
+  QuorumWaiterMessage msg;
+  msg.batch = std::move(serialized);
+  for (size_t i = 0; i < peers.size(); i++) {
+    msg.handlers.emplace_back(peers[i].first, std::move(handlers[i]));
+  }
+  tx_message->send(std::move(msg));
+}
+
+}  // namespace
+
+void BatchMaker::spawn(
+    size_t batch_size, uint64_t max_batch_delay,
+    ChannelPtr<Transaction> rx_transaction,
+    ChannelPtr<QuorumWaiterMessage> tx_message,
+    std::vector<std::pair<PublicKey, Address>> mempool_addresses) {
+  std::thread([batch_size, max_batch_delay, rx_transaction, tx_message,
+               peers = std::move(mempool_addresses)] {
+    ReliableSender network;
+    Batch current;
+    size_t current_size = 0;
+    auto delay = std::chrono::milliseconds(max_batch_delay);
+    auto deadline = std::chrono::steady_clock::now() + delay;
+
+    while (true) {
+      Transaction tx;
+      auto status = rx_transaction->recv_until(&tx, deadline);
+      if (status == RecvStatus::kClosed) return;
+      if (status == RecvStatus::kTimeout) {
+        if (!current.empty()) {
+          seal_and_send(&current, &current_size, &network, peers,
+                        tx_message.get());
+        }
+        deadline = std::chrono::steady_clock::now() + delay;
+        continue;
+      }
+      current_size += tx.size();
+      current.push_back(std::move(tx));
+      if (current_size >= batch_size) {
+        seal_and_send(&current, &current_size, &network, peers,
+                      tx_message.get());
+        deadline = std::chrono::steady_clock::now() + delay;
+      }
+    }
+  }).detach();
+}
+
+}  // namespace mempool
+}  // namespace hotstuff

@@ -1,0 +1,34 @@
+// BatchMaker: accumulates client transactions into batches sealed at
+// batch_size bytes or max_batch_delay ms, broadcasts each sealed batch to
+// all peers via the reliable sender, and hands the serialized batch plus the
+// broadcast ACK handlers to the QuorumWaiter
+// (mempool/src/batch_maker.rs:27-168 in the reference).
+#pragma once
+
+#include <memory>
+#include <thread>
+
+#include "common/channel.hpp"
+#include "mempool/config.hpp"
+#include "mempool/messages.hpp"
+#include "network/reliable_sender.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+struct QuorumWaiterMessage {
+  Bytes batch;  // serialized MempoolMessage::Batch
+  std::vector<std::pair<PublicKey, CancelHandler>> handlers;
+};
+
+class BatchMaker {
+ public:
+  static void spawn(size_t batch_size, uint64_t max_batch_delay,
+                    ChannelPtr<Transaction> rx_transaction,
+                    ChannelPtr<QuorumWaiterMessage> tx_message,
+                    std::vector<std::pair<PublicKey, Address>>
+                        mempool_addresses);
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

@@ -1,0 +1,117 @@
+// Mempool configuration: tunables + committee address book with stake
+// accounting (mempool/src/config.rs:8-115 in the reference). JSON schemas
+// match the harness writers (hotstuff_tpu/harness/config.py).
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "common/json.hpp"
+#include "common/log.hpp"
+#include "crypto/crypto.hpp"
+#include "network/socket.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+using Stake = uint32_t;
+using Round = uint64_t;
+
+struct Parameters {
+  Round gc_depth = 50;
+  uint64_t sync_retry_delay = 5'000;  // ms
+  size_t sync_retry_nodes = 3;
+  size_t batch_size = 500'000;  // bytes
+  uint64_t max_batch_delay = 100;  // ms
+
+  static Parameters from_json(const Json& j) {
+    Parameters p;
+    if (auto* v = j.find("gc_depth")) p.gc_depth = v->as_u64();
+    if (auto* v = j.find("sync_retry_delay")) p.sync_retry_delay = v->as_u64();
+    if (auto* v = j.find("sync_retry_nodes")) {
+      p.sync_retry_nodes = size_t(v->as_u64());
+    }
+    if (auto* v = j.find("batch_size")) p.batch_size = size_t(v->as_u64());
+    if (auto* v = j.find("max_batch_delay")) p.max_batch_delay = v->as_u64();
+    return p;
+  }
+
+  void log() const {
+    // NOTE: These log entries are used to compute performance
+    // (hotstuff_tpu/harness/logs.py config regexes).
+    LOG_INFO("mempool::config")
+        << "Garbage collection depth set to " << gc_depth << " rounds";
+    LOG_INFO("mempool::config")
+        << "Sync retry delay set to " << sync_retry_delay << " ms";
+    LOG_INFO("mempool::config")
+        << "Sync retry nodes set to " << sync_retry_nodes << " nodes";
+    LOG_INFO("mempool::config") << "Batch size set to " << batch_size << " B";
+    LOG_INFO("mempool::config")
+        << "Max batch delay set to " << max_batch_delay << " ms";
+  }
+};
+
+struct Authority {
+  Stake stake = 1;
+  Address transactions_address;  // client-facing (:front)
+  Address mempool_address;       // peer-facing
+};
+
+class Committee {
+ public:
+  Committee() = default;
+  Committee(std::map<PublicKey, Authority> authorities, uint64_t epoch)
+      : authorities_(std::move(authorities)), epoch_(epoch) {}
+
+  static Committee from_json(const Json& j);
+  Json to_json() const;
+
+  size_t size() const { return authorities_.size(); }
+  Stake stake(const PublicKey& name) const {
+    auto it = authorities_.find(name);
+    return it == authorities_.end() ? 0 : it->second.stake;
+  }
+
+  Stake total_stake() const {
+    Stake total = 0;
+    for (const auto& [_, a] : authorities_) total += a.stake;
+    return total;
+  }
+
+  // 2f+1 equivalent: 2N/3 + 1 (mempool/src/config.rs:90-95).
+  Stake quorum_threshold() const { return 2 * total_stake() / 3 + 1; }
+
+  std::optional<Address> transactions_address(const PublicKey& name) const {
+    auto it = authorities_.find(name);
+    if (it == authorities_.end()) return std::nullopt;
+    return it->second.transactions_address;
+  }
+
+  std::optional<Address> mempool_address(const PublicKey& name) const {
+    auto it = authorities_.find(name);
+    if (it == authorities_.end()) return std::nullopt;
+    return it->second.mempool_address;
+  }
+
+  // All peers' mempool addresses except ours.
+  std::vector<std::pair<PublicKey, Address>> broadcast_addresses(
+      const PublicKey& myself) const {
+    std::vector<std::pair<PublicKey, Address>> out;
+    for (const auto& [name, a] : authorities_) {
+      if (name != myself) out.emplace_back(name, a.mempool_address);
+    }
+    return out;
+  }
+
+  const std::map<PublicKey, Authority>& authorities() const {
+    return authorities_;
+  }
+
+ private:
+  std::map<PublicKey, Authority> authorities_;
+  uint64_t epoch_ = 1;
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

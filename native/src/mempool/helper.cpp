@@ -1,0 +1,37 @@
+#include "mempool/helper.hpp"
+
+#include <thread>
+
+#include "common/log.hpp"
+#include "network/simple_sender.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+void Helper::spawn(
+    Committee committee, Store store,
+    ChannelPtr<std::pair<std::vector<Digest>, PublicKey>> rx_request) {
+  std::thread([committee = std::move(committee), store, rx_request]() mutable {
+    SimpleSender network;
+    while (auto req = rx_request->recv()) {
+      const auto& [digests, origin] = *req;
+      auto address = committee.mempool_address(origin);
+      if (!address) {
+        LOG_WARN("mempool::helper")
+            << "Received batch request from unknown authority: "
+            << origin.to_base64();
+        continue;
+      }
+      for (const auto& digest : digests) {
+        auto value = store.read(digest.to_bytes());
+        if (value) {
+          // Stored value is already a serialized MempoolMessage::Batch.
+          network.send(*address, std::move(*value));
+        }
+      }
+    }
+  }).detach();
+}
+
+}  // namespace mempool
+}  // namespace hotstuff

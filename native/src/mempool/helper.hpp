@@ -1,0 +1,21 @@
+// Mempool helper: serves BatchRequest messages by reading the requested
+// batches from storage and sending them back to the requestor
+// (mempool/src/helper.rs:14-68 in the reference).
+#pragma once
+
+#include "common/channel.hpp"
+#include "mempool/config.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+class Helper {
+ public:
+  static void spawn(
+      Committee committee, Store store,
+      ChannelPtr<std::pair<std::vector<Digest>, PublicKey>> rx_request);
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

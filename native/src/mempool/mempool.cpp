@@ -1,0 +1,98 @@
+#include "mempool/mempool.hpp"
+
+#include "common/log.hpp"
+#include "mempool/batch_maker.hpp"
+#include "mempool/helper.hpp"
+#include "mempool/processor.hpp"
+#include "mempool/quorum_waiter.hpp"
+#include "mempool/synchronizer.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+std::unique_ptr<Mempool> Mempool::spawn(
+    PublicKey name, Committee committee, Parameters parameters, Store store,
+    ChannelPtr<ConsensusMempoolMessage> rx_consensus,
+    ChannelPtr<Digest> tx_consensus) {
+  parameters.log();
+
+  auto mp = std::unique_ptr<Mempool>(new Mempool());
+
+  auto tx_batch_maker = make_channel<Transaction>();
+  auto tx_quorum_waiter = make_channel<QuorumWaiterMessage>();
+  auto tx_processor = make_channel<Bytes>();       // our own acked batches
+  auto tx_peer_processor = make_channel<Bytes>();  // peers' batches
+  auto tx_helper =
+      make_channel<std::pair<std::vector<Digest>, PublicKey>>();
+
+  Synchronizer::spawn(name, committee, store, parameters.gc_depth,
+                      parameters.sync_retry_delay,
+                      parameters.sync_retry_nodes, rx_consensus);
+
+  // Client transaction ingress (:front). No ACKs.
+  auto tx_address = committee.transactions_address(name);
+  if (!tx_address) throw std::runtime_error("our key is not in the committee");
+  if (!mp->tx_receiver_.spawn(
+          *tx_address,
+          [tx_batch_maker](ConnectionWriter&, Bytes msg) {
+            tx_batch_maker->send(std::move(msg));
+            return true;
+          },
+          "mempool::tx_receiver")) {
+    throw std::runtime_error("failed to bind " + tx_address->str());
+  }
+  LOG_INFO("mempool::mempool")
+      << "Mempool listening to client transactions on " << tx_address->str();
+
+  BatchMaker::spawn(parameters.batch_size, parameters.max_batch_delay,
+                    tx_batch_maker, tx_quorum_waiter,
+                    committee.broadcast_addresses(name));
+
+  QuorumWaiter::spawn(committee, committee.stake(name), tx_quorum_waiter,
+                      tx_processor);
+
+  // Two processors as in the reference (mempool.rs:147-151, 185-189): one
+  // for our quorum-acked batches, one for batches received from peers.
+  Processor::spawn(store, tx_processor, tx_consensus);
+  Processor::spawn(store, tx_peer_processor, tx_consensus);
+
+  // Peer ingress (:mempool). ACK every message, then route by type
+  // (mempool.rs:225-243).
+  auto peer_address = committee.mempool_address(name);
+  if (!mp->peer_receiver_.spawn(
+          *peer_address,
+          [tx_peer_processor, tx_helper](ConnectionWriter& writer,
+                                         Bytes msg) {
+            writer.send(std::string("Ack"));
+            try {
+              MempoolMessage m = MempoolMessage::deserialize(msg);
+              if (m.kind == MempoolMessage::Kind::kBatch) {
+                tx_peer_processor->send(std::move(msg));
+              } else {
+                tx_helper->send({std::move(m.missing), m.origin});
+              }
+            } catch (const std::exception& e) {
+              // Parse errors on peer bytes must not escape the connection
+              // thread (std::terminate would take the node down).
+              LOG_WARN("mempool::mempool")
+                  << "Serialization failure: " << e.what();
+            }
+            return true;
+          },
+          "mempool::peer_receiver")) {
+    throw std::runtime_error("failed to bind " + peer_address->str());
+  }
+  LOG_INFO("mempool::mempool")
+      << "Mempool listening to mempool messages on " << peer_address->str();
+
+  Helper::spawn(committee, store, tx_helper);
+
+  LOG_INFO("mempool::mempool")
+      << "Mempool successfully booted on " << peer_address->host;
+  return mp;
+}
+
+Mempool::~Mempool() = default;
+
+}  // namespace mempool
+}  // namespace hotstuff

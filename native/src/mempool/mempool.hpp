@@ -1,0 +1,40 @@
+// Mempool facade: spawns the full actor pipeline — client-tx receiver →
+// BatchMaker → QuorumWaiter → Processor → consensus, peer receiver →
+// Processor/Helper, and the Synchronizer servicing consensus commands
+// (mempool/src/mempool.rs:44-193 in the reference).
+#pragma once
+
+#include <memory>
+
+#include "common/channel.hpp"
+#include "mempool/config.hpp"
+#include "mempool/messages.hpp"
+#include "network/receiver.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+class Mempool {
+ public:
+  // tx_consensus carries batch digests into the consensus proposer;
+  // rx_consensus carries Synchronize/Cleanup commands back.
+  static std::unique_ptr<Mempool> spawn(
+      PublicKey name, Committee committee, Parameters parameters, Store store,
+      ChannelPtr<ConsensusMempoolMessage> rx_consensus,
+      ChannelPtr<Digest> tx_consensus);
+
+  ~Mempool();
+
+  NetworkReceiver& tx_receiver() { return tx_receiver_; }
+  NetworkReceiver& peer_receiver() { return peer_receiver_; }
+
+ private:
+  Mempool() = default;
+
+  NetworkReceiver tx_receiver_;
+  NetworkReceiver peer_receiver_;
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

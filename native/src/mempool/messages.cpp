@@ -1,0 +1,89 @@
+#include "mempool/messages.hpp"
+
+#include "mempool/config.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+Bytes MempoolMessage::serialize() const {
+  Writer w;
+  w.tag(static_cast<uint32_t>(kind));
+  switch (kind) {
+    case Kind::kBatch:
+      w.u64(batch.size());
+      for (const auto& tx : batch) w.bytes(tx);
+      break;
+    case Kind::kBatchRequest:
+      w.u64(missing.size());
+      for (const auto& d : missing) d.serialize(&w);
+      origin.serialize(&w);
+      break;
+  }
+  return std::move(w.out);
+}
+
+MempoolMessage MempoolMessage::deserialize(const Bytes& data) {
+  Reader r(data);
+  MempoolMessage m;
+  uint32_t tag = r.tag();
+  switch (tag) {
+    case 0: {
+      m.kind = Kind::kBatch;
+      uint64_t n = r.seq_len(8);
+      m.batch.reserve(n);
+      for (uint64_t i = 0; i < n; i++) m.batch.push_back(r.bytes());
+      break;
+    }
+    case 1: {
+      m.kind = Kind::kBatchRequest;
+      uint64_t n = r.seq_len(32);
+      m.missing.reserve(n);
+      for (uint64_t i = 0; i < n; i++) {
+        m.missing.push_back(Digest::deserialize(&r));
+      }
+      m.origin = PublicKey::deserialize(&r);
+      break;
+    }
+    default:
+      throw SerdeError("bad MempoolMessage tag");
+  }
+  return m;
+}
+
+Json Committee::to_json() const {
+  Json auths = Json::object();
+  for (const auto& [name, a] : authorities_) {
+    Json entry = Json::object();
+    entry.set("stake", Json(int64_t(a.stake)));
+    entry.set("transactions_address", Json(a.transactions_address.str()));
+    entry.set("mempool_address", Json(a.mempool_address.str()));
+    auths.set(name.to_base64(), std::move(entry));
+  }
+  Json j = Json::object();
+  j.set("authorities", std::move(auths));
+  j.set("epoch", Json(int64_t(epoch_)));
+  return j;
+}
+
+Committee Committee::from_json(const Json& j) {
+  std::map<PublicKey, Authority> authorities;
+  for (const auto& [name_b64, entry] : j.at("authorities").members()) {
+    PublicKey name;
+    if (!PublicKey::from_base64(name_b64, &name)) {
+      throw JsonError("bad public key in mempool committee: " + name_b64);
+    }
+    Authority a;
+    a.stake = static_cast<Stake>(entry.at("stake").as_u64());
+    auto ta = Address::parse(entry.at("transactions_address").as_string());
+    auto ma = Address::parse(entry.at("mempool_address").as_string());
+    if (!ta || !ma) throw JsonError("bad address in mempool committee");
+    a.transactions_address = *ta;
+    a.mempool_address = *ma;
+    authorities.emplace(name, std::move(a));
+  }
+  uint64_t epoch = j.find("epoch") ? j.at("epoch").as_u64() : 1;
+  return Committee(std::move(authorities), epoch);
+}
+
+}  // namespace mempool
+}  // namespace hotstuff

@@ -1,0 +1,55 @@
+// Wire messages between mempools + the consensus-to-mempool command type
+// (mempool/src/mempool.rs:29-42 in the reference).
+#pragma once
+
+#include <vector>
+
+#include "common/serde.hpp"
+#include "crypto/crypto.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+using Transaction = Bytes;
+using Batch = std::vector<Transaction>;
+
+struct MempoolMessage {
+  enum class Kind : uint32_t { kBatch = 0, kBatchRequest = 1 };
+
+  Kind kind;
+  Batch batch;                   // kBatch
+  std::vector<Digest> missing;   // kBatchRequest
+  PublicKey origin;              // kBatchRequest
+
+  static MempoolMessage make_batch(Batch b) {
+    MempoolMessage m;
+    m.kind = Kind::kBatch;
+    m.batch = std::move(b);
+    return m;
+  }
+
+  static MempoolMessage make_batch_request(std::vector<Digest> missing,
+                                           const PublicKey& origin) {
+    MempoolMessage m;
+    m.kind = Kind::kBatchRequest;
+    m.missing = std::move(missing);
+    m.origin = origin;
+    return m;
+  }
+
+  Bytes serialize() const;
+  static MempoolMessage deserialize(const Bytes& data);
+};
+
+// Commands the consensus sends to its mempool (Synchronize / Cleanup).
+struct ConsensusMempoolMessage {
+  enum class Kind { kSynchronize, kCleanup };
+
+  Kind kind;
+  std::vector<Digest> digests;  // kSynchronize
+  PublicKey target;             // kSynchronize
+  uint64_t round = 0;           // kCleanup
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

@@ -1,0 +1,20 @@
+// Processor: hashes each quorum-acked (or peer-received) serialized batch,
+// persists it, and forwards the digest to consensus
+// (mempool/src/processor.rs:16-39 in the reference).
+#pragma once
+
+#include "common/channel.hpp"
+#include "crypto/crypto.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+class Processor {
+ public:
+  static void spawn(Store store, ChannelPtr<Bytes> rx_batch,
+                    ChannelPtr<Digest> tx_digest);
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

@@ -1,0 +1,40 @@
+#include "mempool/quorum_waiter.hpp"
+
+#include <condition_variable>
+#include <mutex>
+#include <thread>
+
+namespace hotstuff {
+namespace mempool {
+
+void QuorumWaiter::spawn(Committee committee, Stake my_stake,
+                         ChannelPtr<QuorumWaiterMessage> rx_message,
+                         ChannelPtr<Bytes> tx_batch) {
+  std::thread([committee = std::move(committee), my_stake, rx_message,
+               tx_batch] {
+    while (auto msg = rx_message->recv()) {
+      // Stake accumulates as ACKs arrive in any order (the reference's
+      // FuturesUnordered wait, quorum_waiter.rs:60-86): each handler's
+      // on_ready callback bumps a shared counter; we sleep until quorum.
+      auto m = std::make_shared<std::mutex>();
+      auto cv = std::make_shared<std::condition_variable>();
+      auto total = std::make_shared<Stake>(my_stake);
+      for (const auto& [name, handler] : msg->handlers) {
+        Stake stake = committee.stake(name);
+        handler.on_ready([m, cv, total, stake](const Bytes&) {
+          std::lock_guard<std::mutex> lk(*m);
+          *total += stake;
+          cv->notify_one();
+        });
+      }
+      Stake quorum = committee.quorum_threshold();
+      std::unique_lock<std::mutex> lk(*m);
+      cv->wait(lk, [&] { return *total >= quorum; });
+      lk.unlock();
+      tx_batch->send(std::move(msg->batch));
+    }
+  }).detach();
+}
+
+}  // namespace mempool
+}  // namespace hotstuff

@@ -1,0 +1,21 @@
+// QuorumWaiter: holds each sealed batch until peers with 2f+1 cumulative
+// stake (including our own) have ACKed the broadcast, then releases it for
+// processing (mempool/src/quorum_waiter.rs:22-88 in the reference).
+#pragma once
+
+#include "common/channel.hpp"
+#include "mempool/batch_maker.hpp"
+#include "mempool/config.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+class QuorumWaiter {
+ public:
+  static void spawn(Committee committee, Stake my_stake,
+                    ChannelPtr<QuorumWaiterMessage> rx_message,
+                    ChannelPtr<Bytes> tx_batch);
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

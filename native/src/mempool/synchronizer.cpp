@@ -1,0 +1,126 @@
+#include "mempool/synchronizer.hpp"
+
+#include <chrono>
+#include <map>
+#include <thread>
+
+#include "common/log.hpp"
+#include "network/simple_sender.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+namespace {
+constexpr auto kTimerResolution = std::chrono::milliseconds(1000);
+
+uint64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+void Synchronizer::spawn(PublicKey name, Committee committee, Store store,
+                         Round gc_depth, uint64_t sync_retry_delay,
+                         size_t sync_retry_nodes,
+                         ChannelPtr<ConsensusMempoolMessage> rx_message) {
+  std::thread([name, committee = std::move(committee), store, gc_depth,
+               sync_retry_delay, sync_retry_nodes, rx_message]() mutable {
+    SimpleSender network;
+    // Internal completion channel: notify_read callbacks push the digest
+    // that arrived (replacing the reference's FuturesUnordered stream).
+    // Unbounded so store-thread callbacks never block and no arrival is
+    // dropped (a lost arrival would leave a stale pending entry retried
+    // via lucky_broadcast forever).
+    auto arrived = make_channel<Digest>(SIZE_MAX);
+    // digest -> (round it was requested at, request timestamp ms)
+    std::map<Digest, std::pair<Round, uint64_t>> pending;
+    Round round = 0;
+    auto deadline = std::chrono::steady_clock::now() + kTimerResolution;
+
+    while (true) {
+      // Drain arrivals without blocking.
+      Digest done;
+      while (arrived->recv_until(
+                 &done, std::chrono::steady_clock::now()) ==
+             RecvStatus::kOk) {
+        pending.erase(done);
+      }
+
+      ConsensusMempoolMessage msg;
+      auto status = rx_message->recv_until(&msg, deadline);
+      if (status == RecvStatus::kClosed) return;
+      if (status == RecvStatus::kTimeout) {
+        // Retry stale requests via lucky broadcast
+        // (mempool/src/synchronizer.rs:175-206).
+        std::vector<Digest> retry;
+        uint64_t now = now_ms();
+        for (const auto& [digest, info] : pending) {
+          if (info.second + sync_retry_delay < now) {
+            LOG_DEBUG("mempool::synchronizer")
+                << "Requesting sync for batch " << digest.to_base64()
+                << " (retry)";
+            retry.push_back(digest);
+          }
+        }
+        if (!retry.empty()) {
+          std::vector<Address> addresses;
+          for (const auto& [_, addr] : committee.broadcast_addresses(name)) {
+            addresses.push_back(addr);
+          }
+          Bytes serialized =
+              MempoolMessage::make_batch_request(retry, name).serialize();
+          network.lucky_broadcast(addresses, serialized, sync_retry_nodes);
+        }
+        deadline = std::chrono::steady_clock::now() + kTimerResolution;
+        continue;
+      }
+
+      switch (msg.kind) {
+        case ConsensusMempoolMessage::Kind::kSynchronize: {
+          uint64_t now = now_ms();
+          std::vector<Digest> missing;
+          for (const auto& digest : msg.digests) {
+            if (pending.count(digest)) continue;
+            missing.push_back(digest);
+            LOG_DEBUG("mempool::synchronizer")
+                << "Requesting sync for batch " << digest.to_base64();
+            pending.emplace(digest, std::make_pair(round, now));
+            store.notify_read(digest.to_bytes())
+                .on_ready([arrived, digest](const Bytes&) {
+                  arrived->send(digest);  // unbounded: never blocks
+                });
+          }
+          if (missing.empty()) break;
+          auto address = committee.mempool_address(msg.target);
+          if (!address) {
+            LOG_ERROR("mempool::synchronizer")
+                << "consensus asked us to sync with an unknown node: "
+                << msg.target.to_base64();
+            break;
+          }
+          Bytes serialized =
+              MempoolMessage::make_batch_request(missing, name).serialize();
+          network.send(*address, std::move(serialized));
+          break;
+        }
+        case ConsensusMempoolMessage::Kind::kCleanup: {
+          round = msg.round;
+          if (round < gc_depth) break;
+          Round gc_round = round - gc_depth;
+          for (auto it = pending.begin(); it != pending.end();) {
+            if (it->second.first <= gc_round) {
+              it = pending.erase(it);
+            } else {
+              ++it;
+            }
+          }
+          break;
+        }
+      }
+    }
+  }).detach();
+}
+
+}  // namespace mempool
+}  // namespace hotstuff

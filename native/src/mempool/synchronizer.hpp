@@ -1,0 +1,25 @@
+// Mempool synchronizer: on Synchronize(digests, target) it registers
+// notify_read waiters for the missing batches and sends a BatchRequest to
+// the block author; a 1 s timer rebroadcasts stale requests to a few random
+// peers; Cleanup garbage-collects by round depth
+// (mempool/src/synchronizer.rs:23-210 in the reference).
+#pragma once
+
+#include "common/channel.hpp"
+#include "mempool/config.hpp"
+#include "mempool/messages.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+class Synchronizer {
+ public:
+  static void spawn(PublicKey name, Committee committee, Store store,
+                    Round gc_depth, uint64_t sync_retry_delay,
+                    size_t sync_retry_nodes,
+                    ChannelPtr<ConsensusMempoolMessage> rx_message);
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

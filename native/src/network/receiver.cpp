@@ -1,0 +1,62 @@
+#include "network/receiver.hpp"
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+
+bool NetworkReceiver::spawn(const Address& address, MessageHandler handler,
+                            const std::string& log_module) {
+  auto l = Listener::bind(address);
+  if (!l) {
+    LOG_ERROR(log_module) << "failed to bind " << address.str();
+    return false;
+  }
+  listener_ = std::move(*l);
+  LOG_DEBUG(log_module) << "Listening on " << address.str();
+
+  auto registry = registry_;
+  accept_thread_ = std::thread([this, registry, handler, log_module] {
+    while (!stopping_.load()) {
+      auto sock = listener_.accept();
+      if (!sock) {
+        if (stopping_.load()) return;
+        // Persistent accept failures (e.g. EMFILE) must not busy-spin.
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        continue;
+      }
+      auto sp = std::make_shared<Socket>(std::move(*sock));
+      uint64_t id;
+      {
+        std::lock_guard<std::mutex> lk(registry->m);
+        id = registry->next_id++;
+        registry->conns.emplace(id, sp);
+      }
+      // Detached; self-removes from the registry on exit so long-running
+      // nodes don't accumulate per-connection state.
+      std::thread([registry, id, sp, handler] {
+        ConnectionWriter writer(sp.get());
+        Bytes frame;
+        while (sp->read_frame(&frame)) {
+          if (!handler(writer, std::move(frame))) break;
+          frame.clear();
+        }
+        std::lock_guard<std::mutex> lk(registry->m);
+        registry->conns.erase(id);
+      }).detach();
+    }
+  });
+  return true;
+}
+
+void NetworkReceiver::stop() {
+  if (stopping_.exchange(true)) return;
+  listener_.shutdown();
+  if (accept_thread_.joinable()) accept_thread_.join();
+  listener_.close();
+  // Shut down live connections; their detached threads hold the socket and
+  // registry shared_ptrs and unregister themselves as they exit.
+  std::lock_guard<std::mutex> lk(registry_->m);
+  for (auto& [_, s] : registry_->conns) s->shutdown();
+}
+
+}  // namespace hotstuff

@@ -1,0 +1,74 @@
+// Network receiver: accept loop + one reader thread per connection, each
+// message dispatched through a MessageHandler that may write reply frames
+// (ACKs) back on the same connection — the reference's Receiver<Handler>
+// (network/src/receiver.rs:31-89) in thread form.
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "common/bytes.hpp"
+#include "network/socket.hpp"
+
+namespace hotstuff {
+
+// Reply-capable view of a connection handed to handlers (the Writer half of
+// the reference's split framed transport).
+class ConnectionWriter {
+ public:
+  explicit ConnectionWriter(Socket* sock) : sock_(sock) {}
+
+  bool send(const Bytes& frame) {
+    std::lock_guard<std::mutex> lk(m_);
+    return sock_->write_frame(frame);
+  }
+  bool send(const std::string& s) {
+    std::lock_guard<std::mutex> lk(m_);
+    return sock_->write_frame(reinterpret_cast<const uint8_t*>(s.data()),
+                              s.size());
+  }
+
+ private:
+  std::mutex m_;
+  Socket* sock_;
+};
+
+// dispatch(writer, message): return false to drop the connection.
+using MessageHandler =
+    std::function<bool(ConnectionWriter&, Bytes)>;
+
+class NetworkReceiver {
+ public:
+  NetworkReceiver() = default;
+  ~NetworkReceiver() { stop(); }
+  NetworkReceiver(const NetworkReceiver&) = delete;
+
+  // Binds and spawns the accept loop. Returns false if bind fails.
+  bool spawn(const Address& address, MessageHandler handler,
+             const std::string& log_module = "network::receiver");
+
+  uint16_t port() const { return listener_.port(); }
+  void stop();
+
+ private:
+  // Live connection sockets, keyed for self-removal when a connection
+  // thread exits. Shared with the detached connection threads so they never
+  // touch the receiver object itself (which may be destroyed first).
+  struct ConnRegistry {
+    std::mutex m;
+    uint64_t next_id = 0;
+    std::unordered_map<uint64_t, std::shared_ptr<Socket>> conns;
+  };
+
+  Listener listener_;
+  std::thread accept_thread_;
+  std::atomic<bool> stopping_{false};
+  std::shared_ptr<ConnRegistry> registry_ =
+      std::make_shared<ConnRegistry>();
+};
+
+}  // namespace hotstuff

@@ -1,0 +1,160 @@
+#include "network/reliable_sender.hpp"
+
+#include <atomic>
+#include <chrono>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+
+namespace {
+constexpr auto kInitialBackoff = std::chrono::milliseconds(200);
+constexpr auto kMaxBackoff = std::chrono::milliseconds(60'000);
+}  // namespace
+
+// One long-lived connection task per peer. The writer loop pulls from the
+// queue and sends; a per-socket reader matches incoming ACK frames to the
+// oldest in-flight message (FIFO, as the reference's pending_replies deque,
+// reliable_sender.rs:214-238). On any socket error both halves tear down,
+// un-ACKed messages are queued for retransmission, and the connect loop
+// backs off exponentially.
+struct ReliableSender::Connection {
+  struct Msg {
+    // Shared so broadcast fan-out and the pending/retransmit queues never
+    // deep-copy the payload (the reference's refcounted bytes::Bytes).
+    std::shared_ptr<const Bytes> data;
+    CancelHandler ack;
+  };
+
+  explicit Connection(const Address& addr)
+      : address(addr), queue(kChannelCapacity) {}
+
+  void start(std::shared_ptr<Connection> self) {
+    std::thread([self] { self->run(); }).detach();
+  }
+
+  void run() {
+    auto backoff = kInitialBackoff;
+    std::deque<Msg> retransmit;
+    while (true) {
+      // -- connect (with backoff) ----------------------------------------
+      auto sock_opt = Socket::connect(address);
+      if (!sock_opt) {
+        LOG_DEBUG("network::reliable_sender")
+            << "failed to connect to " << address.str() << "; retrying in "
+            << backoff.count() << " ms";
+        std::this_thread::sleep_for(backoff);
+        backoff = std::min(backoff * 2, kMaxBackoff);
+        continue;
+      }
+      backoff = kInitialBackoff;
+      LOG_DEBUG("network::reliable_sender")
+          << "Outgoing connection established with " << address.str();
+
+      auto sock = std::make_shared<Socket>(std::move(*sock_opt));
+      auto pending = std::make_shared<std::deque<Msg>>();
+      auto pending_m = std::make_shared<std::mutex>();
+      auto broken = std::make_shared<std::atomic<bool>>(false);
+
+      // -- reader: match ACK frames to in-flight messages ----------------
+      std::thread reader([sock, pending, pending_m, broken] {
+        Bytes frame;
+        while (sock->read_frame(&frame)) {
+          std::lock_guard<std::mutex> lk(*pending_m);
+          if (!pending->empty()) {
+            pending->front().ack.set(std::move(frame));
+            pending->pop_front();
+          }
+          frame.clear();
+        }
+        broken->store(true);
+        sock->shutdown();
+      });
+
+      // -- retransmit backlog from the previous socket -------------------
+      bool ok = true;
+      while (ok && !retransmit.empty()) {
+        Msg m = std::move(retransmit.front());
+        retransmit.pop_front();
+        auto data = m.data;
+        {
+          std::lock_guard<std::mutex> lk(*pending_m);
+          pending->push_back(std::move(m));
+        }
+        ok = sock->write_frame(*data);
+      }
+
+      // -- writer loop ---------------------------------------------------
+      while (ok && !broken->load()) {
+        Msg m;
+        auto status = queue.recv_until(
+            &m, std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(100));
+        if (status == RecvStatus::kClosed) return;
+        if (status == RecvStatus::kTimeout) continue;
+        auto data = m.data;
+        {
+          std::lock_guard<std::mutex> lk(*pending_m);
+          pending->push_back(std::move(m));
+        }
+        ok = sock->write_frame(*data);
+      }
+
+      // -- teardown: recover un-ACKed messages ---------------------------
+      sock->shutdown();
+      reader.join();
+      {
+        std::lock_guard<std::mutex> lk(*pending_m);
+        for (auto& m : *pending) retransmit.push_back(std::move(m));
+        pending->clear();
+      }
+      LOG_DEBUG("network::reliable_sender")
+          << "connection to " << address.str() << " dropped; "
+          << retransmit.size() << " message(s) to retransmit";
+    }
+  }
+
+  Address address;
+  Channel<Msg> queue;
+};
+
+ReliableSender::ReliableSender() = default;
+
+std::shared_ptr<ReliableSender::Connection> ReliableSender::get_or_spawn(
+    const Address& address) {
+  auto it = connections_.find(address);
+  if (it != connections_.end()) return it->second;
+  auto conn = std::make_shared<Connection>(address);
+  conn->start(conn);
+  connections_[address] = conn;
+  return conn;
+}
+
+CancelHandler ReliableSender::send(const Address& address, Bytes data) {
+  return send_shared(address,
+                     std::make_shared<const Bytes>(std::move(data)));
+}
+
+CancelHandler ReliableSender::send_shared(
+    const Address& address, std::shared_ptr<const Bytes> data) {
+  auto conn = get_or_spawn(address);
+  Connection::Msg m;
+  m.data = std::move(data);
+  CancelHandler handler = m.ack;
+  conn->queue.send(std::move(m));
+  return handler;
+}
+
+std::vector<CancelHandler> ReliableSender::broadcast(
+    const std::vector<Address>& addresses, const Bytes& data) {
+  auto shared = std::make_shared<const Bytes>(data);
+  std::vector<CancelHandler> handlers;
+  handlers.reserve(addresses.size());
+  for (const auto& a : addresses) handlers.push_back(send_shared(a, shared));
+  return handlers;
+}
+
+}  // namespace hotstuff

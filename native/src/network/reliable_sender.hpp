@@ -1,0 +1,39 @@
+// Reliable sender: every message returns a CancelHandler (oneshot fulfilled
+// with the peer's ACK bytes); per-peer connections retry with exponential
+// backoff (200 ms doubling to 60 s) and retransmit un-ACKed messages on
+// reconnection — the reference's ReliableSender state machine
+// (network/src/reliable_sender.rs:31-248).
+#pragma once
+
+#include <memory>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+#include "common/bytes.hpp"
+#include "common/channel.hpp"
+#include "network/socket.hpp"
+
+namespace hotstuff {
+
+using CancelHandler = Oneshot<Bytes>;
+
+class ReliableSender {
+ public:
+  ReliableSender();
+
+  CancelHandler send(const Address& address, Bytes data);
+  CancelHandler send_shared(const Address& address,
+                            std::shared_ptr<const Bytes> data);
+  std::vector<CancelHandler> broadcast(const std::vector<Address>& addresses,
+                                       const Bytes& data);
+
+ private:
+  struct Connection;
+  std::shared_ptr<Connection> get_or_spawn(const Address& address);
+
+  std::unordered_map<Address, std::shared_ptr<Connection>, AddressHash>
+      connections_;
+};
+
+}  // namespace hotstuff

@@ -1,0 +1,106 @@
+#include "network/simple_sender.hpp"
+
+#include <algorithm>
+#include <atomic>
+#include <thread>
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+
+// A connection drains its queue into one socket. On any socket error the
+// connection marks itself dead and drops remaining queued messages; the
+// next send() to that address spawns a fresh connection (reference
+// Connection::run returns on error, simple_sender.rs:105-143).
+struct SimpleSender::Connection {
+  explicit Connection(const Address& addr)
+      : address(addr), queue(kChannelCapacity) {}
+
+  void start() {
+    auto self = shared;
+    writer_thread = std::thread([self] { self->run(); });
+    writer_thread.detach();
+  }
+
+  void run() {
+    auto sock_opt = Socket::connect(address);
+    if (!sock_opt) {
+      LOG_WARN("network::simple_sender")
+          << "failed to connect to " << address.str();
+      dead.store(true);
+      queue.close();
+      shared.reset();
+      return;
+    }
+    sock = std::move(*sock_opt);
+    LOG_DEBUG("network::simple_sender")
+        << "Outgoing connection established with " << address.str();
+
+    // Sink replies so the peer's ACK writes never fill the TCP buffer.
+    auto self = shared;
+    std::thread([self] {
+      Bytes frame;
+      while (self->sock.read_frame(&frame)) {
+      }
+      self->dead.store(true);
+      self->queue.close();  // wake the writer
+    }).detach();
+
+    while (auto data = queue.recv()) {
+      if (dead.load() || !sock.write_frame(*data)) {
+        LOG_WARN("network::simple_sender")
+            << "failed to send message to " << address.str();
+        break;
+      }
+    }
+    dead.store(true);
+    queue.close();
+    sock.shutdown();
+    shared.reset();  // break the self-cycle so dead connections free
+  }
+
+  Address address;
+  Channel<Bytes> queue;
+  Socket sock;
+  std::atomic<bool> dead{false};
+  std::thread writer_thread;
+  std::shared_ptr<Connection> shared;  // set by get_or_spawn before start()
+};
+
+SimpleSender::SimpleSender() : rng_(std::random_device{}()) {}
+
+std::shared_ptr<SimpleSender::Connection> SimpleSender::get_or_spawn(
+    const Address& address) {
+  auto it = connections_.find(address);
+  if (it != connections_.end() && !it->second->dead.load()) {
+    return it->second;
+  }
+  auto conn = std::make_shared<Connection>(address);
+  conn->shared = conn;
+  conn->start();
+  connections_[address] = conn;
+  return conn;
+}
+
+void SimpleSender::send(const Address& address, Bytes data) {
+  auto conn = get_or_spawn(address);
+  if (!conn->queue.try_send(std::move(data))) {
+    // Queue full or connection died — best-effort: drop.
+    LOG_DEBUG("network::simple_sender")
+        << "dropping message to " << address.str();
+  }
+}
+
+void SimpleSender::broadcast(const std::vector<Address>& addresses,
+                             const Bytes& data) {
+  for (const auto& a : addresses) send(a, data);
+}
+
+void SimpleSender::lucky_broadcast(std::vector<Address> addresses,
+                                   const Bytes& data, size_t nodes) {
+  std::shuffle(addresses.begin(), addresses.end(), rng_);
+  if (addresses.size() > nodes) addresses.resize(nodes);
+  broadcast(addresses, data);
+}
+
+}  // namespace hotstuff

@@ -1,0 +1,38 @@
+// Best-effort sender: one connection task per peer fed by a bounded queue,
+// incoming frames (ACKs) sunk by a reader thread; failed peers drop queued
+// messages and reconnect lazily on the next send — matching the reference's
+// SimpleSender/Connection semantics (network/src/simple_sender.rs:22-143).
+#pragma once
+
+#include <memory>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+#include "common/bytes.hpp"
+#include "common/channel.hpp"
+#include "network/socket.hpp"
+
+namespace hotstuff {
+
+class SimpleSender {
+ public:
+  SimpleSender();
+
+  void send(const Address& address, Bytes data);
+  void broadcast(const std::vector<Address>& addresses, const Bytes& data);
+  // Random subset of `nodes` addresses (mempool sync retries,
+  // mempool/src/synchronizer.rs:196-204 analogue).
+  void lucky_broadcast(std::vector<Address> addresses, const Bytes& data,
+                       size_t nodes);
+
+ private:
+  struct Connection;
+  std::shared_ptr<Connection> get_or_spawn(const Address& address);
+
+  std::unordered_map<Address, std::shared_ptr<Connection>, AddressHash>
+      connections_;
+  std::mt19937 rng_;
+};
+
+}  // namespace hotstuff

@@ -1,0 +1,176 @@
+#include "network/socket.hpp"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace hotstuff {
+
+std::optional<Address> Address::parse(const std::string& s) {
+  auto colon = s.rfind(':');
+  if (colon == std::string::npos) return std::nullopt;
+  Address a;
+  a.host = s.substr(0, colon);
+  try {
+    int p = std::stoi(s.substr(colon + 1));
+    if (p < 0 || p > 65535) return std::nullopt;
+    a.port = static_cast<uint16_t>(p);
+  } catch (const std::exception&) {
+    return std::nullopt;
+  }
+  if (a.host == "localhost") a.host = "127.0.0.1";
+  return a;
+}
+
+namespace {
+
+bool fill_sockaddr(const Address& addr, sockaddr_in* sa) {
+  std::memset(sa, 0, sizeof(*sa));
+  sa->sin_family = AF_INET;
+  sa->sin_port = htons(addr.port);
+  return inet_pton(AF_INET, addr.host.c_str(), &sa->sin_addr) == 1;
+}
+
+void set_common_opts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+Socket& Socket::operator=(Socket&& o) noexcept {
+  if (this != &o) {
+    close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+std::optional<Socket> Socket::connect(const Address& addr) {
+  sockaddr_in sa;
+  if (!fill_sockaddr(addr, &sa)) return std::nullopt;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return std::nullopt;
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0) {
+    ::close(fd);
+    return std::nullopt;
+  }
+  set_common_opts(fd);
+  return Socket(fd);
+}
+
+void Socket::close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Socket::shutdown() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
+bool Socket::read_exact(uint8_t* buf, size_t len) {
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd_, buf + got, len - got, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    got += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool Socket::write_all(const uint8_t* buf, size_t len) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd_, buf + sent, len - sent, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    sent += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool Socket::write_frame(const uint8_t* data, size_t len) {
+  uint8_t hdr[4] = {static_cast<uint8_t>(len >> 24),
+                    static_cast<uint8_t>(len >> 16),
+                    static_cast<uint8_t>(len >> 8),
+                    static_cast<uint8_t>(len)};
+  // Single writev-style send: header + payload back to back. Two sends are
+  // fine under TCP_NODELAY for large frames; coalesce small ones.
+  if (len <= 8192) {
+    Bytes buf;
+    buf.reserve(4 + len);
+    buf.insert(buf.end(), hdr, hdr + 4);
+    buf.insert(buf.end(), data, data + len);
+    return write_all(buf.data(), buf.size());
+  }
+  return write_all(hdr, 4) && write_all(data, len);
+}
+
+bool Socket::write_frame(const Bytes& payload) {
+  return write_frame(payload.data(), payload.size());
+}
+
+bool Socket::read_frame(Bytes* out, size_t max_len) {
+  uint8_t hdr[4];
+  if (!read_exact(hdr, 4)) return false;
+  size_t len = (size_t(hdr[0]) << 24) | (size_t(hdr[1]) << 16) |
+               (size_t(hdr[2]) << 8) | size_t(hdr[3]);
+  if (len > max_len) return false;
+  out->resize(len);
+  return read_exact(out->data(), len);
+}
+
+std::optional<Listener> Listener::bind(const Address& addr) {
+  sockaddr_in sa;
+  if (!fill_sockaddr(addr, &sa)) return std::nullopt;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return std::nullopt;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) != 0 ||
+      ::listen(fd, 1024) != 0) {
+    ::close(fd);
+    return std::nullopt;
+  }
+  Listener l;
+  l.fd_ = fd;
+  sockaddr_in bound;
+  socklen_t blen = sizeof(bound);
+  if (getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen) == 0) {
+    l.port_ = ntohs(bound.sin_port);
+  }
+  return l;
+}
+
+std::optional<Socket> Listener::accept() {
+  int fd = ::accept(fd_, nullptr, nullptr);
+  if (fd < 0) return std::nullopt;
+  set_common_opts(fd);
+  return Socket(fd);
+}
+
+void Listener::close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Listener::shutdown() {
+  if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+}
+
+}  // namespace hotstuff

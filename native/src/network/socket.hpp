@@ -1,0 +1,103 @@
+// Low-level sockets + length-delimited framing (4-byte big-endian prefix),
+// the same frame format the reference gets from LengthDelimitedCodec
+// (network/src/receiver.rs:70) and the verify sidecar speaks
+// (hotstuff_tpu/sidecar/protocol.py).
+#pragma once
+
+#include <optional>
+#include <string>
+
+#include "common/bytes.hpp"
+
+namespace hotstuff {
+
+// "ip:port" address; resolution is numeric-only (the harness always writes
+// numeric addresses, benchmark config.py analogue).
+struct Address {
+  std::string host;
+  uint16_t port = 0;
+
+  static std::optional<Address> parse(const std::string& s);
+  std::string str() const { return host + ":" + std::to_string(port); }
+  bool operator==(const Address& o) const {
+    return host == o.host && port == o.port;
+  }
+  bool operator<(const Address& o) const {
+    return host != o.host ? host < o.host : port < o.port;
+  }
+};
+
+struct AddressHash {
+  size_t operator()(const Address& a) const {
+    return std::hash<std::string>()(a.host) * 31 + a.port;
+  }
+};
+
+// Thin owning wrapper over a connected TCP socket fd.
+class Socket {
+ public:
+  Socket() = default;
+  explicit Socket(int fd) : fd_(fd) {}
+  ~Socket() { close(); }
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+
+  static std::optional<Socket> connect(const Address& addr);
+
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void close();
+  // Shut down both directions (wakes a thread blocked in read_frame).
+  void shutdown();
+
+  // Framed IO. Returns false on EOF/error. The default frame cap matches
+  // the reference's LengthDelimitedCodec limit (8 MiB) — large enough for a
+  // 500 KB batch or a big QC, small enough that a hostile length prefix
+  // can't trigger a giant allocation.
+  bool write_frame(const Bytes& payload);
+  bool write_frame(const uint8_t* data, size_t len);
+  bool read_frame(Bytes* out, size_t max_len = 8u << 20);
+
+ private:
+  bool read_exact(uint8_t* buf, size_t len);
+  bool write_all(const uint8_t* buf, size_t len);
+
+  int fd_ = -1;
+};
+
+// Listening socket (SO_REUSEADDR). port 0 picks an ephemeral port.
+class Listener {
+ public:
+  Listener() = default;
+  ~Listener() { close(); }
+  Listener(Listener&& o) noexcept : fd_(o.fd_), port_(o.port_) {
+    o.fd_ = -1;
+  }
+  Listener& operator=(Listener&& o) noexcept {
+    if (this != &o) {
+      close();
+      fd_ = o.fd_;
+      port_ = o.port_;
+      o.fd_ = -1;
+    }
+    return *this;
+  }
+  Listener(const Listener&) = delete;
+  Listener& operator=(const Listener&) = delete;
+
+  static std::optional<Listener> bind(const Address& addr);
+
+  std::optional<Socket> accept();
+  uint16_t port() const { return port_; }
+  bool valid() const { return fd_ >= 0; }
+  void close();
+  void shutdown();  // unblocks accept()
+
+ private:
+  int fd_ = -1;
+  uint16_t port_ = 0;
+};
+
+}  // namespace hotstuff

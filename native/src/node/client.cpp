@@ -1,0 +1,129 @@
+// Benchmark client: open-loop transaction load generator
+// (node/src/client.rs:15-168 in the reference). Sends `rate` tx/s in
+// PRECISION bursts per second over one framed TCP connection to a node's
+// transactions address. Sample txs ([0u8][u64 BE counter][padding]) are
+// logged for end-to-end latency measurement; filler txs are
+// [1u8][u64 BE r][padding].
+//   client ADDR --size BYTES --rate TXS [--timeout MS] [--nodes A1 A2 ...]
+#include <chrono>
+#include <iostream>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common/log.hpp"
+#include "network/socket.hpp"
+
+using namespace hotstuff;
+
+namespace {
+constexpr uint64_t kPrecision = 20;  // sample precision: bursts per second
+constexpr uint64_t kBurstDurationMs = 1000 / kPrecision;
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string target_str;
+  size_t size = 512;
+  uint64_t rate = 1000;
+  uint64_t timeout_ms = 0;
+  std::vector<std::string> nodes;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        std::cerr << "missing value for " << arg << "\n";
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (arg == "--size") size = std::stoul(next());
+    else if (arg == "--rate") rate = std::stoull(next());
+    else if (arg == "--timeout") timeout_ms = std::stoull(next());
+    else if (arg == "--nodes") {
+      while (i + 1 < argc && argv[i + 1][0] != '-') nodes.push_back(argv[++i]);
+    } else if (arg[0] != '-') target_str = arg;
+  }
+  log_set_level(LogLevel::kInfo);
+
+  auto target = Address::parse(target_str);
+  if (!target) {
+    std::cerr << "client ADDR --size BYTES --rate TXS [--timeout MS] "
+                 "[--nodes ...]\n";
+    return 2;
+  }
+  if (size < 9) {
+    LOG_ERROR("client") << "Transaction size must be at least 9 bytes";
+    return 1;
+  }
+  if (rate < kPrecision) {
+    LOG_ERROR("client") << "rate must be at least " << kPrecision << " tx/s";
+    return 1;
+  }
+
+  LOG_INFO("client") << "Node address: " << target->str();
+  // NOTE: These log entries are used to compute performance
+  // (hotstuff_tpu/harness/logs.py client regexes).
+  LOG_INFO("client") << "Transactions size: " << size << " B";
+  LOG_INFO("client") << "Transactions rate: " << rate << " tx/s";
+
+  // Wait for all nodes to be online, then for synchronization
+  // (client.rs:152-167).
+  LOG_INFO("client") << "Waiting for all nodes to be online...";
+  for (const auto& n : nodes) {
+    auto addr = Address::parse(n);
+    if (!addr) continue;
+    while (!Socket::connect(*addr)) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+  }
+  LOG_INFO("client") << "Waiting for all nodes to be synchronized...";
+  std::this_thread::sleep_for(std::chrono::milliseconds(2 * timeout_ms));
+
+  auto sock = Socket::connect(*target);
+  if (!sock) {
+    LOG_WARN("client") << "failed to connect to " << target->str();
+    return 1;
+  }
+
+  const uint64_t burst = rate / kPrecision;
+  std::mt19937_64 rng(std::random_device{}());
+  uint64_t r = rng();
+  uint64_t counter = 0;
+  Bytes tx(size, 0);
+
+  // NOTE: This log entry is used to compute performance.
+  LOG_INFO("client") << "Start sending transactions";
+
+  auto interval = std::chrono::milliseconds(kBurstDurationMs);
+  auto next_tick = std::chrono::steady_clock::now() + interval;
+  while (true) {
+    std::this_thread::sleep_until(next_tick);
+    next_tick += interval;
+    auto burst_start = std::chrono::steady_clock::now();
+    for (uint64_t x = 0; x < burst; x++) {
+      uint64_t id;
+      if (x == counter % burst) {
+        // NOTE: This log entry is used to compute performance.
+        LOG_INFO("client") << "Sending sample transaction " << counter;
+        tx[0] = 0;  // sample txs start with 0
+        id = counter;
+      } else {
+        tx[0] = 1;  // standard txs start with 1
+        id = ++r;
+      }
+      for (int b = 0; b < 8; b++) tx[1 + b] = (id >> (8 * (7 - b))) & 0xFF;
+      if (!sock->write_frame(tx)) {
+        LOG_WARN("client") << "Failed to send transaction";
+        return 1;
+      }
+    }
+    auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
+        std::chrono::steady_clock::now() - burst_start);
+    if (elapsed.count() > int64_t(kBurstDurationMs)) {
+      // NOTE: This log entry is used to compute performance.
+      LOG_WARN("client") << "Transaction rate too high for this client";
+    }
+    counter++;
+  }
+}

@@ -1,0 +1,67 @@
+#include "node/config.hpp"
+
+namespace hotstuff {
+namespace node {
+
+Secret Secret::generate() {
+  KeyPair kp = generate_keypair();
+  Secret s;
+  s.name = kp.name;
+  s.secret = kp.secret;
+  return s;
+}
+
+Secret Secret::read(const std::string& path) {
+  Json j = Json::read_file(path);
+  Secret s;
+  if (!PublicKey::from_base64(j.at("name").as_string(), &s.name) ||
+      !SecretKey::from_base64(j.at("secret").as_string(), &s.secret)) {
+    throw JsonError("bad key file " + path);
+  }
+  return s;
+}
+
+void Secret::write(const std::string& path) const {
+  Json j = Json::object();
+  j.set("name", Json(name.to_base64()));
+  j.set("secret", Json(secret.to_base64()));
+  j.write_file(path);
+}
+
+Committee Committee::read(const std::string& path) {
+  Json j = Json::read_file(path);
+  Committee c;
+  c.consensus = consensus::Committee::from_json(j.at("consensus"));
+  c.mempool = mempool::Committee::from_json(j.at("mempool"));
+  return c;
+}
+
+void Committee::write(const std::string& path) const {
+  Json j = Json::object();
+  j.set("consensus", consensus.to_json());
+  j.set("mempool", mempool.to_json());
+  j.write_file(path);
+}
+
+Parameters Parameters::from_json(const Json& j) {
+  Parameters p;
+  if (auto* v = j.find("consensus")) {
+    p.consensus = consensus::Parameters::from_json(*v);
+  }
+  if (auto* v = j.find("mempool")) {
+    p.mempool = mempool::Parameters::from_json(*v);
+  }
+  if (auto* v = j.find("tpu_sidecar")) {
+    if (v->type() == Json::Type::kString) {
+      p.tpu_sidecar = Address::parse(v->as_string());
+    }
+  }
+  return p;
+}
+
+Parameters Parameters::read(const std::string& path) {
+  return from_json(Json::read_file(path));
+}
+
+}  // namespace node
+}  // namespace hotstuff

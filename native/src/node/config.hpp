@@ -1,0 +1,45 @@
+// Node-level config files (JSON, harness-generated): keypair, combined
+// committee (consensus + mempool address books), combined parameters
+// (node/src/config.rs:22-87 in the reference). The TPU addition: an
+// optional "tpu_sidecar" address in parameters routes QC batch verification
+// to the JAX verify sidecar.
+#pragma once
+
+#include <optional>
+#include <string>
+
+#include "consensus/config.hpp"
+#include "crypto/crypto.hpp"
+#include "mempool/config.hpp"
+
+namespace hotstuff {
+namespace node {
+
+struct Secret {
+  PublicKey name;
+  SecretKey secret;
+
+  static Secret generate();
+  static Secret read(const std::string& path);
+  void write(const std::string& path) const;
+};
+
+struct Committee {
+  consensus::Committee consensus;
+  mempool::Committee mempool;
+
+  static Committee read(const std::string& path);
+  void write(const std::string& path) const;
+};
+
+struct Parameters {
+  consensus::Parameters consensus;
+  mempool::Parameters mempool;
+  std::optional<Address> tpu_sidecar;
+
+  static Parameters read(const std::string& path);
+  static Parameters from_json(const Json& j);
+};
+
+}  // namespace node
+}  // namespace hotstuff

@@ -1,0 +1,144 @@
+// node binary: keys / run / deploy subcommands (node/src/main.rs:16-154 in
+// the reference).
+//   node keys --filename FILE
+//   node run --keys FILE --committee FILE --store PATH [--parameters FILE] [-v...]
+//   node deploy NODES  (local in-process testbed on ports 25000+)
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common/log.hpp"
+#include "node/config.hpp"
+#include "node/node.hpp"
+
+using namespace hotstuff;
+
+namespace {
+
+struct Args {
+  std::vector<std::string> positional;
+  std::string keys, committee, store, parameters, filename;
+  int verbosity = 0;
+
+  static Args parse(int argc, char** argv) {
+    Args a;
+    for (int i = 1; i < argc; i++) {
+      std::string arg = argv[i];
+      auto next = [&]() -> std::string {
+        if (i + 1 >= argc) {
+          std::cerr << "missing value for " << arg << "\n";
+          std::exit(2);
+        }
+        return argv[++i];
+      };
+      if (arg == "--keys") a.keys = next();
+      else if (arg == "--committee") a.committee = next();
+      else if (arg == "--store") a.store = next();
+      else if (arg == "--parameters") a.parameters = next();
+      else if (arg == "--filename") a.filename = next();
+      else if (arg[0] == '-' && arg.find_first_not_of('v', 1) ==
+               std::string::npos && arg.size() > 1) {
+        a.verbosity += int(arg.size()) - 1;
+      } else a.positional.push_back(arg);
+    }
+    return a;
+  }
+};
+
+void apply_verbosity(int v) {
+  // -v: info (default), -vv: debug (main.rs:43-53 analogue; benchmark logs
+  // need info level).
+  log_set_level(v >= 2 ? LogLevel::kDebug : LogLevel::kInfo);
+}
+
+int cmd_keys(const Args& args) {
+  if (args.filename.empty()) {
+    std::cerr << "node keys --filename FILE\n";
+    return 2;
+  }
+  node::Secret::generate().write(args.filename);
+  return 0;
+}
+
+int cmd_run(const Args& args) {
+  if (args.keys.empty() || args.committee.empty() || args.store.empty()) {
+    std::cerr << "node run --keys FILE --committee FILE --store PATH "
+                 "[--parameters FILE]\n";
+    return 2;
+  }
+  auto node = node::Node::create(args.committee, args.keys, args.store,
+                                 args.parameters);
+  node->analyze_block();
+  return 0;
+}
+
+int cmd_deploy(const Args& args) {
+  if (args.positional.size() < 2) {
+    std::cerr << "node deploy NODES\n";
+    return 2;
+  }
+  size_t nodes = std::stoul(args.positional[1]);
+  uint16_t base_port = 25000;
+
+  // Generate keys + committee (main.rs:94-154 analogue).
+  std::vector<node::Secret> secrets;
+  for (size_t i = 0; i < nodes; i++) secrets.push_back(node::Secret::generate());
+
+  std::map<PublicKey, consensus::Authority> cons_auth;
+  std::map<PublicKey, mempool::Authority> memp_auth;
+  uint16_t port = base_port;
+  for (const auto& s : secrets) {
+    consensus::Authority ca;
+    ca.stake = 1;
+    ca.address = Address{"127.0.0.1", port++};
+    cons_auth.emplace(s.name, ca);
+    mempool::Authority ma;
+    ma.stake = 1;
+    ma.transactions_address = Address{"127.0.0.1", port++};
+    ma.mempool_address = Address{"127.0.0.1", port++};
+    memp_auth.emplace(s.name, ma);
+  }
+  node::Committee committee;
+  committee.consensus = consensus::Committee(std::move(cons_auth), 1);
+  committee.mempool = mempool::Committee(std::move(memp_auth), 1);
+  committee.write(".committee.json");
+
+  std::vector<std::unique_ptr<node::Node>> instances;
+  for (size_t i = 0; i < nodes; i++) {
+    std::string key_file = ".node-" + std::to_string(i) + ".json";
+    secrets[i].write(key_file);
+    std::string store_path = ".db-" + std::to_string(i);
+    instances.push_back(node::Node::create(".committee.json", key_file,
+                                           store_path, ""));
+  }
+  std::vector<std::thread> sinks;
+  for (auto& n : instances) {
+    sinks.emplace_back([&n] { n->analyze_block(); });
+  }
+  for (auto& t : sinks) t.join();
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args args = Args::parse(argc, argv);
+  apply_verbosity(args.verbosity);
+  if (args.positional.empty()) {
+    std::cerr << "usage: node {keys|run|deploy} ...\n";
+    return 2;
+  }
+  const std::string& cmd = args.positional[0];
+  try {
+    if (cmd == "keys") return cmd_keys(args);
+    if (cmd == "run") return cmd_run(args);
+    if (cmd == "deploy") return cmd_deploy(args);
+  } catch (const std::exception& e) {
+    LOG_ERROR("node::main") << e.what();
+    return 1;
+  }
+  std::cerr << "unknown command: " << cmd << "\n";
+  return 2;
+}

@@ -1,0 +1,59 @@
+#include "node/node.hpp"
+
+#include "common/log.hpp"
+#include "crypto/sidecar_client.hpp"
+
+namespace hotstuff {
+namespace node {
+
+std::unique_ptr<Node> Node::create(const std::string& committee_file,
+                                   const std::string& key_file,
+                                   const std::string& store_path,
+                                   const std::string& parameters_file) {
+  Committee committee = Committee::read(committee_file);
+  Secret secret = Secret::read(key_file);
+  Parameters parameters = parameters_file.empty()
+                              ? Parameters{}
+                              : Parameters::read(parameters_file);
+
+  auto node = std::unique_ptr<Node>(new Node());
+  node->name_ = secret.name;
+  node->store_ = Store::open(store_path);
+  node->commit_ = make_channel<consensus::Block>();
+
+  // Device dispatch for QC batch verification (process-wide; the crypto
+  // layer falls back to host verify when absent/unreachable).
+  if (parameters.tpu_sidecar) {
+    TpuVerifier::install(
+        std::make_unique<TpuVerifier>(*parameters.tpu_sidecar));
+  }
+
+  SignatureService signature_service(secret.secret);
+
+  auto tx_mempool_to_consensus = make_channel<Digest>();
+  auto tx_consensus_to_mempool =
+      make_channel<mempool::ConsensusMempoolMessage>();
+
+  node->mempool_ = mempool::Mempool::spawn(
+      secret.name, committee.mempool, parameters.mempool, node->store_,
+      tx_consensus_to_mempool, tx_mempool_to_consensus);
+
+  node->consensus_ = consensus::Consensus::spawn(
+      secret.name, committee.consensus, parameters.consensus,
+      signature_service, node->store_, tx_mempool_to_consensus,
+      tx_consensus_to_mempool, node->commit_);
+
+  LOG_INFO("node::node")
+      << "Node " << secret.name.to_base64() << " successfully booted";
+  return node;
+}
+
+void Node::analyze_block() {
+  while (auto block = commit_->recv()) {
+    // Sink committed blocks (the application layer goes here).
+    (void)block;
+  }
+}
+
+}  // namespace node
+}  // namespace hotstuff

@@ -1,0 +1,41 @@
+// Node assembly: reads configs, opens the store, starts the signature
+// service and (optionally) the TPU verifier, spawns mempool + consensus,
+// and exposes the commit channel (node/src/node.rs:13-81 in the reference).
+#pragma once
+
+#include <memory>
+#include <string>
+
+#include "consensus/consensus.hpp"
+#include "mempool/mempool.hpp"
+#include "node/config.hpp"
+#include "store/store.hpp"
+
+namespace hotstuff {
+namespace node {
+
+class Node {
+ public:
+  static std::unique_ptr<Node> create(const std::string& committee_file,
+                                      const std::string& key_file,
+                                      const std::string& store_path,
+                                      const std::string& parameters_file);
+
+  // Drains the commit channel (node.rs:76-81). Blocks forever.
+  void analyze_block();
+
+  ChannelPtr<consensus::Block> commit_channel() { return commit_; }
+  const PublicKey& name() const { return name_; }
+
+ private:
+  Node() = default;
+
+  PublicKey name_;
+  Store store_;
+  ChannelPtr<consensus::Block> commit_;
+  std::unique_ptr<mempool::Mempool> mempool_;
+  std::unique_ptr<consensus::Consensus> consensus_;
+};
+
+}  // namespace node
+}  // namespace hotstuff

@@ -1,0 +1,141 @@
+#include "store/store.hpp"
+
+#include <sys/stat.h>
+
+#include <cstdio>
+#include <stdexcept>
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+
+namespace {
+
+// WAL record: u32 LE key len | key | u32 LE value len | value.
+void wal_append(std::FILE* f, const Bytes& key, const Bytes& value) {
+  auto put_u32 = [&](uint32_t v) {
+    uint8_t b[4] = {uint8_t(v), uint8_t(v >> 8), uint8_t(v >> 16),
+                    uint8_t(v >> 24)};
+    std::fwrite(b, 1, 4, f);
+  };
+  put_u32(static_cast<uint32_t>(key.size()));
+  std::fwrite(key.data(), 1, key.size(), f);
+  put_u32(static_cast<uint32_t>(value.size()));
+  std::fwrite(value.data(), 1, value.size(), f);
+  std::fflush(f);
+}
+
+void wal_replay(const std::string& path,
+                std::unordered_map<Bytes, Bytes, BytesHash>* map) {
+  std::FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return;
+  auto get_u32 = [&](uint32_t* v) {
+    uint8_t b[4];
+    if (std::fread(b, 1, 4, f) != 4) return false;
+    *v = uint32_t(b[0]) | (uint32_t(b[1]) << 8) | (uint32_t(b[2]) << 16) |
+         (uint32_t(b[3]) << 24);
+    return true;
+  };
+  while (true) {
+    uint32_t klen, vlen;
+    if (!get_u32(&klen)) break;
+    Bytes key(klen);
+    if (std::fread(key.data(), 1, klen, f) != klen) break;
+    if (!get_u32(&vlen)) break;
+    Bytes value(vlen);
+    if (std::fread(value.data(), 1, vlen, f) != vlen) break;
+    (*map)[std::move(key)] = std::move(value);
+  }
+  std::fclose(f);
+}
+
+}  // namespace
+
+Store Store::open(const std::string& path) {
+  auto ch = make_channel<Command>();
+
+  std::FILE* wal = nullptr;
+  auto map = std::make_shared<std::unordered_map<Bytes, Bytes, BytesHash>>();
+  if (!path.empty()) {
+    ::mkdir(path.c_str(), 0755);
+    std::string wal_path = path + "/wal";
+    wal_replay(wal_path, map.get());
+    wal = std::fopen(wal_path.c_str(), "ab");
+    if (!wal) throw std::runtime_error("cannot open WAL at " + wal_path);
+  }
+
+  Store s;
+  s.ch_ = ch;
+  s.worker_ = std::shared_ptr<std::thread>(
+      new std::thread([ch, map, wal] {
+        // Obligations: key -> oneshots fulfilled by a future write
+        // (store/src/lib.rs:36-57 semantics).
+        std::unordered_map<Bytes, std::vector<Oneshot<Bytes>>, BytesHash>
+            obligations;
+        while (auto cmd = ch->recv()) {
+          switch (cmd->kind) {
+            case Command::Kind::kWrite: {
+              if (wal) wal_append(wal, cmd->key, cmd->value);
+              (*map)[cmd->key] = cmd->value;
+              auto it = obligations.find(cmd->key);
+              if (it != obligations.end()) {
+                for (auto& waiter : it->second) waiter.set(cmd->value);
+                obligations.erase(it);
+              }
+              break;
+            }
+            case Command::Kind::kRead: {
+              auto it = map->find(cmd->key);
+              cmd->read_reply.set(it == map->end()
+                                      ? std::nullopt
+                                      : std::optional<Bytes>(it->second));
+              break;
+            }
+            case Command::Kind::kNotifyRead: {
+              auto it = map->find(cmd->key);
+              if (it != map->end()) {
+                cmd->notify_reply.set(it->second);
+              } else {
+                obligations[cmd->key].push_back(cmd->notify_reply);
+              }
+              break;
+            }
+          }
+        }
+        if (wal) std::fclose(wal);
+      }),
+      [ch](std::thread* t) {
+        ch->close();
+        t->join();
+        delete t;
+      });
+  return s;
+}
+
+void Store::write(const Bytes& key, const Bytes& value) {
+  Command cmd;
+  cmd.kind = Command::Kind::kWrite;
+  cmd.key = key;
+  cmd.value = value;
+  ch_->send(std::move(cmd));
+}
+
+std::optional<Bytes> Store::read(const Bytes& key) {
+  Command cmd;
+  cmd.kind = Command::Kind::kRead;
+  cmd.key = key;
+  auto reply = cmd.read_reply;
+  if (!ch_->send(std::move(cmd))) return std::nullopt;
+  return reply.wait();
+}
+
+Oneshot<Bytes> Store::notify_read(const Bytes& key) {
+  Command cmd;
+  cmd.kind = Command::Kind::kNotifyRead;
+  cmd.key = key;
+  auto reply = cmd.notify_reply;
+  ch_->send(std::move(cmd));
+  return reply;
+}
+
+}  // namespace hotstuff

@@ -1,0 +1,52 @@
+// Storage actor: a single thread owning the key-value state, commanded over
+// a channel — the same shape as the reference's Store task wrapping RocksDB
+// (store/src/lib.rs:15-93), including the notify_read obligation contract
+// (register a waiter for a key; fulfilled by a later write).  Backing medium
+// is an in-memory map with an append-only write-ahead log replayed on open
+// (this image has no RocksDB; durability semantics — every batch/block
+// persisted before use — are preserved).
+#pragma once
+
+#include <memory>
+#include <optional>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common/bytes.hpp"
+#include "common/channel.hpp"
+
+namespace hotstuff {
+
+class Store {
+ public:
+  // Opens (creating if needed) the store at `path` (a directory; the WAL
+  // lives at path + "/wal"). Empty path = purely in-memory (tests).
+  static Store open(const std::string& path);
+
+  Store() = default;  // null handle; open() returns the real one
+
+  void write(const Bytes& key, const Bytes& value);
+  std::optional<Bytes> read(const Bytes& key);
+
+  // Returns a oneshot fulfilled with the value as soon as the key exists
+  // (immediately if it already does).
+  Oneshot<Bytes> notify_read(const Bytes& key);
+
+  bool valid() const { return static_cast<bool>(ch_); }
+
+ private:
+  struct Command {
+    enum class Kind { kWrite, kRead, kNotifyRead } kind;
+    Bytes key;
+    Bytes value;                          // write
+    Oneshot<std::optional<Bytes>> read_reply;  // read
+    Oneshot<Bytes> notify_reply;          // notify_read
+  };
+
+  ChannelPtr<Command> ch_;
+  std::shared_ptr<std::thread> worker_;
+};
+
+}  // namespace hotstuff

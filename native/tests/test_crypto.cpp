@@ -1,0 +1,86 @@
+// Crypto layer tests (crypto/src/tests/crypto_tests.rs:31-132 analogue):
+// key round-trips, valid/invalid single + batch verification,
+// SignatureService, and RFC 8032 test vector cross-check.
+#include "test_util.hpp"
+
+using namespace hotstuff;
+using namespace hotstuff::test;
+
+TEST(import_export_public_key) {
+  auto kp = keys()[0];
+  std::string b64 = kp.name.to_base64();
+  PublicKey back;
+  CHECK(PublicKey::from_base64(b64, &back));
+  CHECK(back == kp.name);
+}
+
+TEST(import_export_secret_key) {
+  auto kp = keys()[0];
+  std::string b64 = kp.secret.to_base64();
+  SecretKey back;
+  CHECK(SecretKey::from_base64(b64, &back));
+  CHECK(back.data == kp.secret.data);
+}
+
+TEST(rfc8032_vector) {
+  // RFC 8032 section 7.1 TEST 1: empty message. We sign 32-byte digests in
+  // the protocol, but the primitive must match the RFC on raw messages —
+  // cross-check key derivation: secret 9d61...  -> public d75a...
+  std::array<uint8_t, 32> seed = {
+      0x9d, 0x61, 0xb1, 0x9d, 0xef, 0xfd, 0x5a, 0x60, 0xba, 0x84, 0x4a,
+      0xf4, 0x92, 0xec, 0x2c, 0xc4, 0x44, 0x49, 0xc5, 0x69, 0x7b, 0x32,
+      0x69, 0x19, 0x70, 0x3b, 0xac, 0x03, 0x1c, 0xae, 0x7f, 0x60};
+  std::array<uint8_t, 32> expect_pub = {
+      0xd7, 0x5a, 0x98, 0x01, 0x82, 0xb1, 0x0a, 0xb7, 0xd5, 0x4b, 0xfe,
+      0xd3, 0xc9, 0x64, 0x07, 0x3a, 0x0e, 0xe1, 0x72, 0xf3, 0xda, 0xa6,
+      0x23, 0x25, 0xaf, 0x02, 0x1a, 0x68, 0xf7, 0x07, 0x51, 0x1a};
+  KeyPair kp = keypair_from_seed(seed);
+  CHECK(kp.name.data == expect_pub);
+}
+
+TEST(sign_verify) {
+  auto kp = keys()[0];
+  Digest d = sha512_digest(Bytes{1, 2, 3});
+  Signature sig = Signature::sign(d, kp.secret);
+  CHECK(sig.verify(d, kp.name));
+  // wrong digest
+  Digest d2 = sha512_digest(Bytes{9});
+  CHECK(!sig.verify(d2, kp.name));
+  // wrong key
+  CHECK(!sig.verify(d, keys()[1].name));
+  // corrupted signature
+  Signature bad = sig;
+  bad.data[5] ^= 1;
+  CHECK(!bad.verify(d, kp.name));
+}
+
+TEST(verify_batch) {
+  Digest d = sha512_digest(Bytes{42});
+  std::vector<std::pair<PublicKey, Signature>> votes;
+  for (const auto& kp : keys()) {
+    votes.emplace_back(kp.name, Signature::sign(d, kp.secret));
+  }
+  CHECK(Signature::verify_batch(d, votes));
+  votes[2].second.data[0] ^= 1;
+  CHECK(!Signature::verify_batch(d, votes));
+}
+
+TEST(digest_builder_matches_oneshot) {
+  Bytes msg{1, 2, 3, 4, 5};
+  Digest a = sha512_digest(msg);
+  Digest b = DigestBuilder()
+                 .update(msg.data(), 2)
+                 .update(msg.data() + 2, 3)
+                 .finalize();
+  CHECK(a == b);
+}
+
+TEST(signature_service) {
+  auto kp = keys()[0];
+  SignatureService service(kp.secret);
+  Digest d = sha512_digest(Bytes{7, 7, 7});
+  Signature sig = service.request_signature(d);
+  CHECK(sig.verify(d, kp.name));
+}
+
+int main() { return run_all(); }

@@ -1,0 +1,90 @@
+// End-to-end: 4 full node stacks in one process on localhost ports commit
+// the same block from a client transaction
+// (consensus/src/tests/consensus_tests.rs:56-68 analogue, widened to the
+// full node: mempool batching + quorum dissemination + consensus).
+#include <cstdlib>
+#include <thread>
+
+#include "node/node.hpp"
+#include "test_util.hpp"
+
+using namespace hotstuff;
+using namespace hotstuff::test;
+
+TEST(four_nodes_commit_same_block) {
+  std::system("rm -rf /tmp/.hs_e2e && mkdir -p /tmp/.hs_e2e");
+  const std::string dir = "/tmp/.hs_e2e/";
+
+  // Configs: committee on ports 9500+, small batches, fast timeout off the
+  // happy path (10 s so it never fires).
+  node::Committee committee;
+  committee.consensus = consensus_committee(9500);
+  committee.mempool = mempool_committee(9510);
+  committee.write(dir + "committee.json");
+  {
+    Json params = Json::object();
+    Json cons = Json::object();
+    cons.set("timeout_delay", Json(int64_t(10'000)));
+    cons.set("sync_retry_delay", Json(int64_t(10'000)));
+    Json memp = Json::object();
+    memp.set("batch_size", Json(int64_t(64)));
+    memp.set("max_batch_delay", Json(int64_t(50)));
+    params.set("consensus", std::move(cons));
+    params.set("mempool", std::move(memp));
+    params.write_file(dir + "parameters.json");
+  }
+  auto ks = keys();
+  std::vector<std::unique_ptr<node::Node>> nodes;
+  for (size_t i = 0; i < 4; i++) {
+    node::Secret s;
+    s.name = ks[i].name;
+    s.secret = ks[i].secret;
+    std::string key_file = dir + "node-" + std::to_string(i) + ".json";
+    s.write(key_file);
+    nodes.push_back(node::Node::create(dir + "committee.json", key_file,
+                                       dir + "db-" + std::to_string(i),
+                                       dir + "parameters.json"));
+  }
+
+  // Feed one transaction to every node's transactions address (so whoever
+  // leads has a payload to propose).
+  for (size_t i = 0; i < 4; i++) {
+    auto addr = committee.mempool.transactions_address(ks[i].name);
+    auto sock = Socket::connect(*addr);
+    CHECK(sock.has_value());
+    Bytes tx(32, uint8_t(i + 1));
+    CHECK(sock->write_frame(tx));
+  }
+
+  // Every node commits a block with a payload, and the first such block
+  // matches across all nodes.
+  std::vector<Digest> first_committed(4);
+  std::vector<std::thread> waiters;
+  std::atomic<int> failures{0};
+  for (size_t i = 0; i < 4; i++) {
+    waiters.emplace_back([&, i] {
+      auto ch = nodes[i]->commit_channel();
+      while (true) {
+        consensus::Block b;
+        auto status = ch->recv_until(
+            &b, std::chrono::steady_clock::now() + std::chrono::seconds(30));
+        if (status != RecvStatus::kOk) {
+          failures++;
+          return;
+        }
+        if (!b.payload.empty()) {
+          first_committed[i] = b.digest();
+          return;
+        }
+      }
+    });
+  }
+  for (auto& t : waiters) t.join();
+  CHECK(failures.load() == 0);
+  CHECK(first_committed[0] == first_committed[1]);
+  CHECK(first_committed[0] == first_committed[2]);
+  CHECK(first_committed[0] == first_committed[3]);
+  std::exit(Registry::get().failures ? 1 : 0);  // skip slow teardown
+}
+
+int main() { return run_all(); }

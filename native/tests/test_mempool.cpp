@@ -1,0 +1,210 @@
+// Mempool tests (mempool/src/tests/ analogue): batch sealing by size and by
+// timeout, quorum waiting with fake ACKing peers, processor hash+store,
+// synchronizer request emission, helper batch reply, and the full pipeline
+// client-tx -> digest.
+#include <thread>
+
+#include "mempool/batch_maker.hpp"
+#include "mempool/helper.hpp"
+#include "mempool/mempool.hpp"
+#include "mempool/processor.hpp"
+#include "mempool/quorum_waiter.hpp"
+#include "mempool/synchronizer.hpp"
+#include "test_util.hpp"
+
+using namespace hotstuff;
+using namespace hotstuff::test;
+using namespace hotstuff::mempool;
+
+namespace {
+
+// Listeners for all 3 peer mempool addresses that ACK one batch each.
+std::vector<std::thread> peer_listeners(const Committee& committee,
+                                        const PublicKey& myself,
+                                        ChannelPtr<Bytes> delivered) {
+  std::vector<std::thread> threads;
+  for (const auto& [name, addr] : committee.broadcast_addresses(myself)) {
+    auto l = Listener::bind(addr);
+    if (!l) throw std::runtime_error("bind failed: " + addr.str());
+    threads.push_back(listener(std::move(*l), [delivered](Bytes b) {
+      delivered->send(std::move(b));
+    }));
+  }
+  return threads;
+}
+
+}  // namespace
+
+TEST(batch_maker_seals_by_size) {
+  auto committee = mempool_committee(7100);
+  auto myself = keys()[0].name;
+  auto delivered = make_channel<Bytes>();
+  auto threads = peer_listeners(committee, myself, delivered);
+
+  auto rx_tx = make_channel<Transaction>();
+  auto tx_msg = make_channel<QuorumWaiterMessage>();
+  BatchMaker::spawn(/*batch_size=*/100, /*max_batch_delay=*/60'000, rx_tx,
+                    tx_msg, committee.broadcast_addresses(myself));
+  Transaction tx(60, 5);  // 60 bytes: two txs cross the 100-byte seal point
+  rx_tx->send(tx);
+  rx_tx->send(tx);
+  auto msg = tx_msg->recv();
+  CHECK(msg.has_value());
+  auto m = MempoolMessage::deserialize(msg->batch);
+  CHECK(m.kind == MempoolMessage::Kind::kBatch);
+  CHECK(m.batch.size() == 2);
+  CHECK(m.batch[0] == tx);
+  CHECK(msg->handlers.size() == 3);
+  for (auto& t : threads) t.join();
+}
+
+TEST(batch_maker_seals_by_timeout) {
+  auto committee = mempool_committee(7200);
+  auto myself = keys()[0].name;
+  auto delivered = make_channel<Bytes>();
+  auto threads = peer_listeners(committee, myself, delivered);
+
+  auto rx_tx = make_channel<Transaction>();
+  auto tx_msg = make_channel<QuorumWaiterMessage>();
+  BatchMaker::spawn(/*batch_size=*/1'000'000, /*max_batch_delay=*/50, rx_tx,
+                    tx_msg, committee.broadcast_addresses(myself));
+  rx_tx->send(Transaction(10, 1));
+  auto msg = tx_msg->recv();
+  CHECK(msg.has_value());
+  auto m = MempoolMessage::deserialize(msg->batch);
+  CHECK(m.batch.size() == 1);
+  for (auto& t : threads) t.join();
+}
+
+TEST(quorum_waiter_waits_for_stake) {
+  auto committee = mempool_committee(7300);
+  auto myself = keys()[0].name;
+  auto rx_msg = make_channel<QuorumWaiterMessage>();
+  auto tx_batch = make_channel<Bytes>();
+  QuorumWaiter::spawn(committee, committee.stake(myself), rx_msg, tx_batch);
+
+  QuorumWaiterMessage msg;
+  msg.batch = Bytes{1, 2, 3};
+  std::vector<CancelHandler> handlers;
+  for (const auto& [name, _] : committee.broadcast_addresses(myself)) {
+    CancelHandler h;
+    handlers.push_back(h);
+    msg.handlers.emplace_back(name, h);
+  }
+  rx_msg->send(std::move(msg));
+
+  // With only our stake (1) nothing is delivered yet; two ACKs reach 2f+1=3.
+  Bytes out;
+  CHECK(tx_batch->recv_until(&out, std::chrono::steady_clock::now() +
+                                       std::chrono::milliseconds(100)) ==
+        RecvStatus::kTimeout);
+  handlers[0].set(to_bytes("Ack"));
+  handlers[1].set(to_bytes("Ack"));
+  auto got = tx_batch->recv();
+  CHECK(got.has_value());
+  CHECK(*got == (Bytes{1, 2, 3}));
+}
+
+TEST(processor_hashes_and_stores) {
+  Store store = Store::open("");
+  auto rx_batch = make_channel<Bytes>();
+  auto tx_digest = make_channel<Digest>();
+  Processor::spawn(store, rx_batch, tx_digest);
+  Bytes batch{7, 7, 7, 7};
+  rx_batch->send(batch);
+  auto digest = tx_digest->recv();
+  CHECK(digest.has_value());
+  CHECK(*digest == sha512_digest(batch));
+  auto stored = store.read(digest->to_bytes());
+  CHECK(stored.has_value());
+  CHECK(*stored == batch);
+}
+
+TEST(synchronizer_sends_batch_request) {
+  auto committee = mempool_committee(7400);
+  auto myself = keys()[0].name;
+  auto target = keys()[1].name;
+  auto l = Listener::bind(*committee.mempool_address(target));
+  CHECK(l.has_value());
+  auto delivered = make_channel<Bytes>();
+  auto t = listener(std::move(*l),
+                    [delivered](Bytes b) { delivered->send(std::move(b)); });
+
+  Store store = Store::open("");
+  auto rx_msg = make_channel<ConsensusMempoolMessage>();
+  Synchronizer::spawn(myself, committee, store, /*gc_depth=*/50,
+                      /*sync_retry_delay=*/60'000, /*sync_retry_nodes=*/3,
+                      rx_msg);
+  ConsensusMempoolMessage msg;
+  msg.kind = ConsensusMempoolMessage::Kind::kSynchronize;
+  msg.digests = {sha512_digest(Bytes{1})};
+  msg.target = target;
+  rx_msg->send(std::move(msg));
+
+  auto got = delivered->recv();
+  CHECK(got.has_value());
+  auto m = MempoolMessage::deserialize(*got);
+  CHECK(m.kind == MempoolMessage::Kind::kBatchRequest);
+  CHECK(m.missing.size() == 1);
+  CHECK(m.origin == myself);
+  t.join();
+}
+
+TEST(helper_serves_batches) {
+  auto committee = mempool_committee(7500);
+  auto myself = keys()[0].name;
+  auto requestor = keys()[1].name;
+  auto l = Listener::bind(*committee.mempool_address(requestor));
+  CHECK(l.has_value());
+  auto delivered = make_channel<Bytes>();
+  auto t = listener(std::move(*l),
+                    [delivered](Bytes b) { delivered->send(std::move(b)); });
+
+  Store store = Store::open("");
+  Bytes batch = MempoolMessage::make_batch({{1, 2}}).serialize();
+  Digest digest = sha512_digest(batch);
+  store.write(digest.to_bytes(), batch);
+
+  auto rx_req = make_channel<std::pair<std::vector<Digest>, PublicKey>>();
+  Helper::spawn(committee, store, rx_req);
+  rx_req->send({{digest}, requestor});
+
+  auto got = delivered->recv();
+  CHECK(got.has_value());
+  CHECK(*got == batch);
+  t.join();
+}
+
+TEST(mempool_pipeline_end_to_end) {
+  // Client tx in -> quorum-acked batch digest out (mempool_tests.rs:7-46).
+  auto committee = mempool_committee(7600);
+  auto myself = keys()[0].name;
+  auto delivered = make_channel<Bytes>();
+  auto threads = peer_listeners(committee, myself, delivered);
+
+  Store store = Store::open("");
+  Parameters params;
+  params.batch_size = 20;  // tiny: one tx seals a batch
+  params.max_batch_delay = 10'000;
+  auto rx_consensus = make_channel<ConsensusMempoolMessage>();
+  auto tx_consensus = make_channel<Digest>();
+  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
+                           tx_consensus);
+
+  // Send a client transaction to the :front address.
+  auto sock = Socket::connect(*committee.transactions_address(myself));
+  CHECK(sock.has_value());
+  Bytes tx(32, 9);
+  CHECK(sock->write_frame(tx));
+
+  auto digest = tx_consensus->recv();
+  CHECK(digest.has_value());
+  auto stored = store.read(digest->to_bytes());
+  CHECK(stored.has_value());
+  auto m = MempoolMessage::deserialize(*stored);
+  CHECK(m.batch.size() == 1);
+  CHECK(m.batch[0] == tx);
+  for (auto& t : threads) t.join();
+}
+
+int main() { return run_all(); }

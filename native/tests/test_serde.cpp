@@ -1,0 +1,109 @@
+// Serialization + JSON + base64 round-trips.
+#include "test_util.hpp"
+
+using namespace hotstuff;
+using namespace hotstuff::test;
+
+TEST(base64_roundtrip) {
+  for (size_t len : {0u, 1u, 2u, 3u, 31u, 32u, 33u, 64u}) {
+    Bytes b(len);
+    for (size_t i = 0; i < len; i++) b[i] = uint8_t(i * 7 + 1);
+    Bytes back;
+    CHECK(base64_decode(base64_encode(b), &back));
+    CHECK(back == b);
+  }
+  // 32-byte digests end with '=' (the log parser depends on this).
+  Bytes d(32, 0xAB);
+  std::string enc = base64_encode(d);
+  CHECK(enc.size() == 44);
+  CHECK(enc.back() == '=');
+}
+
+TEST(writer_reader_roundtrip) {
+  Writer w;
+  w.u8(7);
+  w.u32(0xDEADBEEF);
+  w.u64(0x0123456789ABCDEFull);
+  w.bytes(Bytes{1, 2, 3});
+  Reader r(w.out);
+  CHECK(r.u8() == 7);
+  CHECK(r.u32() == 0xDEADBEEF);
+  CHECK(r.u64() == 0x0123456789ABCDEFull);
+  CHECK(r.bytes() == (Bytes{1, 2, 3}));
+  CHECK(r.done());
+}
+
+TEST(reader_rejects_truncation) {
+  Writer w;
+  w.u64(1000);  // claims 1000-element sequence in a tiny buffer
+  Reader r(w.out);
+  bool threw = false;
+  try {
+    r.seq_len();
+  } catch (const SerdeError&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+TEST(json_roundtrip) {
+  std::string text = R"({"a": 1, "b": [true, null, "x\n"], "c": {"d": 2.5}})";
+  Json j = Json::parse(text);
+  CHECK(j.at("a").as_u64() == 1);
+  CHECK(j.at("b").items().size() == 3);
+  CHECK(j.at("b").items()[0].as_bool());
+  CHECK(j.at("b").items()[2].as_string() == "x\n");
+  CHECK(j.at("c").at("d").as_number() == 2.5);
+  Json j2 = Json::parse(j.dump(2));
+  CHECK(j2.at("c").at("d").as_number() == 2.5);
+}
+
+TEST(consensus_message_roundtrip) {
+  auto committee = consensus_committee(6100);
+  auto chain = make_chain(3, committee);
+  consensus::Block& block = chain[2];
+  block.payload.push_back(sha512_digest(Bytes{1, 2, 3}));
+
+  Bytes ser = consensus::ConsensusMessage::propose(block);
+  auto msg = consensus::ConsensusMessage::deserialize(ser);
+  CHECK(msg.kind == consensus::ConsensusMessage::Kind::kPropose);
+  CHECK(msg.block.digest() == block.digest());
+  CHECK(msg.block.qc.votes.size() == 3);
+
+  auto vote = make_vote(block, keys()[0]);
+  auto vmsg = consensus::ConsensusMessage::deserialize(
+      consensus::ConsensusMessage::vote_msg(vote));
+  CHECK(vmsg.vote.digest() == vote.digest());
+  CHECK(vmsg.vote.signature == vote.signature);
+}
+
+TEST(mempool_message_roundtrip) {
+  mempool::Batch batch{{1, 2, 3}, {4, 5}};
+  Bytes ser = mempool::MempoolMessage::make_batch(batch).serialize();
+  auto m = mempool::MempoolMessage::deserialize(ser);
+  CHECK(m.kind == mempool::MempoolMessage::Kind::kBatch);
+  CHECK(m.batch == batch);
+
+  auto req = mempool::MempoolMessage::make_batch_request(
+      {sha512_digest(Bytes{9})}, keys()[1].name);
+  auto m2 = mempool::MempoolMessage::deserialize(req.serialize());
+  CHECK(m2.kind == mempool::MempoolMessage::Kind::kBatchRequest);
+  CHECK(m2.missing.size() == 1);
+  CHECK(m2.origin == keys()[1].name);
+}
+
+TEST(committee_json_roundtrip) {
+  node::Committee c;
+  c.consensus = consensus_committee(6200);
+  c.mempool = mempool_committee(6300);
+  c.write("/tmp/.hs_test_committee.json");
+  node::Committee back = node::Committee::read("/tmp/.hs_test_committee.json");
+  CHECK(back.consensus.size() == 4);
+  CHECK(back.mempool.size() == 4);
+  auto name = keys()[2].name;
+  CHECK(back.consensus.address(name) == c.consensus.address(name));
+  CHECK(back.mempool.mempool_address(name) == c.mempool.mempool_address(name));
+  CHECK(back.consensus.quorum_threshold() == 3);
+}
+
+int main() { return run_all(); }

@@ -1,0 +1,64 @@
+// Store tests (store/src/tests/store_tests.rs:4-73 analogue): create,
+// read/write, unknown key, notify_read wake-on-write, WAL persistence.
+#include <cstdlib>
+#include <thread>
+
+#include "store/store.hpp"
+#include "test_util.hpp"
+
+using namespace hotstuff;
+using namespace hotstuff::test;
+
+TEST(create_store) {
+  Store s = Store::open("");
+  CHECK(s.valid());
+}
+
+TEST(read_write_value) {
+  Store s = Store::open("");
+  Bytes key{0, 1, 2}, value{3, 4, 5};
+  s.write(key, value);
+  auto got = s.read(key);
+  CHECK(got.has_value());
+  CHECK(*got == value);
+}
+
+TEST(read_unknown_key) {
+  Store s = Store::open("");
+  CHECK(!s.read(Bytes{9, 9, 9}).has_value());
+}
+
+TEST(read_notify) {
+  Store s = Store::open("");
+  Bytes key{0, 1, 2}, value{3, 4, 5};
+  auto waiter = s.notify_read(key);
+  CHECK(!waiter.ready());
+  std::thread writer([&] {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    s.write(key, value);
+  });
+  CHECK(waiter.wait() == value);
+  writer.join();
+  // already-present key resolves immediately
+  auto instant = s.notify_read(key);
+  CHECK(instant.wait_for(std::chrono::milliseconds(500)));
+}
+
+TEST(wal_persistence) {
+  std::string path = "/tmp/.hs_test_store";
+  std::system(("rm -rf " + path).c_str());
+  Bytes key{1, 1}, value{2, 2, 2};
+  {
+    Store s = Store::open(path);
+    s.write(key, value);
+    // read-back forces the write to have been applied before scope exit
+    CHECK(s.read(key).has_value());
+  }
+  Store s2 = Store::open(path);
+  auto got = s2.read(key);
+  CHECK(got.has_value());
+  CHECK(*got == value);
+  std::system(("rm -rf " + path).c_str());
+}
+
+int main() { return run_all(); }

@@ -1,0 +1,191 @@
+// Minimal test harness + deterministic fixtures, mirroring the reference's
+// tests/common.rs pattern (seeded keys, 4-node localhost committees with
+// per-file base ports, canned blocks/votes/QCs, chain builder, one-shot
+// listener fakes — consensus/src/tests/common.rs:17-198).
+#pragma once
+
+#include <cstdio>
+#include <functional>
+#include <string>
+#include <vector>
+
+#include "consensus/messages.hpp"
+#include "mempool/config.hpp"
+#include "mempool/messages.hpp"
+#include "node/config.hpp"
+
+namespace hotstuff {
+namespace test {
+
+// -- harness ----------------------------------------------------------------
+
+struct Registry {
+  static Registry& get() {
+    static Registry r;
+    return r;
+  }
+  std::vector<std::pair<std::string, std::function<void()>>> tests;
+  int failures = 0;
+  std::string current;
+};
+
+struct Register {
+  Register(const std::string& name, std::function<void()> fn) {
+    Registry::get().tests.emplace_back(name, std::move(fn));
+  }
+};
+
+#define TEST(name)                                                      \
+  static void test_##name();                                            \
+  static ::hotstuff::test::Register reg_##name(#name, test_##name);     \
+  static void test_##name()
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::printf("FAIL %s: %s (%s:%d)\n",                              \
+                  ::hotstuff::test::Registry::get().current.c_str(),    \
+                  #cond, __FILE__, __LINE__);                           \
+      ::hotstuff::test::Registry::get().failures++;                     \
+      return;                                                           \
+    }                                                                   \
+  } while (0)
+
+inline int run_all() {
+  auto& reg = Registry::get();
+  for (auto& [name, fn] : reg.tests) {
+    reg.current = name;
+    std::printf("RUN  %s\n", name.c_str());
+    std::fflush(stdout);
+    fn();
+  }
+  if (reg.failures) {
+    std::printf("%d FAILURE(S)\n", reg.failures);
+    return 1;
+  }
+  std::printf("OK (%zu tests)\n", reg.tests.size());
+  return 0;
+}
+
+// -- fixtures ---------------------------------------------------------------
+
+// Deterministic 4-node keys (seeds 100..103).
+inline std::vector<KeyPair> keys() {
+  std::vector<KeyPair> out;
+  for (uint8_t i = 0; i < 4; i++) {
+    std::array<uint8_t, 32> seed{};
+    seed[0] = 100 + i;
+    out.push_back(keypair_from_seed(seed));
+  }
+  return out;
+}
+
+inline consensus::Committee consensus_committee(uint16_t base_port) {
+  std::map<PublicKey, consensus::Authority> auth;
+  uint16_t port = base_port;
+  for (const auto& kp : keys()) {
+    consensus::Authority a;
+    a.stake = 1;
+    a.address = Address{"127.0.0.1", port++};
+    auth.emplace(kp.name, a);
+  }
+  return consensus::Committee(std::move(auth), 1);
+}
+
+inline mempool::Committee mempool_committee(uint16_t base_port) {
+  std::map<PublicKey, mempool::Authority> auth;
+  uint16_t port = base_port;
+  for (const auto& kp : keys()) {
+    mempool::Authority a;
+    a.stake = 1;
+    a.transactions_address = Address{"127.0.0.1", port++};
+    a.mempool_address = Address{"127.0.0.1", port++};
+    auth.emplace(kp.name, a);
+  }
+  return mempool::Committee(std::move(auth), 1);
+}
+
+// Signed block from a specific key (Block::new_from_key analogue).
+inline consensus::Block make_block(const consensus::QC& qc,
+                                   const KeyPair& author, uint64_t round,
+                                   std::vector<Digest> payload) {
+  consensus::Block b;
+  b.qc = qc;
+  b.author = author.name;
+  b.round = round;
+  b.payload = std::move(payload);
+  b.signature = Signature::sign(b.digest(), author.secret);
+  return b;
+}
+
+inline consensus::Vote make_vote(const consensus::Block& block,
+                                 const KeyPair& author) {
+  consensus::Vote v;
+  v.hash = block.digest();
+  v.round = block.round;
+  v.author = author.name;
+  v.signature = Signature::sign(v.digest(), author.secret);
+  return v;
+}
+
+// QC over a block hash/round signed by the first 3 fixture keys (quorum).
+inline consensus::QC make_qc(const Digest& hash, uint64_t round) {
+  consensus::QC qc;
+  qc.hash = hash;
+  qc.round = round;
+  consensus::QC unsigned_qc = qc;
+  Digest digest = unsigned_qc.digest();
+  auto ks = keys();
+  for (size_t i = 0; i < 3; i++) {
+    qc.votes.emplace_back(ks[i].name, Signature::sign(digest, ks[i].secret));
+  }
+  return qc;
+}
+
+// Valid chain of n blocks rooted at genesis, each certified by a QC
+// (chain() builder, common.rs:147-179). Leader keys cycle round-robin over
+// the sorted committee so handle_proposal's leader check passes.
+inline std::vector<consensus::Block> make_chain(
+    size_t n, const consensus::Committee& committee) {
+  auto ks = keys();
+  auto sorted = committee.sorted_keys();
+  auto key_for = [&](const PublicKey& name) -> const KeyPair& {
+    for (const auto& kp : ks) {
+      if (kp.name == name) return kp;
+    }
+    throw std::runtime_error("unknown leader");
+  };
+  std::vector<consensus::Block> chain;
+  consensus::QC qc;  // genesis
+  for (size_t i = 0; i < n; i++) {
+    uint64_t round = i + 1;
+    PublicKey leader = sorted[round % sorted.size()];
+    consensus::Block b = make_block(qc, key_for(leader), round, {});
+    qc = make_qc(b.digest(), b.round);
+    chain.push_back(std::move(b));
+  }
+  return chain;
+}
+
+// One-shot fake peer: accepts a connection, receives one frame, replies
+// "Ack", delivers the frame (listener() fixture, common.rs:182-198).
+inline std::thread listener(Listener l, std::function<void(Bytes)> deliver,
+                            bool ack = true) {
+  return std::thread([l = std::make_shared<Listener>(std::move(l)), deliver,
+                      ack]() mutable {
+    auto sock = l->accept();
+    if (!sock) return;
+    Bytes frame;
+    if (sock->read_frame(&frame)) {
+      if (ack) {
+        sock->write_frame(reinterpret_cast<const uint8_t*>("Ack"), 3);
+      }
+      if (deliver) deliver(std::move(frame));
+    }
+    // Closing here is fine: the ACK is already in the TCP buffer, and
+    // senders treat the drop as a peer failure (best-effort / reconnect).
+  });
+}
+
+}  // namespace test
+}  // namespace hotstuff

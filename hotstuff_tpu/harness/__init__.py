@@ -1,0 +1,11 @@
+from .config import (  # noqa: F401
+    BenchParameters,
+    Committee,
+    ConfigError,
+    Key,
+    LocalCommittee,
+    NodeParameters,
+    PlotParameters,
+)
+from .logs import LogParser, ParseError  # noqa: F401
+from .utils import BenchError, PathMaker, Print  # noqa: F401

@@ -1,0 +1,114 @@
+"""Benchmark harness CLI — the `fab local/remote/plot/...` surface of the
+reference (benchmark/fabfile.py:11-155) as a module entry point:
+
+  python -m hotstuff_tpu.harness local [--nodes 4] [--rate 100000] ...
+  python -m hotstuff_tpu.harness plot
+  python -m hotstuff_tpu.harness aggregate
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def cmd_local(args):
+    from .config import BenchParameters, NodeParameters
+    from .local import LocalBench
+    from .utils import BenchError, PathMaker, Print
+
+    bench_params = BenchParameters({
+        "faults": args.faults,
+        "nodes": [args.nodes],
+        "rate": [args.rate],
+        "tx_size": args.tx_size,
+        "duration": args.duration,
+        "tpu_sidecar": args.tpu_sidecar,
+    })
+    node_params = NodeParameters.default(
+        tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
+                     if args.tpu_sidecar else None))
+    node_params.json["mempool"]["batch_size"] = args.batch_size
+    node_params.json["consensus"]["timeout_delay"] = args.timeout
+    try:
+        ret = LocalBench(bench_params, node_params).run(debug=args.debug)
+        print(ret.result())
+        if args.output:
+            ret.print(args.output)
+    except BenchError as e:
+        Print.error(e)
+        sys.exit(1)
+
+
+def cmd_aggregate(args):
+    from .aggregate import LogAggregator
+
+    LogAggregator(max_latencies=args.max_latency).print()
+    print("aggregated series written to plots/")
+
+
+def cmd_plot(args):
+    from .aggregate import LogAggregator
+    from .plot import Ploter, PlotError
+
+    LogAggregator(max_latencies=args.max_latency).print()
+    try:
+        ploter = Ploter()
+        ploter.plot_latency()
+        ploter.plot_robustness()
+        if args.max_latency:
+            ploter.plot_tps()
+        print("plots written to plots/")
+    except PlotError as e:
+        print(f"plot failed: {e}")
+        sys.exit(1)
+
+
+def cmd_logs(args):
+    from .logs import LogParser, ParseError
+
+    try:
+        parser = LogParser.process(args.directory, faults=args.faults)
+        print(parser.result())
+    except ParseError as e:
+        print(f"parse failed: {e}")
+        sys.exit(1)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="hotstuff_tpu.harness")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("local", help="run a local 4-node benchmark")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument("--rate", type=int, default=100_000)
+    p.add_argument("--tx-size", type=int, default=512)
+    p.add_argument("--batch-size", type=int, default=15_000)
+    p.add_argument("--timeout", type=int, default=1_000)
+    p.add_argument("--duration", type=int, default=30, help="seconds")
+    p.add_argument("--tpu-sidecar", action="store_true",
+                   help="route QC verification through the TPU sidecar")
+    p.add_argument("--debug", action="store_true")
+    p.add_argument("--output", help="append summary to this result file")
+    p.set_defaults(func=cmd_local)
+
+    p = sub.add_parser("aggregate", help="aggregate results/ into series")
+    p.add_argument("--max-latency", type=int, nargs="*", default=[])
+    p.set_defaults(func=cmd_aggregate)
+
+    p = sub.add_parser("plot", help="aggregate + plot")
+    p.add_argument("--max-latency", type=int, nargs="*", default=[])
+    p.set_defaults(func=cmd_plot)
+
+    p = sub.add_parser("logs", help="parse a logs directory")
+    p.add_argument("directory", nargs="?", default="logs")
+    p.add_argument("--faults", type=int, default=0)
+    p.set_defaults(func=cmd_logs)
+
+    args = ap.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""Aggregate raw result files (mean ± stdev across runs) into plot series.
+
+Capability mirror of benchmark/benchmark/aggregate.py:80-174: scans
+results/bench-*.txt, groups runs of the same configuration, and emits
+latency-vs-rate, tps-vs-committee-size, and robustness series under
+plots/.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from glob import glob
+from itertools import groupby
+from os.path import join
+from re import findall, search
+from statistics import mean, stdev
+
+from .utils import PathMaker
+
+
+class Setup:
+    def __init__(self, faults, nodes, rate, tx_size):
+        self.faults = faults
+        self.nodes = nodes
+        self.rate = rate
+        self.tx_size = tx_size
+        self.max_latency = None
+
+    def __str__(self):
+        return (
+            f" Faults: {self.faults}\n"
+            f" Committee size: {self.nodes}\n"
+            f" Input rate: {self.rate} tx/s\n"
+            f" Transaction size: {self.tx_size} B\n"
+            f" Max latency: {self.max_latency} ms\n"
+        )
+
+    def __eq__(self, other):
+        return isinstance(other, Setup) and str(self) == str(other)
+
+    def __hash__(self):
+        return hash(str(self))
+
+    @classmethod
+    def from_str(cls, raw):
+        faults = int(search(r"Faults: (\d+)", raw).group(1))
+        nodes = int(search(r"Committee size: (\d+)", raw).group(1))
+        rate = int(search(r"Input rate: ([\d,]+)", raw).group(1).replace(",", ""))
+        tx_size = int(
+            search(r"Transaction size: ([\d,]+)", raw).group(1).replace(",", ""))
+        return cls(faults, nodes, rate, tx_size)
+
+
+class Result:
+    def __init__(self, mean_tps, mean_latency, std_tps=0, std_latency=0):
+        self.mean_tps = mean_tps
+        self.mean_latency = mean_latency
+        self.std_tps = std_tps
+        self.std_latency = std_latency
+
+    def __str__(self):
+        return (
+            f" TPS: {self.mean_tps} +/- {self.std_tps} tx/s\n"
+            f" Latency: {self.mean_latency} +/- {self.std_latency} ms\n"
+        )
+
+    @classmethod
+    def from_str(cls, raw):
+        tps = int(
+            search(r"End-to-end TPS: ([\d,]+)", raw).group(1).replace(",", ""))
+        latency = int(
+            search(r"End-to-end latency: ([\d,]+)", raw).group(1)
+            .replace(",", ""))
+        return cls(tps, latency)
+
+    @classmethod
+    def aggregate(cls, results):
+        assert len(results) > 0
+        if len(results) == 1:
+            return results[0]
+        mean_tps = round(mean(r.mean_tps for r in results))
+        mean_latency = round(mean(r.mean_latency for r in results))
+        std_tps = round(stdev(r.mean_tps for r in results))
+        std_latency = round(stdev(r.mean_latency for r in results))
+        return cls(mean_tps, mean_latency, std_tps, std_latency)
+
+
+class LogAggregator:
+    def __init__(self, max_latencies=None):
+        self.max_latencies = max_latencies or []
+        data = ""
+        for filename in glob(join(PathMaker.results_path(), "bench-*.txt")):
+            with open(filename, "r") as f:
+                data += f.read()
+
+        records = defaultdict(list)
+        for chunk in data.replace(",", "").split("SUMMARY")[1:]:
+            if chunk:
+                records[Setup.from_str(chunk)].append(Result.from_str(chunk))
+
+        self.records = {k: Result.aggregate(v) for k, v in records.items()}
+
+    def print(self):
+        os.makedirs(PathMaker.plot_path(), exist_ok=True)
+        results = [
+            self._print_latency(),
+            self._print_tps(scalability=False),
+            self._print_tps(scalability=True),
+            self._print_robustness(),
+        ]
+        for name, records in results:
+            for setup, values in records.items():
+                data = "\n".join(f" Variable value: X={x}\n{y}"
+                                 for x, y in values)
+                string = (
+                    "\n"
+                    "-----------------------------------------\n"
+                    " RESULTS:\n"
+                    "-----------------------------------------\n"
+                    f"{setup}"
+                    "\n"
+                    f"{data}"
+                    "-----------------------------------------\n"
+                )
+                max_lat = f"-{setup.max_latency}" if setup.max_latency else ""
+                filename = join(
+                    PathMaker.plot_path(),
+                    f"{name}-{setup.faults}-{setup.nodes}-{setup.rate}-"
+                    f"{setup.tx_size}{max_lat}.txt".replace("[", "")
+                    .replace("]", "").replace(" ", ""))
+                with open(filename, "w") as f:
+                    f.write(string)
+
+    def _print_latency(self):
+        """Latency as a function of input rate, per committee size."""
+        organized = defaultdict(list)
+        for setup, result in self.records.items():
+            rate = setup.rate
+            setup_key = Setup(setup.faults, setup.nodes, "any", setup.tx_size)
+            organized[setup_key].append((rate, result))
+        for setup_key in organized:
+            organized[setup_key].sort(key=lambda x: x[0])
+        return "latency", organized
+
+    def _print_tps(self, scalability):
+        """Peak TPS under a latency cap, vs committee size (scalability) or
+        vs rate."""
+        organized = defaultdict(list)
+        for max_latency in self.max_latencies:
+            for setup, result in self.records.items():
+                if result.mean_latency <= max_latency:
+                    nodes = setup.nodes
+                    rate = setup.rate
+                    key = Setup(setup.faults, "x" if scalability else nodes,
+                                "any", setup.tx_size)
+                    key.max_latency = max_latency
+                    variable = nodes if scalability else rate
+                    organized[key].append((variable, result))
+        # keep the best TPS per variable value
+        for key, values in organized.items():
+            values.sort(key=lambda x: (x[0], x[1].mean_tps))
+            best = {}
+            for variable, result in values:
+                best[variable] = result
+            organized[key] = sorted(best.items())
+        return ("tps-scalability" if scalability else "tps"), organized
+
+    def _print_robustness(self):
+        """TPS/latency as input rate grows (stress behavior)."""
+        organized = defaultdict(list)
+        for setup, result in self.records.items():
+            rate = setup.rate
+            key = Setup(setup.faults, setup.nodes, "any", setup.tx_size)
+            organized[key].append((rate, result))
+        for key in organized:
+            organized[key].sort(key=lambda x: x[0])
+        return "robustness", organized

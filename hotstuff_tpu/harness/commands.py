@@ -1,0 +1,80 @@
+"""Shell command strings for the benchmark harness
+(benchmark/benchmark/commands.py:6-56 capability: compile, keygen, run
+node/client, cleanup, binary aliases) — targeting the C++ CMake build
+instead of cargo.
+"""
+
+from __future__ import annotations
+
+from os.path import join
+
+from .utils import PathMaker
+
+
+class CommandMaker:
+    @staticmethod
+    def cleanup():
+        return (
+            f"rm -rf .db-* ; rm -f .*.json ; "
+            f"mkdir -p {PathMaker.results_path()}"
+        )
+
+    @staticmethod
+    def clean_logs():
+        return f"rm -rf {PathMaker.logs_path()} ; mkdir -p {PathMaker.logs_path()}"
+
+    @staticmethod
+    def compile():
+        return (
+            f"cmake -S {PathMaker.node_crate_path()} "
+            f"-B {PathMaker.binary_path()} -G Ninja "
+            f"&& cmake --build {PathMaker.binary_path()}"
+        )
+
+    @staticmethod
+    def generate_key(filename):
+        assert isinstance(filename, str)
+        return f"./node keys --filename {filename}"
+
+    @staticmethod
+    def run_node(keys, committee, store, parameters, debug=False):
+        assert isinstance(keys, str)
+        assert isinstance(committee, str)
+        assert isinstance(parameters, str)
+        assert isinstance(debug, bool)
+        v = "-vv" if debug else "-v"
+        return (
+            f"./node run --keys {keys} --committee {committee} "
+            f"--store {store} --parameters {parameters} {v}"
+        )
+
+    @staticmethod
+    def run_client(address, size, rate, timeout, nodes=None):
+        assert isinstance(address, str)
+        assert isinstance(size, int) and size > 0
+        assert isinstance(rate, int) and rate >= 0
+        assert isinstance(nodes, list) or nodes is None
+        nodes = nodes or []
+        assert all(isinstance(x, str) for x in nodes)
+        nodes_str = f" --nodes {' '.join(nodes)}" if nodes else ""
+        return (
+            f"./client {address} --size {size} "
+            f"--rate {rate} --timeout {timeout}{nodes_str}"
+        )
+
+    @staticmethod
+    def run_sidecar(port, log_path):
+        return (
+            f"python -m hotstuff_tpu.sidecar --port {port} "
+            f"> {log_path} 2>&1"
+        )
+
+    @staticmethod
+    def kill():
+        return "tmux kill-server 2>/dev/null || true"
+
+    @staticmethod
+    def alias_binaries(origin):
+        assert isinstance(origin, str)
+        node, client = join(origin, "node"), join(origin, "client")
+        return f"rm -f node client ; ln -s {node} . ; ln -s {client} ."

@@ -1,0 +1,194 @@
+"""Benchmark configuration: committee/parameters JSON writers matching the
+C++ node's readers (native/src/node/config.cpp), plus bench/plot parameter
+validation. Mirrors benchmark/benchmark/config.py:8-173 in the reference —
+the committee schema is wire-compatible with the node so harness and node
+evolve together.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+
+
+class ConfigError(Exception):
+    pass
+
+
+class Key:
+    def __init__(self, name, secret):
+        self.name = name
+        self.secret = secret
+
+    @classmethod
+    def from_file(cls, filename):
+        assert isinstance(filename, str)
+        with open(filename, "r") as f:
+            data = json.load(f)
+        return cls(data["name"], data["secret"])
+
+
+class Committee:
+    """Address book for consensus + mempool, one authority per node.
+
+    consensus: one address (peer consensus messages)
+    mempool: transactions_address (:front, clients) + mempool_address (peers)
+    """
+
+    def __init__(self, names, consensus_addr, front_addr, mempool_addr):
+        inputs = [names, consensus_addr, front_addr, mempool_addr]
+        assert all(isinstance(x, list) for x in inputs)
+        assert all(isinstance(x, str) for y in inputs for x in y)
+        assert len({len(x) for x in inputs}) == 1
+
+        self.names = names
+        self.consensus = consensus_addr
+        self.front = front_addr
+        self.mempool = mempool_addr
+
+        self.json = {
+            "consensus": self._build_consensus(),
+            "mempool": self._build_mempool(),
+        }
+
+    def _build_consensus(self):
+        node = {}
+        for name, address in zip(self.names, self.consensus):
+            node[name] = {"stake": 1, "address": address}
+        return {"authorities": node, "epoch": 1}
+
+    def _build_mempool(self):
+        node = {}
+        for name, front, mempool in zip(self.names, self.front, self.mempool):
+            node[name] = {
+                "stake": 1,
+                "transactions_address": front,
+                "mempool_address": mempool,
+            }
+        return {"authorities": node, "epoch": 1}
+
+    def print(self, filename):
+        assert isinstance(filename, str)
+        with open(filename, "w") as f:
+            json.dump(self.json, f, indent=4, sort_keys=True)
+
+    def size(self):
+        return len(self.names)
+
+    def front_addresses(self):
+        return self.front
+
+    @staticmethod
+    def ip(address):
+        assert isinstance(address, str)
+        return address.split(":")[0]
+
+
+class LocalCommittee(Committee):
+    """All nodes on localhost, 3 consecutive ports per node from a base
+    (benchmark/benchmark/config.py:81-90 convention)."""
+
+    def __init__(self, names, port):
+        assert isinstance(names, list)
+        assert isinstance(port, int)
+        size = len(names)
+        consensus = [f"127.0.0.1:{port + i}" for i in range(size)]
+        front = [f"127.0.0.1:{port + i + size}" for i in range(size)]
+        mempool = [f"127.0.0.1:{port + i + 2 * size}" for i in range(size)]
+        super().__init__(names, consensus, front, mempool)
+
+
+class NodeParameters:
+    def __init__(self, json_input):
+        inputs = []
+        try:
+            inputs += [json_input["consensus"]["timeout_delay"]]
+            inputs += [json_input["consensus"]["sync_retry_delay"]]
+            inputs += [json_input["mempool"]["gc_depth"]]
+            inputs += [json_input["mempool"]["sync_retry_delay"]]
+            inputs += [json_input["mempool"]["sync_retry_nodes"]]
+            inputs += [json_input["mempool"]["batch_size"]]
+            inputs += [json_input["mempool"]["max_batch_delay"]]
+        except KeyError as e:
+            raise ConfigError(f"Malformed parameters: missing key {e}")
+        if not all(isinstance(x, int) for x in inputs):
+            raise ConfigError("Invalid parameters type")
+        sidecar = json_input.get("tpu_sidecar")
+        if sidecar is not None and not isinstance(sidecar, str):
+            raise ConfigError("tpu_sidecar must be an address string")
+        self.timeout_delay = json_input["consensus"]["timeout_delay"]
+        self.json = json_input
+
+    def print(self, filename):
+        assert isinstance(filename, str)
+        with open(filename, "w") as f:
+            json.dump(self.json, f, indent=4, sort_keys=True)
+
+    @classmethod
+    def default(cls, tpu_sidecar=None):
+        data = {
+            "consensus": {"timeout_delay": 5_000, "sync_retry_delay": 10_000},
+            "mempool": {
+                "gc_depth": 50,
+                "sync_retry_delay": 5_000,
+                "sync_retry_nodes": 3,
+                "batch_size": 500_000,
+                "max_batch_delay": 100,
+            },
+        }
+        if tpu_sidecar:
+            data["tpu_sidecar"] = tpu_sidecar
+        return cls(data)
+
+
+class BenchParameters:
+    def __init__(self, json_input):
+        try:
+            nodes = json_input["nodes"]
+            nodes = nodes if isinstance(nodes, list) else [nodes]
+            if not nodes or any(x <= 1 for x in nodes):
+                raise ConfigError("Missing or invalid number of nodes")
+            rate = json_input["rate"]
+            rate = rate if isinstance(rate, list) else [rate]
+            if not rate:
+                raise ConfigError("Missing input rate")
+            self.nodes = [int(x) for x in nodes]
+            self.rate = [int(x) for x in rate]
+            self.tx_size = int(json_input["tx_size"])
+            self.faults = int(json_input["faults"])
+            self.duration = int(json_input["duration"])
+            self.runs = int(json_input.get("runs", 1))
+            self.tpu_sidecar = bool(json_input.get("tpu_sidecar", False))
+        except KeyError as e:
+            raise ConfigError(f"Malformed bench parameters: missing key {e}")
+        except ValueError:
+            raise ConfigError("Invalid parameters type")
+        if min(self.nodes) <= self.faults:
+            raise ConfigError("There should be more nodes than faults")
+
+
+class PlotParameters:
+    def __init__(self, json_input):
+        try:
+            faults = json_input["faults"]
+            faults = faults if isinstance(faults, list) else [faults]
+            self.faults = [int(x) for x in faults] if faults else [0]
+            nodes = json_input["nodes"]
+            nodes = nodes if isinstance(nodes, list) else [nodes]
+            if not nodes:
+                raise ConfigError("Missing number of nodes")
+            self.nodes = [int(x) for x in nodes]
+            self.tx_size = int(json_input["tx_size"])
+            max_lat = json_input["max_latency"]
+            max_lat = max_lat if isinstance(max_lat, list) else [max_lat]
+            if not max_lat:
+                raise ConfigError("Missing max latency")
+            self.max_latency = [int(x) for x in max_lat]
+        except KeyError as e:
+            raise ConfigError(f"Malformed plot parameters: missing key {e}")
+        except ValueError:
+            raise ConfigError("Invalid parameters type")
+
+
+def ordered(data):
+    return OrderedDict(sorted(data.items()))

@@ -1,0 +1,142 @@
+"""Local benchmark: run a full committee + clients (+ optional TPU verify
+sidecar) on this machine and mine the logs for TPS/latency.
+
+Capability mirror of benchmark/benchmark/local.py:12-120: kill stale
+processes, compile, generate keys/committee/parameters, boot nodes minus
+`faults` (crash faults = nodes never booted), boot one client per node at
+rate/N, run for `duration`, parse logs. Processes are plain subprocesses
+with per-process log redirection (the reference used tmux panes for the
+same effect).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+from time import sleep
+
+from .commands import CommandMaker
+from .config import Key, LocalCommittee, NodeParameters
+from .logs import LogParser, ParseError
+from .utils import BenchError, PathMaker, Print
+
+
+class LocalBench:
+    BASE_PORT = 9000
+    SIDECAR_PORT = 7100
+
+    def __init__(self, bench_parameters, node_parameters=None):
+        self.nodes = bench_parameters.nodes[0]
+        self.rate = bench_parameters.rate[0]
+        self.tx_size = bench_parameters.tx_size
+        self.faults = bench_parameters.faults
+        self.duration = bench_parameters.duration
+        self.tpu_sidecar = getattr(bench_parameters, "tpu_sidecar", False)
+        self.node_parameters = node_parameters or NodeParameters.default(
+            tpu_sidecar=(f"127.0.0.1:{self.SIDECAR_PORT}"
+                         if self.tpu_sidecar else None))
+        self._procs = []
+
+    def _background_run(self, command, log_file):
+        name = command.split()[0]
+        cmd = f"{command} 2> {log_file}"
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c", cmd], preexec_fn=os.setsid)
+        self._procs.append((name, proc))
+
+    def _kill_nodes(self):
+        for _, proc in self._procs:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        self._procs = []
+        subprocess.run(
+            ["/bin/sh", "-c",
+             "pkill -f '\\./node run' 2>/dev/null; "
+             "pkill -f '\\./client 127' 2>/dev/null; true"],
+            check=False)
+
+    def run(self, debug=False):
+        assert isinstance(debug, bool)
+        Print.heading("Starting local benchmark")
+
+        # Kill any previous testbed and cleanup.
+        self._kill_nodes()
+        cmd = f"{CommandMaker.cleanup()} ; {CommandMaker.clean_logs()}"
+        subprocess.run(["/bin/sh", "-c", cmd], check=True)
+
+        try:
+            # Compile the node and create binary aliases.
+            Print.info("Compiling the node...")
+            subprocess.run(["/bin/sh", "-c", CommandMaker.compile()],
+                           check=True, capture_output=True)
+            subprocess.run(
+                ["/bin/sh", "-c",
+                 CommandMaker.alias_binaries(PathMaker.binary_path())],
+                check=True)
+
+            # Generate configuration files.
+            keys = []
+            for i in range(self.nodes):
+                filename = PathMaker.key_file(i)
+                subprocess.run(
+                    ["/bin/sh", "-c", CommandMaker.generate_key(filename)],
+                    check=True)
+                keys.append(Key.from_file(filename))
+            names = [k.name for k in keys]
+            committee = LocalCommittee(names, self.BASE_PORT)
+            committee.print(PathMaker.committee_file())
+            self.node_parameters.print(PathMaker.parameters_file())
+
+            # Optionally start the TPU verify sidecar first so nodes connect
+            # on boot (the crypto layer falls back to host verify until the
+            # sidecar is reachable).
+            if self.tpu_sidecar:
+                Print.info("Booting TPU verify sidecar...")
+                self._background_run(
+                    f"python -m hotstuff_tpu.sidecar "
+                    f"--port {self.SIDECAR_PORT}",
+                    PathMaker.sidecar_log_file())
+
+            # Do not boot faulty nodes (crash faults, local.py:75-76 in the
+            # reference); clients only target alive nodes and split the rate
+            # among them.
+            alive = self.nodes - self.faults
+            addresses = committee.front_addresses()[:alive]
+            rate_share = -(-self.rate // alive)  # ceil
+            timeout = self.node_parameters.timeout_delay
+
+            # Nodes first, then clients with the alive fronts as their
+            # --nodes wait list: the client retries those until reachable
+            # (its single connect to the target would otherwise race a slow
+            # node boot and waste the whole run).
+            for i in range(alive):
+                cmd = CommandMaker.run_node(
+                    PathMaker.key_file(i),
+                    PathMaker.committee_file(),
+                    PathMaker.db_path(i),
+                    PathMaker.parameters_file(),
+                    debug=debug)
+                self._background_run(cmd, PathMaker.node_log_file(i))
+
+            for i, address in enumerate(addresses):
+                cmd = CommandMaker.run_client(
+                    address, self.tx_size, rate_share, timeout,
+                    nodes=addresses)
+                self._background_run(cmd, PathMaker.client_log_file(i))
+
+            # Wait for all transactions to be processed.
+            Print.info(f"Running benchmark ({self.duration} sec)...")
+            sleep(2 * timeout / 1000)
+            sleep(self.duration)
+            self._kill_nodes()
+
+            # Parse logs and return the summary.
+            Print.info("Parsing logs...")
+            return LogParser.process(PathMaker.logs_path(),
+                                     faults=self.faults)
+        except (subprocess.SubprocessError, ParseError) as e:
+            self._kill_nodes()
+            raise BenchError("Failed to run benchmark", e)

@@ -1,0 +1,271 @@
+"""Log mining → TPS/BPS/latency metrics.
+
+Reimplements the reference's measurement pipeline
+(benchmark/benchmark/logs.py:17-251): client logs give input rate, start
+time and per-sample send times; node logs give proposal/commit times per
+batch digest, batch sizes, and sample-tx→batch joins. Consensus metrics
+count from first proposal to last commit; end-to-end metrics count from
+client start. The log grammar is frozen — the C++ node emits exactly these
+phrasings (see native/src/*/: "NOTE: ... used to compute performance").
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from glob import glob
+from os.path import join
+from re import findall, search
+from statistics import mean
+
+from .utils import Print
+
+SIGNATURE_LENGTH = 0
+PUBLICKEY_LENGTH = 0
+
+
+class ParseError(Exception):
+    pass
+
+
+class LogParser:
+    def __init__(self, clients, nodes, faults):
+        inputs = [clients, nodes]
+        assert all(isinstance(x, list) for x in inputs)
+        assert all(isinstance(x, str) for y in inputs for x in y)
+        if not clients or not nodes:
+            raise ParseError("missing client or node logs")
+
+        self.faults = faults
+        if isinstance(faults, int):
+            self.committee_size = len(nodes) + int(faults)
+        else:
+            self.committee_size = "?"
+
+        try:
+            results = [self._parse_client(x) for x in clients]
+        except (ValueError, IndexError, AttributeError) as e:
+            raise ParseError(f"Failed to parse client logs: {e}")
+        self.size, self.rate, self.start, misses, self.sent_samples = zip(
+            *results)
+        self.misses = sum(misses)
+
+        try:
+            results = [self._parse_node(x) for x in nodes]
+        except (ValueError, IndexError, AttributeError) as e:
+            raise ParseError(f"Failed to parse node logs: {e}")
+        proposals, commits, sizes, self.received_samples, timeouts, configs \
+            = zip(*results)
+        self.proposals = self._merge_earliest(proposals)
+        self.commits = self._merge_earliest(commits)
+        self.sizes = {
+            k: v for x in sizes for k, v in x.items() if k in self.commits
+        }
+        self.timeouts = max(timeouts)
+        self.configs = configs
+
+        if self.misses != 0:
+            Print.warn(
+                f"Clients missed their target rate {self.misses:,} time(s)")
+        # Nodes are expected to time out once at the beginning at most.
+        if self.timeouts > 2:
+            Print.warn(f"Nodes timed out {self.timeouts:,} time(s)")
+
+    # -- parsing -------------------------------------------------------------
+
+    @staticmethod
+    def _merge_earliest(dicts):
+        merged = {}
+        for d in dicts:
+            for k, v in d.items():
+                if k not in merged or merged[k] > v:
+                    merged[k] = v
+        return merged
+
+    @staticmethod
+    def _to_posix(ts):
+        return datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+
+    def _parse_client(self, log):
+        # Fatal client conditions in the C++ grammar: any ERROR-level line,
+        # or the send-failure WARN that precedes client exit
+        # (native/src/node/client.cpp).
+        if search(r" ERROR ", log) is not None or \
+                search(r"Failed to send transaction", log) is not None:
+            raise ParseError("Client(s) failed")
+
+        size = int(search(r"Transactions size: (\d+)", log).group(1))
+        rate = int(search(r"Transactions rate: (\d+)", log).group(1))
+        start = self._to_posix(search(r"\[(.*Z) .* Start ", log).group(1))
+        misses = len(findall(r"rate too high", log))
+        samples = {
+            int(s): self._to_posix(t)
+            for t, s in findall(r"\[(.*Z) .* sample transaction (\d+)", log)
+        }
+        return size, rate, start, misses, samples
+
+    def _parse_node(self, log):
+        # Fatal node conditions: ERROR-level lines (uncaught exceptions,
+        # bind failures, store corruption — native/src/node/main.cpp) or a
+        # C++ runtime abort message.
+        if search(r" ERROR ", log) is not None or \
+                search(r"terminate called|panic", log) is not None:
+            raise ParseError("Node(s) failed")
+
+        proposals = self._merge_earliest([{
+            d: self._to_posix(t)
+            for t, d in findall(r"\[(.*Z) .* Created B\d+ -> ([^ ]+=)", log)
+        }])
+        commits = self._merge_earliest([{
+            d: self._to_posix(t)
+            for t, d in findall(r"\[(.*Z) .* Committed B\d+ -> ([^ ]+=)", log)
+        }])
+        sizes = {
+            d: int(s)
+            for d, s in findall(r"Batch ([^ ]+) contains (\d+) B", log)
+        }
+        samples = {
+            int(s): d
+            for d, s in findall(r"Batch ([^ ]+) contains sample tx (\d+)",
+                                log)
+        }
+        timeouts = len(findall(r".* WARN .* Timeout", log))
+
+        configs = {
+            "consensus": {
+                "timeout_delay": int(
+                    search(r"Timeout delay .* (\d+)", log).group(1)),
+                "sync_retry_delay": int(
+                    search(r"consensus.* Sync retry delay .* (\d+)",
+                           log).group(1)),
+            },
+            "mempool": {
+                "gc_depth": int(
+                    search(r"Garbage collection .* (\d+)", log).group(1)),
+                "sync_retry_delay": int(
+                    search(r"mempool.* Sync retry delay .* (\d+)",
+                           log).group(1)),
+                "sync_retry_nodes": int(
+                    search(r"Sync retry nodes .* (\d+)", log).group(1)),
+                "batch_size": int(
+                    search(r"Batch size .* (\d+)", log).group(1)),
+                "max_batch_delay": int(
+                    search(r"Max batch delay .* (\d+)", log).group(1)),
+            },
+        }
+        return proposals, commits, sizes, samples, timeouts, configs
+
+    # -- metrics -------------------------------------------------------------
+
+    def _tx_bytes(self):
+        return self.size[0] + PUBLICKEY_LENGTH + SIGNATURE_LENGTH
+
+    def _consensus_throughput(self):
+        if not self.commits:
+            return 0, 0, 0
+        start = min(self.proposals.values())
+        end = max(self.commits.values())
+        duration = end - start
+        byte_total = sum(self.sizes.values())
+        bps = byte_total / duration if duration else 0
+        tps = bps / self._tx_bytes()
+        return tps, bps, duration
+
+    def _consensus_latency(self):
+        latency = [
+            c - self.proposals[d]
+            for d, c in self.commits.items()
+            if d in self.proposals
+        ]
+        return mean(latency) if latency else 0
+
+    def _end_to_end_throughput(self):
+        if not self.commits:
+            return 0, 0, 0
+        start = min(self.start)
+        end = max(self.commits.values())
+        duration = end - start
+        byte_total = sum(self.sizes.values())
+        bps = byte_total / duration if duration else 0
+        tps = bps / self._tx_bytes()
+        return tps, bps, duration
+
+    def _end_to_end_latency(self):
+        latency = []
+        for sent, received in zip(self.sent_samples, self.received_samples):
+            for tx_id, batch_id in received.items():
+                if batch_id in self.commits and tx_id in sent:
+                    latency.append(self.commits[batch_id] - sent[tx_id])
+        return mean(latency) if latency else 0
+
+    def result(self):
+        consensus_latency = self._consensus_latency() * 1000
+        consensus_tps, consensus_bps, _ = self._consensus_throughput()
+        end_to_end_tps, end_to_end_bps, duration = \
+            self._end_to_end_throughput()
+        end_to_end_latency = self._end_to_end_latency() * 1000
+        cfg = self.configs[0]
+        batch_size = cfg["mempool"]["batch_size"]
+        tx_bytes = self._tx_bytes()
+        mean_block = (
+            round(mean(self.sizes.values()) / tx_bytes, 2)
+            if self.sizes else 0)
+        return (
+            "\n"
+            "-----------------------------------------\n"
+            " SUMMARY:\n"
+            "-----------------------------------------\n"
+            " + CONFIG:\n"
+            f" Faults: {self.faults} nodes\n"
+            f" Committee size: {self.committee_size} nodes\n"
+            f" Input rate: {sum(self.rate):,} tx/s\n"
+            f" Transaction size: {self.size[0]:,} B\n"
+            f" Execution time: {round(duration):,} s\n"
+            "\n"
+            f" Consensus timeout delay: "
+            f"{cfg['consensus']['timeout_delay']:,} ms\n"
+            f" Consensus sync retry delay: "
+            f"{cfg['consensus']['sync_retry_delay']:,} ms\n"
+            f" Mempool GC depth: {cfg['mempool']['gc_depth']:,} rounds\n"
+            f" Mempool sync retry delay: "
+            f"{cfg['mempool']['sync_retry_delay']:,} ms\n"
+            f" Mempool sync retry nodes: "
+            f"{cfg['mempool']['sync_retry_nodes']:,} nodes\n"
+            f" Mempool batch size: {batch_size:,} B\n"
+            f" Mempool max batch delay: "
+            f"{cfg['mempool']['max_batch_delay']:,} ms\n"
+            "\n"
+            " + RESULTS:\n"
+            f" Consensus TPS: {round(consensus_tps):,} tx/s\n"
+            f" Consensus BPS: {round(consensus_bps):,} B/s\n"
+            f" Consensus latency: {round(consensus_latency):,} ms\n"
+            "\n"
+            f" End-to-end TPS: {round(end_to_end_tps):,} tx/s\n"
+            f" End-to-end BPS: {round(end_to_end_bps):,} B/s\n"
+            f" End-to-end latency: {round(end_to_end_latency):,} ms\n"
+            "\n"
+            f" Max transactions per block: "
+            f"{round(batch_size / tx_bytes)} tx/block\n"
+            f" Actual transactions per block: {mean_block} tx/block\n"
+            f" Blocks per second: "
+            f"{round(len(self.sizes) / duration) if duration > 0 else 0} "
+            "blocks/s\n"
+            "-----------------------------------------\n"
+        )
+
+    def print(self, filename):
+        assert isinstance(filename, str)
+        with open(filename, "a") as f:
+            f.write(self.result())
+
+    @classmethod
+    def process(cls, directory, faults=0):
+        assert isinstance(directory, str)
+        clients = []
+        for filename in sorted(glob(join(directory, "client-*.log"))):
+            with open(filename, "r") as f:
+                clients.append(f.read())
+        nodes = []
+        for filename in sorted(glob(join(directory, "node-*.log"))):
+            with open(filename, "r") as f:
+                nodes.append(f.read())
+        return cls(clients, nodes, faults)

@@ -1,0 +1,122 @@
+"""Matplotlib plots over aggregated series
+(benchmark/benchmark/plot.py:16-164 capability: latency-vs-throughput,
+tps-vs-committee-size, robustness; tps↔bps twin axis).
+"""
+
+from __future__ import annotations
+
+from glob import glob
+from itertools import cycle
+from os.path import join
+from re import findall, search
+
+from .utils import PathMaker
+
+
+class PlotError(Exception):
+    pass
+
+
+class Ploter:
+    def __init__(self, width=6.4, height=4.8):
+        import matplotlib
+
+        matplotlib.use("Agg")  # headless
+        import matplotlib.pyplot as plt
+
+        plt.figure(figsize=(width, height))
+        self.plt = plt
+
+    @staticmethod
+    def _natural_keys(text):
+        def try_cast(t):
+            return int(t) if t.isdigit() else t
+        return [try_cast(c) for c in findall(r"(\d+|\D+)", text)]
+
+    @staticmethod
+    def _tps2bps(x, tx_size):
+        return x * tx_size / 1e6
+
+    @staticmethod
+    def _bps2tps(x, tx_size):
+        return x * 1e6 / tx_size
+
+    def _measurements(self, data):
+        values = findall(r"Variable value: X=(\d+)", data)
+        tps = findall(r"TPS: (\d+) \+/- (\d+)", data)
+        latency = findall(r"Latency: (\d+) \+/- (\d+)", data)
+        if not (len(values) == len(tps) == len(latency)):
+            raise PlotError("Unequal number of x and y values")
+        return (
+            [int(x) for x in values],
+            [int(x) for x, _ in tps],
+            [int(s) for _, s in tps],
+            [int(x) for x, _ in latency],
+            [int(s) for _, s in latency],
+        )
+
+    def _plot(self, x_label, y_label, y_axis, z_axis, type,
+              tps_y_axis=False):
+        self.plt.clf()
+        markers = cycle(["o", "v", "s", "d", "^"])
+        files = sorted(glob(join(PathMaker.plot_path(), f"{type}*.txt")),
+                       key=self._natural_keys)
+        if not files:
+            raise PlotError(f"no aggregated data for {type}")
+        tx_size = 512
+        for filename in files:
+            with open(filename, "r") as f:
+                data = f.read()
+            m = search(r"Transaction size: (\d+)", data)
+            if m:
+                tx_size = int(m.group(1))
+            values, tps, tps_std, lat, lat_std = self._measurements(data)
+            x = values
+            y, y_err = y_axis(tps, tps_std, lat, lat_std)
+            label = z_axis(data)
+            self.plt.errorbar(x, y, yerr=y_err, label=label,
+                              marker=next(markers), capsize=3, linestyle="-")
+        self.plt.legend(loc="best", fontsize="small")
+        self.plt.xlabel(x_label)
+        self.plt.ylabel(y_label)
+        self.plt.grid(True, alpha=0.3)
+        if tps_y_axis:
+            # Twin tps<->MB/s axis (the reference's plot.py:46-54).
+            self.plt.gca().secondary_yaxis(
+                "right",
+                functions=(
+                    lambda v: self._tps2bps(v, tx_size),
+                    lambda v: self._bps2tps(v, tx_size),
+                )).set_ylabel("Throughput (MB/s)")
+        for ext in ("pdf", "png"):
+            self.plt.savefig(PathMaker.plot_file(type, ext),
+                             bbox_inches="tight")
+
+    @staticmethod
+    def _committee_label(data):
+        m = search(r"Committee size: (\d+)", data)
+        f = search(r"Faults: (\d+)", data)
+        label = f"{m.group(1)} nodes" if m else "?"
+        if f and int(f.group(1)):
+            label += f" ({f.group(1)} faulty)"
+        return label
+
+    def plot_latency(self):
+        self._plot(
+            "Throughput (tx/s)", "Latency (ms)",
+            lambda tps, tps_std, lat, lat_std: (lat, lat_std),
+            self._committee_label, "latency")
+
+    def plot_robustness(self):
+        self._plot(
+            "Input rate (tx/s)", "Throughput (tx/s)",
+            lambda tps, tps_std, lat, lat_std: (tps, tps_std),
+            self._committee_label, "robustness", tps_y_axis=True)
+
+    def plot_tps(self):
+        def label(data):
+            m = search(r"Max latency: (\d+)", data)
+            return f"max latency {m.group(1)} ms" if m else "tps"
+        self._plot("Committee size", "Throughput (tx/s)",
+                   lambda tps, tps_std, lat, lat_std: (tps, tps_std),
+                   label, "tps-scalability", tps_y_axis=True)

@@ -1,0 +1,218 @@
+"""Remote (multi-host) benchmark orchestration over plain ssh/scp.
+
+Capability mirror of benchmark/benchmark/remote.py:31-300 — install,
+update, configure, run, and collect logs across a fleet of hosts — built
+on subprocess ssh instead of fabric/paramiko (neither ships in this
+image). Hosts come from a `hosts` list in settings.json or an explicit
+list; cloud instance lifecycle (create/start/stop/terminate) lives in
+instance.py and is gated on boto3 availability.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from collections import OrderedDict
+from os.path import basename, join, splitext
+
+from .commands import CommandMaker
+from .config import Committee, Key, NodeParameters
+from .logs import LogParser, ParseError
+from .utils import BenchError, PathMaker, Print, progress_bar
+
+
+class FabricError(Exception):
+    """SSH transport failure (name kept for parity with the reference's
+    error taxonomy)."""
+
+
+class ExecutionError(Exception):
+    pass
+
+
+class RemoteRunner:
+    """Thin ssh/scp wrapper used by Bench below."""
+
+    def __init__(self, user, key_path, connect_timeout=10):
+        self.user = user
+        self.key_path = key_path
+        self.connect_timeout = connect_timeout
+
+    def _ssh_base(self, host):
+        return [
+            "ssh", "-i", self.key_path,
+            "-o", "StrictHostKeyChecking=no",
+            "-o", f"ConnectTimeout={self.connect_timeout}",
+            f"{self.user}@{host}",
+        ]
+
+    def run(self, host, command, check=True, hide=True):
+        result = subprocess.run(
+            self._ssh_base(host) + [command],
+            capture_output=hide, text=True)
+        if check and result.returncode != 0:
+            raise ExecutionError(
+                f"[{host}] {command!r} failed: {result.stderr}")
+        return result
+
+    def run_background(self, host, command, log_file):
+        # nohup + setsid so the process survives the ssh session.
+        wrapped = (f"nohup setsid sh -c '{command}' > {log_file} 2>&1 "
+                   f"< /dev/null &")
+        return self.run(host, wrapped)
+
+    def put(self, host, local, remote):
+        result = subprocess.run(
+            ["scp", "-i", self.key_path, "-o", "StrictHostKeyChecking=no",
+             local, f"{self.user}@{host}:{remote}"],
+            capture_output=True, text=True)
+        if result.returncode != 0:
+            raise FabricError(f"scp to {host} failed: {result.stderr}")
+
+    def get(self, host, remote, local):
+        result = subprocess.run(
+            ["scp", "-i", self.key_path, "-o", "StrictHostKeyChecking=no",
+             f"{self.user}@{host}:{remote}", local],
+            capture_output=True, text=True)
+        if result.returncode != 0:
+            raise FabricError(f"scp from {host} failed: {result.stderr}")
+
+
+class Bench:
+    """Multi-host benchmark: one node per host, one client per node."""
+
+    def __init__(self, settings, hosts, user="ubuntu"):
+        self.settings = settings
+        self.hosts = hosts
+        self.runner = RemoteRunner(user, settings.key_path)
+
+    def install(self):
+        """Install the toolchain + clone the repo on every host
+        (remote.py:52-81 analogue, apt/cmake instead of rustup)."""
+        cmd = " && ".join([
+            "sudo apt-get update",
+            "sudo apt-get -y install build-essential cmake ninja-build "
+            "python3 python3-pip",
+            f"(git clone {self.settings.repo_url} || true)",
+        ])
+        for host in progress_bar(self.hosts, prefix="Installing:"):
+            self.runner.run(host, cmd)
+
+    def update(self):
+        """Pull + rebuild on every host (remote.py:115-130 analogue)."""
+        repo = self.settings.repo_name
+        cmd = " && ".join([
+            f"cd {repo}",
+            f"git fetch -f && git checkout -f {self.settings.branch}",
+            "git pull -f",
+            CommandMaker.compile(),
+        ])
+        for host in progress_bar(self.hosts, prefix="Updating:"):
+            self.runner.run(host, cmd)
+
+    def _config(self, hosts, node_parameters):
+        """Generate keys locally, build the committee from host IPs, upload
+        configs (remote.py:132-177 analogue)."""
+        subprocess.run(["/bin/sh", "-c", CommandMaker.cleanup()], check=False)
+        keys = []
+        key_files = [PathMaker.key_file(i) for i in range(len(hosts))]
+        for filename in key_files:
+            subprocess.run(
+                ["/bin/sh", "-c",
+                 join(PathMaker.binary_path(), "node")
+                 + f" keys --filename {filename}"],
+                check=True)
+            keys.append(Key.from_file(filename))
+        names = [k.name for k in keys]
+        base = self.settings.base_port
+        consensus = [f"{h}:{base}" for h in hosts]
+        front = [f"{h}:{base - 2000}" for h in hosts]
+        mempool = [f"{h}:{base - 1000}" for h in hosts]
+        committee = Committee(names, consensus, front, mempool)
+        committee.print(PathMaker.committee_file())
+        node_parameters.print(PathMaker.parameters_file())
+        repo = self.settings.repo_name
+        for i, host in enumerate(hosts):
+            self.runner.run(host, f"rm -rf {repo}/.db-* {repo}/.*.json",
+                            check=False)
+            self.runner.put(host, PathMaker.committee_file(),
+                            f"{repo}/{PathMaker.committee_file()}")
+            self.runner.put(host, PathMaker.parameters_file(),
+                            f"{repo}/{PathMaker.parameters_file()}")
+            self.runner.put(host, key_files[i],
+                            f"{repo}/{PathMaker.key_file(i)}")
+        return committee
+
+    def _run_single(self, hosts, committee, rate, tx_size, faults, duration,
+                    debug=False):
+        Print.info(f"Running {len(hosts)} nodes (rate {rate:,} tx/s)...")
+        repo = self.settings.repo_name
+        timeout = NodeParameters.default().timeout_delay
+
+        # Boot clients then nodes (minus faults), as the reference does.
+        rate_share = int(rate / (len(hosts) - faults)) if hosts else 0
+        front = committee.front_addresses()
+        for i, host in enumerate(hosts):
+            cmd = (f"cd {repo} && "
+                   + CommandMaker.run_client(
+                       front[i], tx_size, rate_share, timeout, nodes=front))
+            self.runner.run_background(
+                host, cmd, f"{repo}/{PathMaker.client_log_file(i)}")
+        for i, host in enumerate(hosts[:len(hosts) - faults]):
+            cmd = (f"cd {repo} && "
+                   + CommandMaker.run_node(
+                       PathMaker.key_file(i), PathMaker.committee_file(),
+                       PathMaker.db_path(i), PathMaker.parameters_file(),
+                       debug=debug))
+            self.runner.run_background(
+                host, cmd, f"{repo}/{PathMaker.node_log_file(i)}")
+
+        from time import sleep
+
+        sleep(2 * timeout / 1000 + duration)
+        for host in hosts:
+            self.runner.run(host, "pkill -f './node run' || true",
+                            check=False)
+            self.runner.run(host, "pkill -f './client ' || true",
+                            check=False)
+
+    def _logs(self, hosts, faults):
+        subprocess.run(["/bin/sh", "-c", CommandMaker.clean_logs()],
+                       check=True)
+        repo = self.settings.repo_name
+        for i, host in enumerate(
+                progress_bar(hosts, prefix="Downloading logs:")):
+            self.runner.get(host, f"{repo}/{PathMaker.node_log_file(i)}",
+                            PathMaker.node_log_file(i))
+            self.runner.get(host, f"{repo}/{PathMaker.client_log_file(i)}",
+                            PathMaker.client_log_file(i))
+        return LogParser.process(PathMaker.logs_path(), faults=faults)
+
+    def run(self, bench_parameters, node_parameters, debug=False):
+        """Full matrix: nodes x rate x runs, appending to result files
+        (remote.py:245-300 analogue)."""
+        Print.heading("Starting remote benchmark")
+        for n in bench_parameters.nodes:
+            hosts = self.hosts[:n]
+            if len(hosts) < n:
+                Print.warn(f"only {len(hosts)} hosts for {n}-node run; "
+                           "skipping")
+                continue
+            committee = self._config(hosts, node_parameters)
+            for rate in bench_parameters.rate:
+                for run in range(bench_parameters.runs):
+                    Print.heading(
+                        f"Run {run + 1}/{bench_parameters.runs}: "
+                        f"{n} nodes, {rate:,} tx/s")
+                    try:
+                        self._run_single(
+                            hosts, committee, rate,
+                            bench_parameters.tx_size,
+                            bench_parameters.faults,
+                            bench_parameters.duration, debug)
+                        parser = self._logs(hosts, bench_parameters.faults)
+                        parser.print(PathMaker.result_file(
+                            bench_parameters.faults, n, rate,
+                            bench_parameters.tx_size))
+                    except (ExecutionError, FabricError, ParseError) as e:
+                        Print.error(BenchError("Benchmark failed", e))
+                        continue

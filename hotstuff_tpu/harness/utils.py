@@ -1,0 +1,140 @@
+"""Harness utilities: file-layout conventions, colored printing, progress.
+
+Capability mirror of the reference's benchmark/benchmark/utils.py:12-134
+(PathMaker / Print / progress_bar), with the same on-disk naming scheme so
+results remain comparable across harnesses.
+"""
+
+from __future__ import annotations
+
+import sys
+from os.path import join
+
+
+class BenchError(Exception):
+    def __init__(self, message, error=None):
+        super().__init__(message)
+        self.message = message
+        self.cause = error
+
+
+class PathMaker:
+    @staticmethod
+    def binary_path():
+        return join("native", "build")
+
+    @staticmethod
+    def node_crate_path():
+        return "native"
+
+    @staticmethod
+    def committee_file():
+        return ".committee.json"
+
+    @staticmethod
+    def parameters_file():
+        return ".parameters.json"
+
+    @staticmethod
+    def key_file(i):
+        assert isinstance(i, int) and i >= 0
+        return f".node-{i}.json"
+
+    @staticmethod
+    def db_path(i):
+        assert isinstance(i, int) and i >= 0
+        return f".db-{i}"
+
+    @staticmethod
+    def logs_path():
+        return "logs"
+
+    @staticmethod
+    def node_log_file(i):
+        assert isinstance(i, int) and i >= 0
+        return join(PathMaker.logs_path(), f"node-{i}.log")
+
+    @staticmethod
+    def client_log_file(i):
+        assert isinstance(i, int) and i >= 0
+        return join(PathMaker.logs_path(), f"client-{i}.log")
+
+    @staticmethod
+    def sidecar_log_file():
+        return join(PathMaker.logs_path(), "sidecar.log")
+
+    @staticmethod
+    def results_path():
+        return "results"
+
+    @staticmethod
+    def result_file(faults, nodes, rate, tx_size):
+        return join(
+            PathMaker.results_path(),
+            f"bench-{faults}-{nodes}-{rate}-{tx_size}.txt",
+        )
+
+    @staticmethod
+    def plot_path():
+        return "plots"
+
+    @staticmethod
+    def agg_file(type, faults, nodes, rate, tx_size, max_latency=None):
+        name = f"{type}-{faults}-{nodes}-{rate}-{tx_size}"
+        if max_latency is not None:
+            name += f"-{max_latency}"
+        return join(PathMaker.plot_path(), f"{name}.txt")
+
+    @staticmethod
+    def plot_file(name, ext):
+        return join(PathMaker.plot_path(), f"{name}.{ext}")
+
+
+class Color:
+    HEADER = "\033[95m"
+    OK_BLUE = "\033[94m"
+    OK_GREEN = "\033[92m"
+    WARNING = "\033[93m"
+    FAIL = "\033[91m"
+    END = "\033[0m"
+    BOLD = "\033[1m"
+
+
+class Print:
+    @staticmethod
+    def heading(message):
+        assert isinstance(message, str)
+        print(f"{Color.OK_GREEN}{message}{Color.END}")
+
+    @staticmethod
+    def info(message):
+        assert isinstance(message, str)
+        print(message)
+
+    @staticmethod
+    def warn(message):
+        assert isinstance(message, str)
+        print(f"{Color.BOLD}{Color.WARNING}WARN{Color.END}: {message}")
+
+    @staticmethod
+    def error(e):
+        assert isinstance(e, BenchError)
+        print(f"\n{Color.BOLD}{Color.FAIL}ERROR{Color.END}: {e}\n")
+        if e.cause is not None:
+            print(f"Caused by: \n{e.cause}\n")
+
+
+def progress_bar(it, prefix="", size=30, file=sys.stdout):
+    count = len(it)
+
+    def show(j):
+        x = int(size * j / max(count, 1))
+        file.write(f"{prefix}[{'#' * x}{'.' * (size - x)}] {j}/{count}\r")
+        file.flush()
+
+    show(0)
+    for i, item in enumerate(it):
+        yield item
+        show(i + 1)
+    file.write("\n")
+    file.flush()

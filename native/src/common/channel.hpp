@@ -44,6 +44,15 @@ class Channel {
     return true;
   }
 
+  bool try_recv(T* out) {
+    std::lock_guard<std::mutex> lk(m_);
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    cv_send_.notify_one();
+    return true;
+  }
+
   // Blocks while empty. nullopt once closed and drained.
   std::optional<T> recv() {
     std::unique_lock<std::mutex> lk(m_);

@@ -19,32 +19,8 @@ std::unique_ptr<Consensus> Consensus::spawn(
   auto c = std::unique_ptr<Consensus>(new Consensus());
 
   auto tx_core = make_channel<CoreEvent>();
-  auto tx_proposer = make_channel<ProposerEvent>();
-  auto tx_helper = make_channel<std::pair<Digest, PublicKey>>();
-
-  // Proposer command channel: Core sends ProposerMessage, adapted into the
-  // proposer's unified event stream.
   auto tx_proposer_cmd = make_channel<ProposerMessage>();
-  std::thread([tx_proposer_cmd, tx_proposer] {
-    while (auto cmd = tx_proposer_cmd->recv()) {
-      ProposerEvent e;
-      e.kind = ProposerEvent::Kind::kCommand;
-      e.command = std::move(*cmd);
-      tx_proposer->send(std::move(e));
-    }
-  }).detach();
-
-  // Mempool digests pump into the proposer buffer.
-  c->digest_pump_ = std::make_shared<std::thread>(
-      [rx_mempool, tx_proposer] {
-        while (auto digest = rx_mempool->recv()) {
-          ProposerEvent e;
-          e.kind = ProposerEvent::Kind::kDigest;
-          e.digest = *digest;
-          tx_proposer->send(std::move(e));
-        }
-      });
-  c->digest_pump_->detach();
+  auto tx_helper = make_channel<std::pair<Digest, PublicKey>>();
 
   // Network ingress: ACK only proposals, route sync requests to the helper
   // (consensus.rs:126-162).
@@ -89,7 +65,8 @@ std::unique_ptr<Consensus> Consensus::spawn(
               mempool_driver, synchronizer, parameters.timeout_delay, tx_core,
               tx_proposer_cmd, tx_commit);
 
-  Proposer::spawn(name, committee, signature_service, tx_proposer, tx_core);
+  Proposer::spawn(name, committee, signature_service, rx_mempool,
+                  tx_proposer_cmd, tx_core);
 
   Helper::spawn(committee, store, tx_helper);
 

@@ -33,7 +33,6 @@ class Consensus {
   Consensus() = default;
 
   NetworkReceiver receiver_;
-  std::shared_ptr<std::thread> digest_pump_;
 };
 
 }  // namespace consensus

@@ -68,27 +68,49 @@ void make_block(const PublicKey& name, const Committee& committee,
 
 void Proposer::spawn(PublicKey name, Committee committee,
                      SignatureService signature_service,
-                     ChannelPtr<ProposerEvent> rx_event,
+                     ChannelPtr<Digest> rx_mempool,
+                     ChannelPtr<ProposerMessage> rx_message,
                      ChannelPtr<CoreEvent> tx_loopback) {
   std::thread([name, committee = std::move(committee),
-               signature_service = std::move(signature_service), rx_event,
-               tx_loopback]() mutable {
+               signature_service = std::move(signature_service), rx_mempool,
+               rx_message, tx_loopback]() mutable {
     ReliableSender network;
     std::set<Digest> buffer;
-    while (auto event = rx_event->recv()) {
-      switch (event->kind) {
-        case ProposerEvent::Kind::kDigest:
-          buffer.insert(event->digest);
-          break;
-        case ProposerEvent::Kind::kCommand:
-          if (event->command.kind == ProposerMessage::Kind::kMake) {
-            make_block(name, committee, signature_service, &network, &buffer,
-                       event->command.round, std::move(event->command.qc),
-                       std::move(event->command.tc), tx_loopback.get());
-          } else {
-            for (const Digest& d : event->command.digests) buffer.erase(d);
+    while (true) {
+      // Select: block (briefly) on the command channel, opportunistically
+      // draining the digest flood each iteration; digests are also drained
+      // right before a command so Make sees the freshest payload set.
+      ProposerMessage cmd;
+      auto status = rx_message->recv_until(
+          &cmd, std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(1));
+      Digest digest;
+      while (rx_mempool->try_recv(&digest)) buffer.insert(digest);
+      if (status == RecvStatus::kClosed) return;
+      if (status == RecvStatus::kTimeout) continue;
+      if (cmd.kind == ProposerMessage::Kind::kMake) {
+        // Idle-race throttle: with no payload ready, wait briefly for the
+        // mempool instead of burning a full proposal round on an empty
+        // block. Without this, an idle committee races rounds at pure
+        // sig-op speed and starves the rest of the node for CPU (the
+        // reference races too, but its geo-replicated RTT hides it). Any
+        // digest ends the wait; the consensus timeout (>=1s) dwarfs it.
+        if (buffer.empty()) {
+          Digest digest;
+          if (rx_mempool->recv_until(
+                  &digest, std::chrono::steady_clock::now() +
+                               std::chrono::milliseconds(20)) ==
+              RecvStatus::kOk) {
+            buffer.insert(digest);
+            Digest more;
+            while (rx_mempool->try_recv(&more)) buffer.insert(more);
           }
-          break;
+        }
+        make_block(name, committee, signature_service, &network, &buffer,
+                   cmd.round, std::move(cmd.qc), std::move(cmd.tc),
+                   tx_loopback.get());
+      } else {
+        for (const Digest& d : cmd.digests) buffer.erase(d);
       }
     }
   }).detach();

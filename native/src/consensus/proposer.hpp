@@ -10,19 +10,18 @@
 namespace hotstuff {
 namespace consensus {
 
-// Unified input: mempool digests + core commands (the reference selects
-// over rx_mempool and rx_message, proposer.rs:125-141).
-struct ProposerEvent {
-  enum class Kind { kDigest, kCommand } kind = Kind::kDigest;
-  Digest digest;            // kDigest
-  ProposerMessage command;  // kCommand
-};
-
 class Proposer {
  public:
+  // Two independent inputs, as in the reference (proposer.rs:125-141):
+  // rx_mempool carries the payload-digest flood from the processors and may
+  // back-pressure them; rx_message carries the core's Make/Cleanup commands
+  // and must never be wedged behind digests (sharing one queue deadlocks
+  // the whole committee under load: core blocked on proposer, proposer
+  // blocked on peers' ACKs, peers' receivers blocked on their cores).
   static void spawn(PublicKey name, Committee committee,
                     SignatureService signature_service,
-                    ChannelPtr<ProposerEvent> rx_event,
+                    ChannelPtr<Digest> rx_mempool,
+                    ChannelPtr<ProposerMessage> rx_message,
                     ChannelPtr<CoreEvent> tx_loopback);
 };
 

@@ -1,0 +1,144 @@
+"""Harness tests: log parser against golden logs in the frozen grammar,
+committee/parameters writers against the C++ readers' expectations, and
+aggregation math. (The reference has no harness tests — SURVEY.md §4 —
+but the parser's regex dependence on exact phrasing makes golden-log
+coverage essential here.)
+"""
+
+import json
+
+import pytest
+
+from hotstuff_tpu.harness import (
+    BenchParameters,
+    ConfigError,
+    LocalCommittee,
+    LogParser,
+    NodeParameters,
+    ParseError,
+)
+
+GOLDEN_CLIENT = """\
+[2026-07-29T14:54:56.456Z INFO client] Node address: 127.0.0.1:9701
+[2026-07-29T14:54:56.456Z INFO client] Transactions size: 512 B
+[2026-07-29T14:54:56.456Z INFO client] Transactions rate: 2000 tx/s
+[2026-07-29T14:54:56.456Z INFO client] Waiting for all nodes to be online...
+[2026-07-29T14:54:54.525Z INFO client] Waiting for all nodes to be synchronized...
+[2026-07-29T14:54:56.525Z INFO client] Start sending transactions
+[2026-07-29T14:54:56.577Z INFO client] Sending sample transaction 0
+[2026-07-29T14:54:56.627Z INFO client] Sending sample transaction 1
+"""
+
+GOLDEN_NODE = """\
+[2026-07-29T14:54:55.100Z INFO mempool::config] Garbage collection depth set to 50 rounds
+[2026-07-29T14:54:55.100Z INFO mempool::config] Sync retry delay set to 5000 ms
+[2026-07-29T14:54:55.100Z INFO mempool::config] Sync retry nodes set to 3 nodes
+[2026-07-29T14:54:55.100Z INFO mempool::config] Batch size set to 15000 B
+[2026-07-29T14:54:55.100Z INFO mempool::config] Max batch delay set to 100 ms
+[2026-07-29T14:54:55.101Z INFO consensus::config] Timeout delay set to 1000 ms
+[2026-07-29T14:54:55.101Z INFO consensus::config] Sync retry delay set to 10000 ms
+[2026-07-29T14:54:55.102Z INFO node::node] Node abc= successfully booted
+[2026-07-29T14:54:56.577Z INFO mempool::batch_maker] Batch 2hHolx56fF0YIblphIzIeT2IHMTpt2ISKPP/4qqCsaU= contains sample tx 0
+[2026-07-29T14:54:56.578Z INFO mempool::batch_maker] Batch 2hHolx56fF0YIblphIzIeT2IHMTpt2ISKPP/4qqCsaU= contains 15360 B
+[2026-07-29T14:54:56.627Z INFO mempool::batch_maker] Batch 8obhcmwCu1dRnxvU+n/mr/KqNZ5OWZueM4no1X1NNCo= contains sample tx 1
+[2026-07-29T14:54:56.628Z INFO mempool::batch_maker] Batch 8obhcmwCu1dRnxvU+n/mr/KqNZ5OWZueM4no1X1NNCo= contains 15360 B
+[2026-07-29T14:54:56.700Z INFO consensus::proposer] Created B2
+[2026-07-29T14:54:56.700Z INFO consensus::proposer] Created B2 -> 2hHolx56fF0YIblphIzIeT2IHMTpt2ISKPP/4qqCsaU=
+[2026-07-29T14:54:56.750Z INFO consensus::proposer] Created B3
+[2026-07-29T14:54:56.750Z INFO consensus::proposer] Created B3 -> 8obhcmwCu1dRnxvU+n/mr/KqNZ5OWZueM4no1X1NNCo=
+[2026-07-29T14:54:57.000Z INFO consensus::core] Committed B2
+[2026-07-29T14:54:57.000Z INFO consensus::core] Committed B2 -> 2hHolx56fF0YIblphIzIeT2IHMTpt2ISKPP/4qqCsaU=
+[2026-07-29T14:54:57.200Z INFO consensus::core] Committed B3
+[2026-07-29T14:54:57.200Z INFO consensus::core] Committed B3 -> 8obhcmwCu1dRnxvU+n/mr/KqNZ5OWZueM4no1X1NNCo=
+"""
+
+
+def test_parser_mines_golden_logs():
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    # Both batches committed, 15360 B each at 512 B/tx = 60 tx.
+    assert len(parser.commits) == 2
+    assert len(parser.proposals) == 2
+    assert sum(parser.sizes.values()) == 2 * 15360
+    # Consensus latency: commits at +300ms and +450ms after proposals.
+    lat = parser._consensus_latency()
+    assert 0.3 < lat < 0.5
+    # e2e latency: sample 0 sent 14:54:56.577, its batch committed .000 ->
+    # 423ms; sample 1: .627 -> 57.200 = 573ms; mean ~498ms.
+    e2e = parser._end_to_end_latency()
+    assert 0.4 < e2e < 0.6
+    out = parser.result()
+    assert "End-to-end TPS" in out
+    assert "Consensus latency" in out
+
+
+def test_parser_rejects_client_error():
+    # The two fatal shapes the C++ client can emit.
+    bad = GOLDEN_CLIENT + \
+        "[2026-07-29T14:55:00.000Z ERROR client] something exploded\n"
+    with pytest.raises(ParseError):
+        LogParser([bad], [GOLDEN_NODE], faults=0)
+    bad = GOLDEN_CLIENT + \
+        "[2026-07-29T14:55:00.000Z WARN client] Failed to send transaction\n"
+    with pytest.raises(ParseError):
+        LogParser([bad], [GOLDEN_NODE], faults=0)
+
+
+def test_parser_rejects_node_error():
+    bad = GOLDEN_NODE + \
+        "[2026-07-29T14:55:00.000Z ERROR node::main] uncaught exception\n"
+    with pytest.raises(ParseError):
+        LogParser([GOLDEN_CLIENT], [bad], faults=0)
+
+
+def test_parser_real_logs_match_grammar(tmp_path):
+    """End-to-end grammar lock: logs produced by the actual C++ binaries
+    (committed fixtures from a real 4-node run) must parse."""
+    import pathlib
+
+    fixture = pathlib.Path(__file__).parent / "golden_logs"
+    if not fixture.exists():
+        pytest.skip("golden log fixtures not generated yet")
+    parser = LogParser.process(str(fixture), faults=0)
+    assert parser.commits, "no commits mined from real logs"
+    assert parser._end_to_end_latency() > 0
+
+
+def test_local_committee_layout(tmp_path):
+    names = ["a=", "b=", "c=", "d="]
+    committee = LocalCommittee(names, 9000)
+    f = tmp_path / "committee.json"
+    committee.print(str(f))
+    data = json.loads(f.read_text())
+    assert set(data) == {"consensus", "mempool"}
+    cons = data["consensus"]["authorities"]
+    memp = data["mempool"]["authorities"]
+    assert cons["a="]["address"] == "127.0.0.1:9000"
+    assert memp["a="]["transactions_address"] == "127.0.0.1:9004"
+    assert memp["a="]["mempool_address"] == "127.0.0.1:9008"
+    assert all(cons[n]["stake"] == 1 for n in names)
+
+
+def test_node_parameters_roundtrip(tmp_path):
+    params = NodeParameters.default(tpu_sidecar="127.0.0.1:7100")
+    f = tmp_path / "parameters.json"
+    params.print(str(f))
+    data = json.loads(f.read_text())
+    assert data["consensus"]["timeout_delay"] == 5000
+    assert data["mempool"]["batch_size"] == 500_000
+    assert data["tpu_sidecar"] == "127.0.0.1:7100"
+    # malformed params rejected
+    with pytest.raises(ConfigError):
+        NodeParameters({"consensus": {}})
+
+
+def test_bench_parameters_validation():
+    ok = BenchParameters({
+        "faults": 1, "nodes": 4, "rate": [10_000], "tx_size": 512,
+        "duration": 20,
+    })
+    assert ok.nodes == [4] and ok.rate == [10_000]
+    with pytest.raises(ConfigError):
+        BenchParameters({
+            "faults": 4, "nodes": 4, "rate": 1000, "tx_size": 512,
+            "duration": 20,
+        })

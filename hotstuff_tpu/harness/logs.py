@@ -111,14 +111,18 @@ class LogParser:
                 search(r"terminate called|panic", log) is not None:
             raise ParseError("Node(s) failed")
 
-        proposals = self._merge_earliest([{
-            d: self._to_posix(t)
-            for t, d in findall(r"\[(.*Z) .* Created B\d+ -> ([^ ]+=)", log)
-        }])
-        commits = self._merge_earliest([{
-            d: self._to_posix(t)
-            for t, d in findall(r"\[(.*Z) .* Committed B\d+ -> ([^ ]+=)", log)
-        }])
+        # Earliest occurrence wins even within one log (a digest can be
+        # re-proposed after a fallthrough round).
+        proposals = {}
+        for t, d in findall(r"\[(.*Z) .* Created B\d+ -> ([^ ]+=)", log):
+            ts = self._to_posix(t)
+            if d not in proposals or proposals[d] > ts:
+                proposals[d] = ts
+        commits = {}
+        for t, d in findall(r"\[(.*Z) .* Committed B\d+ -> ([^ ]+=)", log):
+            ts = self._to_posix(t)
+            if d not in commits or commits[d] > ts:
+                commits[d] = ts
         sizes = {
             d: int(s)
             for d, s in findall(r"Batch ([^ ]+) contains (\d+) B", log)

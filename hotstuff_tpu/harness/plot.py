@@ -63,13 +63,13 @@ class Ploter:
                        key=self._natural_keys)
         if not files:
             raise PlotError(f"no aggregated data for {type}")
-        tx_size = 512
+        tx_sizes = set()
         for filename in files:
             with open(filename, "r") as f:
                 data = f.read()
             m = search(r"Transaction size: (\d+)", data)
             if m:
-                tx_size = int(m.group(1))
+                tx_sizes.add(int(m.group(1)))
             values, tps, tps_std, lat, lat_std = self._measurements(data)
             x = values
             y, y_err = y_axis(tps, tps_std, lat, lat_std)
@@ -80,8 +80,11 @@ class Ploter:
         self.plt.xlabel(x_label)
         self.plt.ylabel(y_label)
         self.plt.grid(True, alpha=0.3)
-        if tps_y_axis:
-            # Twin tps<->MB/s axis (the reference's plot.py:46-54).
+        if tps_y_axis and len(tx_sizes) == 1:
+            # Twin tps<->MB/s axis (the reference's plot.py:46-54). Only
+            # drawn when every series shares one tx size — a mixed plot
+            # would mislabel the MB/s scale for all but one series.
+            tx_size = tx_sizes.pop()
             self.plt.gca().secondary_yaxis(
                 "right",
                 functions=(

@@ -148,16 +148,20 @@ class Bench:
         repo = self.settings.repo_name
         timeout = NodeParameters.default().timeout_delay
 
-        # Boot clients then nodes (minus faults), as the reference does.
-        rate_share = int(rate / (len(hosts) - faults)) if hosts else 0
-        front = committee.front_addresses()
-        for i, host in enumerate(hosts):
-            cmd = (f"cd {repo} && "
+        # Nodes minus faults; clients only on alive hosts, waiting only on
+        # alive fronts (a dead front in --nodes would block the client's
+        # readiness loop forever).
+        alive = len(hosts) - faults
+        rate_share = -(-rate // alive) if alive else 0
+        front = committee.front_addresses()[:alive]
+        for i, host in enumerate(hosts[:alive]):
+            cmd = (f"cd {repo} && rm -rf {PathMaker.logs_path()} && "
+                   f"mkdir -p {PathMaker.logs_path()} && "
                    + CommandMaker.run_client(
                        front[i], tx_size, rate_share, timeout, nodes=front))
             self.runner.run_background(
                 host, cmd, f"{repo}/{PathMaker.client_log_file(i)}")
-        for i, host in enumerate(hosts[:len(hosts) - faults]):
+        for i, host in enumerate(hosts[:alive]):
             cmd = (f"cd {repo} && "
                    + CommandMaker.run_node(
                        PathMaker.key_file(i), PathMaker.committee_file(),
@@ -179,8 +183,9 @@ class Bench:
         subprocess.run(["/bin/sh", "-c", CommandMaker.clean_logs()],
                        check=True)
         repo = self.settings.repo_name
+        alive = hosts[:len(hosts) - faults]  # faulty hosts ran nothing
         for i, host in enumerate(
-                progress_bar(hosts, prefix="Downloading logs:")):
+                progress_bar(alive, prefix="Downloading logs:")):
             self.runner.get(host, f"{repo}/{PathMaker.node_log_file(i)}",
                             PathMaker.node_log_file(i))
             self.runner.get(host, f"{repo}/{PathMaker.client_log_file(i)}",

@@ -1,0 +1,4 @@
+"""Off-chain digital-signature benchmarking suite: EdDSA (host + TPU
+batch), ECDSA and Schnorr over secp256k1, and BLS12-381 with aggregation —
+the capability of the reference's off-chain-benchmarking/ directory
+(SURVEY.md §2.1) with pure-Python + JAX implementations."""

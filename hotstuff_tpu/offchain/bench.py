@@ -1,0 +1,198 @@
+"""Off-chain signature microbenchmarks.
+
+Reproduces the reference's two workloads with this framework's schemes:
+  * single-verify latency, N iterations per scheme
+    (off-chain-benchmarking/main.py:10-38, 100 iters)
+  * batch/aggregate-verify scaling sweep over batch sizes
+    (off-chain-benchmarking/main.py:78-111: 20..300 step 20;
+     production/src/main.rs:19-64: EdDSA sequential vs BLS aggregate)
+plus the TPU batch path that is this framework's reason to exist.
+
+Results go to stdout as JSON lines and optionally to CSV/plots (pandas +
+matplotlib, as the reference used).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _timed(fn, iters=1):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    dt = (time.perf_counter() - t0) / iters
+    return out, dt
+
+
+def _make_ed25519(n, msg_len=32):
+    from . import eddsa
+
+    rng = np.random.default_rng(11)
+    msgs, pks, sigs = [], [], []
+    for _ in range(n):
+        sk, pk = eddsa.key_gen(rng.bytes(32))
+        msg = rng.bytes(msg_len)
+        msgs.append(msg)
+        pks.append(pk)
+        sigs.append(eddsa.sign(sk, msg))
+    return msgs, pks, sigs
+
+
+def measure_single(iters=100, schemes=("eddsa", "ecdsa", "schnorr", "bls")):
+    """Single sign + verify latency per scheme (reference main.py:10-38)."""
+    results = []
+    msg = b"off-chain benchmark message"
+
+    if "eddsa" in schemes:
+        from . import eddsa
+
+        sk, pk = eddsa.key_gen(b"\x01" * 32)
+        sig, sign_dt = _timed(lambda: eddsa.sign(sk, msg), iters)
+        ok, verify_dt = _timed(lambda: eddsa.verify(pk, msg, sig), iters)
+        assert ok
+        results.append(("eddsa", sign_dt, verify_dt))
+
+    if "ecdsa" in schemes:
+        from . import ecdsa
+
+        sk, pk = ecdsa.key_gen(b"\x02")
+        sig, sign_dt = _timed(lambda: ecdsa.sign(sk, msg), iters)
+        ok, verify_dt = _timed(lambda: ecdsa.verify(pk, msg, sig), iters)
+        assert ok
+        results.append(("ecdsa", sign_dt, verify_dt))
+
+    if "schnorr" in schemes:
+        from . import schnorr
+
+        sk, pk = schnorr.key_gen(b"\x03")
+        sig, sign_dt = _timed(lambda: schnorr.sign(sk, msg), iters)
+        ok, verify_dt = _timed(lambda: schnorr.verify(pk, msg, sig), iters)
+        assert ok
+        results.append(("schnorr", sign_dt, verify_dt))
+
+    if "bls" in schemes:
+        from . import bls12381 as bls
+
+        # Pure-Python pairing: a handful of iterations is plenty.
+        bls_iters = max(1, min(iters, 3))
+        sk, pk = bls.key_gen(b"\x04")
+        sig, sign_dt = _timed(lambda: bls.sign(sk, msg), bls_iters)
+        ok, verify_dt = _timed(lambda: bls.verify(pk, msg, sig), bls_iters)
+        assert ok
+        results.append(("bls", sign_dt, verify_dt))
+
+    rows = []
+    for scheme, sign_dt, verify_dt in results:
+        row = {
+            "workload": "single",
+            "scheme": scheme,
+            "sign_ms": round(sign_dt * 1e3, 4),
+            "verify_ms": round(verify_dt * 1e3, 4),
+        }
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
+def measure_batch(sizes=tuple(range(20, 301, 20)), tpu=True):
+    """Batch-verify scaling (reference main.py:78-111 sweep + the Rust
+    production comparison): Ed25519 sequential host loop vs TPU batch vs
+    BLS aggregate (common message, 2-pairing fast path)."""
+    from . import bls12381 as bls
+    from . import eddsa
+
+    rows = []
+    msgs_all, pks_all, sigs_all = _make_ed25519(max(sizes))
+
+    # BLS: one shared message, aggregated signature (QC-style).
+    bls_keys = [bls.key_gen(i.to_bytes(2, "big") * 4)
+                for i in range(max(sizes))]
+    common = b"common quorum digest"
+    bls_sigs = [bls.sign(sk, common) for sk, _ in bls_keys]
+
+    for n in sizes:
+        msgs, pks, sigs = msgs_all[:n], pks_all[:n], sigs_all[:n]
+        _, host_dt = _timed(lambda: eddsa.verify_batch_host(msgs, pks, sigs))
+        row = {
+            "workload": "batch",
+            "n": n,
+            "eddsa_host_ms": round(host_dt * 1e3, 3),
+        }
+
+        if tpu:
+            # Warm the jit cache for this bucket shape, then time.
+            eddsa.verify_batch_tpu(msgs, pks, sigs)
+            mask, tpu_dt = _timed(
+                lambda: eddsa.verify_batch_tpu(msgs, pks, sigs))
+            assert all(mask)
+            row["eddsa_tpu_ms"] = round(tpu_dt * 1e3, 3)
+
+        agg = bls.aggregate(bls_sigs[:n])
+        apks = [pk for _, pk in bls_keys[:n]]
+        ok, bls_dt = _timed(
+            lambda: bls.verify_aggregate_common(apks, common, agg))
+        assert ok
+        row["bls_aggregate_ms"] = round(bls_dt * 1e3, 3)
+
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
+def measure_message_length(lengths=tuple(range(64, 6401, 640)), iters=20):
+    """Single-verify cost vs message length
+    (production/src/main.rs:67-108)."""
+    from . import eddsa
+
+    rows = []
+    rng = np.random.default_rng(5)
+    sk, pk = eddsa.key_gen(b"\x09" * 32)
+    for length in lengths:
+        msg = rng.bytes(length)
+        sig = eddsa.sign(sk, msg)
+        ok, dt = _timed(lambda: eddsa.verify(pk, msg, sig), iters)
+        assert ok
+        row = {
+            "workload": "msg-length",
+            "scheme": "eddsa",
+            "msg_len": length,
+            "verify_ms": round(dt * 1e3, 4),
+        }
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
+def to_csv(rows, path):
+    import pandas as pd
+
+    pd.DataFrame(rows).to_csv(path, index=False)
+
+
+def plot_batch(rows, path):
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    batch = [r for r in rows if r.get("workload") == "batch"]
+    if not batch:
+        return
+    n = [r["n"] for r in batch]
+    plt.figure(figsize=(6.4, 4.8))
+    for key, label in (("eddsa_host_ms", "Ed25519 host loop"),
+                       ("eddsa_tpu_ms", "Ed25519 TPU batch"),
+                       ("bls_aggregate_ms", "BLS aggregate (common msg)")):
+        ys = [r[key] for r in batch if key in r]
+        if len(ys) == len(n):
+            plt.plot(n, ys, marker="o", label=label)
+    plt.xlabel("signatures")
+    plt.ylabel("verify time (ms)")
+    plt.yscale("log")
+    plt.grid(True, alpha=0.3)
+    plt.legend()
+    plt.savefig(path, bbox_inches="tight")

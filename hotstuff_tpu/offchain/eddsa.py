@@ -1,0 +1,48 @@
+"""Ed25519 scheme wrapper for the off-chain suite: host keygen/sign, and
+three verify paths — host single (the reference's ed25519-dalek loop,
+production/src/main.rs:19-64), TPU batch (this framework's device engine),
+and host batch (sequential loop, the comparison baseline).
+"""
+
+from __future__ import annotations
+
+from ..crypto import ref_ed25519 as _ref
+
+
+def key_gen(seed: bytes):
+    sk, pk = _ref.generate_keypair(seed)
+    return sk, pk
+
+
+def sign(sk: bytes, msg: bytes) -> bytes:
+    return _ref.sign(sk, msg)
+
+
+def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    """Host single verify (OpenSSL-backed when available, else the pure
+    reference implementation)."""
+    try:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey,
+        )
+
+        try:
+            Ed25519PublicKey.from_public_bytes(pk).verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+    except ImportError:
+        return _ref.verify(pk, msg, sig)
+
+
+def verify_batch_host(msgs, pks, sigs):
+    """Sequential host loop (what the reference's EdDSA bench measures)."""
+    return [verify(pk, msg, sig) for msg, pk, sig in zip(msgs, pks, sigs)]
+
+
+def verify_batch_tpu(msgs, pks, sigs):
+    """Device batch verification (vmapped ladder; hotstuff_tpu/ops)."""
+    from ..crypto import eddsa as device
+
+    return list(device.verify_batch(msgs, pks, sigs))

@@ -1,0 +1,171 @@
+"""Off-chain suite tests: secp256k1 ECDSA cross-checked against OpenSSL,
+Schnorr roundtrips, BLS12-381 pairing algebra + signatures + aggregation,
+and the benchmark harness plumbing."""
+
+import hashlib
+
+import pytest
+
+from hotstuff_tpu.offchain import bls12381 as bls
+from hotstuff_tpu.offchain import ecdsa, eddsa, schnorr, secp256k1
+
+
+# ---------------------------------------------------------------------------
+# secp256k1
+# ---------------------------------------------------------------------------
+
+def test_secp256k1_point_arithmetic():
+    g = (secp256k1.GX, secp256k1.GY)
+    assert secp256k1.on_curve(g)
+    assert secp256k1.point_mul(secp256k1.N) is None  # group order
+    two_g = secp256k1.point_add(g, g)
+    assert two_g == secp256k1.point_mul(2)
+    assert secp256k1.on_curve(two_g)
+    # encode/decode roundtrip, both parities
+    for k in (2, 3, 12345):
+        p = secp256k1.point_mul(k)
+        assert secp256k1.point_decode(secp256k1.point_encode(p)) == p
+
+
+def test_ecdsa_roundtrip_and_tamper():
+    sk, pk = ecdsa.key_gen(b"seed")
+    sig = ecdsa.sign(sk, b"hello")
+    assert ecdsa.verify(pk, b"hello", sig)
+    assert not ecdsa.verify(pk, b"world", sig)
+    r, s = sig
+    assert not ecdsa.verify(pk, b"hello", (r, (s + 1) % secp256k1.N))
+    _, pk2 = ecdsa.key_gen(b"other")
+    assert not ecdsa.verify(pk2, b"hello", sig)
+
+
+def test_ecdsa_cross_check_openssl():
+    """Our signatures must verify under OpenSSL's secp256k1 and vice
+    versa (DER interchange)."""
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    msg = b"cross-check message"
+    sk, pk = ecdsa.key_gen(b"xseed")
+    sig = ecdsa.sign(sk, msg)
+
+    # ours -> OpenSSL
+    ossl_pk = ec.EllipticCurvePublicNumbers(
+        pk[0], pk[1], ec.SECP256K1()).public_key()
+    ossl_pk.verify(secp256k1.ecdsa_sig_to_der(sig), msg,
+                   ec.ECDSA(hashes.SHA256()))
+
+    # OpenSSL -> ours
+    ossl_sk = ec.derive_private_key(sk, ec.SECP256K1())
+    der = ossl_sk.sign(msg, ec.ECDSA(hashes.SHA256()))
+    r, s = decode_dss_signature(der)
+    if s > secp256k1.N // 2:
+        s = secp256k1.N - s  # our verifier accepts either; normalize anyway
+    assert ecdsa.verify(pk, msg, (r, s))
+
+
+def test_schnorr_roundtrip_and_tamper():
+    sk, pk = schnorr.key_gen(b"seed")
+    sig = schnorr.sign(sk, b"msg")
+    assert schnorr.verify(pk, b"msg", sig)
+    assert not schnorr.verify(pk, b"other", sig)
+    R, s = sig
+    assert not schnorr.verify(pk, b"msg", (R, (s + 1) % secp256k1.N))
+
+
+# ---------------------------------------------------------------------------
+# BLS12-381
+# ---------------------------------------------------------------------------
+
+def test_bls_pairing_bilinearity():
+    g1, g2 = bls.g1_generator(), bls.g2_generator()
+    e = bls.pairing(g1, g2)
+    assert e != bls.FQ12_ONE  # non-degenerate
+    assert bls.fq12_pow(e, bls.R) == bls.FQ12_ONE  # order divides r
+    assert bls.pairing(bls.g1_mul(g1, 2), g2) == bls.fq12_mul(e, e)
+    assert bls.pairing(g1, bls.g2_mul(g2, 3)) == bls.fq12_pow(e, 3)
+    # e(aP, bQ) = e(P, Q)^(ab)
+    assert bls.pairing(bls.g1_mul(g1, 5),
+                       bls.g2_mul(g2, 7)) == bls.fq12_pow(e, 35)
+
+
+def test_bls_hash_to_g2_in_subgroup():
+    H = bls.hash_to_g2(b"x")
+    assert bls.g2_on_curve(H)
+    eH = bls.pairing(bls.g1_generator(), H)
+    # bilinearity with a hashed point proves subgroup membership
+    assert bls.pairing(bls.g1_mul(bls.g1_generator(), 2),
+                       H) == bls.fq12_mul(eH, eH)
+    # deterministic
+    assert bls.hash_to_g2(b"x") == H
+    assert bls.hash_to_g2(b"y") != H
+
+
+def test_bls_sign_verify():
+    sk, pk = bls.key_gen(b"seed")
+    sig = bls.sign(sk, b"msg")
+    assert bls.verify(pk, b"msg", sig)
+    assert not bls.verify(pk, b"other", sig)
+    _, pk2 = bls.key_gen(b"seed2")
+    assert not bls.verify(pk2, b"msg", sig)
+
+
+def test_bls_aggregate():
+    keys = [bls.key_gen(bytes([i])) for i in range(3)]
+    msgs = [b"m0", b"m1", b"m2"]
+    agg = bls.aggregate([bls.sign(sk, m) for (sk, _), m in zip(keys, msgs)])
+    pks = [pk for _, pk in keys]
+    assert bls.verify_aggregate(pks, msgs, agg)
+    assert not bls.verify_aggregate(pks, [b"m0", b"m1", b"bad"], agg)
+
+    # common-message fast path (QC shape: 2 Miller loops for any quorum)
+    aggc = bls.aggregate([bls.sign(sk, b"common") for sk, _ in keys])
+    assert bls.verify_aggregate_common(pks, b"common", aggc)
+    assert not bls.verify_aggregate_common(pks[:2], b"common", aggc)
+
+
+def test_bls_encoding_roundtrip():
+    sk, pk = bls.key_gen(b"enc")
+    sig = bls.sign(sk, b"m")
+    assert bls.g1_decode(bls.g1_encode(pk)) == pk
+    assert bls.g2_decode(bls.g2_encode(sig)) == sig
+    assert len(bls.g1_encode(pk)) == 96
+    assert len(bls.g2_encode(sig)) == 192
+
+
+# ---------------------------------------------------------------------------
+# EdDSA wrapper + bench plumbing
+# ---------------------------------------------------------------------------
+
+def test_eddsa_wrapper_paths_agree():
+    msgs, pks, sigs = [], [], []
+    for i in range(4):
+        sk, pk = eddsa.key_gen(hashlib.sha256(bytes([i])).digest())
+        msg = b"msg-%d" % i
+        sig = eddsa.sign(sk, msg)
+        msgs.append(msg)
+        pks.append(pk)
+        sigs.append(sig)
+    sigs[2] = sigs[2][:10] + bytes([sigs[2][10] ^ 1]) + sigs[2][11:]
+    expect = [True, True, False, True]
+    assert eddsa.verify_batch_host(msgs, pks, sigs) == expect
+    assert eddsa.verify_batch_tpu(msgs, pks, sigs) == expect
+
+
+def test_bench_measure_single_smoke():
+    from hotstuff_tpu.offchain import bench
+
+    rows = bench.measure_single(iters=2, schemes=("eddsa", "schnorr"))
+    assert {r["scheme"] for r in rows} == {"eddsa", "schnorr"}
+    assert all(r["verify_ms"] > 0 for r in rows)
+
+
+def test_bench_measure_batch_smoke():
+    from hotstuff_tpu.offchain import bench
+
+    rows = bench.measure_batch(sizes=(8,), tpu=True)
+    assert rows[0]["n"] == 8
+    assert rows[0]["eddsa_tpu_ms"] > 0
+    assert rows[0]["bls_aggregate_ms"] > 0

@@ -69,9 +69,7 @@ def tpu_throughput(msgs, pks, sigs) -> float:
     def run(prev):
         prep = eddsa.prepare_batch(msgs, pks, sigs)
         assert prep["host_ok"].all()
-        args = tuple(jnp.asarray(prep[k])
-                     for k in ("ay", "a_sign", "ry", "r_sign", "digits"))
-        out = E.verify_prepared_jit(*args)
+        out = E.verify_packed_jit(jnp.asarray(prep["packed"]))
         return out
 
     mask = run(None)  # compile + warmup
@@ -86,6 +84,9 @@ def tpu_throughput(msgs, pks, sigs) -> float:
 
 
 def main():
+    from hotstuff_tpu.ops import field25519
+
+    field25519.mul_selfcheck()  # trip fast if this backend's conv is inexact
     msgs, pks, sigs = make_batch()
     cpu = cpu_baseline(msgs, pks, sigs)
     tpu = tpu_throughput(msgs, pks, sigs)

@@ -155,8 +155,13 @@ class Bench:
         rate_share = -(-rate // alive) if alive else 0
         front = committee.front_addresses()[:alive]
         for i, host in enumerate(hosts[:alive]):
-            cmd = (f"cd {repo} && rm -rf {PathMaker.logs_path()} && "
-                   f"mkdir -p {PathMaker.logs_path()} && "
+            # Clean logs in a separate foreground command: the background
+            # wrapper's shell opens the redirect target inside logs/ before
+            # the command runs, so an in-command rm would unlink it.
+            self.runner.run(
+                host, f"cd {repo} && rm -rf {PathMaker.logs_path()} && "
+                      f"mkdir -p {PathMaker.logs_path()}")
+            cmd = (f"cd {repo} && "
                    + CommandMaker.run_client(
                        front[i], tx_size, rate_share, timeout, nodes=front))
             self.runner.run_background(

@@ -533,6 +533,8 @@ def aggregate(sigs):
 
 def verify_aggregate(pks, msgs, agg_sig) -> bool:
     """Distinct messages: prod e(pk_i, H(m_i)) == e(g1, agg)."""
+    if len(pks) != len(msgs):
+        return False  # zip would silently verify a different statement
     if agg_sig is None or not g2_on_curve(agg_sig):
         return False
     pairs = [(g1_neg(g1_generator()), agg_sig)]

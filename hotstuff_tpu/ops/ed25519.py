@@ -151,6 +151,66 @@ def _digit_select(table: jnp.ndarray, digit: jnp.ndarray) -> jnp.ndarray:
     return jnp.take_along_axis(table, idx, axis=-3)[..., 0, :, :]
 
 
+def unpack_digits(s_bytes: jnp.ndarray, k_bytes: jnp.ndarray) -> jnp.ndarray:
+    """(B, 32) uint8 little-endian S and k scalars -> (B, 256) int32
+    MSB-first 2-bit joint digits bit_i(S) + 2*bit_i(k).
+
+    Runs on device: the host ships 64 bytes per signature instead of a
+    1 KB digit schedule — on a tunneled TPU the host->device transfer is
+    the bottleneck, not the ladder itself.
+    """
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    def bits_le(b):
+        # (B, 32) -> (B, 256) little-endian bit order
+        x = (b.astype(jnp.int32)[..., None] >> shifts) & 1
+        return x.reshape(*b.shape[:-1], 256)
+    s_bits = bits_le(s_bytes)
+    k_bits = bits_le(k_bytes)
+    return (s_bits + 2 * k_bits)[..., ::-1]  # MSB-first schedule
+
+
+def split_y_sign(y_bytes: jnp.ndarray):
+    """(B, 32) uint8 compressed point -> ((B, 32) int32 y limbs with bit
+    255 cleared, (B,) int32 x-sign bit). Device-side byte parsing."""
+    y = y_bytes.astype(jnp.int32)
+    sign = y[..., 31] >> 7
+    y = y.at[..., 31].set(y[..., 31] & 0x7F)
+    return y, sign
+
+
+def verify_compact(a_bytes: jnp.ndarray, r_bytes: jnp.ndarray,
+                   s_bytes: jnp.ndarray, k_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Device-side Ed25519 verification from raw wire bytes.
+
+    Args (all (B, 32) uint8): compressed pubkey A, compressed R, scalar S
+    (little-endian), and the host-hashed challenge k = SHA512(R||A||M) mod L.
+    130 bytes/signature cross the host->device boundary; limb conversion,
+    sign extraction and the 512-entry bit unpack all happen on device.
+
+    Returns (B,) bool validity mask (host-side canonicality checks are
+    ANDed by the caller, crypto/eddsa.verify_batch).
+    """
+    ay, a_sign = split_y_sign(a_bytes)
+    ry, r_sign = split_y_sign(r_bytes)
+    digits = unpack_digits(s_bytes, k_bytes)
+    return verify_prepared(ay, a_sign, ry, r_sign, digits)
+
+
+verify_compact_jit = jax.jit(verify_compact)
+
+
+def verify_packed(packed: jnp.ndarray) -> jnp.ndarray:
+    """(B, 128) uint8 rows of A || R || S || k -> (B,) bool mask.
+
+    Single-array variant of verify_compact: one host->device transfer per
+    batch (each array transfer over a tunneled TPU pays a round trip)."""
+    return verify_compact(packed[..., 0:32], packed[..., 32:64],
+                          packed[..., 64:96], packed[..., 96:128])
+
+
+verify_packed_jit = jax.jit(verify_packed)
+
+
 def verify_prepared(ay: jnp.ndarray, a_sign: jnp.ndarray,
                     ry: jnp.ndarray, r_sign: jnp.ndarray,
                     digits: jnp.ndarray) -> jnp.ndarray:

@@ -44,17 +44,17 @@ _P_DIGITS = [(P >> (8 * i)) & 0xFF for i in range(NLIMBS)]
 # = [1896, 2040 x30, 1016]; all >= 511.
 _SUB_BIAS = [8 * d for d in _P_DIGITS]
 
-# One-hot "convolution" matrix: flattens the (32, 32) outer product of limbs
-# into the 63 coefficients of the product polynomial.  Constant, so XLA folds
-# it into a single (..., 1024) @ (1024, 63) matmul.
-_CONV = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS - 1), dtype=np.float32)
-for _i in range(NLIMBS):
-    for _j in range(NLIMBS):
-        _CONV[_i * NLIMBS + _j, _i + _j] = 1.0
+# Matmul precision for the limb-product convolution. HIGH (bf16x3 passes)
+# measured exact for this workload's 23-bit sums on real TPU (see
+# mul_selfcheck, which bench.py runs before timing) and ~16% faster than
+# HIGHEST; override with HOTSTUFF_TPU_MUL_PRECISION=highest if a backend
+# ever fails the self-check.
+import os as _os
 
-
-def _conv_mat() -> jnp.ndarray:
-    return jnp.asarray(_CONV)
+_PRECISION = {
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+}[_os.environ.get("HOTSTUFF_TPU_MUL_PRECISION", "high").lower()]
 
 
 # ---------------------------------------------------------------------------
@@ -141,17 +141,29 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a * b mod p (weak).  Partial-product sums < 32 * (2^9)^2 = 2^23: exact
-    in float32, so the schoolbook product is a single MXU matmul.  The 38-fold
-    keeps coefficients < 39 * 2^23 < 2^28.6 (int32-safe); four parallel carry
+    """a * b mod p (weak).
+
+    The schoolbook product is a depthwise (per-signature-kernel) 1-D
+    convolution: out[b] = a[b] conv b[b], exactly
+    ``lax.conv_general_dilated`` with ``feature_group_count = batch`` and a
+    lane-flipped kernel. That costs 32x63 MACs per element — 64x less
+    arithmetic than flattening the outer product through a one-hot matmul,
+    which ran at fp32-MXU peak multiplying mostly zeros. Partial-product
+    sums < 32 * (2^9)^2 = 2^23: exact in float32. The 38-fold keeps
+    coefficients < 39 * 2^23 < 2^28.6 (int32-safe); four parallel carry
     steps restore limbs < 2^9."""
-    outer = (a[..., :, None] * b[..., None, :]).astype(jnp.float32)
-    flat = outer.reshape(*outer.shape[:-2], NLIMBS * NLIMBS)
-    coeffs = jax.lax.dot_general(
-        flat, _conv_mat(),
-        dimension_numbers=(((flat.ndim - 1,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-    ).astype(jnp.int32)
+    batch_shape = a.shape[:-1]
+    n = 1
+    for d in batch_shape:
+        n *= d
+    lhs = a.reshape(1, n, NLIMBS).astype(jnp.float32)
+    rhs = jnp.flip(b.reshape(n, 1, NLIMBS), -1).astype(jnp.float32)
+    coeffs = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[(NLIMBS - 1, NLIMBS - 1)],
+        dimension_numbers=("NCH", "OIH", "NCH"),
+        feature_group_count=n,
+        precision=_PRECISION,
+    ).reshape(*batch_shape, 2 * NLIMBS - 1).astype(jnp.int32)
     lo, hi = coeffs[..., :NLIMBS], coeffs[..., NLIMBS:]
     folded = lo + 38 * jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
     return weak_normalize(folded, 4)
@@ -159,6 +171,27 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
     return mul(a, a)
+
+
+def mul_selfcheck(batch: int = 256, seed: int = 0) -> None:
+    """Assert the convolution path is bit-exact on the CURRENT backend for
+    adversarial full-range weak limbs. Cheap (one jit call); bench.py and
+    deployments should run it once at startup — if a future TPU generation
+    lowers Precision.HIGH in a non-exact way this trips immediately instead
+    of corrupting verification masks silently."""
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, 512, (batch, NLIMBS))
+    b = rng.integers(0, 512, (batch, NLIMBS))
+    a[0, :] = 511
+    b[0, :] = 511
+    got = batch_from_limbs(np.asarray(
+        canonical(mul(jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32)))))
+    want = [(x * y) % P for x, y in zip(batch_from_limbs(a),
+                                        batch_from_limbs(b))]
+    if got != want:
+        raise AssertionError(
+            "field multiply is not exact on this backend; set "
+            "HOTSTUFF_TPU_MUL_PRECISION=highest")
 
 
 # ---------------------------------------------------------------------------

@@ -23,10 +23,10 @@ from ..ops import ed25519 as E
 from .mesh import BATCH_AXIS
 
 
-def _shard_body(ay, a_sign, ry, r_sign, digits, present):
+def _shard_body(a, r, s, k, present):
     """present: (B,) int32 — 1 for a real, host-canonical vote; 0 for batch
     padding or votes already rejected on host (non-canonical encodings)."""
-    mask = E.verify_prepared(ay, a_sign, ry, r_sign, digits) & (present > 0)
+    mask = E.verify_compact(a, r, s, k) & (present > 0)
     # QC verdict: count of present-but-invalid votes, psum-reduced over ICI.
     bad = jnp.sum((present > 0) & ~mask).astype(jnp.int32)
     bad_total = jax.lax.psum(bad, BATCH_AXIS)
@@ -34,8 +34,9 @@ def _shard_body(ay, a_sign, ry, r_sign, digits, present):
 
 
 def make_sharded_verifier(mesh: Mesh):
-    """Returns jitted fn over prepared arrays + present mask (global batch B,
-    B % n_devices == 0) -> ((B,) bool mask, () int32 invalid vote count).
+    """Returns jitted fn over compact byte arrays + present mask (global
+    batch B, B % n_devices == 0) -> ((B,) bool mask, () int32 invalid vote
+    count).
 
     Note: ``bad_total`` counts votes with present=1 whose signature fails on
     device; host-side encoding rejections must be folded into ``present`` by
@@ -48,7 +49,7 @@ def make_sharded_verifier(mesh: Mesh):
     fn = shard_map(
         _shard_body,
         mesh=mesh,
-        in_specs=(batched,) * 6,
+        in_specs=(batched,) * 5,
         out_specs=(batched, Pspec()),
         check_vma=False,
     )
@@ -64,13 +65,13 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False):
     """Run a host-prepared batch (see crypto/eddsa.prepare_batch) across the
     mesh.  Pads the batch to a multiple of the mesh size; padding and
     host-rejected votes are excluded from the device-side verdict count."""
-    n = prep["ay"].shape[0]
+    n = prep["a"].shape[0]
     n_dev = mesh.devices.size
     m = ((n + n_dev - 1) // n_dev) * n_dev
     arrays = dict(prep)
     arrays["present"] = prep["host_ok"].astype(np.int32)
     out = []
-    for key in ("ay", "a_sign", "ry", "r_sign", "digits", "present"):
+    for key in ("a", "r", "s", "k", "present"):
         a = arrays[key]
         if m != n:
             a = np.pad(a, [(0, m - n)] + [(0, 0)] * (a.ndim - 1))

@@ -18,3 +18,12 @@ def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_subprocess_reexec():
+    """Cover the branch the driver actually hits: this process has only 8
+    virtual devices, so asking for 16 must re-exec a fresh child with
+    --xla_force_host_platform_device_count=16 and propagate its success."""
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(16)

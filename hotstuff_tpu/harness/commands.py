@@ -25,10 +25,14 @@ class CommandMaker:
 
     @staticmethod
     def compile():
+        # A build dir configured with a different generator (or a stale
+        # toolchain path) makes `cmake -G Ninja` fail on its cache; wipe the
+        # cache and reconfigure instead of aborting the whole benchmark.
+        src, bld = PathMaker.node_crate_path(), PathMaker.binary_path()
+        cfg = f"cmake -S {src} -B {bld} -G Ninja"
         return (
-            f"cmake -S {PathMaker.node_crate_path()} "
-            f"-B {PathMaker.binary_path()} -G Ninja "
-            f"&& cmake --build {PathMaker.binary_path()}"
+            f"( {cfg} || {{ rm -rf {bld}/CMakeCache.txt {bld}/CMakeFiles "
+            f"&& {cfg} ; }} ) && cmake --build {bld}"
         )
 
     @staticmethod

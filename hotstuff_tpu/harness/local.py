@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 import signal
 import subprocess
-from time import sleep
+from time import monotonic, sleep
 
 from .commands import CommandMaker
 from .config import Key, LocalCommittee, NodeParameters
@@ -44,6 +44,28 @@ class LocalBench:
         proc = subprocess.Popen(
             ["/bin/sh", "-c", cmd], preexec_fn=os.setsid)
         self._procs.append((name, proc))
+
+    def _wait_sidecar_ready(self, deadline_s=300):
+        """Block until the sidecar answers a PING (it binds post-warmup, so
+        the first accepted connection implies the jit cache is hot)."""
+        from ..sidecar.client import SidecarClient
+
+        start = monotonic()
+        while True:
+            try:
+                with SidecarClient(port=self.SIDECAR_PORT,
+                                   timeout=5.0) as client:
+                    client.ping()
+                Print.info(f"Sidecar ready after "
+                           f"{monotonic() - start:.0f}s (warmup done)")
+                return
+            except (OSError, ConnectionError):
+                if monotonic() - start > deadline_s:
+                    raise BenchError(
+                        "TPU sidecar failed to become ready; see "
+                        f"{PathMaker.sidecar_log_file()}",
+                        TimeoutError(f"{deadline_s}s elapsed"))
+                sleep(0.5)
 
     def _kill_nodes(self):
         for _, proc in self._procs:
@@ -90,15 +112,18 @@ class LocalBench:
             committee.print(PathMaker.committee_file())
             self.node_parameters.print(PathMaker.parameters_file())
 
-            # Optionally start the TPU verify sidecar first so nodes connect
-            # on boot (the crypto layer falls back to host verify until the
-            # sidecar is reachable).
+            # Optionally start the TPU verify sidecar first and WAIT until
+            # it answers a PING before booting any node. The sidecar only
+            # binds its socket after jit warmup, so reachable == ready; a
+            # node booted earlier would merely fall back to host verify, but
+            # the whole point of this mode is to measure the device path.
             if self.tpu_sidecar:
                 Print.info("Booting TPU verify sidecar...")
                 self._background_run(
                     f"python -m hotstuff_tpu.sidecar "
                     f"--port {self.SIDECAR_PORT}",
                     PathMaker.sidecar_log_file())
+                self._wait_sidecar_ready()
 
             # Do not boot faulty nodes (crash faults, local.py:75-76 in the
             # reference); clients only target alive nodes and split the rate

@@ -19,6 +19,7 @@ import queue
 import socket
 import socketserver
 import threading
+from time import monotonic
 
 import numpy as np
 
@@ -196,12 +197,18 @@ class SidecarServer(socketserver.ThreadingTCPServer):
 
 def serve(host: str = "127.0.0.1", port: int = 7100,
           mesh_devices: int | None = None, use_host: bool = False,
-          ready_event: threading.Event | None = None):
+          ready_event: threading.Event | None = None,
+          warm_max: int = 128):
     engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host)
-    server = SidecarServer((host, port), engine)  # bind first: fail fast
-    # Warm the jit cache so the first QC verify doesn't pay compilation.
+    # Warm the jit cache BEFORE binding: until the socket exists, node
+    # crypto gets ECONNREFUSED and falls back to host verify instead of
+    # connecting into a server whose device thread is still compiling.
+    # (A bound-but-compiling socket accepts into the TCP backlog and
+    # silently stalls every client for the whole compile — the round-2
+    # 0-TPS failure mode.)
     if not use_host:
-        _warmup(engine)
+        _warmup(engine, warm_max)
+    server = SidecarServer((host, port), engine)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
     if ready_event is not None:
         ready_event.set()
@@ -213,17 +220,28 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
     return server
 
 
-def _warmup(engine):
+def _warmup(engine, warm_max: int = 128):
+    """Compile every padded batch shape a live run will hit.
+
+    Requests pad to power-of-two buckets (crypto/eddsa._bucket), so warming
+    N = 8, 16, ... warm_max covers any QC size up to warm_max votes plus the
+    coalesced shapes the engine builds from concurrent requests. Uses the
+    engine's own verify path so the exact jitted callable is cached.
+    """
     from ..crypto import ref_ed25519 as ref
 
     sk = bytes(range(32))
     _, pk = ref.generate_keypair(sk)
     msg = b"\x00" * 32
     sig = ref.sign(sk, msg)
-    done = threading.Event()
-    req = proto.VerifyRequest(0, [msg], [pk], [sig])
-    engine.submit(req, lambda mask: done.set())
-    done.wait(timeout=300)
+    n = 8
+    while n <= warm_max:
+        t0 = monotonic()
+        mask = engine._verify([msg] * n, [pk] * n, [sig] * n)
+        if not all(mask):
+            log.error("warmup verify returned false at N=%d", n)
+        log.info("warmup N=%d done in %.1fs", n, monotonic() - t0)
+        n *= 2
 
 
 def main(argv=None):
@@ -234,6 +252,9 @@ def main(argv=None):
                     help="shard verify over an N-device mesh (0 = single)")
     ap.add_argument("--host-crypto", action="store_true",
                     help="pure-host verification (debug/fallback)")
+    ap.add_argument("--warm", type=int, default=128,
+                    help="largest batch shape to pre-compile before "
+                         "listening (power-of-two buckets up to this)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -241,7 +262,7 @@ def main(argv=None):
         format="%(asctime)s.%(msecs)03dZ %(levelname)s [%(name)s] %(message)s",
         datefmt="%Y-%m-%dT%H:%M:%S")
     serve(args.host, args.port, mesh_devices=args.mesh or None,
-          use_host=args.host_crypto)
+          use_host=args.host_crypto, warm_max=args.warm)
 
 
 if __name__ == "__main__":

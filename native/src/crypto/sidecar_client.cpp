@@ -26,8 +26,11 @@ bool TpuVerifier::connected() {
 
 bool TpuVerifier::ensure_connected_locked() {
   if (sock_.valid()) return true;
-  auto s = Socket::connect(addr_);
+  if (std::chrono::steady_clock::now() < backoff_until_) return false;
+  auto s = Socket::connect(addr_, kConnectTimeoutMs);
   if (!s) {
+    backoff_until_ = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(kBackoffMs);
     if (!ever_connected_) return false;
     LOG_WARN("crypto::sidecar") << "lost connection to verify sidecar "
                                 << addr_.str();
@@ -35,6 +38,7 @@ bool TpuVerifier::ensure_connected_locked() {
     return false;
   }
   sock_ = std::move(*s);
+  sock_.set_recv_timeout(kRecvTimeoutMs);
   if (!ever_connected_) {
     LOG_INFO("crypto::sidecar") << "connected to verify sidecar "
                                 << addr_.str();
@@ -64,12 +68,22 @@ std::optional<std::vector<bool>> TpuVerifier::verify_batch(
   }
   if (!sock_.write_frame(w.out)) {
     sock_.close();
+    backoff_until_ = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(kBackoffMs);
     return std::nullopt;
   }
 
+  // Bounded wait (SO_RCVTIMEO set at connect): a wedged sidecar costs at
+  // most kRecvTimeoutMs once per backoff window, then the caller's host
+  // fallback takes over. Closing the socket also discards any late reply,
+  // so request/reply framing can never desynchronize.
   Bytes reply;
   if (!sock_.read_frame(&reply)) {
+    LOG_WARN("crypto::sidecar")
+        << "sidecar read failed/timed out; falling back to host verify";
     sock_.close();
+    backoff_until_ = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(kBackoffMs);
     return std::nullopt;
   }
   try {

@@ -5,6 +5,7 @@
 // dalek::verify_batch call of the reference (crypto/src/lib.rs:210-223).
 #pragma once
 
+#include <chrono>
 #include <memory>
 #include <mutex>
 #include <optional>
@@ -35,6 +36,15 @@ class TpuVerifier {
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes);
 
+  // Deadlines (ms). Every sidecar interaction is bounded: a slow or wedged
+  // device process makes verify_batch return nullopt (host fallback), never
+  // stalls the consensus Core thread (SURVEY.md §7 latency discipline).
+  static constexpr int kConnectTimeoutMs = 250;
+  static constexpr int kRecvTimeoutMs = 1000;
+  // After a transport failure, skip the sidecar entirely for this long so a
+  // dead device costs one timeout, not one per QC.
+  static constexpr int kBackoffMs = 2000;
+
  private:
   bool ensure_connected_locked();
 
@@ -43,6 +53,7 @@ class TpuVerifier {
   Socket sock_;
   uint32_t next_id_ = 0;
   bool ever_connected_ = false;
+  std::chrono::steady_clock::time_point backoff_until_{};
 };
 
 }  // namespace hotstuff

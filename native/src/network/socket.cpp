@@ -1,9 +1,12 @@
 #include "network/socket.hpp"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -63,6 +66,46 @@ std::optional<Socket> Socket::connect(const Address& addr) {
   }
   set_common_opts(fd);
   return Socket(fd);
+}
+
+std::optional<Socket> Socket::connect(const Address& addr, int timeout_ms) {
+  sockaddr_in sa;
+  if (!fill_sockaddr(addr, &sa)) return std::nullopt;
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return std::nullopt;
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+  if (rc != 0) {
+    if (errno != EINPROGRESS) {
+      ::close(fd);
+      return std::nullopt;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    if (::poll(&pfd, 1, timeout_ms) != 1) {
+      ::close(fd);
+      return std::nullopt;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+      ::close(fd);
+      return std::nullopt;
+    }
+  }
+  // Back to blocking mode; per-read deadlines come from set_recv_timeout.
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0 || fcntl(fd, F_SETFL, flags & ~O_NONBLOCK) != 0) {
+    ::close(fd);
+    return std::nullopt;
+  }
+  set_common_opts(fd);
+  return Socket(fd);
+}
+
+bool Socket::set_recv_timeout(int timeout_ms) {
+  timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  return setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) == 0;
 }
 
 void Socket::close() {

@@ -45,6 +45,14 @@ class Socket {
   Socket& operator=(const Socket&) = delete;
 
   static std::optional<Socket> connect(const Address& addr);
+  // Non-blocking connect bounded by `timeout_ms` (poll-based); used for
+  // dispatch paths that must never stall a state-machine thread, e.g. the
+  // TPU sidecar client.
+  static std::optional<Socket> connect(const Address& addr, int timeout_ms);
+
+  // Bound every subsequent recv: read_frame/read_exact fail (returning
+  // false) instead of blocking past the deadline. 0 disables.
+  bool set_recv_timeout(int timeout_ms);
 
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }

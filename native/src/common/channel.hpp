@@ -44,6 +44,24 @@ class Channel {
     return true;
   }
 
+  // Bounded send: blocks until capacity frees, the deadline passes, or the
+  // channel closes. On kTimeout the value is NOT consumed (still valid in
+  // *value) so callers can retry or cancel — the escape hatch that lets a
+  // producer observe a stop signal instead of wedging on a full queue.
+  RecvStatus send_until(T* value,
+                        std::chrono::steady_clock::time_point deadline) {
+    std::unique_lock<std::mutex> lk(m_);
+    if (!cv_send_.wait_until(lk, deadline, [&] {
+          return q_.size() < capacity_ || closed_;
+        })) {
+      return RecvStatus::kTimeout;
+    }
+    if (closed_) return RecvStatus::kClosed;
+    q_.push_back(std::move(*value));
+    cv_recv_.notify_one();
+    return RecvStatus::kOk;
+  }
+
   bool try_recv(T* out) {
     std::lock_guard<std::mutex> lk(m_);
     if (q_.empty()) return false;

@@ -61,19 +61,41 @@ std::unique_ptr<Consensus> Consensus::spawn(
   auto synchronizer = std::make_shared<Synchronizer>(
       name, committee, store, tx_core, parameters.sync_retry_delay);
 
-  Core::spawn(name, committee, signature_service, store, leader_elector,
-              mempool_driver, synchronizer, parameters.timeout_delay, tx_core,
-              tx_proposer_cmd, tx_commit);
+  c->closers_.push_back([tx_core] { tx_core->close(); });
+  c->closers_.push_back([tx_proposer_cmd] { tx_proposer_cmd->close(); });
+  c->closers_.push_back([tx_helper] { tx_helper->close(); });
+  c->closers_.push_back([rx_mempool] { rx_mempool->close(); });
+  c->closers_.push_back([tx_commit] { tx_commit->close(); });
 
-  Proposer::spawn(name, committee, signature_service, rx_mempool,
-                  tx_proposer_cmd, tx_core);
+  // Core's thread owns the last refs to the synchronizer and mempool driver
+  // (their inner threads join in their destructors when Core's lambda state
+  // is destroyed at thread exit).
+  c->threads_.push_back(Core::spawn(
+      name, committee, signature_service, store, leader_elector,
+      mempool_driver, synchronizer, parameters.timeout_delay, tx_core,
+      tx_proposer_cmd, tx_commit));
 
-  Helper::spawn(committee, store, tx_helper);
+  c->threads_.push_back(Proposer::spawn(name, committee, signature_service,
+                                        rx_mempool, tx_proposer_cmd, tx_core,
+                                        c->stop_flag_));
+
+  c->threads_.push_back(Helper::spawn(committee, store, tx_helper));
 
   return c;
 }
 
-Consensus::~Consensus() = default;
+void Consensus::stop() {
+  if (stopped_) return;
+  stopped_ = true;
+  stop_flag_->store(true);
+  for (auto& close : closers_) close();
+  receiver_.stop();
+  for (auto& t : threads_) {
+    if (t.joinable()) t.join();
+  }
+}
+
+Consensus::~Consensus() { stop(); }
 
 }  // namespace consensus
 }  // namespace hotstuff

@@ -3,7 +3,11 @@
 // (consensus/src/consensus.rs:41-162 in the reference).
 #pragma once
 
+#include <atomic>
+#include <functional>
 #include <memory>
+#include <thread>
+#include <vector>
 
 #include "common/channel.hpp"
 #include "consensus/core.hpp"
@@ -27,12 +31,21 @@ class Consensus {
       ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
       ChannelPtr<Block> tx_commit);
 
+  // Orderly teardown: set the stop flag, close every channel (including
+  // tx_commit, which releases the application's commit drain), stop the
+  // receiver, join Core/Proposer/Helper. Idempotent; destructor calls it.
+  void stop();
   ~Consensus();
 
  private:
   Consensus() = default;
 
   NetworkReceiver receiver_;
+  std::shared_ptr<std::atomic<bool>> stop_flag_ =
+      std::make_shared<std::atomic<bool>>(false);
+  std::vector<std::function<void()>> closers_;
+  std::vector<std::thread> threads_;
+  bool stopped_ = false;
 };
 
 }  // namespace consensus

@@ -230,6 +230,13 @@ class CoreImpl {
   }
 
   VerifyResult handle_tc(const TC& tc) {
+    if (tc.round < round_) return VerifyResult::good();  // stale: skip
+    // The reference skips verification here (core.rs:429-435), which lets
+    // any peer — or one corrupted frame — advance our round arbitrarily
+    // (observed in round 2 as a node jumping to round 97 during a stalled
+    // run). Verify before trusting the round number.
+    VerifyResult valid = tc.verify(committee_);
+    if (!valid.ok()) return valid;
     advance_round(tc.round);
     if (name_ == leader_elector_->get_leader(round_)) {
       generate_proposal(tc);
@@ -341,22 +348,22 @@ class CoreImpl {
 
 }  // namespace
 
-void Core::spawn(PublicKey name, Committee committee,
-                 SignatureService signature_service, Store store,
-                 std::shared_ptr<LeaderElector> leader_elector,
-                 std::shared_ptr<MempoolDriver> mempool_driver,
-                 std::shared_ptr<Synchronizer> synchronizer,
-                 uint64_t timeout_delay, ChannelPtr<CoreEvent> rx_event,
-                 ChannelPtr<ProposerMessage> tx_proposer,
-                 ChannelPtr<Block> tx_commit) {
-  std::thread([=] {
+std::thread Core::spawn(PublicKey name, Committee committee,
+                        SignatureService signature_service, Store store,
+                        std::shared_ptr<LeaderElector> leader_elector,
+                        std::shared_ptr<MempoolDriver> mempool_driver,
+                        std::shared_ptr<Synchronizer> synchronizer,
+                        uint64_t timeout_delay, ChannelPtr<CoreEvent> rx_event,
+                        ChannelPtr<ProposerMessage> tx_proposer,
+                        ChannelPtr<Block> tx_commit) {
+  return std::thread([=] {
     CoreImpl core(name, std::move(committee), std::move(signature_service),
                   std::move(store), std::move(leader_elector),
                   std::move(mempool_driver), std::move(synchronizer),
                   timeout_delay, std::move(rx_event), std::move(tx_proposer),
                   std::move(tx_commit));
     core.run();
-  }).detach();
+  });
 }
 
 }  // namespace consensus

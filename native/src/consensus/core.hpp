@@ -4,6 +4,7 @@
 #pragma once
 
 #include <memory>
+#include <thread>
 
 #include "common/channel.hpp"
 #include "consensus/aggregator.hpp"
@@ -50,14 +51,16 @@ struct ProposerMessage {
 
 class Core {
  public:
-  static void spawn(PublicKey name, Committee committee,
-                    SignatureService signature_service, Store store,
-                    std::shared_ptr<LeaderElector> leader_elector,
-                    std::shared_ptr<MempoolDriver> mempool_driver,
-                    std::shared_ptr<Synchronizer> synchronizer,
-                    uint64_t timeout_delay, ChannelPtr<CoreEvent> rx_event,
-                    ChannelPtr<ProposerMessage> tx_proposer,
-                    ChannelPtr<Block> tx_commit);
+  // Returns the replica thread; it exits when rx_event is closed.
+  static std::thread spawn(PublicKey name, Committee committee,
+                           SignatureService signature_service, Store store,
+                           std::shared_ptr<LeaderElector> leader_elector,
+                           std::shared_ptr<MempoolDriver> mempool_driver,
+                           std::shared_ptr<Synchronizer> synchronizer,
+                           uint64_t timeout_delay,
+                           ChannelPtr<CoreEvent> rx_event,
+                           ChannelPtr<ProposerMessage> tx_proposer,
+                           ChannelPtr<Block> tx_commit);
 };
 
 }  // namespace consensus

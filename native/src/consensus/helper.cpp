@@ -8,9 +8,9 @@
 namespace hotstuff {
 namespace consensus {
 
-void Helper::spawn(Committee committee, Store store,
+std::thread Helper::spawn(Committee committee, Store store,
                    ChannelPtr<std::pair<Digest, PublicKey>> rx_request) {
-  std::thread([committee = std::move(committee), store,
+  return std::thread([committee = std::move(committee), store,
                rx_request]() mutable {
     SimpleSender network;
     while (auto req = rx_request->recv()) {
@@ -28,7 +28,7 @@ void Helper::spawn(Committee committee, Store store,
         network.send(*address, ConsensusMessage::propose(block));
       }
     }
-  }).detach();
+  });
 }
 
 }  // namespace consensus

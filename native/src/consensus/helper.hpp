@@ -3,6 +3,8 @@
 // (consensus/src/helper.rs:15-68 in the reference).
 #pragma once
 
+#include <thread>
+
 #include "common/channel.hpp"
 #include "consensus/messages.hpp"
 #include "store/store.hpp"
@@ -12,7 +14,8 @@ namespace consensus {
 
 class Helper {
  public:
-  static void spawn(Committee committee, Store store,
+  // Returns the actor thread; exits when rx_request is closed and drained.
+  static std::thread spawn(Committee committee, Store store,
                     ChannelPtr<std::pair<Digest, PublicKey>> rx_request);
 };
 

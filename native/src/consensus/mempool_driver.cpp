@@ -20,7 +20,7 @@ MempoolDriver::MempoolDriver(
       // block; the pending map dedups future kWaits).
       tx_payload_waiter_(make_channel<WaiterMessage>(SIZE_MAX)) {
   auto rx = tx_payload_waiter_;
-  std::thread([store, rx, tx_loopback]() mutable {
+  thread_ = std::thread([store, rx, tx_loopback]() mutable {
     struct Pending {
       Round round;
       Block block;
@@ -77,7 +77,12 @@ MempoolDriver::MempoolDriver(
         }
       }
     }
-  }).detach();
+  });
+}
+
+MempoolDriver::~MempoolDriver() {
+  tx_payload_waiter_->close();
+  if (thread_.joinable()) thread_.join();
 }
 
 bool MempoolDriver::verify(const Block& block) {

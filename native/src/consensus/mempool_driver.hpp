@@ -6,6 +6,7 @@
 #pragma once
 
 #include <memory>
+#include <thread>
 
 #include "common/channel.hpp"
 #include "consensus/messages.hpp"
@@ -22,6 +23,10 @@ class MempoolDriver {
   MempoolDriver(Store store,
                 ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
                 ChannelPtr<CoreEvent> tx_loopback);
+  // Closes the waiter channel and joins the payload-waiter thread.
+  ~MempoolDriver();
+  MempoolDriver(const MempoolDriver&) = delete;
+  MempoolDriver& operator=(const MempoolDriver&) = delete;
 
   // Called from the core thread: true when all payload batches are local.
   bool verify(const Block& block);
@@ -40,6 +45,7 @@ class MempoolDriver {
   Store store_;
   ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool_;
   ChannelPtr<WaiterMessage> tx_payload_waiter_;
+  std::thread thread_;
 };
 
 }  // namespace consensus

@@ -1,5 +1,7 @@
 #include "consensus/proposer.hpp"
 
+#include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <mutex>
 #include <set>
@@ -17,7 +19,8 @@ void make_block(const PublicKey& name, const Committee& committee,
                 const SignatureService& signature_service,
                 ReliableSender* network, std::set<Digest>* buffer,
                 Round round, QC qc, std::optional<TC> tc,
-                Channel<CoreEvent>* tx_loopback) {
+                Channel<CoreEvent>* tx_loopback,
+                const std::atomic<bool>& stop) {
   Block block;
   block.qc = std::move(qc);
   block.tc = std::move(tc);
@@ -61,20 +64,26 @@ void make_block(const PublicKey& name, const Committee& committee,
   }
   Stake quorum = committee.quorum_threshold();
   std::unique_lock<std::mutex> lk(*m);
-  cv->wait(lk, [&] { return *total >= quorum; });
+  // Bounded waits so teardown (stop set, peers gone) can't wedge the
+  // proposer inside its backpressure wait; live ACKs wake us immediately.
+  while (*total < quorum && !stop.load()) {
+    cv->wait_for(lk, std::chrono::milliseconds(50));
+  }
 }
 
 }  // namespace
 
-void Proposer::spawn(PublicKey name, Committee committee,
-                     SignatureService signature_service,
-                     ChannelPtr<Digest> rx_mempool,
-                     ChannelPtr<ProposerMessage> rx_message,
-                     ChannelPtr<CoreEvent> tx_loopback) {
-  std::thread([name, committee = std::move(committee),
-               signature_service = std::move(signature_service), rx_mempool,
-               rx_message, tx_loopback]() mutable {
-    ReliableSender network;
+std::thread Proposer::spawn(PublicKey name, Committee committee,
+                            SignatureService signature_service,
+                            ChannelPtr<Digest> rx_mempool,
+                            ChannelPtr<ProposerMessage> rx_message,
+                            ChannelPtr<CoreEvent> tx_loopback,
+                            std::shared_ptr<std::atomic<bool>> stop) {
+  return std::thread([name, committee = std::move(committee),
+                      signature_service = std::move(signature_service),
+                      rx_mempool, rx_message, tx_loopback,
+                      stop = std::move(stop)]() mutable {
+    ReliableSender network(stop);
     std::set<Digest> buffer;
     while (true) {
       // Select: block (briefly) on the command channel, opportunistically
@@ -108,12 +117,12 @@ void Proposer::spawn(PublicKey name, Committee committee,
         }
         make_block(name, committee, signature_service, &network, &buffer,
                    cmd.round, std::move(cmd.qc), std::move(cmd.tc),
-                   tx_loopback.get());
+                   tx_loopback.get(), *stop);
       } else {
         for (const Digest& d : cmd.digests) buffer.erase(d);
       }
     }
-  }).detach();
+  });
 }
 
 }  // namespace consensus

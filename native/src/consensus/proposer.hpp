@@ -4,6 +4,10 @@
 // system, consensus/src/proposer.rs:19-143).
 #pragma once
 
+#include <atomic>
+#include <memory>
+#include <thread>
+
 #include "common/channel.hpp"
 #include "consensus/core.hpp"
 
@@ -18,11 +22,14 @@ class Proposer {
   // and must never be wedged behind digests (sharing one queue deadlocks
   // the whole committee under load: core blocked on proposer, proposer
   // blocked on peers' ACKs, peers' receivers blocked on their cores).
-  static void spawn(PublicKey name, Committee committee,
-                    SignatureService signature_service,
-                    ChannelPtr<Digest> rx_mempool,
-                    ChannelPtr<ProposerMessage> rx_message,
-                    ChannelPtr<CoreEvent> tx_loopback);
+  // Returns the actor thread; exits when rx_message is closed. `stop`
+  // breaks an in-progress 2f+1 ACK wait at teardown.
+  static std::thread spawn(PublicKey name, Committee committee,
+                           SignatureService signature_service,
+                           ChannelPtr<Digest> rx_mempool,
+                           ChannelPtr<ProposerMessage> rx_message,
+                           ChannelPtr<CoreEvent> tx_loopback,
+                           std::shared_ptr<std::atomic<bool>> stop);
 };
 
 }  // namespace consensus

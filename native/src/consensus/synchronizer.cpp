@@ -32,8 +32,8 @@ Synchronizer::Synchronizer(PublicKey name, Committee committee, Store store,
       // number of distinct suspended blocks.
       inner_(make_channel<SyncCommand>(SIZE_MAX)) {
   auto inner = inner_;
-  std::thread([name, committee = std::move(committee), store, tx_loopback,
-               sync_retry_delay, inner]() mutable {
+  thread_ = std::thread([name, committee = std::move(committee), store,
+                         tx_loopback, sync_retry_delay, inner]() mutable {
     SimpleSender network;
     std::set<Digest> pending;              // block digests being resolved
     std::map<Digest, uint64_t> requests;   // parent digest -> request ts
@@ -95,7 +95,12 @@ Synchronizer::Synchronizer(PublicKey name, Committee committee, Store store,
         }
       }
     }
-  }).detach();
+  });
+}
+
+Synchronizer::~Synchronizer() {
+  inner_->close();
+  if (thread_.joinable()) thread_.join();
 }
 
 std::optional<Block> Synchronizer::get_parent_block(const Block& block) {

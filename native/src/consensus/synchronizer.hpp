@@ -6,6 +6,7 @@
 #pragma once
 
 #include <optional>
+#include <thread>
 #include <utility>
 
 #include "common/channel.hpp"
@@ -21,6 +22,10 @@ class Synchronizer {
  public:
   Synchronizer(PublicKey name, Committee committee, Store store,
                ChannelPtr<CoreEvent> tx_loopback, uint64_t sync_retry_delay);
+  // Closes the inner channel and joins the waiter thread.
+  ~Synchronizer();
+  Synchronizer(const Synchronizer&) = delete;
+  Synchronizer& operator=(const Synchronizer&) = delete;
 
   // Called from the core thread. nullopt = missing, sync requested, the
   // block will loop back when its parent is available.
@@ -36,6 +41,7 @@ class Synchronizer {
 
   Store store_;
   ChannelPtr<SyncCommand> inner_;
+  std::thread thread_;
 };
 
 }  // namespace consensus

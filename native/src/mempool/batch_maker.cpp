@@ -54,14 +54,16 @@ void seal_and_send(Batch* current, size_t* current_size,
 
 }  // namespace
 
-void BatchMaker::spawn(
+std::thread BatchMaker::spawn(
     size_t batch_size, uint64_t max_batch_delay,
     ChannelPtr<Transaction> rx_transaction,
     ChannelPtr<QuorumWaiterMessage> tx_message,
-    std::vector<std::pair<PublicKey, Address>> mempool_addresses) {
-  std::thread([batch_size, max_batch_delay, rx_transaction, tx_message,
-               peers = std::move(mempool_addresses)] {
-    ReliableSender network;
+    std::vector<std::pair<PublicKey, Address>> mempool_addresses,
+    std::shared_ptr<std::atomic<bool>> stop) {
+  return std::thread([batch_size, max_batch_delay, rx_transaction, tx_message,
+               peers = std::move(mempool_addresses),
+               stop = std::move(stop)] {
+    ReliableSender network(stop);
     Batch current;
     size_t current_size = 0;
     auto delay = std::chrono::milliseconds(max_batch_delay);
@@ -87,7 +89,7 @@ void BatchMaker::spawn(
         deadline = std::chrono::steady_clock::now() + delay;
       }
     }
-  }).detach();
+  });
 }
 
 }  // namespace mempool

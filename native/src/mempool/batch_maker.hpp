@@ -5,6 +5,7 @@
 // (mempool/src/batch_maker.rs:27-168 in the reference).
 #pragma once
 
+#include <atomic>
 #include <memory>
 #include <thread>
 
@@ -23,11 +24,15 @@ struct QuorumWaiterMessage {
 
 class BatchMaker {
  public:
-  static void spawn(size_t batch_size, uint64_t max_batch_delay,
-                    ChannelPtr<Transaction> rx_transaction,
-                    ChannelPtr<QuorumWaiterMessage> tx_message,
-                    std::vector<std::pair<PublicKey, Address>>
-                        mempool_addresses);
+  // Returns the actor thread; it exits when rx_transaction is closed and
+  // drained. The caller owns the join. `stop` makes the broadcast sends
+  // interruptible at teardown (see ReliableSender).
+  static std::thread spawn(size_t batch_size, uint64_t max_batch_delay,
+                           ChannelPtr<Transaction> rx_transaction,
+                           ChannelPtr<QuorumWaiterMessage> tx_message,
+                           std::vector<std::pair<PublicKey, Address>>
+                               mempool_addresses,
+                           std::shared_ptr<std::atomic<bool>> stop);
 };
 
 }  // namespace mempool

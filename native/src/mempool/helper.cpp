@@ -8,10 +8,10 @@
 namespace hotstuff {
 namespace mempool {
 
-void Helper::spawn(
+std::thread Helper::spawn(
     Committee committee, Store store,
     ChannelPtr<std::pair<std::vector<Digest>, PublicKey>> rx_request) {
-  std::thread([committee = std::move(committee), store, rx_request]() mutable {
+  return std::thread([committee = std::move(committee), store, rx_request]() mutable {
     SimpleSender network;
     while (auto req = rx_request->recv()) {
       const auto& [digests, origin] = *req;
@@ -30,7 +30,7 @@ void Helper::spawn(
         }
       }
     }
-  }).detach();
+  });
 }
 
 }  // namespace mempool

@@ -3,6 +3,8 @@
 // (mempool/src/helper.rs:14-68 in the reference).
 #pragma once
 
+#include <thread>
+
 #include "common/channel.hpp"
 #include "mempool/config.hpp"
 #include "store/store.hpp"
@@ -12,7 +14,8 @@ namespace mempool {
 
 class Helper {
  public:
-  static void spawn(
+  // Returns the actor thread; exits when rx_request is closed and drained.
+  static std::thread spawn(
       Committee committee, Store store,
       ChannelPtr<std::pair<std::vector<Digest>, PublicKey>> rx_request);
 };

@@ -25,9 +25,19 @@ std::unique_ptr<Mempool> Mempool::spawn(
   auto tx_helper =
       make_channel<std::pair<std::vector<Digest>, PublicKey>>();
 
-  Synchronizer::spawn(name, committee, store, parameters.gc_depth,
-                      parameters.sync_retry_delay,
-                      parameters.sync_retry_nodes, rx_consensus);
+  // Everything the facade created gets a closer; stop() runs them all
+  // before joining so no actor can stay blocked in a channel op.
+  mp->closers_.push_back([tx_batch_maker] { tx_batch_maker->close(); });
+  mp->closers_.push_back([tx_quorum_waiter] { tx_quorum_waiter->close(); });
+  mp->closers_.push_back([tx_processor] { tx_processor->close(); });
+  mp->closers_.push_back([tx_peer_processor] { tx_peer_processor->close(); });
+  mp->closers_.push_back([tx_helper] { tx_helper->close(); });
+  mp->closers_.push_back([rx_consensus] { rx_consensus->close(); });
+
+  mp->threads_.push_back(
+      Synchronizer::spawn(name, committee, store, parameters.gc_depth,
+                          parameters.sync_retry_delay,
+                          parameters.sync_retry_nodes, rx_consensus));
 
   // Client transaction ingress (:front). No ACKs.
   auto tx_address = committee.transactions_address(name);
@@ -44,17 +54,21 @@ std::unique_ptr<Mempool> Mempool::spawn(
   LOG_INFO("mempool::mempool")
       << "Mempool listening to client transactions on " << tx_address->str();
 
-  BatchMaker::spawn(parameters.batch_size, parameters.max_batch_delay,
-                    tx_batch_maker, tx_quorum_waiter,
-                    committee.broadcast_addresses(name));
+  mp->threads_.push_back(
+      BatchMaker::spawn(parameters.batch_size, parameters.max_batch_delay,
+                        tx_batch_maker, tx_quorum_waiter,
+                        committee.broadcast_addresses(name),
+                        mp->stop_flag_));
 
-  QuorumWaiter::spawn(committee, committee.stake(name), tx_quorum_waiter,
-                      tx_processor);
+  mp->threads_.push_back(QuorumWaiter::spawn(committee, committee.stake(name),
+                                             tx_quorum_waiter, tx_processor,
+                                             mp->stop_flag_));
 
   // Two processors as in the reference (mempool.rs:147-151, 185-189): one
   // for our quorum-acked batches, one for batches received from peers.
-  Processor::spawn(store, tx_processor, tx_consensus);
-  Processor::spawn(store, tx_peer_processor, tx_consensus);
+  mp->threads_.push_back(Processor::spawn(store, tx_processor, tx_consensus));
+  mp->threads_.push_back(
+      Processor::spawn(store, tx_peer_processor, tx_consensus));
 
   // Peer ingress (:mempool). ACK every message, then route by type
   // (mempool.rs:225-243).
@@ -85,14 +99,26 @@ std::unique_ptr<Mempool> Mempool::spawn(
   LOG_INFO("mempool::mempool")
       << "Mempool listening to mempool messages on " << peer_address->str();
 
-  Helper::spawn(committee, store, tx_helper);
+  mp->threads_.push_back(Helper::spawn(committee, store, tx_helper));
 
   LOG_INFO("mempool::mempool")
       << "Mempool successfully booted on " << peer_address->host;
   return mp;
 }
 
-Mempool::~Mempool() = default;
+void Mempool::stop() {
+  if (stopped_) return;
+  stopped_ = true;
+  stop_flag_->store(true);
+  for (auto& close : closers_) close();
+  tx_receiver_.stop();
+  peer_receiver_.stop();
+  for (auto& t : threads_) {
+    if (t.joinable()) t.join();
+  }
+}
+
+Mempool::~Mempool() { stop(); }
 
 }  // namespace mempool
 }  // namespace hotstuff

@@ -4,7 +4,11 @@
 // (mempool/src/mempool.rs:44-193 in the reference).
 #pragma once
 
+#include <atomic>
+#include <functional>
 #include <memory>
+#include <thread>
+#include <vector>
 
 #include "common/channel.hpp"
 #include "mempool/config.hpp"
@@ -24,6 +28,10 @@ class Mempool {
       ChannelPtr<ConsensusMempoolMessage> rx_consensus,
       ChannelPtr<Digest> tx_consensus);
 
+  // Orderly teardown: set the stop flag, close every channel (waking any
+  // actor blocked in send/recv), stop the receivers, join all actor
+  // threads. Idempotent; the destructor calls it.
+  void stop();
   ~Mempool();
 
   NetworkReceiver& tx_receiver() { return tx_receiver_; }
@@ -34,6 +42,11 @@ class Mempool {
 
   NetworkReceiver tx_receiver_;
   NetworkReceiver peer_receiver_;
+  std::shared_ptr<std::atomic<bool>> stop_flag_ =
+      std::make_shared<std::atomic<bool>>(false);
+  std::vector<std::function<void()>> closers_;
+  std::vector<std::thread> threads_;
+  bool stopped_ = false;
 };
 
 }  // namespace mempool

@@ -5,15 +5,15 @@
 namespace hotstuff {
 namespace mempool {
 
-void Processor::spawn(Store store, ChannelPtr<Bytes> rx_batch,
+std::thread Processor::spawn(Store store, ChannelPtr<Bytes> rx_batch,
                       ChannelPtr<Digest> tx_digest) {
-  std::thread([store, rx_batch, tx_digest]() mutable {
+  return std::thread([store, rx_batch, tx_digest]() mutable {
     while (auto batch = rx_batch->recv()) {
       Digest digest = sha512_digest(*batch);
       store.write(digest.to_bytes(), *batch);
       tx_digest->send(digest);
     }
-  }).detach();
+  });
 }
 
 }  // namespace mempool

@@ -3,6 +3,8 @@
 // (mempool/src/processor.rs:16-39 in the reference).
 #pragma once
 
+#include <thread>
+
 #include "common/channel.hpp"
 #include "crypto/crypto.hpp"
 #include "store/store.hpp"
@@ -12,7 +14,8 @@ namespace mempool {
 
 class Processor {
  public:
-  static void spawn(Store store, ChannelPtr<Bytes> rx_batch,
+  // Returns the actor thread; exits when rx_batch is closed and drained.
+  static std::thread spawn(Store store, ChannelPtr<Bytes> rx_batch,
                     ChannelPtr<Digest> tx_digest);
 };
 

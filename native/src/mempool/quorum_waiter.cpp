@@ -1,5 +1,6 @@
 #include "mempool/quorum_waiter.hpp"
 
+#include <chrono>
 #include <condition_variable>
 #include <mutex>
 #include <thread>
@@ -7,11 +8,12 @@
 namespace hotstuff {
 namespace mempool {
 
-void QuorumWaiter::spawn(Committee committee, Stake my_stake,
-                         ChannelPtr<QuorumWaiterMessage> rx_message,
-                         ChannelPtr<Bytes> tx_batch) {
-  std::thread([committee = std::move(committee), my_stake, rx_message,
-               tx_batch] {
+std::thread QuorumWaiter::spawn(Committee committee, Stake my_stake,
+                                ChannelPtr<QuorumWaiterMessage> rx_message,
+                                ChannelPtr<Bytes> tx_batch,
+                                std::shared_ptr<std::atomic<bool>> stop) {
+  return std::thread([committee = std::move(committee), my_stake, rx_message,
+                      tx_batch, stop] {
     while (auto msg = rx_message->recv()) {
       // Stake accumulates as ACKs arrive in any order (the reference's
       // FuturesUnordered wait, quorum_waiter.rs:60-86): each handler's
@@ -29,11 +31,16 @@ void QuorumWaiter::spawn(Committee committee, Stake my_stake,
       }
       Stake quorum = committee.quorum_threshold();
       std::unique_lock<std::mutex> lk(*m);
-      cv->wait(lk, [&] { return *total >= quorum; });
+      // Bounded waits so a teardown (stop set, peers gone) can't wedge the
+      // actor; in steady state the notify wakes us immediately.
+      while (*total < quorum && !stop->load()) {
+        cv->wait_for(lk, std::chrono::milliseconds(50));
+      }
+      if (*total < quorum) break;  // stopped mid-wait
       lk.unlock();
       tx_batch->send(std::move(msg->batch));
     }
-  }).detach();
+  });
 }
 
 }  // namespace mempool

@@ -3,6 +3,10 @@
 // processing (mempool/src/quorum_waiter.rs:22-88 in the reference).
 #pragma once
 
+#include <atomic>
+#include <memory>
+#include <thread>
+
 #include "common/channel.hpp"
 #include "mempool/batch_maker.hpp"
 #include "mempool/config.hpp"
@@ -12,9 +16,13 @@ namespace mempool {
 
 class QuorumWaiter {
  public:
-  static void spawn(Committee committee, Stake my_stake,
-                    ChannelPtr<QuorumWaiterMessage> rx_message,
-                    ChannelPtr<Bytes> tx_batch);
+  // Returns the actor thread; exits when rx_message is closed and drained.
+  // `stop` breaks an in-progress stake wait at teardown (the ACKs it is
+  // waiting for may never arrive once peers shut down).
+  static std::thread spawn(Committee committee, Stake my_stake,
+                           ChannelPtr<QuorumWaiterMessage> rx_message,
+                           ChannelPtr<Bytes> tx_batch,
+                           std::shared_ptr<std::atomic<bool>> stop);
 };
 
 }  // namespace mempool

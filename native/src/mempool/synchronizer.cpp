@@ -20,11 +20,11 @@ uint64_t now_ms() {
 }
 }  // namespace
 
-void Synchronizer::spawn(PublicKey name, Committee committee, Store store,
+std::thread Synchronizer::spawn(PublicKey name, Committee committee, Store store,
                          Round gc_depth, uint64_t sync_retry_delay,
                          size_t sync_retry_nodes,
                          ChannelPtr<ConsensusMempoolMessage> rx_message) {
-  std::thread([name, committee = std::move(committee), store, gc_depth,
+  return std::thread([name, committee = std::move(committee), store, gc_depth,
                sync_retry_delay, sync_retry_nodes, rx_message]() mutable {
     SimpleSender network;
     // Internal completion channel: notify_read callbacks push the digest
@@ -119,7 +119,7 @@ void Synchronizer::spawn(PublicKey name, Committee committee, Store store,
         }
       }
     }
-  }).detach();
+  });
 }
 
 }  // namespace mempool

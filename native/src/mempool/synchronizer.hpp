@@ -5,6 +5,8 @@
 // (mempool/src/synchronizer.rs:23-210 in the reference).
 #pragma once
 
+#include <thread>
+
 #include "common/channel.hpp"
 #include "mempool/config.hpp"
 #include "mempool/messages.hpp"
@@ -15,7 +17,8 @@ namespace mempool {
 
 class Synchronizer {
  public:
-  static void spawn(PublicKey name, Committee committee, Store store,
+  // Returns the actor thread; exits when rx_message is closed and drained.
+  static std::thread spawn(PublicKey name, Committee committee, Store store,
                     Round gc_depth, uint64_t sync_retry_delay,
                     size_t sync_retry_nodes,
                     ChannelPtr<ConsensusMempoolMessage> rx_message);

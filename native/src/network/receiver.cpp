@@ -31,9 +31,10 @@ bool NetworkReceiver::spawn(const Address& address, MessageHandler handler,
         id = registry->next_id++;
         registry->conns.emplace(id, sp);
       }
-      // Detached; self-removes from the registry on exit so long-running
-      // nodes don't accumulate per-connection state.
-      std::thread([registry, id, sp, handler] {
+      // Joinable: the thread parks its own handle in the graveyard when it
+      // exits (reaped below / in stop()), so long-running nodes don't
+      // accumulate per-connection state yet every thread gets joined.
+      std::thread conn_thread([registry, id, sp, handler] {
         ConnectionWriter writer(sp.get());
         Bytes frame;
         while (sp->read_frame(&frame)) {
@@ -42,7 +43,26 @@ bool NetworkReceiver::spawn(const Address& address, MessageHandler handler,
         }
         std::lock_guard<std::mutex> lk(registry->m);
         registry->conns.erase(id);
-      }).detach();
+        auto it = registry->threads.find(id);
+        if (it != registry->threads.end()) {
+          registry->graveyard.push_back(std::move(it->second));
+          registry->threads.erase(it);
+        }
+      });
+      {
+        std::lock_guard<std::mutex> lk(registry->m);
+        // The thread may have already finished and found no handle to
+        // park; only register it if its connection is still live — else
+        // straight to the graveyard.
+        if (registry->conns.count(id)) {
+          registry->threads.emplace(id, std::move(conn_thread));
+        } else {
+          registry->graveyard.push_back(std::move(conn_thread));
+        }
+        // Reap finished threads (join returns immediately for them).
+        for (auto& t : registry->graveyard) t.join();
+        registry->graveyard.clear();
+      }
     }
   });
   return true;
@@ -53,10 +73,19 @@ void NetworkReceiver::stop() {
   listener_.shutdown();
   if (accept_thread_.joinable()) accept_thread_.join();
   listener_.close();
-  // Shut down live connections; their detached threads hold the socket and
-  // registry shared_ptrs and unregister themselves as they exit.
-  std::lock_guard<std::mutex> lk(registry_->m);
-  for (auto& [_, s] : registry_->conns) s->shutdown();
+  // Shut down live connections and join every connection thread. Callers
+  // must close the channels the handler feeds BEFORE stopping the receiver,
+  // or a handler blocked in a full channel send would stall the join.
+  std::vector<std::thread> to_join;
+  {
+    std::lock_guard<std::mutex> lk(registry_->m);
+    for (auto& [_, s] : registry_->conns) s->shutdown();
+    for (auto& [_, t] : registry_->threads) to_join.push_back(std::move(t));
+    registry_->threads.clear();
+    for (auto& t : registry_->graveyard) to_join.push_back(std::move(t));
+    registry_->graveyard.clear();
+  }
+  for (auto& t : to_join) t.join();
 }
 
 }  // namespace hotstuff

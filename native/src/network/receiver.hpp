@@ -10,6 +10,7 @@
 #include <mutex>
 #include <thread>
 #include <unordered_map>
+#include <vector>
 
 #include "common/bytes.hpp"
 #include "network/socket.hpp"
@@ -55,13 +56,17 @@ class NetworkReceiver {
   void stop();
 
  private:
-  // Live connection sockets, keyed for self-removal when a connection
-  // thread exits. Shared with the detached connection threads so they never
-  // touch the receiver object itself (which may be destroyed first).
+  // Live connection sockets + their (joinable) threads. A connection thread
+  // that finishes moves its own thread handle to the graveyard, which the
+  // accept loop reaps opportunistically and stop() drains; stop() therefore
+  // joins every connection thread ever spawned — no detached thread can
+  // outlive the receiver (the round-1/2 shutdown segfault family).
   struct ConnRegistry {
     std::mutex m;
     uint64_t next_id = 0;
     std::unordered_map<uint64_t, std::shared_ptr<Socket>> conns;
+    std::unordered_map<uint64_t, std::thread> threads;
+    std::vector<std::thread> graveyard;
   };
 
   Listener listener_;

@@ -13,6 +13,7 @@ namespace hotstuff {
 namespace {
 constexpr auto kInitialBackoff = std::chrono::milliseconds(200);
 constexpr auto kMaxBackoff = std::chrono::milliseconds(60'000);
+constexpr int kConnectTimeoutMs = 5000;
 }  // namespace
 
 // One long-lived connection task per peer. The writer loop pulls from the
@@ -32,21 +33,32 @@ struct ReliableSender::Connection {
   explicit Connection(const Address& addr)
       : address(addr), queue(kChannelCapacity) {}
 
-  void start(std::shared_ptr<Connection> self) {
-    std::thread([self] { self->run(); }).detach();
+  void start() {
+    thread = std::thread([this] { run(); });
   }
 
   void run() {
     auto backoff = kInitialBackoff;
     std::deque<Msg> retransmit;
-    while (true) {
+    bool closed = false;
+    while (!closed) {
       // -- connect (with backoff) ----------------------------------------
-      auto sock_opt = Socket::connect(address);
+      auto sock_opt = Socket::connect(address, kConnectTimeoutMs);
       if (!sock_opt) {
         LOG_DEBUG("network::reliable_sender")
             << "failed to connect to " << address.str() << "; retrying in "
             << backoff.count() << " ms";
-        std::this_thread::sleep_for(backoff);
+        // Interruptible backoff: new messages arriving while disconnected
+        // are stashed for the retransmit pass, and a closed queue
+        // (teardown) ends the loop instead of sleeping out the backoff.
+        Msg stash;
+        auto status = queue.recv_until(
+            &stash, std::chrono::steady_clock::now() + backoff);
+        if (status == RecvStatus::kOk) {
+          retransmit.push_back(std::move(stash));
+        } else if (status == RecvStatus::kClosed) {
+          closed = true;
+        }
         backoff = std::min(backoff * 2, kMaxBackoff);
         continue;
       }
@@ -55,6 +67,12 @@ struct ReliableSender::Connection {
           << "Outgoing connection established with " << address.str();
 
       auto sock = std::make_shared<Socket>(std::move(*sock_opt));
+      {
+        // Publish the live socket so ~ReliableSender can shutdown() it and
+        // unblock a writer stuck in write_frame against a wedged peer.
+        std::lock_guard<std::mutex> lk(live_sock_m);
+        live_sock = sock;
+      }
       auto pending = std::make_shared<std::deque<Msg>>();
       auto pending_m = std::make_shared<std::mutex>();
       auto broken = std::make_shared<std::atomic<bool>>(false);
@@ -93,7 +111,10 @@ struct ReliableSender::Connection {
         auto status = queue.recv_until(
             &m, std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(100));
-        if (status == RecvStatus::kClosed) return;
+        if (status == RecvStatus::kClosed) {
+          closed = true;
+          break;
+        }
         if (status == RecvStatus::kTimeout) continue;
         auto data = m.data;
         {
@@ -104,6 +125,10 @@ struct ReliableSender::Connection {
       }
 
       // -- teardown: recover un-ACKed messages ---------------------------
+      {
+        std::lock_guard<std::mutex> lk(live_sock_m);
+        live_sock.reset();
+      }
       sock->shutdown();
       reader.join();
       {
@@ -115,20 +140,45 @@ struct ReliableSender::Connection {
           << "connection to " << address.str() << " dropped; "
           << retransmit.size() << " message(s) to retransmit";
     }
+    // Teardown: cancel every outstanding send by fulfilling its ack with
+    // empty bytes, so QuorumWaiter/Proposer stake-waits can't hang on
+    // messages that will never be delivered.
+    for (auto& m : retransmit) m.ack.set(Bytes{});
+    Msg leftover;
+    while (queue.try_recv(&leftover)) leftover.ack.set(Bytes{});
+  }
+
+  void shutdown_live_socket() {
+    std::lock_guard<std::mutex> lk(live_sock_m);
+    if (live_sock) live_sock->shutdown();
   }
 
   Address address;
   Channel<Msg> queue;
+  std::thread thread;
+  std::mutex live_sock_m;
+  std::shared_ptr<Socket> live_sock;
 };
 
-ReliableSender::ReliableSender() = default;
+ReliableSender::ReliableSender(std::shared_ptr<std::atomic<bool>> stop)
+    : stop_(std::move(stop)) {}
+
+ReliableSender::~ReliableSender() {
+  for (auto& [_, conn] : connections_) conn->queue.close();
+  // A writer blocked inside write_frame (peer TCP-connected but not
+  // reading) cannot observe the closed queue; cut the socket under it.
+  for (auto& [_, conn] : connections_) conn->shutdown_live_socket();
+  for (auto& [_, conn] : connections_) {
+    if (conn->thread.joinable()) conn->thread.join();
+  }
+}
 
 std::shared_ptr<ReliableSender::Connection> ReliableSender::get_or_spawn(
     const Address& address) {
   auto it = connections_.find(address);
   if (it != connections_.end()) return it->second;
   auto conn = std::make_shared<Connection>(address);
-  conn->start(conn);
+  conn->start();
   connections_[address] = conn;
   return conn;
 }
@@ -144,8 +194,18 @@ CancelHandler ReliableSender::send_shared(
   Connection::Msg m;
   m.data = std::move(data);
   CancelHandler handler = m.ack;
-  conn->queue.send(std::move(m));
-  return handler;
+  // Bounded, stop-aware send: a full queue (peer long gone, 1000-message
+  // backlog) must not wedge the calling actor past teardown.
+  while (true) {
+    auto status = conn->queue.send_until(
+        &m, std::chrono::steady_clock::now() +
+                std::chrono::milliseconds(100));
+    if (status == RecvStatus::kOk) return handler;
+    if (status == RecvStatus::kClosed || (stop_ && stop_->load())) {
+      handler.set(Bytes{});  // cancelled — waiters must not hang on this
+      return handler;
+    }
+  }
 }
 
 std::vector<CancelHandler> ReliableSender::broadcast(

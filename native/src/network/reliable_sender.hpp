@@ -5,6 +5,7 @@
 // (network/src/reliable_sender.rs:31-248).
 #pragma once
 
+#include <atomic>
 #include <memory>
 #include <random>
 #include <unordered_map>
@@ -20,7 +21,18 @@ using CancelHandler = Oneshot<Bytes>;
 
 class ReliableSender {
  public:
-  ReliableSender();
+  // `stop` (optional) makes send() interruptible: a send blocked on a full
+  // per-peer queue re-checks it every 100 ms and cancels (empty-ACK) once
+  // set, so an actor mid-send can always reach its own teardown.
+  explicit ReliableSender(
+      std::shared_ptr<std::atomic<bool>> stop = nullptr);
+  // Closes every per-peer queue and joins the connection threads; any
+  // outstanding CancelHandler is fulfilled with empty bytes so quorum
+  // waiters can never block on an ACK that will not come (the reference
+  // gets the same from dropped oneshot senders, reliable_sender.rs:25).
+  ~ReliableSender();
+  ReliableSender(const ReliableSender&) = delete;
+  ReliableSender& operator=(const ReliableSender&) = delete;
 
   CancelHandler send(const Address& address, Bytes data);
   CancelHandler send_shared(const Address& address,
@@ -34,6 +46,7 @@ class ReliableSender {
 
   std::unordered_map<Address, std::shared_ptr<Connection>, AddressHash>
       connections_;
+  std::shared_ptr<std::atomic<bool>> stop_;
 };
 
 }  // namespace hotstuff

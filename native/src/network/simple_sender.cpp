@@ -8,6 +8,12 @@
 
 namespace hotstuff {
 
+namespace {
+// Bound the connect syscall so a vanished peer can't pin a connection
+// thread (and its joiner) for the kernel's multi-minute TCP timeout.
+constexpr int kConnectTimeoutMs = 5000;
+}  // namespace
+
 // A connection drains its queue into one socket. On any socket error the
 // connection marks itself dead and drops remaining queued messages; the
 // next send() to that address spawns a fresh connection (reference
@@ -16,35 +22,38 @@ struct SimpleSender::Connection {
   explicit Connection(const Address& addr)
       : address(addr), queue(kChannelCapacity) {}
 
+  ~Connection() { stop_and_join(); }
+
   void start() {
-    auto self = shared;
-    writer_thread = std::thread([self] { self->run(); });
-    writer_thread.detach();
+    writer_thread = std::thread([this] { run(); });
   }
 
   void run() {
-    auto sock_opt = Socket::connect(address);
+    auto sock_opt = Socket::connect(address, kConnectTimeoutMs);
     if (!sock_opt) {
       LOG_WARN("network::simple_sender")
           << "failed to connect to " << address.str();
       dead.store(true);
       queue.close();
-      shared.reset();
       return;
     }
-    sock = std::move(*sock_opt);
+    {
+      // Serialize the fd hand-off against a concurrent stop_and_join()
+      // shutdown (the owner may reap this connection while we connect).
+      std::lock_guard<std::mutex> lk(sock_m);
+      sock = std::move(*sock_opt);
+    }
     LOG_DEBUG("network::simple_sender")
         << "Outgoing connection established with " << address.str();
 
     // Sink replies so the peer's ACK writes never fill the TCP buffer.
-    auto self = shared;
-    std::thread([self] {
+    reader_thread = std::thread([this] {
       Bytes frame;
-      while (self->sock.read_frame(&frame)) {
+      while (sock.read_frame(&frame)) {
       }
-      self->dead.store(true);
-      self->queue.close();  // wake the writer
-    }).detach();
+      dead.store(true);
+      queue.close();  // wake the writer
+    });
 
     while (auto data = queue.recv()) {
       if (dead.load() || !sock.write_frame(*data)) {
@@ -55,19 +64,36 @@ struct SimpleSender::Connection {
     }
     dead.store(true);
     queue.close();
-    sock.shutdown();
-    shared.reset();  // break the self-cycle so dead connections free
+    std::lock_guard<std::mutex> lk(sock_m);
+    sock.shutdown();  // wake the reader
+  }
+
+  // Idempotent; joining the writer first guarantees reader_thread is fully
+  // constructed (the writer creates it) before we join it.
+  void stop_and_join() {
+    queue.close();
+    {
+      std::lock_guard<std::mutex> lk(sock_m);
+      sock.shutdown();
+    }
+    if (writer_thread.joinable()) writer_thread.join();
+    if (reader_thread.joinable()) reader_thread.join();
   }
 
   Address address;
   Channel<Bytes> queue;
+  std::mutex sock_m;  // guards fd hand-off/shutdown, not steady-state IO
   Socket sock;
   std::atomic<bool> dead{false};
   std::thread writer_thread;
-  std::shared_ptr<Connection> shared;  // set by get_or_spawn before start()
+  std::thread reader_thread;
 };
 
 SimpleSender::SimpleSender() : rng_(std::random_device{}()) {}
+
+SimpleSender::~SimpleSender() {
+  for (auto& [_, conn] : connections_) conn->stop_and_join();
+}
 
 std::shared_ptr<SimpleSender::Connection> SimpleSender::get_or_spawn(
     const Address& address) {
@@ -75,10 +101,10 @@ std::shared_ptr<SimpleSender::Connection> SimpleSender::get_or_spawn(
   if (it != connections_.end() && !it->second->dead.load()) {
     return it->second;
   }
+  if (it != connections_.end()) it->second->stop_and_join();
   auto conn = std::make_shared<Connection>(address);
-  conn->shared = conn;
   conn->start();
-  connections_[address] = conn;
+  connections_[address] = conn;  // old entry (if any) joined above
   return conn;
 }
 

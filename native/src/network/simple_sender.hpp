@@ -2,6 +2,9 @@
 // incoming frames (ACKs) sunk by a reader thread; failed peers drop queued
 // messages and reconnect lazily on the next send — matching the reference's
 // SimpleSender/Connection semantics (network/src/simple_sender.rs:22-143).
+// All connection threads are joinable: the destructor closes every queue,
+// shuts the sockets, and joins, so a SimpleSender never leaks a thread past
+// its owner (tokio gives the reference this for free on runtime drop).
 #pragma once
 
 #include <memory>
@@ -18,6 +21,9 @@ namespace hotstuff {
 class SimpleSender {
  public:
   SimpleSender();
+  ~SimpleSender();
+  SimpleSender(const SimpleSender&) = delete;
+  SimpleSender& operator=(const SimpleSender&) = delete;
 
   void send(const Address& address, Bytes data);
   void broadcast(const std::vector<Address>& addresses, const Bytes& data);

@@ -3,6 +3,8 @@
 //   node keys --filename FILE
 //   node run --keys FILE --committee FILE --store PATH [--parameters FILE] [-v...]
 //   node deploy NODES  (local in-process testbed on ports 25000+)
+#include <chrono>
+#include <csignal>
 #include <cstring>
 #include <iostream>
 #include <string>
@@ -16,6 +18,28 @@
 using namespace hotstuff;
 
 namespace {
+
+volatile std::sig_atomic_t g_shutdown = 0;
+
+void on_signal(int) { g_shutdown = 1; }
+
+void install_signal_handlers() {
+  std::signal(SIGTERM, on_signal);
+  std::signal(SIGINT, on_signal);
+}
+
+// Drain the commit channel until the node's channels close or a signal
+// arrives (polling the async-signal-safe flag every 200 ms).
+void drain_commits(node::Node& node) {
+  auto ch = node.commit_channel();
+  while (!g_shutdown) {
+    consensus::Block block;
+    auto status = ch->recv_until(&block,
+                                 std::chrono::steady_clock::now() +
+                                     std::chrono::milliseconds(200));
+    if (status == RecvStatus::kClosed) return;
+  }
+}
 
 struct Args {
   std::vector<std::string> positional;
@@ -68,9 +92,13 @@ int cmd_run(const Args& args) {
                  "[--parameters FILE]\n";
     return 2;
   }
+  install_signal_handlers();
   auto node = node::Node::create(args.committee, args.keys, args.store,
                                  args.parameters);
-  node->analyze_block();
+  drain_commits(*node);
+  LOG_INFO("node::main") << "shutting down";
+  node->stop();
+  LOG_INFO("node::main") << "shutdown complete";
   return 0;
 }
 
@@ -113,10 +141,15 @@ int cmd_deploy(const Args& args) {
     instances.push_back(node::Node::create(".committee.json", key_file,
                                            store_path, ""));
   }
+  install_signal_handlers();
   std::vector<std::thread> sinks;
   for (auto& n : instances) {
     sinks.emplace_back([&n] { n->analyze_block(); });
   }
+  while (!g_shutdown) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+  for (auto& n : instances) n->stop();
   for (auto& t : sinks) t.join();
   return 0;
 }

@@ -55,5 +55,15 @@ void Node::analyze_block() {
   }
 }
 
+void Node::stop() {
+  // Consensus first (it closes tx_commit and stops proposing), then the
+  // mempool; the store and signature service wind down with their last
+  // handles. The reference gets the equivalent ordering from tokio runtime
+  // drop; here it is explicit so `node` exits cleanly on SIGTERM and the
+  // in-process e2e test tears down without leaking threads.
+  if (consensus_) consensus_->stop();
+  if (mempool_) mempool_->stop();
+}
+
 }  // namespace node
 }  // namespace hotstuff

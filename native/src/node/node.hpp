@@ -21,8 +21,13 @@ class Node {
                                       const std::string& store_path,
                                       const std::string& parameters_file);
 
-  // Drains the commit channel (node.rs:76-81). Blocks forever.
+  // Drains the commit channel (node.rs:76-81). Returns once stop() closes
+  // the channel.
   void analyze_block();
+
+  // Orderly shutdown: stops consensus then mempool (joining every actor
+  // thread), which also closes the commit channel. Idempotent.
+  void stop();
 
   ChannelPtr<consensus::Block> commit_channel() { return commit_; }
   const PublicKey& name() const { return name_; }

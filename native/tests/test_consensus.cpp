@@ -78,6 +78,7 @@ struct CoreFixture {
   ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool =
       make_channel<mempool::ConsensusMempoolMessage>();
   Store store = Store::open("");
+  std::thread core_thread;
 
   // Spawns a core for fixture key `idx` with the given committee.
   void spawn_core(size_t idx, const Committee& committee,
@@ -89,9 +90,17 @@ struct CoreFixture {
         std::make_shared<MempoolDriver>(store, tx_mempool, tx_core);
     auto synchronizer = std::make_shared<Synchronizer>(
         kp.name, committee, store, tx_core, /*sync_retry_delay=*/60'000);
-    Core::spawn(kp.name, committee, service, store, leader_elector,
-                mempool_driver, synchronizer, timeout_delay, tx_core,
-                tx_proposer, tx_commit);
+    core_thread = Core::spawn(kp.name, committee, service, store,
+                              leader_elector, mempool_driver, synchronizer,
+                              timeout_delay, tx_core, tx_proposer, tx_commit);
+  }
+
+  ~CoreFixture() {
+    tx_core->close();
+    tx_proposer->close();
+    tx_commit->close();
+    tx_mempool->close();
+    if (core_thread.joinable()) core_thread.join();
   }
 };
 

@@ -43,8 +43,10 @@ TEST(batch_maker_seals_by_size) {
 
   auto rx_tx = make_channel<Transaction>();
   auto tx_msg = make_channel<QuorumWaiterMessage>();
-  BatchMaker::spawn(/*batch_size=*/100, /*max_batch_delay=*/60'000, rx_tx,
-                    tx_msg, committee.broadcast_addresses(myself));
+  auto actor = BatchMaker::spawn(
+      /*batch_size=*/100, /*max_batch_delay=*/60'000, rx_tx, tx_msg,
+      committee.broadcast_addresses(myself),
+      std::make_shared<std::atomic<bool>>(false));
   Transaction tx(60, 5);  // 60 bytes: two txs cross the 100-byte seal point
   rx_tx->send(tx);
   rx_tx->send(tx);
@@ -56,6 +58,9 @@ TEST(batch_maker_seals_by_size) {
   CHECK(m.batch[0] == tx);
   CHECK(msg->handlers.size() == 3);
   for (auto& t : threads) t.join();
+  rx_tx->close();
+  tx_msg->close();
+  actor.join();
 }
 
 TEST(batch_maker_seals_by_timeout) {
@@ -66,14 +71,19 @@ TEST(batch_maker_seals_by_timeout) {
 
   auto rx_tx = make_channel<Transaction>();
   auto tx_msg = make_channel<QuorumWaiterMessage>();
-  BatchMaker::spawn(/*batch_size=*/1'000'000, /*max_batch_delay=*/50, rx_tx,
-                    tx_msg, committee.broadcast_addresses(myself));
+  auto actor = BatchMaker::spawn(
+      /*batch_size=*/1'000'000, /*max_batch_delay=*/50, rx_tx, tx_msg,
+      committee.broadcast_addresses(myself),
+      std::make_shared<std::atomic<bool>>(false));
   rx_tx->send(Transaction(10, 1));
   auto msg = tx_msg->recv();
   CHECK(msg.has_value());
   auto m = MempoolMessage::deserialize(msg->batch);
   CHECK(m.batch.size() == 1);
   for (auto& t : threads) t.join();
+  rx_tx->close();
+  tx_msg->close();
+  actor.join();
 }
 
 TEST(quorum_waiter_waits_for_stake) {
@@ -81,7 +91,9 @@ TEST(quorum_waiter_waits_for_stake) {
   auto myself = keys()[0].name;
   auto rx_msg = make_channel<QuorumWaiterMessage>();
   auto tx_batch = make_channel<Bytes>();
-  QuorumWaiter::spawn(committee, committee.stake(myself), rx_msg, tx_batch);
+  auto stop = std::make_shared<std::atomic<bool>>(false);
+  auto actor = QuorumWaiter::spawn(committee, committee.stake(myself), rx_msg,
+                                   tx_batch, stop);
 
   QuorumWaiterMessage msg;
   msg.batch = Bytes{1, 2, 3};
@@ -103,13 +115,16 @@ TEST(quorum_waiter_waits_for_stake) {
   auto got = tx_batch->recv();
   CHECK(got.has_value());
   CHECK(*got == (Bytes{1, 2, 3}));
+  rx_msg->close();
+  tx_batch->close();
+  actor.join();
 }
 
 TEST(processor_hashes_and_stores) {
   Store store = Store::open("");
   auto rx_batch = make_channel<Bytes>();
   auto tx_digest = make_channel<Digest>();
-  Processor::spawn(store, rx_batch, tx_digest);
+  auto actor = Processor::spawn(store, rx_batch, tx_digest);
   Bytes batch{7, 7, 7, 7};
   rx_batch->send(batch);
   auto digest = tx_digest->recv();
@@ -118,6 +133,9 @@ TEST(processor_hashes_and_stores) {
   auto stored = store.read(digest->to_bytes());
   CHECK(stored.has_value());
   CHECK(*stored == batch);
+  rx_batch->close();
+  tx_digest->close();
+  actor.join();
 }
 
 TEST(synchronizer_sends_batch_request) {
@@ -132,9 +150,10 @@ TEST(synchronizer_sends_batch_request) {
 
   Store store = Store::open("");
   auto rx_msg = make_channel<ConsensusMempoolMessage>();
-  Synchronizer::spawn(myself, committee, store, /*gc_depth=*/50,
-                      /*sync_retry_delay=*/60'000, /*sync_retry_nodes=*/3,
-                      rx_msg);
+  auto actor = Synchronizer::spawn(myself, committee, store,
+                                   /*gc_depth=*/50,
+                                   /*sync_retry_delay=*/60'000,
+                                   /*sync_retry_nodes=*/3, rx_msg);
   ConsensusMempoolMessage msg;
   msg.kind = ConsensusMempoolMessage::Kind::kSynchronize;
   msg.digests = {sha512_digest(Bytes{1})};
@@ -148,6 +167,8 @@ TEST(synchronizer_sends_batch_request) {
   CHECK(m.missing.size() == 1);
   CHECK(m.origin == myself);
   t.join();
+  rx_msg->close();
+  actor.join();
 }
 
 TEST(helper_serves_batches) {
@@ -166,13 +187,15 @@ TEST(helper_serves_batches) {
   store.write(digest.to_bytes(), batch);
 
   auto rx_req = make_channel<std::pair<std::vector<Digest>, PublicKey>>();
-  Helper::spawn(committee, store, rx_req);
+  auto actor = Helper::spawn(committee, store, rx_req);
   rx_req->send({{digest}, requestor});
 
   auto got = delivered->recv();
   CHECK(got.has_value());
   CHECK(*got == batch);
   t.join();
+  rx_req->close();
+  actor.join();
 }
 
 TEST(mempool_pipeline_end_to_end) {

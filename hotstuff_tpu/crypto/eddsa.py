@@ -9,7 +9,8 @@ which is what quorum-certificate verification wants
 Host responsibilities (cheap, byte-oriented): SHA-512 challenge hashing,
 encoding canonicality checks (y < p, S < L), limb/bit unpacking into dense
 arrays.  Device responsibilities (the FLOPs): point decompression, the
-256-step double-scalar ladder, batched across the whole quorum.
+fixed-base comb + windowed variable-base ladder (ops/ed25519.py), batched
+across the whole quorum.
 
 Batch shapes are padded to power-of-two buckets so XLA compiles a handful of
 program shapes, then results are sliced back.
@@ -85,14 +86,18 @@ def prepare_batch(msgs, pks, sigs):
     s_bytes = np.ascontiguousarray(sig_arr[:, 32:])
     host_ok = (len_ok & ~_ge_p(ay_b) & ~_ge_p(ry_b) & _lt_L(s_bytes))
 
-    # challenge scalars k = SHA512(R||A||M) mod L (host hashing, C-speed)
-    k_bytes = np.zeros((n, 32), np.uint8)
+    # challenge scalars k = SHA512(R||A||M) mod L (host hashing, C-speed).
+    # One contiguous bytearray + a single frombuffer at the end: per-row
+    # numpy assignments dominated this loop before (~2 us/sig of pure
+    # overhead at N=1024).
+    k_buf = bytearray(32 * n)
     sig_rows, pk_rows = sig_arr.tobytes(), pk_arr.tobytes()
     for i in np.nonzero(host_ok)[0]:
         h = hashlib.sha512(sig_rows[64 * i:64 * i + 32]
                            + pk_rows[32 * i:32 * i + 32] + msgs[i]).digest()
         k = int.from_bytes(h, "little") % L
-        k_bytes[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+        k_buf[32 * i:32 * i + 32] = k.to_bytes(32, "little")
+    k_bytes = np.frombuffer(bytes(k_buf), np.uint8).reshape(n, 32)
 
     # One allocation; a/r/s/k are views into it (the sharded path slices,
     # the single-device path ships the whole row).

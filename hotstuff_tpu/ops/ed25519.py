@@ -4,20 +4,23 @@ The device-side half of the framework's equivalent of the reference's
 ``Signature::verify`` / ``Signature::verify_batch``
 (reference: crypto/src/lib.rs:177-224).  Scalars, hashing (SHA-512) and
 encoding checks live on the host (see hotstuff_tpu/crypto/eddsa.py); the
-device receives pre-parsed limb arrays + the 2-bit digit schedule of the
-double-scalar multiplication and returns a per-signature validity mask —
-the mask shape is what quorum-certificate verification consumes
-(consensus/src/messages.rs:180-198 in the reference).
+device receives raw scalar/point bytes and returns a per-signature
+validity mask — the mask shape is what quorum-certificate verification
+consumes (consensus/src/messages.rs:180-198 in the reference).
+
+The check [S]B - [k]A == R splits into a fixed-base comb for [S]B (32
+adds against a host-precomputed affine table, zero doublings) plus a
+4-bit windowed variable-base ladder for [k](-A) (64 scan steps of four
+doublings and one add against an on-device 16-entry table). See
+scripts/PROFILE.md for the measurements behind this shape.
 
 TPU-first design notes:
 * Points are dense ``(..., 4, 32)`` int32 arrays (X, Y, Z, T) in extended
   twisted-Edwards coordinates — a pytree-free layout that vmaps/shards
   cleanly along the batch axis.
 * All control flow is static: complete addition formulas (no exceptional
-  cases), `lax.scan` over a fixed 256-entry digit schedule, constant-time
-  table selection via `take_along_axis` (gather on device).
-* The per-signature lookup table {O, B, -A, B-A} is built on device; B is a
-  compile-time constant.
+  cases), `lax.scan` over fixed digit schedules, table selection via
+  `take_along_axis` (gather on device).
 """
 
 from __future__ import annotations
@@ -76,7 +79,11 @@ def point_add(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
 
     add-2008-hwcd-3 for a=-1 (the ref10 ge_add shape) — complete on the
     twisted Edwards curve, so it needs no doubling/identity branches: ideal
-    for SIMD/scan execution on TPU.
+    for SIMD/scan execution on TPU.  Measured note: keeping the 7 muls as
+    separate 1024-group convs beats stacking them into one 4096-group conv
+    (40.6 ms vs 23.0 ms for the full ladder on a v5e) — the depthwise conv
+    is compute-bound on the VPU and large group counts lower its
+    efficiency, so fewer-but-fatter launches LOSE here.
     """
     x1, y1, z1, t1 = _unpack(p)
     ypx2, ymx2, z2, t2d2 = _unpack(qc)
@@ -92,8 +99,13 @@ def point_add(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
     return _pack(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 
-def point_dbl(p: jnp.ndarray) -> jnp.ndarray:
-    """Dedicated doubling (dbl-2008-hwcd, a=-1): 4M + 4S."""
+def point_dbl(p: jnp.ndarray, with_t: bool = True) -> jnp.ndarray:
+    """Dedicated doubling (dbl-2008-hwcd, a=-1): 4M + 4S.
+
+    with_t=False skips the T-output multiply (3M + 4S): legal whenever the
+    next consumer is another doubling, which only reads X, Y, Z. Static
+    python bool, so each variant compiles to its own fixed program.
+    """
     x1, y1, z1, _ = _unpack(p)
     a = F.sqr(x1)
     b = F.sqr(y1)
@@ -103,7 +115,8 @@ def point_dbl(p: jnp.ndarray) -> jnp.ndarray:
     g = F.sub(b, a)                                 # B - A   (= D + B, D = -A)
     f = F.sub(g, c)
     h = F.neg(F.add(a, b))                          # -(A+B)  (= D - B)
-    return _pack(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    t_out = F.mul(e, h) if with_t else jnp.zeros_like(x1)
+    return _pack(F.mul(e, f), F.mul(g, h), F.mul(f, g), t_out)
 
 
 # ---------------------------------------------------------------------------
@@ -142,31 +155,91 @@ def decompress(y_limbs: jnp.ndarray, sign_bit: jnp.ndarray):
 
 
 # ---------------------------------------------------------------------------
+# Fixed-base comb table for S*B (host-precomputed, device constant)
+# ---------------------------------------------------------------------------
+
+_COMB_W = 8          # one comb position per S byte
+_COMB_POSITIONS = 32
+
+_comb_cache: np.ndarray | None = None
+
+
+def _host_pt_add(p, q):
+    """Extended-coordinate add on python ints (table generation only)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * D % P
+    dd = 2 * z1 * z2 % P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def comb_table() -> np.ndarray:
+    """(32, 256, 4, 32) int32: COMB[j][d] = cached affine form of d*(256^j)*B.
+
+    S*B = sum_j COMB[j][S_byte_j] — 31 additions and ZERO doublings for the
+    whole fixed-base half of the verification equation (the little-endian S
+    bytes are directly the comb digits). Built lazily on host (~8k python
+    point adds + one batched inversion), then baked into the jitted program
+    as a constant (~4 MB).
+    """
+    global _comb_cache
+    if _comb_cache is not None:
+        return _comb_cache
+    base = (BX, BY, 1, BX * BY % P)
+    entries = []  # flat ext points, position-major
+    for _ in range(_COMB_POSITIONS):
+        acc = (0, 1, 1, 0)
+        for _ in range(256):
+            entries.append(acc)
+            acc = _host_pt_add(acc, base)
+        base = acc  # 256^{j+1} * B = 256 * (256^j * B); acc ran to 256*base
+    # Batch affine normalization: one modular inverse total (Montgomery).
+    zs = [e[2] for e in entries]
+    prefix = [1]
+    for z in zs:
+        prefix.append(prefix[-1] * z % P)
+    inv_all = pow(prefix[-1], P - 2, P)
+    invs = [0] * len(zs)
+    for i in range(len(zs) - 1, -1, -1):
+        invs[i] = prefix[i] * inv_all % P
+        inv_all = inv_all * zs[i] % P
+    out = np.zeros((_COMB_POSITIONS, 256, 4, F.NLIMBS), np.int32)
+    for idx, ((x, y, _, _), zi) in enumerate(zip(entries, invs)):
+        xa, ya = x * zi % P, y * zi % P
+        j, d = divmod(idx, 256)
+        out[j, d, 0] = F.to_limbs((ya + xa) % P)
+        out[j, d, 1] = F.to_limbs((ya - xa) % P)
+        out[j, d, 2] = F.to_limbs(1)
+        out[j, d, 3] = F.to_limbs(K2D * xa * ya % P)
+    _comb_cache = out
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Batched verification
 # ---------------------------------------------------------------------------
 
 def _digit_select(table: jnp.ndarray, digit: jnp.ndarray) -> jnp.ndarray:
-    """table (..., 4tab, 4coord, 32), digit (...,) in [0,4) -> (..., 4, 32)."""
+    """table (..., Ktab, 4coord, 32), digit (...,) in [0,K) -> (..., 4, 32)."""
     idx = digit[..., None, None, None].astype(jnp.int32)
     return jnp.take_along_axis(table, idx, axis=-3)[..., 0, :, :]
 
 
-def unpack_digits(s_bytes: jnp.ndarray, k_bytes: jnp.ndarray) -> jnp.ndarray:
-    """(B, 32) uint8 little-endian S and k scalars -> (B, 256) int32
-    MSB-first 2-bit joint digits bit_i(S) + 2*bit_i(k).
 
-    Runs on device: the host ships 64 bytes per signature instead of a
-    1 KB digit schedule — on a tunneled TPU the host->device transfer is
-    the bottleneck, not the ladder itself.
+
+def unpack_nibbles_msb(k_bytes: jnp.ndarray) -> jnp.ndarray:
+    """(B, 32) uint8 little-endian scalar -> (B, 64) int32 MSB-first 4-bit
+    digits, the schedule of the windowed variable-base ladder.
+
+    Runs on device: the host ships raw scalar bytes; digit expansion is
+    free next to the curve arithmetic.
     """
-    shifts = jnp.arange(8, dtype=jnp.int32)
-    def bits_le(b):
-        # (B, 32) -> (B, 256) little-endian bit order
-        x = (b.astype(jnp.int32)[..., None] >> shifts) & 1
-        return x.reshape(*b.shape[:-1], 256)
-    s_bits = bits_le(s_bytes)
-    k_bits = bits_le(k_bytes)
-    return (s_bits + 2 * k_bits)[..., ::-1]  # MSB-first schedule
+    b = k_bytes.astype(jnp.int32)[..., ::-1]  # big-endian byte order
+    hi, lo = b >> 4, b & 0xF
+    return jnp.stack([hi, lo], axis=-1).reshape(*b.shape[:-1], 64)
 
 
 def split_y_sign(y_bytes: jnp.ndarray):
@@ -185,15 +258,16 @@ def verify_compact(a_bytes: jnp.ndarray, r_bytes: jnp.ndarray,
     Args (all (B, 32) uint8): compressed pubkey A, compressed R, scalar S
     (little-endian), and the host-hashed challenge k = SHA512(R||A||M) mod L.
     130 bytes/signature cross the host->device boundary; limb conversion,
-    sign extraction and the 512-entry bit unpack all happen on device.
+    sign extraction and digit expansion all happen on device.
 
     Returns (B,) bool validity mask (host-side canonicality checks are
     ANDed by the caller, crypto/eddsa.verify_batch).
     """
     ay, a_sign = split_y_sign(a_bytes)
     ry, r_sign = split_y_sign(r_bytes)
-    digits = unpack_digits(s_bytes, k_bytes)
-    return verify_prepared(ay, a_sign, ry, r_sign, digits)
+    s_digits = s_bytes.astype(jnp.int32)  # little-endian bytes = comb digits
+    k_digits = unpack_nibbles_msb(k_bytes)
+    return verify_prepared(ay, a_sign, ry, r_sign, s_digits, k_digits)
 
 
 verify_compact_jit = jax.jit(verify_compact)
@@ -213,46 +287,79 @@ verify_packed_jit = jax.jit(verify_packed)
 
 def verify_prepared(ay: jnp.ndarray, a_sign: jnp.ndarray,
                     ry: jnp.ndarray, r_sign: jnp.ndarray,
-                    digits: jnp.ndarray) -> jnp.ndarray:
+                    s_digits: jnp.ndarray,
+                    k_digits: jnp.ndarray) -> jnp.ndarray:
     """Device-side Ed25519 verification over a batch.
 
-    Checks [S]B - [k]A == R via one joint double-scalar ladder.
+    Checks [S]B - [k]A == R, split into:
+      * [S]B via a fixed-base comb (32 adds against a host-precomputed
+        affine table, zero doublings), and
+      * [k](-A) via a 4-bit windowed variable-base ladder (64 steps of
+        4 doublings + 1 table add against an on-device 16-entry table),
+    then one combining add and a projective compare against R. This is
+    ~3,350 conv launches vs ~4,900 for the old joint 1-bit ladder — the
+    program is conv-throughput-bound (scripts/PROFILE.md).
 
     Args:
       ay, ry:   (B, 32) int32 canonical y limbs of pubkey / R point.
       a_sign, r_sign: (B,) int32 x-parity bits.
-      digits:   (B, 256) int32 in [0,4): MSB-first 2-bit schedule
-                bit_i(S) + 2*bit_i(k), k = SHA512(R||A||M) mod L (host-hashed).
+      s_digits: (B, 32) int32 little-endian base-256 digits of S (= bytes).
+      k_digits: (B, 64) int32 MSB-first base-16 digits of
+                k = SHA512(R||A||M) mod L (host-hashed).
     Returns:
       (B,) bool validity mask (encoding checks done host-side are ANDed by
       the caller).
     """
     batch_shape = ay.shape[:-1]
+    # Two separate decompressions, NOT one stacked (2, B) call: measured on
+    # a v5e, convs with >1024 groups slow disproportionately (the stacked
+    # variant cost +8.6 ms end-to-end) and N=2048-group programs can take
+    # minutes to compile. Keep every conv at <= batch groups.
     a_pt, ok_a = decompress(ay, a_sign)
     r_pt, ok_r = decompress(ry, r_sign)
 
-    neg_a = cached_neg(to_cached(a_pt))
-    b_ext = jnp.broadcast_to(basepoint_ext(), (*batch_shape, 4, F.NLIMBS))
-    b_cached = to_cached(b_ext)
-    b_minus_a = to_cached(point_add(b_ext, neg_a))
-    id_cached = to_cached(identity_ext(batch_shape))
-    # table index = bit(S) + 2*bit(k): [O, B, -A, B-A]
-    table = jnp.stack([id_cached, b_cached, neg_a, b_minus_a], axis=-3)
+    # -- variable-base half: [k](-A), 4-bit windows ------------------------
+    ax, ay_l, az, at = _unpack(a_pt)
+    neg_a_ext = _pack(F.neg(ax), ay_l, az, F.neg(at))
+    neg_a_cached = to_cached(neg_a_ext)
+    # 16-entry table of d*(-A), d = 0..15, in cached form.
+    entries = [identity_ext(batch_shape), neg_a_ext]
+    for _ in range(2, 16):
+        entries.append(point_add(entries[-1], neg_a_cached))
+    table = jnp.stack([to_cached(e) for e in entries], axis=-3)
 
-    def body(p, digit_row):
-        p = point_dbl(p)
+    def ladder_body(p, digit_row):
+        p = point_dbl(p, with_t=False)
+        p = point_dbl(p, with_t=False)
+        p = point_dbl(p, with_t=False)
+        p = point_dbl(p)  # the add below reads T
         p = point_add(p, _digit_select(table, digit_row))
         return p, None
 
-    p0 = identity_ext(batch_shape)
-    # scan over the 256 digit positions (leading axis), batch stays vectorized
-    digits_t = jnp.moveaxis(digits, -1, 0)
-    p_final, _ = jax.lax.scan(body, p0, digits_t)
+    ka_pt, _ = jax.lax.scan(ladder_body, identity_ext(batch_shape),
+                            jnp.moveaxis(k_digits, -1, 0))
 
-    x3, y3, z3, _ = _unpack(p_final)
+    # -- fixed-base half: [S]B via the comb --------------------------------
+    comb = jnp.asarray(comb_table())  # (32, 256, 4, 32) constant
+
+    def comb_body(acc, xs):
+        comb_j, digit_row = xs
+        entry = jnp.take(comb_j, digit_row, axis=0)  # (B, 4, 32)
+        return point_add(acc, entry), None
+
+    sb_pt, _ = jax.lax.scan(
+        comb_body, identity_ext(batch_shape),
+        (comb, jnp.moveaxis(s_digits, -1, 0)))
+
+    # -- combine and compare ----------------------------------------------
+    lhs = point_add(sb_pt, to_cached(ka_pt))  # [S]B - [k]A
+    x3, y3, z3, _ = _unpack(lhs)
     rx, ry_, rz, _ = _unpack(r_pt)
-    ok_eq = F.eq(F.mul(x3, rz), F.mul(rx, z3)) & \
-            F.eq(F.mul(y3, rz), F.mul(ry_, z3))
+    # Projective equality, all four cross-products in one conv.
+    cross = F.canonical(F.mul(_pack(x3, rx, y3, ry_),
+                              _pack(rz, z3, rz, z3)))
+    ok_eq = jnp.all(cross[..., 0, :] == cross[..., 1, :], axis=-1) & \
+            jnp.all(cross[..., 2, :] == cross[..., 3, :], axis=-1)
     return ok_a & ok_r & ok_eq
 
 

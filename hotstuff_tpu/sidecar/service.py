@@ -28,8 +28,11 @@ from . import protocol as proto
 log = logging.getLogger("sidecar")
 
 # One coalesced device launch covers at most this many signatures; requests
-# beyond it wait for the next launch (keeps compile-shape buckets small).
-MAX_COALESCED = 4096
+# beyond it wait for the next launch. 1024 is a hard sweet spot measured on
+# v5e: the verify program's grouped convolutions degrade sharply past 1024
+# groups (an N=2048 batch shape took minutes to compile and ran worse), so
+# bigger launches would wedge the engine, not speed it up.
+MAX_COALESCED = 1024
 
 
 class _Pending:

@@ -1,0 +1,20 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/native
+# Build directory: /root/repo/native/build-review
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test([=[serde]=] "/root/repo/native/build-review/test_serde")
+set_tests_properties([=[serde]=] PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;71;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[crypto]=] "/root/repo/native/build-review/test_crypto")
+set_tests_properties([=[crypto]=] PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;71;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[store]=] "/root/repo/native/build-review/test_store")
+set_tests_properties([=[store]=] PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;71;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[network]=] "/root/repo/native/build-review/test_network")
+set_tests_properties([=[network]=] PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;71;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[mempool]=] "/root/repo/native/build-review/test_mempool")
+set_tests_properties([=[mempool]=] PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;71;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[consensus]=] "/root/repo/native/build-review/test_consensus")
+set_tests_properties([=[consensus]=] PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;71;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test([=[e2e]=] "/root/repo/native/build-review/test_e2e")
+set_tests_properties([=[e2e]=] PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;71;add_test;/root/repo/native/CMakeLists.txt;0;")

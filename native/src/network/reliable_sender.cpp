@@ -73,6 +73,15 @@ struct ReliableSender::Connection {
         std::lock_guard<std::mutex> lk(live_sock_m);
         live_sock = sock;
       }
+      // Close the teardown/connect race: if ~ReliableSender ran its
+      // shutdown pass while we were inside connect() (live_sock was null,
+      // nothing to cut), we must not start writing on a socket nobody can
+      // shut down. stopping is set before that pass, so checking it after
+      // publishing covers both interleavings.
+      if (stopping.load()) {
+        sock->shutdown();
+        break;
+      }
       auto pending = std::make_shared<std::deque<Msg>>();
       auto pending_m = std::make_shared<std::mutex>();
       auto broken = std::make_shared<std::atomic<bool>>(false);
@@ -156,6 +165,7 @@ struct ReliableSender::Connection {
   Address address;
   Channel<Msg> queue;
   std::thread thread;
+  std::atomic<bool> stopping{false};
   std::mutex live_sock_m;
   std::shared_ptr<Socket> live_sock;
 };
@@ -164,7 +174,10 @@ ReliableSender::ReliableSender(std::shared_ptr<std::atomic<bool>> stop)
     : stop_(std::move(stop)) {}
 
 ReliableSender::~ReliableSender() {
-  for (auto& [_, conn] : connections_) conn->queue.close();
+  for (auto& [_, conn] : connections_) {
+    conn->stopping.store(true);
+    conn->queue.close();
+  }
   // A writer blocked inside write_frame (peer TCP-connected but not
   // reading) cannot observe the closed queue; cut the socket under it.
   for (auto& [_, conn] : connections_) conn->shutdown_live_socket();

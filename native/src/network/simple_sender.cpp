@@ -43,6 +43,16 @@ struct SimpleSender::Connection {
       std::lock_guard<std::mutex> lk(sock_m);
       sock = std::move(*sock_opt);
     }
+    // Close the teardown/connect race: stop_and_join()'s shutdown may have
+    // hit the pre-connect placeholder fd while we were inside connect().
+    // dead is set before that shutdown, so checking it after the hand-off
+    // covers both interleavings — without this, the writer would drain
+    // already-queued frames into a socket nobody can cut.
+    if (dead.load()) {
+      std::lock_guard<std::mutex> lk(sock_m);
+      sock.shutdown();
+      return;
+    }
     LOG_DEBUG("network::simple_sender")
         << "Outgoing connection established with " << address.str();
 
@@ -71,6 +81,7 @@ struct SimpleSender::Connection {
   // Idempotent; joining the writer first guarantees reader_thread is fully
   // constructed (the writer creates it) before we join it.
   void stop_and_join() {
+    dead.store(true);  // before the shutdown: see the post-connect check
     queue.close();
     {
       std::lock_guard<std::mutex> lk(sock_m);

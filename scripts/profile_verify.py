@@ -20,13 +20,16 @@ import numpy as np
 
 
 def timeit(fn, *args, reps=10, warmup=2):
+    # Force a D2H copy to synchronize: through the axon tunnel,
+    # block_until_ready() returns before the program actually finishes and
+    # under-reports by 1000x (see scripts/PROFILE.md).
     for _ in range(warmup):
         out = fn(*args)
-    jax.block_until_ready(out)
+    np.asarray(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(*args)
-    jax.block_until_ready(out)
+    np.asarray(out)
     return (time.perf_counter() - t0) / reps
 
 
@@ -69,17 +72,19 @@ def main():
     print(f"decompress one point    : {t*1e3:8.2f} ms")
 
     # --- digit unpack ---
-    unp = jax.jit(E.unpack_digits)
-    t = timeit(unp, packed[:, 64:96], packed[:, 96:128])
-    print(f"unpack_digits           : {t*1e3:8.2f} ms")
+    unp = jax.jit(E.unpack_nibbles_msb)
+    t = timeit(unp, packed[:, 96:128])
+    print(f"unpack_nibbles_msb      : {t*1e3:8.2f} ms")
 
-    # --- ladder only (table build + 256-step scan + final eq) given points --
-    digits = unp(packed[:, 64:96], packed[:, 96:128])
+    # --- comb + ladder + final eq, given points ---
+    s_digits = packed[:, 64:96].astype(jnp.int32)
+    k_digits = unp(packed[:, 96:128])
 
-    def ladder_only(ay, a_sign, ry, r_sign, digits):
-        return E.verify_prepared(ay, a_sign, ry, r_sign, digits)
+    def ladder_only(ay, a_sign, ry, r_sign, s_digits, k_digits):
+        return E.verify_prepared(ay, a_sign, ry, r_sign, s_digits, k_digits)
 
-    t = timeit(jax.jit(ladder_only), ay, a_sign, ry, r_sign, digits)
+    t = timeit(jax.jit(ladder_only), ay, a_sign, ry, r_sign, s_digits,
+               k_digits)
     print(f"verify_prepared (full)  : {t*1e3:8.2f} ms")
 
     # --- single field mul at batch (N,32) ---

@@ -75,6 +75,113 @@ def cmd_logs(args):
         sys.exit(1)
 
 
+def _load_settings(args):
+    from .settings import Settings, SettingsError
+    from .utils import BenchError
+
+    try:
+        return Settings.load(args.settings)
+    except SettingsError as e:
+        raise BenchError("Failed to load settings", e)
+
+
+def _resolve_hosts(args, settings):
+    """Explicit --hosts beats settings.json's \"hosts\" list beats the
+    cloud inventory (remote.py:31-50 host discovery analogue)."""
+    if args.hosts:
+        return args.hosts
+    if settings.hosts:
+        return settings.hosts
+    from .instance import InstanceManager
+
+    return InstanceManager(settings).hosts()
+
+
+def cmd_remote(args):
+    from .config import BenchParameters, ConfigError, NodeParameters
+    from .remote import Bench
+    from .utils import BenchError, Print
+
+    try:
+        settings = _load_settings(args)
+        hosts = _resolve_hosts(args, settings)
+        bench_params = BenchParameters({
+            "faults": args.faults,
+            "nodes": args.nodes,
+            "rate": args.rate,
+            "tx_size": args.tx_size,
+            "duration": args.duration,
+            "runs": args.runs,
+        })
+        bench = Bench(settings, hosts, user=args.user)
+        if args.install:
+            bench.install()
+        if args.update:
+            bench.update()
+        bench.run(bench_params, NodeParameters.default(), debug=args.debug)
+    except ConfigError as e:
+        Print.error(BenchError("Invalid benchmark parameters", e))
+        sys.exit(1)
+    except BenchError as e:
+        Print.error(e)
+        sys.exit(1)
+
+
+def cmd_install(args):
+    from .remote import Bench
+    from .utils import BenchError, Print
+
+    try:
+        settings = _load_settings(args)
+        hosts = _resolve_hosts(args, settings)
+        Bench(settings, hosts, user=args.user).install()
+    except BenchError as e:
+        Print.error(e)
+        sys.exit(1)
+
+
+def cmd_kill(args):
+    """Stop every node/client on the fleet (fabfile.py kill analogue)."""
+    from .remote import Bench
+    from .utils import BenchError, Print
+
+    try:
+        settings = _load_settings(args)
+        hosts = _resolve_hosts(args, settings)
+        Bench(settings, hosts, user=args.user).kill()
+        Print.info(f"killed node/client processes on {len(hosts)} host(s)")
+    except BenchError as e:
+        Print.error(e)
+        sys.exit(1)
+
+
+def cmd_cloud(args):
+    """AWS instance lifecycle (fabfile.py create/destroy/start/stop/info
+    analogue); requires boto3 + credentials."""
+    from .instance import InstanceManager
+    from .utils import BenchError, Print
+
+    try:
+        settings = _load_settings(args)
+        manager = InstanceManager(settings)
+        if args.action == "create":
+            manager.create_instances(args.instances)
+        elif args.action == "destroy":
+            manager.terminate_instances()
+        elif args.action == "start":
+            manager.start_instances()
+        elif args.action == "stop":
+            manager.stop_instances()
+        elif args.action == "info":
+            manager.print_info()
+    except BenchError as e:
+        Print.error(e)
+        sys.exit(1)
+    except Exception as e:  # boto3/botocore errors (no credentials, API)
+        Print.error(BenchError("Cloud operation failed", e))
+        sys.exit(1)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="hotstuff_tpu.harness")
     sub = ap.add_subparsers(dest="command", required=True)
@@ -105,6 +212,51 @@ def main(argv=None):
     p.add_argument("directory", nargs="?", default="logs")
     p.add_argument("--faults", type=int, default=0)
     p.set_defaults(func=cmd_logs)
+
+    def add_fleet_args(p):
+        p.add_argument("--settings", default="settings.json")
+        p.add_argument("--hosts", nargs="*", default=[],
+                       help="override host list (else settings.json "
+                            "'hosts', else the cloud inventory)")
+        p.add_argument("--user", default="ubuntu")
+
+    p = sub.add_parser("remote",
+                       help="multi-host benchmark matrix over ssh")
+    add_fleet_args(p)
+    p.add_argument("--nodes", type=int, nargs="+", default=[4])
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument("--rate", type=int, nargs="+", default=[50_000])
+    p.add_argument("--tx-size", type=int, default=512)
+    p.add_argument("--duration", type=int, default=30)
+    p.add_argument("--runs", type=int, default=1)
+    p.add_argument("--install", action="store_true",
+                   help="install toolchain on hosts first")
+    p.add_argument("--update", action="store_true",
+                   help="git pull + rebuild on hosts first")
+    p.add_argument("--debug", action="store_true")
+    p.set_defaults(func=cmd_remote)
+
+    p = sub.add_parser("install", help="install toolchain on the fleet")
+    add_fleet_args(p)
+    p.set_defaults(func=cmd_install)
+
+    p = sub.add_parser("kill", help="kill node/client on the fleet")
+    add_fleet_args(p)
+    p.set_defaults(func=cmd_kill)
+
+    for action, help_text in [
+        ("create", "create cloud instances"),
+        ("destroy", "terminate cloud instances"),
+        ("start", "start stopped cloud instances"),
+        ("stop", "stop cloud instances"),
+        ("info", "print cloud instance info"),
+    ]:
+        p = sub.add_parser(action, help=help_text)
+        p.add_argument("--settings", default="settings.json")
+        if action == "create":
+            p.add_argument("--instances", type=int, default=2,
+                           help="instances per region")
+        p.set_defaults(func=cmd_cloud, action=action)
 
     args = ap.parse_args(argv)
     args.func(args)

@@ -96,8 +96,17 @@ class LogAggregator:
 
         records = defaultdict(list)
         for chunk in data.replace(",", "").split("SUMMARY")[1:]:
-            if chunk:
-                records[Setup.from_str(chunk)].append(Result.from_str(chunk))
+            if not chunk:
+                continue
+            # Failed runs (zero execution time / zero TPS) would silently
+            # drag every averaged series down; reject them here instead of
+            # trusting result files to be hand-curated.
+            exec_time = search(r"Execution time: (\d+)", chunk)
+            result = Result.from_str(chunk)
+            if (exec_time and int(exec_time.group(1)) == 0) or \
+                    result.mean_tps == 0:
+                continue
+            records[Setup.from_str(chunk)].append(result)
 
         self.records = {k: Result.aggregate(v) for k, v in records.items()}
 

@@ -178,11 +178,13 @@ class Bench:
         from time import sleep
 
         sleep(2 * timeout / 1000 + duration)
-        for host in hosts:
-            self.runner.run(host, "pkill -f './node run' || true",
-                            check=False)
-            self.runner.run(host, "pkill -f './client ' || true",
-                            check=False)
+        self.kill(hosts)
+
+    def kill(self, hosts=None):
+        """Stop every node/client process on the fleet (fabfile kill)."""
+        for host in hosts if hosts is not None else self.hosts:
+            self.runner.run(host, "pkill -f './node run'", check=False)
+            self.runner.run(host, "pkill -f './client '", check=False)
 
     def _logs(self, hosts, faults):
         subprocess.run(["/bin/sh", "-c", CommandMaker.clean_logs()],

@@ -15,7 +15,7 @@ class SettingsError(Exception):
 
 class Settings:
     def __init__(self, testbed, key_name, key_path, base_port, repo_name,
-                 repo_url, branch, instance_type, aws_regions):
+                 repo_url, branch, instance_type, aws_regions, hosts=None):
         regions = (aws_regions if isinstance(aws_regions, list)
                    else [aws_regions])
         inputs_str = [testbed, key_name, key_path, repo_name, repo_url,
@@ -34,6 +34,7 @@ class Settings:
         self.branch = branch
         self.instance_type = instance_type
         self.aws_regions = regions
+        self.hosts = list(hosts or [])
 
     @classmethod
     def load(cls, filename="settings.json"):
@@ -52,6 +53,7 @@ class Settings:
                 data["repo"]["branch"],
                 data["instances"]["type"],
                 data["instances"]["regions"],
+                hosts=data.get("hosts", []),
             )
         except (json.JSONDecodeError, KeyError) as e:
             raise SettingsError(f"Malformed settings: {e}")

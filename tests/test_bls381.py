@@ -1,0 +1,108 @@
+"""Device BLS12-381 engine tests: Fq Montgomery arithmetic, the Fq12
+tower, Frobenius/inversion, and (behind HOTSTUFF_TPU_SLOW_TESTS=1, ~4 min
+of XLA compile on CPU) the full aggregate pairing check against the host
+reference (offchain/bls12381.py).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from hotstuff_tpu.offchain import bls12381 as host
+from hotstuff_tpu.ops import bls381 as D
+from hotstuff_tpu.ops import field381 as F
+
+RNG = np.random.default_rng(11)
+
+
+def rand_fq() -> int:
+    return int.from_bytes(RNG.bytes(48), "little") % F.Q
+
+
+def rand_fq12():
+    return tuple(rand_fq() for _ in range(12))
+
+
+def to_dev(x):
+    return jnp.asarray(D.host_fq12_to_mont_limbs(x))[None]
+
+
+def from_dev(d):
+    return tuple(F.from_limbs(r) for r in np.asarray(F.from_mont(d))[0])
+
+
+def test_field381_mont_roundtrip_and_ops():
+    F.mul_selfcheck()
+    xs = [rand_fq() for _ in range(8)]
+    ys = [rand_fq() for _ in range(8)]
+    a = jnp.asarray(np.stack([F.to_limbs(x * F.R % F.Q) for x in xs]))
+    b = jnp.asarray(np.stack([F.to_limbs(y * F.R % F.Q) for y in ys]))
+    assert [F.from_limbs(v) for v in np.asarray(F.from_mont(F.add(a, b)))] \
+        == [(x + y) % F.Q for x, y in zip(xs, ys)]
+    assert [F.from_limbs(v) for v in np.asarray(F.from_mont(F.sub(a, b)))] \
+        == [(x - y) % F.Q for x, y in zip(xs, ys)]
+    assert [F.from_limbs(v) for v in np.asarray(F.from_mont(F.inv(a)))] \
+        == [pow(x, F.Q - 2, F.Q) for x in xs]
+
+
+def test_field381_mul_chain_stability():
+    """Digit bounds must hold over arbitrarily long mul/sub chains."""
+    x, y = rand_fq(), rand_fq()
+    a = jnp.asarray(F.to_limbs(x * F.R % F.Q))[None]
+    b = jnp.asarray(F.to_limbs(y * F.R % F.Q))[None]
+    acc, want = a, x
+    for _ in range(50):
+        acc = F.mont_mul(F.sub(acc, b), b)
+        want = (want - y) * y % F.Q
+    assert F.from_limbs(np.asarray(F.from_mont(acc))[0]) == want
+
+
+def test_fq12_mul_matches_host():
+    x, y = rand_fq12(), rand_fq12()
+    assert from_dev(D.fq12_mul(to_dev(x), to_dev(y))) == host.fq12_mul(x, y)
+
+
+def test_fq12_mul_deep_chain():
+    """The reduce_sum invariant: 20 chained tower muls stay exact (without
+    it the top limb creeps past the f32 conv bound and results corrupt
+    silently)."""
+    x, y = rand_fq12(), rand_fq12()
+    acc, hacc = to_dev(x), x
+    for _ in range(20):
+        acc = D.fq12_mul(acc, to_dev(y))
+        hacc = host.fq12_mul(hacc, y)
+    assert from_dev(acc) == hacc
+
+
+def test_fq12_frobenius_and_inverse():
+    x = rand_fq12()
+    dx = to_dev(x)
+    assert from_dev(D.fq12_frobenius(dx, 1)) == host.fq12_pow(x, host.Q)
+    assert from_dev(D.fq12_frobenius(dx, 6)) == host.fq12_pow(x, host.Q ** 6)
+    assert from_dev(D.fq12_inv(dx)) == host.fq12_inv(x)
+
+
+def test_miller_lines_match_host_miller():
+    """Accumulating the host-precomputed lines reproduces the host Miller
+    value (up to the BLS_X-sign inversion the device skips)."""
+    sk, pk = host.key_gen(b"\x07" * 32)
+    sig = host.sign(sk, b"m")
+    lines = D.miller_lines(pk, sig)
+    f_dev = from_dev(D.miller_accumulate(jnp.asarray(lines)[None]))
+    f_host = host.miller_loop(host._twist(sig), host._cast_g1_fq12(pk))
+    assert f_dev == host.fq12_inv(f_host)  # host returns the inverse
+
+
+@pytest.mark.skipif(os.environ.get("HOTSTUFF_TPU_SLOW_TESTS") != "1",
+                    reason="~4 min XLA compile; set HOTSTUFF_TPU_SLOW_TESTS=1")
+def test_aggregate_verify_device_end_to_end():
+    msg = b"quorum certificate digest"
+    sks, pks = zip(*[host.key_gen(bytes([i]) * 32) for i in range(1, 5)])
+    sigs = [host.sign(s, msg) for s in sks]
+    agg = host.aggregate(sigs)
+    assert D.verify_aggregate_common(list(pks), msg, agg)
+    bad = host.aggregate(sigs[:3] + [host.sign(sks[0], b"other")])
+    assert not D.verify_aggregate_common(list(pks), msg, bad)

@@ -23,11 +23,14 @@ def cmd_local(args):
         "rate": [args.rate],
         "tx_size": args.tx_size,
         "duration": args.duration,
-        "tpu_sidecar": args.tpu_sidecar,
+        "tpu_sidecar": args.tpu_sidecar or args.scheme == "bls",
+        "scheme": args.scheme,
     })
     node_params = NodeParameters.default(
         tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
-                     if args.tpu_sidecar else None))
+                     if (args.tpu_sidecar or args.scheme == "bls")
+                     else None),
+        scheme=args.scheme if args.scheme != "ed25519" else None)
     node_params.json["mempool"]["batch_size"] = args.batch_size
     node_params.json["consensus"]["timeout_delay"] = args.timeout
     try:
@@ -196,6 +199,9 @@ def main(argv=None):
     p.add_argument("--duration", type=int, default=30, help="seconds")
     p.add_argument("--tpu-sidecar", action="store_true",
                    help="route QC verification through the TPU sidecar")
+    p.add_argument("--scheme", choices=["ed25519", "bls"],
+                   default="ed25519",
+                   help="signature scheme (bls implies --tpu-sidecar)")
     p.add_argument("--debug", action="store_true")
     p.add_argument("--output", help="append summary to this result file")
     p.set_defaults(func=cmd_local)

@@ -35,16 +35,19 @@ class Committee:
     mempool: transactions_address (:front, clients) + mempool_address (peers)
     """
 
-    def __init__(self, names, consensus_addr, front_addr, mempool_addr):
+    def __init__(self, names, consensus_addr, front_addr, mempool_addr,
+                 bls_pubkeys=None):
         inputs = [names, consensus_addr, front_addr, mempool_addr]
         assert all(isinstance(x, list) for x in inputs)
         assert all(isinstance(x, str) for y in inputs for x in y)
         assert len({len(x) for x in inputs}) == 1
+        assert bls_pubkeys is None or len(bls_pubkeys) == len(names)
 
         self.names = names
         self.consensus = consensus_addr
         self.front = front_addr
         self.mempool = mempool_addr
+        self.bls_pubkeys = bls_pubkeys  # base64 96-byte G1, scheme=bls only
 
         self.json = {
             "consensus": self._build_consensus(),
@@ -53,8 +56,11 @@ class Committee:
 
     def _build_consensus(self):
         node = {}
-        for name, address in zip(self.names, self.consensus):
-            node[name] = {"stake": 1, "address": address}
+        for i, (name, address) in enumerate(zip(self.names, self.consensus)):
+            entry = {"stake": 1, "address": address}
+            if self.bls_pubkeys:
+                entry["bls_pubkey"] = self.bls_pubkeys[i]
+            node[name] = entry
         return {"authorities": node, "epoch": 1}
 
     def _build_mempool(self):
@@ -88,14 +94,15 @@ class LocalCommittee(Committee):
     """All nodes on localhost, 3 consecutive ports per node from a base
     (benchmark/benchmark/config.py:81-90 convention)."""
 
-    def __init__(self, names, port):
+    def __init__(self, names, port, bls_pubkeys=None):
         assert isinstance(names, list)
         assert isinstance(port, int)
         size = len(names)
         consensus = [f"127.0.0.1:{port + i}" for i in range(size)]
         front = [f"127.0.0.1:{port + i + size}" for i in range(size)]
         mempool = [f"127.0.0.1:{port + i + 2 * size}" for i in range(size)]
-        super().__init__(names, consensus, front, mempool)
+        super().__init__(names, consensus, front, mempool,
+                         bls_pubkeys=bls_pubkeys)
 
 
 class NodeParameters:
@@ -125,7 +132,7 @@ class NodeParameters:
             json.dump(self.json, f, indent=4, sort_keys=True)
 
     @classmethod
-    def default(cls, tpu_sidecar=None):
+    def default(cls, tpu_sidecar=None, scheme=None):
         data = {
             "consensus": {"timeout_delay": 5_000, "sync_retry_delay": 10_000},
             "mempool": {
@@ -138,7 +145,34 @@ class NodeParameters:
         }
         if tpu_sidecar:
             data["tpu_sidecar"] = tpu_sidecar
+        if scheme:
+            data["scheme"] = scheme
         return cls(data)
+
+
+def add_bls_keys(key_files, committee_names):
+    """Generate a BLS keypair per node (scheme=bls deployments): injects
+    base64 'bls_secret' into each key file and returns the base64
+    96-byte G1 public keys in committee order."""
+    import base64
+
+    from ..offchain import bls12381 as bls
+
+    pubkeys = {}
+    for filename in key_files:
+        with open(filename, "r") as f:
+            data = json.load(f)
+        # Fresh cryptographic randomness per node — NOT derived from the
+        # public name (that would let anyone recompute every secret from
+        # the committee file).
+        sk, pk = bls.key_gen()
+        data["bls_secret"] = base64.b64encode(
+            sk.to_bytes(48, "big")).decode()
+        with open(filename, "w") as f:
+            json.dump(data, f, indent=4, sort_keys=True)
+        pubkeys[data["name"]] = base64.b64encode(
+            bls.g1_encode(pk)).decode()
+    return [pubkeys[name] for name in committee_names]
 
 
 class BenchParameters:
@@ -159,6 +193,7 @@ class BenchParameters:
             self.duration = int(json_input["duration"])
             self.runs = int(json_input.get("runs", 1))
             self.tpu_sidecar = bool(json_input.get("tpu_sidecar", False))
+            self.scheme = str(json_input.get("scheme", "ed25519"))
         except KeyError as e:
             raise ConfigError(f"Malformed bench parameters: missing key {e}")
         except ValueError:

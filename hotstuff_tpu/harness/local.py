@@ -33,9 +33,13 @@ class LocalBench:
         self.faults = bench_parameters.faults
         self.duration = bench_parameters.duration
         self.tpu_sidecar = getattr(bench_parameters, "tpu_sidecar", False)
+        self.scheme = getattr(bench_parameters, "scheme", "ed25519")
+        if self.scheme == "bls":
+            self.tpu_sidecar = True  # no host pairing in the C++ plane
         self.node_parameters = node_parameters or NodeParameters.default(
             tpu_sidecar=(f"127.0.0.1:{self.SIDECAR_PORT}"
-                         if self.tpu_sidecar else None))
+                         if self.tpu_sidecar else None),
+            scheme=self.scheme if self.scheme != "ed25519" else None)
         self._procs = []
 
     def _background_run(self, command, log_file):
@@ -108,7 +112,15 @@ class LocalBench:
                     check=True)
                 keys.append(Key.from_file(filename))
             names = [k.name for k in keys]
-            committee = LocalCommittee(names, self.BASE_PORT)
+            bls_pubkeys = None
+            if self.scheme == "bls":
+                from .config import add_bls_keys
+
+                bls_pubkeys = add_bls_keys(
+                    [PathMaker.key_file(i) for i in range(self.nodes)],
+                    names)
+            committee = LocalCommittee(names, self.BASE_PORT,
+                                       bls_pubkeys=bls_pubkeys)
             committee.print(PathMaker.committee_file())
             self.node_parameters.print(PathMaker.parameters_file())
 
@@ -119,11 +131,15 @@ class LocalBench:
             # the whole point of this mode is to measure the device path.
             if self.tpu_sidecar:
                 Print.info("Booting TPU verify sidecar...")
+                warm_bls = " --warm-bls" if self.scheme == "bls" else ""
                 self._background_run(
                     f"python -m hotstuff_tpu.sidecar "
-                    f"--port {self.SIDECAR_PORT}",
+                    f"--port {self.SIDECAR_PORT}{warm_bls}",
                     PathMaker.sidecar_log_file())
-                self._wait_sidecar_ready()
+                # The BLS pairing program is a multi-minute first compile
+                # (cached across restarts via the XLA compilation cache).
+                self._wait_sidecar_ready(
+                    deadline_s=900 if self.scheme == "bls" else 300)
 
             # Do not boot faulty nodes (crash faults, local.py:75-76 in the
             # reference); clients only target alive nodes and split the rate

@@ -98,10 +98,10 @@ def measure_single(iters=100, schemes=("eddsa", "ecdsa", "schnorr", "bls")):
     return rows
 
 
-def measure_batch(sizes=tuple(range(20, 301, 20)), tpu=True):
+def measure_batch(sizes=tuple(range(20, 301, 20)), tpu=True, tpu_bls=True):
     """Batch-verify scaling (reference main.py:78-111 sweep + the Rust
     production comparison): Ed25519 sequential host loop vs TPU batch vs
-    BLS aggregate (common message, 2-pairing fast path)."""
+    BLS aggregate (common message, 2-pairing fast path), host vs device."""
     from . import bls12381 as bls
     from . import eddsa
 
@@ -113,6 +113,17 @@ def measure_batch(sizes=tuple(range(20, 301, 20)), tpu=True):
                 for i in range(max(sizes))]
     common = b"common quorum digest"
     bls_sigs = [bls.sign(sk, common) for sk, _ in bls_keys]
+
+    if tpu_bls:
+        from ..ops import bls381 as dbls
+
+        dbls.selfcheck()
+        # One warm-up compiles the pairing program; its device shape is
+        # N-independent (pk aggregation happens on host), so every sweep
+        # size reuses it.
+        agg0 = bls.aggregate(bls_sigs[:2])
+        assert dbls.verify_aggregate_common(
+            [pk for _, pk in bls_keys[:2]], common, agg0)
 
     for n in sizes:
         msgs, pks, sigs = msgs_all[:n], pks_all[:n], sigs_all[:n]
@@ -137,6 +148,12 @@ def measure_batch(sizes=tuple(range(20, 301, 20)), tpu=True):
             lambda: bls.verify_aggregate_common(apks, common, agg))
         assert ok
         row["bls_aggregate_ms"] = round(bls_dt * 1e3, 3)
+
+        if tpu_bls:
+            ok, dbls_dt = _timed(
+                lambda: dbls.verify_aggregate_common(apks, common, agg))
+            assert ok
+            row["bls_aggregate_tpu_ms"] = round(dbls_dt * 1e3, 3)
 
         rows.append(row)
         print(json.dumps(row))

@@ -45,7 +45,24 @@ class SidecarClient:
         if not msgs:
             return []
         rid = self._send(lambda r: proto.encode_request(r, msgs, pks, sigs))
-        return self._await(rid)
+        return [bool(b) for b in self._await(rid)]
+
+    def bls_verify_aggregate(self, msg: bytes, agg_sig: bytes, pks) -> bool:
+        """Common-message BLS aggregate verify (pks: 96 B uncompressed G1,
+        agg_sig: 192 B uncompressed G2)."""
+        rid = self._send(
+            lambda r: proto.encode_bls_agg_request(r, msg, agg_sig, pks))
+        body = self._await(rid)
+        return bool(body and body[0])
+
+    def bls_sign(self, msg: bytes, sk: bytes) -> bytes:
+        """BLS sign via the sidecar's host signer -> 192 B G2 signature.
+        Raises on failure (the service replies with an empty body)."""
+        rid = self._send(lambda r: proto.encode_bls_sign_request(r, msg, sk))
+        sig = bytes(self._await(rid))
+        if len(sig) != proto.BLS_SIG_LEN:
+            raise RuntimeError("sidecar BLS signing failed")
+        return sig
 
     # -- internals ---------------------------------------------------------
 
@@ -71,12 +88,12 @@ class SidecarClient:
                             if rid in self._results:
                                 return self._results.pop(rid)
                         payload = proto.read_frame(self._sock)
-                        _, got_rid, mask = proto.decode_reply(payload)
+                        _, got_rid, body = proto.decode_reply_raw(payload)
                         with self._cond:
                             if got_rid in self._abandoned:
                                 self._abandoned.discard(got_rid)
                             else:
-                                self._results[got_rid] = mask
+                                self._results[got_rid] = body
                                 self._cond.notify_all()
                     finally:
                         self._recv_lock.release()

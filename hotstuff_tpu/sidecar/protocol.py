@@ -34,11 +34,25 @@ from dataclasses import dataclass
 
 OP_VERIFY_BATCH = 1
 OP_PING = 2
+# BLS extension (the reference's bls branch capability): aggregate verify
+# over one common message (the QC shape), G1 pks (96 B uncompressed) and
+# G2 signatures (192 B uncompressed), plus signing for the node's
+# SignatureService when the committee runs scheme=bls.
+OP_BLS_VERIFY_AGG = 3
+OP_BLS_SIGN = 4
+# Per-vote variant used by the C++ node (it cannot aggregate G2 points):
+# the sidecar aggregates the signatures itself, then runs the same
+# common-message 2-pairing check. Reply: one 0/1 byte.
+OP_BLS_VERIFY_VOTES = 5
 
 _HDR = struct.Struct("<BIIH")  # opcode, request id, count, msg_len
 _REPLY_HDR = struct.Struct("<BII")
 
 MAX_FRAME = 64 * 1024 * 1024
+
+BLS_PK_LEN = 96
+BLS_SIG_LEN = 192
+BLS_SK_LEN = 48
 
 
 @dataclass
@@ -47,6 +61,29 @@ class VerifyRequest:
     msgs: list
     pks: list
     sigs: list
+
+
+@dataclass
+class BlsAggRequest:
+    request_id: int
+    msg: bytes
+    agg_sig: bytes        # 192 B uncompressed G2
+    pks: list             # n x 96 B uncompressed G1
+
+
+@dataclass
+class BlsSignRequest:
+    request_id: int
+    msg: bytes
+    sk: bytes             # 48 B big-endian scalar
+
+
+@dataclass
+class BlsVotesRequest:
+    request_id: int
+    msg: bytes
+    pks: list             # n x 96 B uncompressed G1
+    sigs: list            # n x 192 B uncompressed G2
 
 
 def encode_request(request_id: int, msgs, pks, sigs) -> bytes:
@@ -68,13 +105,69 @@ def encode_ping(request_id: int = 0) -> bytes:
     return struct.pack(">I", len(payload)) + payload
 
 
+def encode_bls_agg_request(request_id: int, msg: bytes, agg_sig: bytes,
+                           pks) -> bytes:
+    assert len(agg_sig) == BLS_SIG_LEN
+    assert all(len(p) == BLS_PK_LEN for p in pks)
+    payload = (_HDR.pack(OP_BLS_VERIFY_AGG, request_id, len(pks), len(msg))
+               + msg + agg_sig + b"".join(pks))
+    return struct.pack(">I", len(payload)) + payload
+
+
+def encode_bls_sign_request(request_id: int, msg: bytes, sk: bytes) -> bytes:
+    assert len(sk) == BLS_SK_LEN
+    payload = (_HDR.pack(OP_BLS_SIGN, request_id, 1, len(msg)) + msg + sk)
+    return struct.pack(">I", len(payload)) + payload
+
+
+def encode_bls_votes_request(request_id: int, msg: bytes, pks,
+                             sigs) -> bytes:
+    assert len(pks) == len(sigs)
+    recs = b"".join(p + s for p, s in zip(pks, sigs))
+    payload = (_HDR.pack(OP_BLS_VERIFY_VOTES, request_id, len(pks),
+                         len(msg)) + msg + recs)
+    return struct.pack(">I", len(payload)) + payload
+
+
 def decode_request(payload: bytes):
-    """payload (no length prefix) -> (opcode, VerifyRequest)."""
+    """payload (no length prefix) -> (opcode, request dataclass)."""
     opcode, request_id, n, msg_len = _HDR.unpack_from(payload, 0)
-    if opcode not in (OP_VERIFY_BATCH, OP_PING):
+    if opcode not in (OP_VERIFY_BATCH, OP_PING, OP_BLS_VERIFY_AGG,
+                      OP_BLS_SIGN, OP_BLS_VERIFY_VOTES):
         raise ValueError(f"unknown opcode {opcode}")
     if opcode == OP_PING:
         return opcode, VerifyRequest(request_id, [], [], [])
+    if opcode == OP_BLS_VERIFY_AGG:
+        off = _HDR.size
+        msg = payload[off:off + msg_len]
+        off += msg_len
+        agg = payload[off:off + BLS_SIG_LEN]
+        off += BLS_SIG_LEN
+        if len(payload) != off + n * BLS_PK_LEN:
+            raise ValueError("bad BLS aggregate frame")
+        pks = [payload[off + i * BLS_PK_LEN:off + (i + 1) * BLS_PK_LEN]
+               for i in range(n)]
+        return opcode, BlsAggRequest(request_id, msg, agg, pks)
+    if opcode == OP_BLS_SIGN:
+        off = _HDR.size
+        msg = payload[off:off + msg_len]
+        sk = payload[off + msg_len:off + msg_len + BLS_SK_LEN]
+        if len(payload) != off + msg_len + BLS_SK_LEN:
+            raise ValueError("bad BLS sign frame")
+        return opcode, BlsSignRequest(request_id, msg, sk)
+    if opcode == OP_BLS_VERIFY_VOTES:
+        off = _HDR.size
+        msg = payload[off:off + msg_len]
+        off += msg_len
+        rec = BLS_PK_LEN + BLS_SIG_LEN
+        if len(payload) != off + n * rec:
+            raise ValueError("bad BLS votes frame")
+        pks, sigs = [], []
+        for i in range(n):
+            base = off + i * rec
+            pks.append(payload[base:base + BLS_PK_LEN])
+            sigs.append(payload[base + BLS_PK_LEN:base + rec])
+        return opcode, BlsVotesRequest(request_id, msg, pks, sigs)
     rec = msg_len + 32 + 64
     off = _HDR.size
     if len(payload) != off + n * rec:
@@ -97,10 +190,22 @@ def encode_reply(opcode: int, request_id: int, mask) -> bytes:
     return struct.pack(">I", len(payload)) + payload
 
 
+def encode_reply_raw(opcode: int, request_id: int, body: bytes) -> bytes:
+    """Reply whose body is raw bytes (BLS signatures) rather than a 0/1
+    mask; same framing, count = body length."""
+    payload = _REPLY_HDR.pack(opcode, request_id, len(body)) + body
+    return struct.pack(">I", len(payload)) + payload
+
+
 def decode_reply(payload: bytes):
     opcode, request_id, n = _REPLY_HDR.unpack_from(payload, 0)
     mask = [bool(b) for b in payload[_REPLY_HDR.size:_REPLY_HDR.size + n]]
     return opcode, request_id, mask
+
+
+def decode_reply_raw(payload: bytes):
+    opcode, request_id, n = _REPLY_HDR.unpack_from(payload, 0)
+    return opcode, request_id, payload[_REPLY_HDR.size:_REPLY_HDR.size + n]
 
 
 def read_frame(sock) -> bytes:

@@ -77,6 +77,17 @@ class VerifyEngine:
                 item = self._queue.get()
             if item is None:
                 continue
+            # BLS requests run individually (a QC aggregate is one check;
+            # there is nothing to coalesce) on the same device thread.
+            if isinstance(item.request, (proto.BlsAggRequest,
+                                         proto.BlsSignRequest,
+                                         proto.BlsVotesRequest)):
+                try:
+                    self._execute_bls(item)
+                except Exception:
+                    log.exception("BLS request failed")
+                    item.reply_fn(None)
+                continue
             batch = [item]
             total = len(item.request.msgs)
             # coalesce whatever else is already waiting, up to the launch cap
@@ -116,6 +127,36 @@ class VerifyEngine:
             n = len(p.request.msgs)
             p.reply_fn([bool(b) for b in mask[off:off + n]])
             off += n
+
+    def _execute_bls(self, item):
+        from ..offchain import bls12381 as bls
+
+        req = item.request
+        if isinstance(req, proto.BlsSignRequest):
+            # Signing is G2 scalar multiplication — host bigint work, no
+            # pairing; mirrors the reference keeping signing on CPU.
+            sk = int.from_bytes(req.sk, "big")
+            sig = bls.g2_encode(bls.sign(sk, req.msg))
+            item.reply_fn(sig)
+            return
+        try:
+            if isinstance(req, proto.BlsVotesRequest):
+                # C++ nodes ship per-vote signatures; aggregate them here
+                # (host G2 adds), then run the same common-message check.
+                agg = bls.aggregate([bls.g2_decode(s) for s in req.sigs])
+            else:
+                agg = bls.g2_decode(req.agg_sig)
+            pks = [bls.g1_decode(p) for p in req.pks]
+        except ValueError:
+            item.reply_fn([False])
+            return
+        if self._use_host:
+            ok = bls.verify_aggregate_common(pks, req.msg, agg)
+        else:
+            from ..ops import bls381 as dbls
+
+            ok = dbls.verify_aggregate_common(pks, req.msg, agg)
+        item.reply_fn([bool(ok)])
 
     def _verify(self, msgs, pks, sigs) -> np.ndarray:
         if not msgs:
@@ -176,9 +217,14 @@ class _Handler(socketserver.BaseRequestHandler):
                         proto.OP_PING, req.request_id, []))
                     continue
 
-                def reply(mask, _rid=req.request_id):
-                    frame = proto.encode_reply(
-                        proto.OP_VERIFY_BATCH, _rid, mask)
+                def reply(result, _rid=req.request_id, _op=opcode):
+                    if _op == proto.OP_BLS_SIGN:
+                        frame = proto.encode_reply_raw(
+                            _op, _rid, result if result else b"")
+                    else:
+                        frame = proto.encode_reply(
+                            _op, _rid, result if result is not None
+                            else [False])
                     try:
                         outbox.put_nowait(frame)
                     except queue.Full:
@@ -201,7 +247,7 @@ class SidecarServer(socketserver.ThreadingTCPServer):
 def serve(host: str = "127.0.0.1", port: int = 7100,
           mesh_devices: int | None = None, use_host: bool = False,
           ready_event: threading.Event | None = None,
-          warm_max: int = 128):
+          warm_max: int = 128, warm_bls: bool = False):
     engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host)
     # Warm the jit cache BEFORE binding: until the socket exists, node
     # crypto gets ECONNREFUSED and falls back to host verify instead of
@@ -210,7 +256,10 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
     # silently stalls every client for the whole compile — the round-2
     # 0-TPS failure mode.)
     if not use_host:
+        _enable_compilation_cache()
         _warmup(engine, warm_max)
+        if warm_bls:
+            _warmup_bls()
     server = SidecarServer((host, port), engine)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
     if ready_event is not None:
@@ -221,6 +270,38 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         engine.stop()
         server.server_close()
     return server
+
+
+def _enable_compilation_cache():
+    """Persist XLA compilations across sidecar restarts; the BLS pairing
+    program alone is minutes of compile, paid once per cache dir."""
+    import os
+
+    import jax
+
+    cache_dir = os.environ.get("HOTSTUFF_TPU_XLA_CACHE",
+                               os.path.expanduser("~/.cache/hotstuff_tpu"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # older jax without the option: lazy compiles only
+        log.warning("jax compilation cache unavailable")
+
+
+def _warmup_bls(n_pks: int = 3):
+    """Compile the device pairing program before listen() so the first QC
+    under scheme=bls doesn't eat a multi-minute compile against the C++
+    client's 60 s deadline."""
+    from ..offchain import bls12381 as bls
+    from ..ops import bls381 as dbls
+
+    t0 = monotonic()
+    dbls.selfcheck()
+    msg = b"warmup"
+    keys = [bls.key_gen(bytes([i]) * 32) for i in range(1, n_pks + 1)]
+    agg = bls.aggregate([bls.sign(sk, msg) for sk, _ in keys])
+    if not dbls.verify_aggregate_common([pk for _, pk in keys], msg, agg):
+        log.error("BLS warmup verify returned False")
+    log.info("BLS pairing warmup done in %.1fs", monotonic() - t0)
 
 
 def _warmup(engine, warm_max: int = 128):
@@ -258,6 +339,9 @@ def main(argv=None):
     ap.add_argument("--warm", type=int, default=128,
                     help="largest batch shape to pre-compile before "
                          "listening (power-of-two buckets up to this)")
+    ap.add_argument("--warm-bls", action="store_true",
+                    help="also pre-compile the BLS pairing program "
+                         "(scheme=bls deployments)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -265,7 +349,8 @@ def main(argv=None):
         format="%(asctime)s.%(msecs)03dZ %(levelname)s [%(name)s] %(message)s",
         datefmt="%Y-%m-%dT%H:%M:%S")
     serve(args.host, args.port, mesh_devices=args.mesh or None,
-          use_host=args.host_crypto, warm_max=args.warm)
+          use_host=args.host_crypto, warm_max=args.warm,
+          warm_bls=args.warm_bls)
 
 
 if __name__ == "__main__":

@@ -41,6 +41,7 @@ struct Parameters {
 struct Authority {
   Stake stake = 1;
   Address address;
+  Bytes bls_pubkey;  // optional 96-byte uncompressed G1 (scheme=bls)
 };
 
 class Committee {
@@ -53,6 +54,10 @@ class Committee {
   Json to_json() const;
 
   size_t size() const { return authorities_.size(); }
+
+  const std::map<PublicKey, Authority>& authorities() const {
+    return authorities_;
+  }
 
   Stake stake(const PublicKey& name) const {
     auto it = authorities_.find(name);

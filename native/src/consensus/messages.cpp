@@ -398,6 +398,9 @@ Json Committee::to_json() const {
     Json entry = Json::object();
     entry.set("stake", Json(int64_t(a.stake)));
     entry.set("address", Json(a.address.str()));
+    if (!a.bls_pubkey.empty()) {
+      entry.set("bls_pubkey", Json(base64_encode(a.bls_pubkey)));
+    }
     auths.set(name.to_base64(), std::move(entry));
   }
   Json j = Json::object();
@@ -418,6 +421,12 @@ Committee Committee::from_json(const Json& j) {
     auto addr = Address::parse(entry.at("address").as_string());
     if (!addr) throw JsonError("bad address in consensus committee");
     a.address = *addr;
+    if (auto* v = entry.find("bls_pubkey")) {
+      if (!base64_decode(v->as_string(), &a.bls_pubkey) ||
+          a.bls_pubkey.size() != 96) {
+        throw JsonError("bad bls_pubkey in consensus committee");
+      }
+    }
     authorities.emplace(name, std::move(a));
   }
   uint64_t epoch = j.find("epoch") ? j.at("epoch").as_u64() : 1;

@@ -1,12 +1,33 @@
 #include "crypto/crypto.hpp"
 
+#include <atomic>
+#include <chrono>
 #include <cstring>
 #include <stdexcept>
 
+#include "common/log.hpp"
 #include "crypto/openssl_shim.hpp"
 #include "crypto/sidecar_client.hpp"
 
 namespace hotstuff {
+
+namespace {
+// Atomic: in-process multi-node deployments (test_e2e, `node deploy`)
+// re-assert the scheme from each Node::create while earlier nodes' actor
+// threads are already signing. The BLS context itself is process-wide and
+// single-keyed, so scheme=bls supports one node per process (the harness
+// always runs one process per node).
+std::atomic<Scheme> g_scheme{Scheme::kEd25519};
+std::unique_ptr<BlsContext> g_bls;
+}  // namespace
+
+Scheme current_scheme() { return g_scheme.load(std::memory_order_relaxed); }
+void set_scheme(Scheme s) { g_scheme.store(s, std::memory_order_relaxed); }
+
+BlsContext* BlsContext::instance() { return g_bls.get(); }
+void BlsContext::install(std::unique_ptr<BlsContext> ctx) {
+  g_bls = std::move(ctx);
+}
 
 Digest sha512_digest(const uint8_t* data, size_t len) {
   unsigned char md[64];
@@ -85,6 +106,34 @@ struct CtxGuard {
 }  // namespace
 
 Signature Signature::sign(const Digest& digest, const SecretKey& sk) {
+  if (current_scheme() == Scheme::kBls) {
+    TpuVerifier* tpu = TpuVerifier::instance();
+    BlsContext* bls = BlsContext::instance();
+    if (!tpu || !bls) {
+      throw std::runtime_error("scheme=bls requires sidecar + BLS keys");
+    }
+    // Bounded retries over transient sidecar failures, then degrade to an
+    // invalid (all-zero) signature: peers simply reject the vote. This
+    // runs on the SignatureService worker thread, which has no exception
+    // handler — a throw here would std::terminate the whole node on one
+    // sidecar hiccup.
+    for (int attempt = 0; attempt < 10; attempt++) {
+      auto sig = tpu->bls_sign(digest, bls->secret);
+      if (sig) {
+        Signature s;
+        s.data = std::move(*sig);
+        return s;
+      }
+      LOG_WARN("crypto") << "BLS sign attempt " << attempt + 1
+                         << " failed; retrying";
+      std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    }
+    LOG_ERROR("crypto") << "BLS signing unavailable; emitting invalid "
+                           "signature (vote will be rejected)";
+    Signature s;
+    s.data = Bytes(192, 0);
+    return s;
+  }
   PkeyGuard key{EVP_PKEY_new_raw_private_key(kEvpPkeyEd25519, nullptr,
                                              sk.seed(), 32)};
   if (!key.p) throw std::runtime_error("bad secret key");
@@ -101,6 +150,10 @@ Signature Signature::sign(const Digest& digest, const SecretKey& sk) {
 }
 
 bool Signature::verify(const Digest& digest, const PublicKey& pk) const {
+  if (current_scheme() == Scheme::kBls) {
+    return verify_batch(digest, {{pk, *this}});
+  }
+  if (data.size() != 64) return false;
   PkeyGuard key{EVP_PKEY_new_raw_public_key(kEvpPkeyEd25519, nullptr,
                                             pk.data.data(), 32)};
   if (!key.p) return false;
@@ -116,6 +169,13 @@ bool Signature::verify_batch(
     const Digest& digest,
     const std::vector<std::pair<PublicKey, Signature>>& votes) {
   TpuVerifier* tpu = TpuVerifier::instance();
+  if (current_scheme() == Scheme::kBls) {
+    // No host pairing exists in the C++ plane; the sidecar is mandatory
+    // for BLS (asserted at boot) and a transport failure rejects.
+    if (!tpu) return false;
+    auto ok = tpu->bls_verify_votes(digest, votes);
+    return ok.value_or(false);
+  }
   if (tpu && tpu->connected()) {
     auto mask = tpu->verify_batch(digest, votes);
     if (mask) {

@@ -8,6 +8,7 @@
 #pragma once
 
 #include <array>
+#include <map>
 #include <memory>
 #include <thread>
 #include <utility>
@@ -93,25 +94,51 @@ struct SecretKey {
   static bool from_base64(const std::string& s, SecretKey* out);
 };
 
+// Signature scheme knob (the reference's EdDSA main branch vs BLS sibling
+// branch, README.md:1-3, selected per-deployment in node parameters).
+enum class Scheme { kEd25519, kBls };
+
+Scheme current_scheme();
+void set_scheme(Scheme s);
+
+// Process-wide BLS context, installed at node boot when scheme=bls: the
+// node's signing scalar plus the committee's 96-byte uncompressed G1
+// public keys (the 32-byte PublicKey stays the node identity everywhere;
+// BLS material rides alongside it in the config files).
+struct BlsContext {
+  Bytes secret;                              // 48-byte big-endian scalar
+  std::map<PublicKey, Bytes> public_keys;    // name -> 96-byte G1
+
+  static BlsContext* instance();
+  static void install(std::unique_ptr<BlsContext> ctx);
+};
+
 struct Signature {
-  std::array<uint8_t, 64> data{};
+  // 64 bytes (Ed25519) or 192 bytes (uncompressed BLS G2); variable so the
+  // scheme knob doesn't triple the wire cost of the default scheme.
+  Bytes data = Bytes(64, 0);
 
   bool operator==(const Signature& o) const { return data == o.data; }
 
-  void serialize(Writer* w) const { w->fixed(data); }
+  void serialize(Writer* w) const { w->bytes(data); }
   static Signature deserialize(Reader* r) {
     Signature s;
-    r->fixed(&s.data);
+    s.data = r->bytes();
+    if (s.data.size() != 64 && s.data.size() != 192) {
+      throw SerdeError("bad signature length");
+    }
     return s;
   }
 
-  // Sign a 32-byte digest (the message is always a Digest in this protocol).
+  // Sign a 32-byte digest (the message is always a Digest in this
+  // protocol). scheme=bls routes to the sidecar's host signer.
   static Signature sign(const Digest& digest, const SecretKey& sk);
 
   bool verify(const Digest& digest, const PublicKey& pk) const;
 
   // Batch verification over a QC's votes. Uses the process-wide TpuVerifier
-  // if one is installed (see sidecar_client.hpp), else a host loop.
+  // if one is installed (see sidecar_client.hpp), else a host loop
+  // (scheme=bls requires the sidecar: there is no host pairing in C++).
   static bool verify_batch(
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes);

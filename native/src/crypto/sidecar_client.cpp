@@ -8,6 +8,12 @@ namespace hotstuff {
 
 namespace {
 constexpr uint8_t kOpVerifyBatch = 1;
+constexpr uint8_t kOpBlsVerifyAgg = 3;
+constexpr uint8_t kOpBlsSign = 4;
+constexpr uint8_t kOpBlsVerifyVotes = 5;
+constexpr size_t kBlsPkLen = 96;
+constexpr size_t kBlsSigLen = 192;
+constexpr size_t kBlsSkLen = 48;
 std::unique_ptr<TpuVerifier> g_instance;
 }  // namespace
 
@@ -62,9 +68,10 @@ std::optional<std::vector<bool>> TpuVerifier::verify_batch(
   w.u8(32);  // msg_len lo (u16 LE)
   w.u8(0);   // msg_len hi
   for (const auto& [pk, sig] : votes) {
+    if (sig.data.size() != 64) return std::nullopt;  // not an Ed25519 sig
     w.fixed(digest.data);
     w.fixed(pk.data);
-    w.fixed(sig.data);
+    w.out.insert(w.out.end(), sig.data.begin(), sig.data.end());
   }
   if (!sock_.write_frame(w.out)) {
     sock_.close();
@@ -99,6 +106,100 @@ std::optional<std::vector<bool>> TpuVerifier::verify_batch(
     std::vector<bool> mask(n);
     for (uint32_t i = 0; i < n; i++) mask[i] = r.u8() != 0;
     return mask;
+  } catch (const SerdeError&) {
+    sock_.close();
+    return std::nullopt;
+  }
+}
+
+// -- BLS operations ---------------------------------------------------------
+
+// One request/reply exchange under the (longer) BLS deadline; resets the
+// socket on any failure so framing can't desynchronize.
+std::optional<Bytes> TpuVerifier::bls_roundtrip_locked_(const Bytes& frame) {
+  if (!ensure_connected_locked()) return std::nullopt;
+  sock_.set_recv_timeout(kBlsRecvTimeoutMs);
+  bool ok = sock_.write_frame(frame);
+  Bytes reply;
+  if (ok) ok = sock_.read_frame(&reply);
+  sock_.set_recv_timeout(kRecvTimeoutMs);
+  if (!ok) {
+    LOG_WARN("crypto::sidecar") << "BLS sidecar exchange failed";
+    sock_.close();
+    backoff_until_ = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(kBackoffMs);
+    return std::nullopt;
+  }
+  return reply;
+}
+
+std::optional<Bytes> TpuVerifier::bls_sign(const Digest& digest,
+                                           const Bytes& sk48) {
+  if (sk48.size() != kBlsSkLen) return std::nullopt;
+  std::lock_guard<std::mutex> lk(m_);
+  Writer w;
+  uint32_t rid = next_id_++;
+  w.u8(kOpBlsSign);
+  w.u32(rid);
+  w.u32(1);
+  w.u8(32);  // msg_len lo (u16 LE)
+  w.u8(0);
+  w.fixed(digest.data);
+  w.out.insert(w.out.end(), sk48.begin(), sk48.end());
+  auto reply = bls_roundtrip_locked_(w.out);
+  if (!reply) return std::nullopt;
+  try {
+    Reader r(*reply);
+    uint8_t opcode = r.u8();
+    uint32_t got_rid = r.u32();
+    uint32_t n = r.u32();
+    if (opcode != kOpBlsSign || got_rid != rid || n != kBlsSigLen) {
+      return std::nullopt;
+    }
+    Bytes sig(kBlsSigLen);
+    for (auto& b : sig) b = r.u8();
+    return sig;
+  } catch (const SerdeError&) {
+    sock_.close();
+    return std::nullopt;
+  }
+}
+
+std::optional<bool> TpuVerifier::bls_verify_votes(
+    const Digest& digest,
+    const std::vector<std::pair<PublicKey, Signature>>& votes) {
+  BlsContext* bls = BlsContext::instance();
+  if (!bls) return std::nullopt;
+  std::lock_guard<std::mutex> lk(m_);
+  Writer w;
+  uint32_t rid = next_id_++;
+  w.u8(kOpBlsVerifyVotes);
+  w.u32(rid);
+  w.u32(static_cast<uint32_t>(votes.size()));
+  w.u8(32);  // msg_len lo (u16 LE)
+  w.u8(0);
+  w.fixed(digest.data);
+  for (const auto& [pk, sig] : votes) {
+    auto it = bls->public_keys.find(pk);
+    if (it == bls->public_keys.end() ||
+        it->second.size() != kBlsPkLen ||
+        sig.data.size() != kBlsSigLen) {
+      return false;  // unknown authority or malformed signature: reject
+    }
+    w.out.insert(w.out.end(), it->second.begin(), it->second.end());
+    w.out.insert(w.out.end(), sig.data.begin(), sig.data.end());
+  }
+  auto reply = bls_roundtrip_locked_(w.out);
+  if (!reply) return std::nullopt;
+  try {
+    Reader r(*reply);
+    uint8_t opcode = r.u8();
+    uint32_t got_rid = r.u32();
+    uint32_t n = r.u32();
+    if (opcode != kOpBlsVerifyVotes || got_rid != rid || n != 1) {
+      return std::nullopt;
+    }
+    return r.u8() != 0;
   } catch (const SerdeError&) {
     sock_.close();
     return std::nullopt;

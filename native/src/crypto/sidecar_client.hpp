@@ -36,17 +36,27 @@ class TpuVerifier {
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes);
 
+  // scheme=bls operations (pairing lives only in the sidecar; signing is
+  // its host G2 scalar mult). These use a longer receive deadline than
+  // Ed25519 batches — a pairing is milliseconds-to-seconds, not micro.
+  std::optional<Bytes> bls_sign(const Digest& digest, const Bytes& sk48);
+  std::optional<bool> bls_verify_votes(
+      const Digest& digest,
+      const std::vector<std::pair<PublicKey, Signature>>& votes);
+
   // Deadlines (ms). Every sidecar interaction is bounded: a slow or wedged
   // device process makes verify_batch return nullopt (host fallback), never
   // stalls the consensus Core thread (SURVEY.md §7 latency discipline).
   static constexpr int kConnectTimeoutMs = 250;
   static constexpr int kRecvTimeoutMs = 1000;
+  static constexpr int kBlsRecvTimeoutMs = 60'000;
   // After a transport failure, skip the sidecar entirely for this long so a
   // dead device costs one timeout, not one per QC.
   static constexpr int kBackoffMs = 2000;
 
  private:
   bool ensure_connected_locked();
+  std::optional<Bytes> bls_roundtrip_locked_(const Bytes& frame);
 
   Address addr_;
   std::mutex m_;

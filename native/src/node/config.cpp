@@ -18,6 +18,12 @@ Secret Secret::read(const std::string& path) {
       !SecretKey::from_base64(j.at("secret").as_string(), &s.secret)) {
     throw JsonError("bad key file " + path);
   }
+  if (auto* v = j.find("bls_secret")) {
+    if (!base64_decode(v->as_string(), &s.bls_secret) ||
+        s.bls_secret.size() != 48) {
+      throw JsonError("bad bls_secret in " + path);
+    }
+  }
   return s;
 }
 
@@ -25,6 +31,9 @@ void Secret::write(const std::string& path) const {
   Json j = Json::object();
   j.set("name", Json(name.to_base64()));
   j.set("secret", Json(secret.to_base64()));
+  if (!bls_secret.empty()) {
+    j.set("bls_secret", Json(base64_encode(bls_secret)));
+  }
   j.write_file(path);
 }
 
@@ -54,6 +63,12 @@ Parameters Parameters::from_json(const Json& j) {
   if (auto* v = j.find("tpu_sidecar")) {
     if (v->type() == Json::Type::kString) {
       p.tpu_sidecar = Address::parse(v->as_string());
+    }
+  }
+  if (auto* v = j.find("scheme")) {
+    p.scheme = v->as_string();
+    if (p.scheme != "ed25519" && p.scheme != "bls") {
+      throw JsonError("unknown scheme: " + p.scheme);
     }
   }
   return p;

@@ -18,6 +18,7 @@ namespace node {
 struct Secret {
   PublicKey name;
   SecretKey secret;
+  Bytes bls_secret;  // optional 48-byte scalar (scheme=bls deployments)
 
   static Secret generate();
   static Secret read(const std::string& path);
@@ -36,6 +37,9 @@ struct Parameters {
   consensus::Parameters consensus;
   mempool::Parameters mempool;
   std::optional<Address> tpu_sidecar;
+  // "ed25519" (default) or "bls" — the reference's branch-level scheme
+  // choice as a runtime knob (README.md:1-3).
+  std::string scheme = "ed25519";
 
   static Parameters read(const std::string& path);
   static Parameters from_json(const Json& j);

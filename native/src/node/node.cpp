@@ -28,6 +28,32 @@ std::unique_ptr<Node> Node::create(const std::string& committee_file,
         std::make_unique<TpuVerifier>(*parameters.tpu_sidecar));
   }
 
+  // Scheme knob (the reference's EdDSA-vs-BLS branch choice as runtime
+  // config). BLS has no C++ pairing or signer: the sidecar is mandatory.
+  if (parameters.scheme == "bls") {
+    if (!parameters.tpu_sidecar) {
+      throw std::runtime_error("scheme=bls requires a tpu_sidecar address");
+    }
+    if (secret.bls_secret.size() != 48) {
+      throw std::runtime_error("scheme=bls requires bls_secret in the key "
+                               "file");
+    }
+    auto ctx = std::make_unique<BlsContext>();
+    ctx->secret = secret.bls_secret;
+    for (const auto& [auth_name, auth] : committee.consensus.authorities()) {
+      if (auth.bls_pubkey.size() != 96) {
+        throw std::runtime_error(
+            "scheme=bls requires bls_pubkey for every authority");
+      }
+      ctx->public_keys.emplace(auth_name, auth.bls_pubkey);
+    }
+    BlsContext::install(std::move(ctx));
+    set_scheme(Scheme::kBls);
+    LOG_INFO("node::node") << "Signature scheme: bls (sidecar-backed)";
+  } else {
+    set_scheme(Scheme::kEd25519);
+  }
+
   SignatureService signature_service(secret.secret);
 
   auto tx_mempool_to_consensus = make_channel<Digest>();

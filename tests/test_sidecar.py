@@ -90,3 +90,43 @@ def test_sidecar_empty_batch(server):
     port = server.server_address[1]
     with SidecarClient(port=port) as client:
         assert client.verify_batch([], [], []) == []
+
+
+@pytest.fixture(scope="module")
+def host_server():
+    """Host-crypto server: exercises the BLS ops without device compiles."""
+    engine = VerifyEngine(use_host=True)
+    srv = SidecarServer(("127.0.0.1", 0), engine)
+    t = threading.Thread(target=srv.serve_forever,
+                         kwargs=dict(poll_interval=0.1), daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    engine.stop()
+    srv.server_close()
+
+
+def test_sidecar_bls_sign_and_aggregate_verify(host_server):
+    """The scheme=bls wire surface: sidecar signing + common-message
+    aggregate verification (the QC verify shape of the reference's bls
+    branch)."""
+    from hotstuff_tpu.offchain import bls12381 as bls
+
+    port = host_server.server_address[1]
+    msg = b"qc digest under bls"
+    keys = [bls.key_gen(bytes([i]) * 32) for i in range(1, 4)]
+    pk_enc = [bls.g1_encode(pk) for _, pk in keys]
+    with SidecarClient(port=port) as client:
+        sigs = [client.bls_sign(msg, sk.to_bytes(48, "big"))
+                for sk, _ in keys]
+        assert all(len(s) == 192 for s in sigs)
+        agg = bls.g2_encode(bls.aggregate([bls.g2_decode(s) for s in sigs]))
+        assert client.bls_verify_aggregate(msg, agg, pk_enc)
+        # tampered aggregate rejects
+        bad = bls.g2_encode(bls.aggregate(
+            [bls.g2_decode(s) for s in sigs[:2]]
+            + [bls.sign(keys[0][0], b"other")]))
+        assert not client.bls_verify_aggregate(msg, bad, pk_enc)
+        # garbage bytes reject instead of crashing the connection
+        assert not client.bls_verify_aggregate(msg, b"\x01" * 192, pk_enc)
+        assert client.ping()  # connection still healthy

@@ -83,4 +83,53 @@ TEST(signature_service) {
   CHECK(sig.verify(d, kp.name));
 }
 
+TEST(signature_serde_variable_length) {
+  // 64-byte (Ed25519) and 192-byte (BLS G2) signatures round-trip; any
+  // other length is rejected at deserialization (scheme=bls support).
+  for (size_t len : {size_t(64), size_t(192)}) {
+    Signature s;
+    s.data = Bytes(len);
+    for (size_t i = 0; i < len; i++) s.data[i] = uint8_t(i * 7);
+    Writer w;
+    s.serialize(&w);
+    Reader r(w.out);
+    Signature back = Signature::deserialize(&r);
+    CHECK(back == s);
+  }
+  Signature bad;
+  bad.data = Bytes(128, 3);
+  Writer w;
+  bad.serialize(&w);
+  Reader r(w.out);
+  bool threw = false;
+  try {
+    Signature::deserialize(&r);
+  } catch (const SerdeError&) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+namespace {
+// Restores the process-global scheme even when a failing CHECK returns
+// early (a leaked kBls would poison every later test in the binary).
+struct SchemeGuard {
+  ~SchemeGuard() { set_scheme(Scheme::kEd25519); }
+};
+}  // namespace
+
+TEST(bls_signature_paths_reject_without_sidecar) {
+  // Under scheme=bls with no sidecar installed, verification rejects
+  // (it must never fall through to the Ed25519 host loop).
+  auto kp = keys()[0];
+  Digest d = sha512_digest(Bytes{9});
+  Signature sig = Signature::sign(d, kp.secret);  // ed25519-signed
+  SchemeGuard guard;
+  set_scheme(Scheme::kBls);
+  CHECK(!sig.verify(d, kp.name));
+  CHECK(!Signature::verify_batch(d, {{kp.name, sig}}));
+  set_scheme(Scheme::kEd25519);
+  CHECK(sig.verify(d, kp.name));
+}
+
 int main() { return run_all(); }

@@ -106,4 +106,33 @@ TEST(committee_json_roundtrip) {
   CHECK(back.consensus.quorum_threshold() == 3);
 }
 
+TEST(bls_config_roundtrip) {
+  // scheme=bls material: per-authority bls_pubkey in the committee and
+  // bls_secret in the key file survive the JSON round-trip.
+  auto auths = consensus_committee(6400).authorities();
+  std::map<PublicKey, consensus::Authority> with_bls;
+  uint8_t fill = 1;
+  for (auto [name, a] : auths) {
+    a.bls_pubkey = Bytes(96, fill++);
+    with_bls.emplace(name, std::move(a));
+  }
+  node::Committee c;
+  c.consensus = consensus::Committee(std::move(with_bls), 1);
+  c.mempool = mempool_committee(6500);
+  c.write("/tmp/.hs_test_committee_bls.json");
+  node::Committee back =
+      node::Committee::read("/tmp/.hs_test_committee_bls.json");
+  const auto& orig = c.consensus.authorities();
+  for (const auto& [name, a] : back.consensus.authorities()) {
+    CHECK(a.bls_pubkey == orig.at(name).bls_pubkey);  // exact per-authority
+  }
+
+  node::Secret s = node::Secret::generate();
+  s.bls_secret = Bytes(48, 0x5A);
+  s.write("/tmp/.hs_test_secret_bls.json");
+  node::Secret back_s = node::Secret::read("/tmp/.hs_test_secret_bls.json");
+  CHECK(back_s.bls_secret == s.bls_secret);
+  CHECK(back_s.name == s.name);
+}
+
 int main() { return run_all(); }

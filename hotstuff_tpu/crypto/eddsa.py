@@ -107,22 +107,48 @@ def prepare_batch(msgs, pks, sigs):
                 k=packed[:, 96:128], packed=packed, host_ok=host_ok)
 
 
+# Per-program sub-batch cap. A/B-measured best end-to-end shape on v5e
+# (scripts/eval_device.py): larger batches run as sub-batches of this size
+# scanned inside ONE dispatch (ops/ed25519.verify_packed_chunked), which
+# amortizes the fixed per-dispatch tunnel cost while keeping every conv's
+# group count at a size XLA handles well.
+MAX_SUBBATCH = 1024
+
+
 def verify_batch(msgs, pks, sigs, *, pad: bool = True) -> np.ndarray:
     """Batch Ed25519 verify on the default JAX device -> (N,) bool mask.
 
     TPU analogue of ``Signature::verify_batch``
     (reference: crypto/src/lib.rs:210-223), with per-signature results.
+    Any batch size works: n <= 1024 pads to a power-of-two bucket and runs
+    one plain program; larger n runs as ceil(n/1024) sub-batches inside a
+    single chunked-scan dispatch.
     """
     n = len(msgs)
     if n == 0:
         return np.zeros((0,), bool)
     prep = prepare_batch(msgs, pks, sigs)
-    m = _bucket(n) if pad else n
-    packed = prep["packed"]
+    mask = verify_prepared_rows(prep["packed"], n, pad=pad)
+    return mask & prep["host_ok"]
+
+
+def verify_prepared_rows(packed: np.ndarray, n: int, *,
+                         pad: bool = True) -> np.ndarray:
+    """(n, 128) prepared rows -> (n,) device mask (no host_ok fold)."""
+    if n <= MAX_SUBBATCH:
+        m = _bucket(n) if pad else n
+        if m != n:
+            packed = np.pad(packed, [(0, m - n), (0, 0)])
+        return np.asarray(E.verify_packed_jit(jnp.asarray(packed)))[:n]
+    g = -(-n // MAX_SUBBATCH)
+    if pad:  # bound the number of compiled scan lengths: next power of two
+        g = 1 << (g - 1).bit_length()
+    m = g * MAX_SUBBATCH
     if m != n:
         packed = np.pad(packed, [(0, m - n), (0, 0)])
-    mask = E.verify_packed_jit(jnp.asarray(packed))
-    return np.asarray(mask)[:n] & prep["host_ok"]
+    chunked = packed.reshape(g, MAX_SUBBATCH, 128)
+    mask = E.verify_packed_chunked_jit(jnp.asarray(chunked))
+    return np.asarray(mask).reshape(m)[:n]
 
 
 def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:

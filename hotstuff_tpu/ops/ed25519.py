@@ -36,6 +36,14 @@ K2D = (2 * D) % P
 
 _const = F.constant
 
+# A/B switches for the point-op conv shapes (see scripts/eval_device.py).
+# Defaults are the slope-measured winners on a real v5e chip.
+import os as _os
+
+_STACK_MULS = _os.environ.get("HOTSTUFF_TPU_STACK_MULS", "0") == "1"
+_ONEHOT_SELECT = _os.environ.get("HOTSTUFF_TPU_ONEHOT_SELECT", "0") == "1"
+_JOINT_DECOMPRESS = _os.environ.get("HOTSTUFF_TPU_JOINT_DECOMPRESS", "1") == "1"
+
 
 # ---------------------------------------------------------------------------
 # Point representation helpers.  ext = (X, Y, Z, T); cached = (Y+X, Y-X, Z, 2dT)
@@ -75,27 +83,35 @@ def cached_neg(c: jnp.ndarray) -> jnp.ndarray:
 
 
 def point_add(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
-    """Complete unified addition, ext + cached -> ext (7 field muls).
+    """Complete unified addition, ext + cached -> ext (8 field muls).
 
     add-2008-hwcd-3 for a=-1 (the ref10 ge_add shape) — complete on the
     twisted Edwards curve, so it needs no doubling/identity branches: ideal
-    for SIMD/scan execution on TPU.  Measured note: keeping the 7 muls as
-    separate 1024-group convs beats stacking them into one 4096-group conv
-    (40.6 ms vs 23.0 ms for the full ladder on a v5e) — the depthwise conv
-    is compute-bound on the VPU and large group counts lower its
-    efficiency, so fewer-but-fatter launches LOSE here.
+    for SIMD/scan execution on TPU.  Default: the muls stay separate
+    batch-group convs, which XLA overlaps well.  HOTSTUFF_TPU_STACK_MULS=1
+    instead fuses the 4 independent input products and the 4 output
+    products into two 4*batch-group convs — slope-measured ~2x SLOWER
+    end-to-end on a v5e (scripts/PROFILE.md), kept only as an A/B switch
+    for future backends.
     """
     x1, y1, z1, t1 = _unpack(p)
     ypx2, ymx2, z2, t2d2 = _unpack(qc)
-    a = F.mul(F.sub(y1, x1), ymx2)
-    b = F.mul(F.add(y1, x1), ypx2)
-    c = F.mul(t1, t2d2)
-    zz = F.mul(z1, z2)
+    if _STACK_MULS:
+        m = F.mul(_pack(F.sub(y1, x1), F.add(y1, x1), t1, z1),
+                  _pack(ymx2, ypx2, t2d2, z2))
+        a, b, c, zz = _unpack(m)
+    else:
+        a = F.mul(F.sub(y1, x1), ymx2)
+        b = F.mul(F.add(y1, x1), ypx2)
+        c = F.mul(t1, t2d2)
+        zz = F.mul(z1, z2)
     d = F.add(zz, zz)
     e = F.sub(b, a)
     f = F.sub(d, c)
     g = F.add(d, c)
     h = F.add(b, a)
+    if _STACK_MULS:
+        return F.mul(_pack(e, g, f, e), _pack(f, h, g, h))
     return _pack(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
 
 
@@ -103,18 +119,33 @@ def point_dbl(p: jnp.ndarray, with_t: bool = True) -> jnp.ndarray:
     """Dedicated doubling (dbl-2008-hwcd, a=-1): 4M + 4S.
 
     with_t=False skips the T-output multiply (3M + 4S): legal whenever the
-    next consumer is another doubling, which only reads X, Y, Z. Static
+    next consumer is another doubling, which only reads X, Y, Z.  Static
     python bool, so each variant compiles to its own fixed program.
+    Default: separate batch-group convs (XLA overlaps the 4 independent
+    squarings); HOTSTUFF_TPU_STACK_MULS=1 fuses them into stacked convs —
+    measured slower (see point_add).
     """
     x1, y1, z1, _ = _unpack(p)
-    a = F.sqr(x1)
-    b = F.sqr(y1)
-    zz = F.sqr(z1)
+    if _STACK_MULS:
+        s = F.sqr(_pack(x1, y1, z1, F.add(x1, y1)))
+        a, b, zz, s3 = _unpack(s)
+    else:
+        a = F.sqr(x1)
+        b = F.sqr(y1)
+        zz = F.sqr(z1)
+        s3 = F.sqr(F.add(x1, y1))
     c = F.add(zz, zz)
-    e = F.sub(F.sub(F.sqr(F.add(x1, y1)), a), b)   # 2*X1*Y1
+    e = F.sub(F.sub(s3, a), b)                      # 2*X1*Y1
     g = F.sub(b, a)                                 # B - A   (= D + B, D = -A)
     f = F.sub(g, c)
     h = F.neg(F.add(a, b))                          # -(A+B)  (= D - B)
+    if _STACK_MULS:
+        if with_t:
+            return F.mul(_pack(e, g, f, e), _pack(f, h, g, h))
+        out = F.mul(jnp.stack([e, g, f], axis=-2),
+                    jnp.stack([f, h, g], axis=-2))
+        t_zero = jnp.zeros_like(out[..., :1, :])
+        return jnp.concatenate([out, t_zero], axis=-2)
     t_out = F.mul(e, h) if with_t else jnp.zeros_like(x1)
     return _pack(F.mul(e, f), F.mul(g, h), F.mul(f, g), t_out)
 
@@ -223,9 +254,20 @@ def comb_table() -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 def _digit_select(table: jnp.ndarray, digit: jnp.ndarray) -> jnp.ndarray:
-    """table (..., Ktab, 4coord, 32), digit (...,) in [0,K) -> (..., 4, 32)."""
-    idx = digit[..., None, None, None].astype(jnp.int32)
-    return jnp.take_along_axis(table, idx, axis=-3)[..., 0, :, :]
+    """table (..., Ktab, 4coord, 32), digit (...,) in [0,K) -> (..., 4, 32).
+
+    Default: take_along_axis (XLA gather).  HOTSTUFF_TPU_ONEHOT_SELECT=1
+    switches to a one-hot masked sum, which looked 4x better in an isolated
+    microbench but is neutral-to-worse inside the full verify program on a
+    v5e (scripts/PROFILE.md) — kept as an A/B switch.
+    """
+    if not _ONEHOT_SELECT:
+        idx = digit[..., None, None, None].astype(jnp.int32)
+        return jnp.take_along_axis(table, idx, axis=-3)[..., 0, :, :]
+    k = table.shape[-3]
+    d = jax.lax.broadcasted_iota(jnp.int32, (k,), 0)
+    mask = (digit[..., None] == d).astype(table.dtype)[..., None, None]
+    return jnp.sum(table * mask, axis=-3)
 
 
 
@@ -285,6 +327,25 @@ def verify_packed(packed: jnp.ndarray) -> jnp.ndarray:
 verify_packed_jit = jax.jit(verify_packed)
 
 
+def verify_packed_chunked(packed_g: jnp.ndarray) -> jnp.ndarray:
+    """(G, B, 128) uint8 -> (G, B) bool: G sub-batches verified by ONE
+    program (lax.scan over sub-batches).
+
+    The tunneled TPU pays a fixed 15-20 ms per dispatch+sync regardless of
+    batch, while per-conv group counts must stay <= ~1024 for sane compile
+    times — so large backlogs go through this shape: group count stays at
+    the sub-batch size, but G sub-batches share one dispatch.  This is the
+    production launch shape for the sidecar's bulk path and the headline
+    bench (scripts/PROFILE.md "Throughput structure")."""
+    def body(_, chunk):
+        return None, verify_packed(chunk)
+    _, masks = jax.lax.scan(body, None, packed_g)
+    return masks
+
+
+verify_packed_chunked_jit = jax.jit(verify_packed_chunked)
+
+
 def verify_prepared(ay: jnp.ndarray, a_sign: jnp.ndarray,
                     ry: jnp.ndarray, r_sign: jnp.ndarray,
                     s_digits: jnp.ndarray,
@@ -311,12 +372,19 @@ def verify_prepared(ay: jnp.ndarray, a_sign: jnp.ndarray,
       the caller).
     """
     batch_shape = ay.shape[:-1]
-    # Two separate decompressions, NOT one stacked (2, B) call: measured on
-    # a v5e, convs with >1024 groups slow disproportionately (the stacked
-    # variant cost +8.6 ms end-to-end) and N=2048-group programs can take
-    # minutes to compile. Keep every conv at <= batch groups.
-    a_pt, ok_a = decompress(ay, a_sign)
-    r_pt, ok_r = decompress(ry, r_sign)
+    if _JOINT_DECOMPRESS:
+        # One stacked decompression for A and R: halves the length of the
+        # dependent x-recovery pow chain (one conv at 2*batch groups
+        # instead of two dependent batch-group convs).
+        both_pt, ok_both = decompress(jnp.concatenate([ay, ry], axis=0),
+                                      jnp.concatenate([a_sign, r_sign],
+                                                      axis=0))
+        n = ay.shape[0]
+        a_pt, r_pt = both_pt[:n], both_pt[n:]
+        ok_a, ok_r = ok_both[:n], ok_both[n:]
+    else:
+        a_pt, ok_a = decompress(ay, a_sign)
+        r_pt, ok_r = decompress(ry, r_sign)
 
     # -- variable-base half: [k](-A), 4-bit windows ------------------------
     ax, ay_l, az, at = _unpack(a_pt)

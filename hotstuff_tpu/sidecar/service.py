@@ -27,12 +27,18 @@ from . import protocol as proto
 
 log = logging.getLogger("sidecar")
 
-# One coalesced device launch covers at most this many signatures; requests
-# beyond it wait for the next launch. 1024 is a hard sweet spot measured on
-# v5e: the verify program's grouped convolutions degrade sharply past 1024
-# groups (an N=2048 batch shape took minutes to compile and ran worse), so
-# bigger launches would wedge the engine, not speed it up.
-MAX_COALESCED = 1024
+from ..crypto.eddsa import MAX_SUBBATCH  # per-program sub-batch cap
+
+# With bulk mode warmed (--warm-bulk), one coalesced launch drains up to
+# this many queued signatures as sub-batches of MAX_SUBBATCH scanned inside
+# ONE program (ops/ed25519.verify_packed_chunked) — the tunneled device
+# charges a fixed 15-20 ms per dispatch, so scanning beats splitting.  The
+# cap bounds both the compiled scan lengths (g <= 16, the same shape
+# bench.py measures) and how long a bulk backlog can occupy the engine
+# ahead of consensus-latency QC verifies.  Without bulk warmup the launch
+# cap stays at MAX_SUBBATCH so a live backlog can never trigger a
+# first-time XLA compile on the engine thread.
+MAX_COALESCED = 16 * MAX_SUBBATCH
 
 
 class _Pending:
@@ -50,6 +56,11 @@ class VerifyEngine:
         self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=1024)
         self._carry: _Pending | None = None  # over-budget request held over
         self._use_host = use_host
+        # Until the chunked-scan program shapes are warmed (enable_bulk),
+        # launches cap at MAX_SUBBATCH; _warmup covers every padded bucket
+        # up to that cap, so warmed deployments never hit a first-time
+        # compile on this thread.
+        self._launch_cap = MAX_SUBBATCH
         self._mesh = None
         if mesh_devices and mesh_devices > 1:
             from ..parallel.mesh import make_mesh
@@ -62,6 +73,11 @@ class VerifyEngine:
 
     def submit(self, request, reply_fn):
         self._queue.put(_Pending(request, reply_fn))
+
+    def enable_bulk(self):
+        """Raise the per-launch cap to MAX_COALESCED; call only after the
+        chunked-scan shapes have been compiled (see _warmup_bulk)."""
+        self._launch_cap = MAX_COALESCED
 
     def stop(self):
         self._stopped.set()
@@ -91,14 +107,14 @@ class VerifyEngine:
             batch = [item]
             total = len(item.request.msgs)
             # coalesce whatever else is already waiting, up to the launch cap
-            while total < MAX_COALESCED:
+            while total < self._launch_cap:
                 try:
                     nxt = self._queue.get_nowait()
                 except queue.Empty:
                     break
                 if nxt is None:
                     continue
-                if total + len(nxt.request.msgs) > MAX_COALESCED:
+                if total + len(nxt.request.msgs) > self._launch_cap:
                     self._carry = nxt  # runs first in the next launch
                     break
                 batch.append(nxt)
@@ -116,11 +132,18 @@ class VerifyEngine:
             msgs += p.request.msgs
             pks += p.request.pks
             sigs += p.request.sigs
-        # Chunk the launch so a single oversized request can't force a giant
-        # compile shape or device OOM; MAX_COALESCED stays the true cap.
+        # The host/mesh paths verify per sub-batch; the default device path
+        # (eddsa.verify_batch) runs up to a whole launch-cap window as one
+        # chunked-scan dispatch, so the per-dispatch tunnel cost is paid
+        # once.  A single request larger than the cap (the coalescer only
+        # bounds *additional* requests) is still sliced here so no request
+        # can force an unwarmed compile shape or an unbounded device
+        # allocation.
+        step = (MAX_SUBBATCH if self._use_host or self._mesh is not None
+                else self._launch_cap)
         mask = []
-        for i in range(0, len(msgs), MAX_COALESCED):
-            j = i + MAX_COALESCED
+        for i in range(0, len(msgs), step):
+            j = i + step
             mask.extend(self._verify(msgs[i:j], pks[i:j], sigs[i:j]))
         off = 0
         for p in batch:
@@ -247,7 +270,8 @@ class SidecarServer(socketserver.ThreadingTCPServer):
 def serve(host: str = "127.0.0.1", port: int = 7100,
           mesh_devices: int | None = None, use_host: bool = False,
           ready_event: threading.Event | None = None,
-          warm_max: int = 128, warm_bls: bool = False):
+          warm_max: int = MAX_SUBBATCH, warm_bls: bool = False,
+          warm_bulk: bool = False):
     engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host)
     # Warm the jit cache BEFORE binding: until the socket exists, node
     # crypto gets ECONNREFUSED and falls back to host verify instead of
@@ -260,6 +284,14 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         _warmup(engine, warm_max)
         if warm_bls:
             _warmup_bls()
+        if warm_bulk:
+            if engine._mesh is not None:
+                log.warning("--warm-bulk ignored: the mesh-sharded verify "
+                            "path has no chunked-scan program; launches "
+                            "stay capped at %d", MAX_SUBBATCH)
+            else:
+                _warmup_bulk(engine)
+                engine.enable_bulk()
     server = SidecarServer((host, port), engine)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
     if ready_event is not None:
@@ -304,28 +336,42 @@ def _warmup_bls(n_pks: int = 3):
     log.info("BLS pairing warmup done in %.1fs", monotonic() - t0)
 
 
-def _warmup(engine, warm_max: int = 128):
-    """Compile every padded batch shape a live run will hit.
-
-    Requests pad to power-of-two buckets (crypto/eddsa._bucket), so warming
-    N = 8, 16, ... warm_max covers any QC size up to warm_max votes plus the
-    coalesced shapes the engine builds from concurrent requests. Uses the
-    engine's own verify path so the exact jitted callable is cached.
-    """
+def _warm_shapes(engine, start: int, stop: int, label: str):
+    """Compile padded batch shapes start, 2*start, ... stop through the
+    engine's own verify path so the exact jitted callables are cached."""
     from ..crypto import ref_ed25519 as ref
 
     sk = bytes(range(32))
     _, pk = ref.generate_keypair(sk)
     msg = b"\x00" * 32
     sig = ref.sign(sk, msg)
-    n = 8
-    while n <= warm_max:
+    n = start
+    while n <= stop:
         t0 = monotonic()
         mask = engine._verify([msg] * n, [pk] * n, [sig] * n)
         if not all(mask):
-            log.error("warmup verify returned false at N=%d", n)
-        log.info("warmup N=%d done in %.1fs", n, monotonic() - t0)
+            log.error("%s verify returned false at N=%d", label, n)
+        log.info("%s N=%d done in %.1fs", label, n, monotonic() - t0)
         n *= 2
+
+
+def _warmup_bulk(engine):
+    """Compile the chunked-scan shapes (g = 2 .. 16 sub-batches) that bulk
+    coalescing can hit once enable_bulk() raises the launch cap.  Cached
+    across restarts by the persistent compilation cache."""
+    _warm_shapes(engine, 2 * MAX_SUBBATCH, MAX_COALESCED, "bulk warmup")
+
+
+def _warmup(engine, warm_max: int = MAX_SUBBATCH):
+    """Compile every padded batch shape a live run will hit.
+
+    Requests pad to power-of-two buckets (crypto/eddsa._bucket) and the
+    coalescer caps launches at MAX_SUBBATCH, so warming N = 8, 16, ...
+    MAX_SUBBATCH covers every shape the engine can launch (a smaller
+    warm_max trades boot time for possible mid-traffic compiles). Uses the
+    engine's own verify path so the exact jitted callable is cached.
+    """
+    _warm_shapes(engine, 8, warm_max, "warmup")
 
 
 def main(argv=None):
@@ -336,12 +382,17 @@ def main(argv=None):
                     help="shard verify over an N-device mesh (0 = single)")
     ap.add_argument("--host-crypto", action="store_true",
                     help="pure-host verification (debug/fallback)")
-    ap.add_argument("--warm", type=int, default=128,
+    ap.add_argument("--warm", type=int, default=MAX_SUBBATCH,
                     help="largest batch shape to pre-compile before "
-                         "listening (power-of-two buckets up to this)")
+                         "listening (power-of-two buckets up to this; "
+                         "default covers every launchable shape)")
     ap.add_argument("--warm-bls", action="store_true",
                     help="also pre-compile the BLS pairing program "
                          "(scheme=bls deployments)")
+    ap.add_argument("--warm-bulk", action="store_true",
+                    help="also pre-compile the chunked-scan bulk shapes and "
+                         "raise the per-launch cap to %d sigs (bulk/offchain "
+                         "workloads)" % MAX_COALESCED)
     ap.add_argument("-v", "--verbose", action="count", default=0)
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -350,7 +401,7 @@ def main(argv=None):
         datefmt="%Y-%m-%dT%H:%M:%S")
     serve(args.host, args.port, mesh_devices=args.mesh or None,
           use_host=args.host_crypto, warm_max=args.warm,
-          warm_bls=args.warm_bls)
+          warm_bls=args.warm_bls, warm_bulk=args.warm_bulk)
 
 
 if __name__ == "__main__":

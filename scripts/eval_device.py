@@ -1,0 +1,61 @@
+"""Reliable device-cost eval for verify_packed: slope between G=2 and G=10
+chunked-scan calls (cancels fixed tunnel overhead), min over trials
+(cancels latency spikes).  Prints one number: device ms per 1024-batch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
+from hotstuff_tpu.ops import ed25519 as E
+
+N = 1024
+
+
+def measure(packed_np, G, trials=5, reps=3):
+    verify_chunked = E.verify_packed_chunked_jit  # the shipped program
+
+    big = jnp.asarray(np.broadcast_to(packed_np, (G, N, 128)).copy())
+    out = verify_chunked(big)
+    assert np.asarray(out).all()
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = verify_chunked(big)
+        np.asarray(out)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(7)
+    msgs, pks, sigs = [], [], []
+    for _ in range(N):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        m = rng.bytes(64)
+        msgs.append(m)
+        pks.append(pk)
+        sigs.append(ref.sign(sk, m))
+    prep = eddsa.prepare_batch(msgs, pks, sigs)
+    packed_np = prep["packed"]
+
+    t2 = measure(packed_np, 2)
+    t10 = measure(packed_np, 10)
+    slope = (t10 - t2) / 8
+    print(f"G2 {t2*1e3:.2f} ms, G10 {t10*1e3:.2f} ms")
+    print(f"DEVICE {slope*1e3:.2f} ms/1024  ({N/slope:,.0f} sigs/s ceiling)")
+
+
+if __name__ == "__main__":
+    main()

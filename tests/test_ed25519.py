@@ -109,3 +109,20 @@ def test_fuzz_device_matches_reference():
         expect.append(ref.verify(pk, msg, sig))
     mask = eddsa.verify_batch(msgs, pks, sigs)
     assert list(mask) == expect
+
+
+def test_chunked_batch_over_subbatch_cap():
+    """n > MAX_SUBBATCH runs as a chunked-scan single dispatch; the chunk
+    count rounds to the next power of two (1500 -> g=2), not the row
+    bucket's minimum of 8."""
+    n = eddsa.MAX_SUBBATCH + 476
+    triples = make_sigs(4, seed=13)
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        m, p, s = triples[i % 4]
+        msgs.append(m); pks.append(p); sigs.append(s)
+    sigs[eddsa.MAX_SUBBATCH + 7] = bytes(64)  # invalid, lands in chunk 2
+    mask = eddsa.verify_batch(msgs, pks, sigs)
+    assert mask.shape == (n,)
+    assert not mask[eddsa.MAX_SUBBATCH + 7]
+    assert mask.sum() == n - 1

@@ -106,26 +106,54 @@ def tpu_throughput(msgs, pks, sigs) -> float:
     out = verify_chunked(jnp.asarray(prep_round()))   # compile + warmup
     assert np.asarray(out).all(), "benchmark signatures must verify"
 
+    # One prep thread: host preparation of round i+1 overlaps BOTH the
+    # device compute and the blocking tunnel transfers of round i (the
+    # SHA-512 loop releases the GIL; transfers block in C).  Every round's
+    # full prep cost is still paid inside the timed window.
+    from concurrent.futures import ThreadPoolExecutor
+
     best = 0.0
-    for _ in range(TRIALS):
-        t0 = time.perf_counter()
-        pending = None
-        for _ in range(ROUNDS):
-            pending = verify_chunked(jnp.asarray(prep_round()))
-        final = np.asarray(pending)
-        dt = time.perf_counter() - t0
-        assert final.all(), "benchmark signatures must verify"
-        best = max(best, G * N * ROUNDS / dt)
+    with ThreadPoolExecutor(1) as pool:
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            fut = pool.submit(prep_round)
+            pending = None
+            for r in range(ROUNDS):
+                arr = fut.result()
+                if r + 1 < ROUNDS:
+                    fut = pool.submit(prep_round)
+                pending = verify_chunked(jnp.asarray(arr))
+            final = np.asarray(pending)
+            dt = time.perf_counter() - t0
+            assert final.all(), "benchmark signatures must verify"
+            best = max(best, G * N * ROUNDS / dt)
     return best
 
 
 def main():
+    # Watchdog: the tunneled TPU can wedge indefinitely (observed: a plain
+    # 8x8 matmul never returning).  A hung bench is worse than a failed
+    # one — the driver's round-end run must always terminate.
+    import os
+    import threading
+
+    def _abort():
+        print(json.dumps({"metric": "ed25519-batch-verify", "value": 0,
+                          "unit": "sigs/sec", "vs_baseline": 0,
+                          "error": "watchdog: TPU unresponsive for 900s"}))
+        os._exit(3)
+
+    watchdog = threading.Timer(900.0, _abort)
+    watchdog.daemon = True
+    watchdog.start()
+
     from hotstuff_tpu.ops import field25519
 
     field25519.mul_selfcheck()  # trip fast if this backend's conv is inexact
     msgs, pks, sigs = make_batch()
     cpu = cpu_baseline(msgs, pks, sigs)
     tpu = tpu_throughput(msgs, pks, sigs)
+    watchdog.cancel()
     print(json.dumps({
         "metric": "ed25519-batch-verify",
         "value": round(tpu, 1),

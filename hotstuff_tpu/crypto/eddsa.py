@@ -70,14 +70,21 @@ def prepare_batch(msgs, pks, sigs):
     """
     n = len(msgs)
     assert len(pks) == n and len(sigs) == n
-    pk_arr = np.zeros((n, 32), np.uint8)
-    sig_arr = np.zeros((n, 64), np.uint8)
-    len_ok = np.zeros((n,), bool)
-    for i, (pk, sig) in enumerate(zip(pks, sigs)):
-        if len(pk) == 32 and len(sig) == 64:
-            pk_arr[i] = np.frombuffer(pk, np.uint8)
-            sig_arr[i] = np.frombuffer(sig, np.uint8)
-            len_ok[i] = True
+    if all(len(pk) == 32 for pk in pks) and all(len(s) == 64 for s in sigs):
+        # Common case: two bulk copies instead of 2n per-row frombuffers
+        # (the per-row path costs ~2 us/sig of pure python overhead).
+        pk_arr = np.frombuffer(b"".join(pks), np.uint8).reshape(n, 32).copy()
+        sig_arr = np.frombuffer(b"".join(sigs), np.uint8).reshape(n, 64).copy()
+        len_ok = np.ones((n,), bool)
+    else:
+        pk_arr = np.zeros((n, 32), np.uint8)
+        sig_arr = np.zeros((n, 64), np.uint8)
+        len_ok = np.zeros((n,), bool)
+        for i, (pk, sig) in enumerate(zip(pks, sigs)):
+            if len(pk) == 32 and len(sig) == 64:
+                pk_arr[i] = np.frombuffer(pk, np.uint8)
+                sig_arr[i] = np.frombuffer(sig, np.uint8)
+                len_ok[i] = True
 
     ay_b = pk_arr.copy()
     ay_b[:, 31] &= 0x7F

@@ -33,6 +33,8 @@ def cmd_local(args):
         scheme=args.scheme if args.scheme != "ed25519" else None)
     node_params.json["mempool"]["batch_size"] = args.batch_size
     node_params.json["consensus"]["timeout_delay"] = args.timeout
+    if args.chain != 2:
+        node_params.json["consensus"]["chain_depth"] = args.chain
     try:
         ret = LocalBench(bench_params, node_params).run(debug=args.debug)
         print(ret.result())
@@ -199,6 +201,8 @@ def main(argv=None):
     p.add_argument("--duration", type=int, default=30, help="seconds")
     p.add_argument("--tpu-sidecar", action="store_true",
                    help="route QC verification through the TPU sidecar")
+    p.add_argument("--chain", type=int, choices=[2, 3], default=2,
+                   help="commit-rule depth: 2-chain (default) or 3-chain")
     p.add_argument("--scheme", choices=["ed25519", "bls"],
                    default="ed25519",
                    help="signature scheme (bls implies --tpu-sidecar)")

@@ -123,6 +123,9 @@ class NodeParameters:
         sidecar = json_input.get("tpu_sidecar")
         if sidecar is not None and not isinstance(sidecar, str):
             raise ConfigError("tpu_sidecar must be an address string")
+        chain = json_input["consensus"].get("chain_depth", 2)
+        if chain not in (2, 3):
+            raise ConfigError("chain_depth must be 2 or 3")
         self.timeout_delay = json_input["consensus"]["timeout_delay"]
         self.json = json_input
 

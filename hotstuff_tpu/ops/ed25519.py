@@ -43,6 +43,13 @@ import os as _os
 _STACK_MULS = _os.environ.get("HOTSTUFF_TPU_STACK_MULS", "0") == "1"
 _ONEHOT_SELECT = _os.environ.get("HOTSTUFF_TPU_ONEHOT_SELECT", "0") == "1"
 _JOINT_DECOMPRESS = _os.environ.get("HOTSTUFF_TPU_JOINT_DECOMPRESS", "1") == "1"
+# Carry point coordinates through the ladder/comb scans as a 4-tuple of
+# (B, 32) arrays instead of one stacked (B, 4, 32) array. Hypothesis was
+# that _pack/_unpack in the scan body cost real data movement; measured on
+# a v5e the packed layout is consistently ~1-2 ms/batch FASTER (XLA fuses
+# the packing; the stacked table gather beats 4 per-coordinate gathers),
+# so the default stays packed.
+_TUPLE_POINTS = _os.environ.get("HOTSTUFF_TPU_TUPLE_POINTS", "0") == "1"
 
 
 # ---------------------------------------------------------------------------
@@ -71,9 +78,7 @@ def basepoint_ext() -> jnp.ndarray:
 
 
 def to_cached(p: jnp.ndarray) -> jnp.ndarray:
-    x, y, z, t = _unpack(p)
-    k2d = jnp.broadcast_to(_const(K2D), t.shape)
-    return _pack(F.add(y, x), F.sub(y, x), z, F.mul(t, k2d))
+    return _pack(*to_cached_t(_unpack(p)))
 
 
 def cached_neg(c: jnp.ndarray) -> jnp.ndarray:
@@ -94,25 +99,19 @@ def point_add(p: jnp.ndarray, qc: jnp.ndarray) -> jnp.ndarray:
     end-to-end on a v5e (scripts/PROFILE.md), kept only as an A/B switch
     for future backends.
     """
+    if not _STACK_MULS:
+        return _pack(*add_t(_unpack(p), _unpack(qc)))
     x1, y1, z1, t1 = _unpack(p)
     ypx2, ymx2, z2, t2d2 = _unpack(qc)
-    if _STACK_MULS:
-        m = F.mul(_pack(F.sub(y1, x1), F.add(y1, x1), t1, z1),
-                  _pack(ymx2, ypx2, t2d2, z2))
-        a, b, c, zz = _unpack(m)
-    else:
-        a = F.mul(F.sub(y1, x1), ymx2)
-        b = F.mul(F.add(y1, x1), ypx2)
-        c = F.mul(t1, t2d2)
-        zz = F.mul(z1, z2)
+    m = F.mul(_pack(F.sub(y1, x1), F.add(y1, x1), t1, z1),
+              _pack(ymx2, ypx2, t2d2, z2))
+    a, b, c, zz = _unpack(m)
     d = F.add(zz, zz)
     e = F.sub(b, a)
     f = F.sub(d, c)
     g = F.add(d, c)
     h = F.add(b, a)
-    if _STACK_MULS:
-        return F.mul(_pack(e, g, f, e), _pack(f, h, g, h))
-    return _pack(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    return F.mul(_pack(e, g, f, e), _pack(f, h, g, h))
 
 
 def point_dbl(p: jnp.ndarray, with_t: bool = True) -> jnp.ndarray:
@@ -126,36 +125,33 @@ def point_dbl(p: jnp.ndarray, with_t: bool = True) -> jnp.ndarray:
     measured slower (see point_add).
     """
     x1, y1, z1, _ = _unpack(p)
-    if _STACK_MULS:
-        s = F.sqr(_pack(x1, y1, z1, F.add(x1, y1)))
-        a, b, zz, s3 = _unpack(s)
-    else:
-        a = F.sqr(x1)
-        b = F.sqr(y1)
-        zz = F.sqr(z1)
-        s3 = F.sqr(F.add(x1, y1))
+    if not _STACK_MULS:
+        out = dbl_t((x1, y1, z1), with_t=with_t)
+        if with_t:
+            return _pack(*out)
+        return _pack(*out, jnp.zeros_like(x1))
+    s = F.sqr(_pack(x1, y1, z1, F.add(x1, y1)))
+    a, b, zz, s3 = _unpack(s)
     c = F.add(zz, zz)
     e = F.sub(F.sub(s3, a), b)                      # 2*X1*Y1
     g = F.sub(b, a)                                 # B - A   (= D + B, D = -A)
     f = F.sub(g, c)
     h = F.neg(F.add(a, b))                          # -(A+B)  (= D - B)
-    if _STACK_MULS:
-        if with_t:
-            return F.mul(_pack(e, g, f, e), _pack(f, h, g, h))
-        out = F.mul(jnp.stack([e, g, f], axis=-2),
-                    jnp.stack([f, h, g], axis=-2))
-        t_zero = jnp.zeros_like(out[..., :1, :])
-        return jnp.concatenate([out, t_zero], axis=-2)
-    t_out = F.mul(e, h) if with_t else jnp.zeros_like(x1)
-    return _pack(F.mul(e, f), F.mul(g, h), F.mul(f, g), t_out)
+    if with_t:
+        return F.mul(_pack(e, g, f, e), _pack(f, h, g, h))
+    out = F.mul(jnp.stack([e, g, f], axis=-2),
+                jnp.stack([f, h, g], axis=-2))
+    t_zero = jnp.zeros_like(out[..., :1, :])
+    return jnp.concatenate([out, t_zero], axis=-2)
 
 
 # ---------------------------------------------------------------------------
 # Decompression (x-recovery), fully on device
 # ---------------------------------------------------------------------------
 
-def decompress(y_limbs: jnp.ndarray, sign_bit: jnp.ndarray):
-    """(..., 32) canonical y limbs + (...,) sign bit -> (ext point, ok mask).
+def decompress_t(y_limbs: jnp.ndarray, sign_bit: jnp.ndarray):
+    """(..., 32) canonical y limbs + (...,) sign bit ->
+    ((x, y, z, t) tuple, ok mask).
 
     RFC 8032 §5.1.3 x-recovery: x = u v^3 (u v^7)^((p-5)/8), with u = y²-1,
     v = d y²+1; multiply by sqrt(-1) when v x² = -u; fail when neither.
@@ -182,7 +178,66 @@ def decompress(y_limbs: jnp.ndarray, sign_bit: jnp.ndarray):
     ok = ok & ~(x_zero & (sign_bit == 1))
     t = F.mul(x, y_limbs)
     z = jnp.broadcast_to(_const(1), y_limbs.shape)
-    return _pack(x, y_limbs, z, t), ok
+    return (x, y_limbs, z, t), ok
+
+
+def decompress(y_limbs: jnp.ndarray, sign_bit: jnp.ndarray):
+    """Packed-layout wrapper over decompress_t: -> ((..., 4, 32) ext, ok)."""
+    (x, y, z, t), ok = decompress_t(y_limbs, sign_bit)
+    return _pack(x, y, z, t), ok
+
+
+# ---------------------------------------------------------------------------
+# Tuple-layout point ops (the scan-hot-loop form; see _TUPLE_POINTS)
+# ---------------------------------------------------------------------------
+
+def identity_t(batch_shape=()):
+    one = jnp.broadcast_to(_const(1), (*batch_shape, F.NLIMBS))
+    zero = jnp.broadcast_to(_const(0), (*batch_shape, F.NLIMBS))
+    return (zero, one, one, zero)
+
+
+def to_cached_t(p):
+    """(x, y, z, t) -> cached (y+x, y-x, z, 2d*t)."""
+    x, y, z, t = p
+    k2d = jnp.broadcast_to(_const(K2D), t.shape)
+    return (F.add(y, x), F.sub(y, x), z, F.mul(t, k2d))
+
+
+def add_t(p, qc):
+    """Complete unified addition on tuples: ext + cached -> ext (8 muls,
+    separate batch-group convs — the measured-best conv shape)."""
+    x1, y1, z1, t1 = p
+    ypx2, ymx2, z2, t2d2 = qc
+    a = F.mul(F.sub(y1, x1), ymx2)
+    b = F.mul(F.add(y1, x1), ypx2)
+    c = F.mul(t1, t2d2)
+    zz = F.mul(z1, z2)
+    d = F.add(zz, zz)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def dbl_t(p, with_t: bool = True):
+    """Doubling on tuples (dbl-2008-hwcd, a=-1): 4M+4S (3M+4S w/o T).
+
+    Accepts a 3-tuple (x, y, z) or 4-tuple (T input unused); returns a
+    3-tuple when with_t=False."""
+    x1, y1, z1 = p[0], p[1], p[2]
+    a = F.sqr(x1)
+    b = F.sqr(y1)
+    zz = F.sqr(z1)
+    c = F.add(zz, zz)
+    e = F.sub(F.sub(F.sqr(F.add(x1, y1)), a), b)   # 2*X1*Y1
+    g = F.sub(b, a)
+    f = F.sub(g, c)
+    h = F.neg(F.add(a, b))
+    if with_t:
+        return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g))
 
 
 # ---------------------------------------------------------------------------
@@ -376,54 +431,98 @@ def verify_prepared(ay: jnp.ndarray, a_sign: jnp.ndarray,
         # One stacked decompression for A and R: halves the length of the
         # dependent x-recovery pow chain (one conv at 2*batch groups
         # instead of two dependent batch-group convs).
-        both_pt, ok_both = decompress(jnp.concatenate([ay, ry], axis=0),
-                                      jnp.concatenate([a_sign, r_sign],
-                                                      axis=0))
+        both_pt, ok_both = decompress_t(
+            jnp.concatenate([ay, ry], axis=0),
+            jnp.concatenate([a_sign, r_sign], axis=0))
         n = ay.shape[0]
-        a_pt, r_pt = both_pt[:n], both_pt[n:]
+        a_pt = tuple(c[:n] for c in both_pt)
+        r_pt = tuple(c[n:] for c in both_pt)
         ok_a, ok_r = ok_both[:n], ok_both[n:]
     else:
-        a_pt, ok_a = decompress(ay, a_sign)
-        r_pt, ok_r = decompress(ry, r_sign)
+        a_pt, ok_a = decompress_t(ay, a_sign)
+        r_pt, ok_r = decompress_t(ry, r_sign)
 
     # -- variable-base half: [k](-A), 4-bit windows ------------------------
-    ax, ay_l, az, at = _unpack(a_pt)
-    neg_a_ext = _pack(F.neg(ax), ay_l, az, F.neg(at))
-    neg_a_cached = to_cached(neg_a_ext)
+    ax, ay_l, az, at = a_pt
+    neg_a = (F.neg(ax), ay_l, az, F.neg(at))
+    neg_a_cached = to_cached_t(neg_a)
     # 16-entry table of d*(-A), d = 0..15, in cached form.
-    entries = [identity_ext(batch_shape), neg_a_ext]
+    entries = [identity_t(batch_shape), neg_a]
     for _ in range(2, 16):
-        entries.append(point_add(entries[-1], neg_a_cached))
-    table = jnp.stack([to_cached(e) for e in entries], axis=-3)
+        entries.append(add_t(entries[-1], neg_a_cached))
+    cached_entries = [to_cached_t(e) for e in entries]
 
-    def ladder_body(p, digit_row):
-        p = point_dbl(p, with_t=False)
-        p = point_dbl(p, with_t=False)
-        p = point_dbl(p, with_t=False)
-        p = point_dbl(p)  # the add below reads T
-        p = point_add(p, _digit_select(table, digit_row))
-        return p, None
+    if _TUPLE_POINTS:
+        # Per-coordinate tables: 4 arrays of (..., 16, 32); selection is 4
+        # per-coordinate gathers, and the scan carry is a coordinate tuple
+        # (no stacked-layout packing anywhere in the hot loop).
+        table_t = tuple(
+            jnp.stack([e[c] for e in cached_entries], axis=-2)
+            for c in range(4))
 
-    ka_pt, _ = jax.lax.scan(ladder_body, identity_ext(batch_shape),
-                            jnp.moveaxis(k_digits, -1, 0))
+        def select_t(digit_row):
+            idx = digit_row[..., None, None].astype(jnp.int32)
+            return tuple(
+                jnp.take_along_axis(tc, idx, axis=-2)[..., 0, :]
+                for tc in table_t)
 
-    # -- fixed-base half: [S]B via the comb --------------------------------
-    comb = jnp.asarray(comb_table())  # (32, 256, 4, 32) constant
+        def ladder_body(p, digit_row):
+            p = dbl_t(p, with_t=False)
+            p = dbl_t(p, with_t=False)
+            p = dbl_t(p, with_t=False)
+            p = dbl_t(p)  # the add below reads T
+            p = add_t(p, select_t(digit_row))
+            return p, None
 
-    def comb_body(acc, xs):
-        comb_j, digit_row = xs
-        entry = jnp.take(comb_j, digit_row, axis=0)  # (B, 4, 32)
-        return point_add(acc, entry), None
+        ka_pt, _ = jax.lax.scan(ladder_body, identity_t(batch_shape),
+                                jnp.moveaxis(k_digits, -1, 0))
 
-    sb_pt, _ = jax.lax.scan(
-        comb_body, identity_ext(batch_shape),
-        (comb, jnp.moveaxis(s_digits, -1, 0)))
+        # -- fixed-base half: [S]B via the comb ----------------------------
+        comb = jnp.asarray(comb_table())  # (32, 256, 4, 32) constant
+        comb_coords = tuple(comb[:, :, c, :] for c in range(4))
 
-    # -- combine and compare ----------------------------------------------
-    lhs = point_add(sb_pt, to_cached(ka_pt))  # [S]B - [k]A
-    x3, y3, z3, _ = _unpack(lhs)
-    rx, ry_, rz, _ = _unpack(r_pt)
-    # Projective equality, all four cross-products in one conv.
+        def comb_body(acc, xs):
+            digit_row = xs[-1]
+            entry = tuple(jnp.take(cj, digit_row, axis=0) for cj in xs[:4])
+            return add_t(acc, entry), None
+
+        sb_pt, _ = jax.lax.scan(
+            comb_body, identity_t(batch_shape),
+            (*comb_coords, jnp.moveaxis(s_digits, -1, 0)))
+
+        lhs = add_t(sb_pt, to_cached_t(ka_pt))  # [S]B - [k]A
+        x3, y3, z3 = lhs[0], lhs[1], lhs[2]
+        rx, ry_, rz = r_pt[0], r_pt[1], r_pt[2]
+    else:
+        table = jnp.stack([_pack(*e) for e in cached_entries], axis=-3)
+
+        def ladder_body(p, digit_row):
+            p = point_dbl(p, with_t=False)
+            p = point_dbl(p, with_t=False)
+            p = point_dbl(p, with_t=False)
+            p = point_dbl(p)  # the add below reads T
+            p = point_add(p, _digit_select(table, digit_row))
+            return p, None
+
+        ka_pt, _ = jax.lax.scan(ladder_body, identity_ext(batch_shape),
+                                jnp.moveaxis(k_digits, -1, 0))
+
+        comb = jnp.asarray(comb_table())  # (32, 256, 4, 32) constant
+
+        def comb_body(acc, xs):
+            comb_j, digit_row = xs
+            entry = jnp.take(comb_j, digit_row, axis=0)  # (B, 4, 32)
+            return point_add(acc, entry), None
+
+        sb_pt, _ = jax.lax.scan(
+            comb_body, identity_ext(batch_shape),
+            (comb, jnp.moveaxis(s_digits, -1, 0)))
+
+        lhs = point_add(sb_pt, to_cached(ka_pt))
+        x3, y3, z3, _ = _unpack(lhs)
+        rx, ry_, rz = r_pt[0], r_pt[1], r_pt[2]
+
+    # -- projective equality: all four cross-products in one conv ----------
     cross = F.canonical(F.mul(_pack(x3, rx, y3, ry_),
                               _pack(rz, z3, rz, z3)))
     ok_eq = jnp.all(cross[..., 0, :] == cross[..., 1, :], axis=-1) & \

@@ -292,6 +292,33 @@ def inv(x: jnp.ndarray) -> jnp.ndarray:
     return pow_const(x, P - 2)
 
 
+def _pow_2k(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """x^(2^k) — k squarings; scanned for k >= 8 to keep the program small."""
+    if k < 8:
+        for _ in range(k):
+            x = sqr(x)
+        return x
+    out, _ = jax.lax.scan(lambda a, _: (sqr(a), None), x, None, length=k)
+    return out
+
+
 def pow_p58(x: jnp.ndarray) -> jnp.ndarray:
-    """x^((p-5)/8), the core of the square-root used in point decompression."""
-    return pow_const(x, (P - 5) // 8)
+    """x^((p-5)/8) = x^(2^252 - 3), the x-recovery exponent.
+
+    Uses the standard ref10-style addition chain (2^252 - 3 =
+    4*(2^250 - 1) + 1): 251 squarings + 11 multiplies ≈ 262 dependent ops,
+    vs ~329 for the generic 4-bit windowed pow_const — the decompression
+    pow chain is the longest serial dependency in verification, so ~20%
+    off it is free latency.
+    """
+    x2 = mul(sqr(x), x)                       # x^(2^2 - 1)
+    x4 = mul(_pow_2k(x2, 2), x2)              # x^(2^4 - 1)
+    x5 = mul(sqr(x4), x)                      # x^(2^5 - 1)
+    x10 = mul(_pow_2k(x5, 5), x5)             # x^(2^10 - 1)
+    x20 = mul(_pow_2k(x10, 10), x10)          # x^(2^20 - 1)
+    x40 = mul(_pow_2k(x20, 20), x20)          # x^(2^40 - 1)
+    x50 = mul(_pow_2k(x40, 10), x10)          # x^(2^50 - 1)
+    x100 = mul(_pow_2k(x50, 50), x50)         # x^(2^100 - 1)
+    x200 = mul(_pow_2k(x100, 100), x100)      # x^(2^200 - 1)
+    x250 = mul(_pow_2k(x200, 50), x50)        # x^(2^250 - 1)
+    return mul(_pow_2k(x250, 2), x)           # x^(2^252 - 3)

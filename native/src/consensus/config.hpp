@@ -20,11 +20,20 @@ using Round = uint64_t;
 struct Parameters {
   uint64_t timeout_delay = 5'000;      // ms
   uint64_t sync_retry_delay = 10'000;  // ms
+  // Commit-rule depth: 2 = 2-chain HotStuff (the reference's main branch),
+  // 3 = 3-chain (the variant behind benchmark/data/3-chain/ in the
+  // reference's published results; one extra round of commit latency).
+  uint32_t chain_depth = 2;
 
   static Parameters from_json(const Json& j) {
     Parameters p;
     if (auto* v = j.find("timeout_delay")) p.timeout_delay = v->as_u64();
     if (auto* v = j.find("sync_retry_delay")) p.sync_retry_delay = v->as_u64();
+    if (auto* v = j.find("chain_depth")) {
+      p.chain_depth = uint32_t(v->as_u64());
+      if (p.chain_depth < 2 || p.chain_depth > 3)
+        throw std::runtime_error("chain_depth must be 2 or 3");
+    }
     return p;
   }
 
@@ -35,6 +44,8 @@ struct Parameters {
         << "Timeout delay set to " << timeout_delay << " ms";
     LOG_INFO("consensus::config")
         << "Sync retry delay set to " << sync_retry_delay << " ms";
+    LOG_INFO("consensus::config")
+        << "Chain depth set to " << chain_depth;
   }
 };
 

@@ -19,7 +19,7 @@ class CoreImpl {
            std::shared_ptr<LeaderElector> leader_elector,
            std::shared_ptr<MempoolDriver> mempool_driver,
            std::shared_ptr<Synchronizer> synchronizer, uint64_t timeout_delay,
-           ChannelPtr<CoreEvent> rx_event,
+           uint32_t chain_depth, ChannelPtr<CoreEvent> rx_event,
            ChannelPtr<ProposerMessage> tx_proposer,
            ChannelPtr<Block> tx_commit)
       : name_(name),
@@ -30,6 +30,7 @@ class CoreImpl {
         mempool_driver_(std::move(mempool_driver)),
         synchronizer_(std::move(synchronizer)),
         timeout_delay_(timeout_delay),
+        chain_depth_(chain_depth),
         rx_event_(std::move(rx_event)),
         tx_proposer_(std::move(tx_proposer)),
         tx_commit_(std::move(tx_commit)),
@@ -278,8 +279,25 @@ class CoreImpl {
     store_block(block);
     cleanup_proposer(b0, b1, block);
 
-    // 2-chain commit rule (core.rs:363-366).
-    if (b0.round + 1 == b1.round) {
+    // Commit rule (core.rs:363-366). 2-chain: b0 commits once its direct
+    // descendant b1 is certified in the next round (block.qc certifies b1,
+    // so this processing event is the earliest proof). 3-chain (upstream
+    // HotStuff; the variant behind the reference's benchmark/data/3-chain
+    // results): commit requires THREE consecutive certified rounds
+    // g0 <- b0 <- b1, so the candidate is one generation older and lands
+    // one round later than 2-chain.
+    if (chain_depth_ == 3) {
+      if (b0.round + 1 == b1.round) {
+        auto g0 = synchronizer_->get_parent_block(b0);
+        // nullopt fires a sync request; the commit() catch-up walk of a
+        // later block commits g0 once it arrives.
+        if (g0 && g0->round + 1 == b0.round) {
+          mempool_driver_->cleanup(g0->round);
+          VerifyResult r = commit(*g0);
+          if (!r.ok()) return r;
+        }
+      }
+    } else if (b0.round + 1 == b1.round) {
       mempool_driver_->cleanup(b0.round);
       VerifyResult r = commit(b0);
       if (!r.ok()) return r;
@@ -333,6 +351,7 @@ class CoreImpl {
   std::shared_ptr<MempoolDriver> mempool_driver_;
   std::shared_ptr<Synchronizer> synchronizer_;
   uint64_t timeout_delay_;
+  uint32_t chain_depth_ = 2;
   ChannelPtr<CoreEvent> rx_event_;
   ChannelPtr<ProposerMessage> tx_proposer_;
   ChannelPtr<Block> tx_commit_;
@@ -353,15 +372,16 @@ std::thread Core::spawn(PublicKey name, Committee committee,
                         std::shared_ptr<LeaderElector> leader_elector,
                         std::shared_ptr<MempoolDriver> mempool_driver,
                         std::shared_ptr<Synchronizer> synchronizer,
-                        uint64_t timeout_delay, ChannelPtr<CoreEvent> rx_event,
+                        uint64_t timeout_delay, uint32_t chain_depth,
+                        ChannelPtr<CoreEvent> rx_event,
                         ChannelPtr<ProposerMessage> tx_proposer,
                         ChannelPtr<Block> tx_commit) {
   return std::thread([=] {
     CoreImpl core(name, std::move(committee), std::move(signature_service),
                   std::move(store), std::move(leader_elector),
                   std::move(mempool_driver), std::move(synchronizer),
-                  timeout_delay, std::move(rx_event), std::move(tx_proposer),
-                  std::move(tx_commit));
+                  timeout_delay, chain_depth, std::move(rx_event),
+                  std::move(tx_proposer), std::move(tx_commit));
     core.run();
   });
 }

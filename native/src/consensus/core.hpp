@@ -57,7 +57,7 @@ class Core {
                            std::shared_ptr<LeaderElector> leader_elector,
                            std::shared_ptr<MempoolDriver> mempool_driver,
                            std::shared_ptr<Synchronizer> synchronizer,
-                           uint64_t timeout_delay,
+                           uint64_t timeout_delay, uint32_t chain_depth,
                            ChannelPtr<CoreEvent> rx_event,
                            ChannelPtr<ProposerMessage> tx_proposer,
                            ChannelPtr<Block> tx_commit);

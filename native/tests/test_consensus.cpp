@@ -82,7 +82,7 @@ struct CoreFixture {
 
   // Spawns a core for fixture key `idx` with the given committee.
   void spawn_core(size_t idx, const Committee& committee,
-                  uint64_t timeout_delay = 60'000) {
+                  uint64_t timeout_delay = 60'000, uint32_t chain_depth = 2) {
     auto kp = keys()[idx];
     SignatureService service(kp.secret);
     auto leader_elector = std::make_shared<LeaderElector>(committee);
@@ -92,7 +92,8 @@ struct CoreFixture {
         kp.name, committee, store, tx_core, /*sync_retry_delay=*/60'000);
     core_thread = Core::spawn(kp.name, committee, service, store,
                               leader_elector, mempool_driver, synchronizer,
-                              timeout_delay, tx_core, tx_proposer, tx_commit);
+                              timeout_delay, chain_depth, tx_core,
+                              tx_proposer, tx_commit);
   }
 
   ~CoreFixture() {
@@ -200,6 +201,50 @@ TEST(core_commits_two_chain) {
     fx.tx_core->send(CoreEvent::msg(
         ConsensusMessage::deserialize(ConsensusMessage::propose(b))));
   }
+  auto committed = fx.tx_commit->recv();
+  CHECK(committed.has_value());
+  CHECK(committed->round == 1);
+  CHECK(committed->digest() == chain[0].digest());
+}
+
+TEST(core_commits_three_chain_one_round_later) {
+  // Under chain_depth=3 the commit rule needs THREE consecutive certified
+  // rounds: processing blocks 1..3 (which under 2-chain already commits
+  // block 1) must commit nothing, and block 4 then commits block 1 — the
+  // "+1 round of commit latency" the 3-chain variant exists to measure.
+  auto committee = consensus_committee(8700);
+  CoreFixture fx;
+  auto ks = keys();
+  auto sorted = committee.sorted_keys();
+  auto key_for = [&](const PublicKey& name) -> const KeyPair& {
+    for (const auto& kp : ks) {
+      if (kp.name == name) return kp;
+    }
+    throw std::runtime_error("unknown leader");
+  };
+  std::vector<Block> chain;
+  QC qc;
+  for (uint64_t round = 1; round <= 4; round++) {
+    Bytes payload_bytes{uint8_t(round)};
+    Digest payload = sha512_digest(payload_bytes);
+    fx.store.write(payload.to_bytes(), payload_bytes);
+    Block b = make_block(qc, key_for(sorted[round % sorted.size()]), round,
+                         {payload});
+    qc = make_qc(b.digest(), b.round);
+    chain.push_back(std::move(b));
+  }
+  fx.spawn_core(0, committee, /*timeout_delay=*/60'000, /*chain_depth=*/3);
+  for (size_t i = 0; i < 3; i++) {
+    fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+        ConsensusMessage::propose(chain[i]))));
+  }
+  Block none;
+  auto status = fx.tx_commit->recv_until(
+      &none, std::chrono::steady_clock::now() + std::chrono::milliseconds(500));
+  CHECK(status == RecvStatus::kTimeout);  // 2-chain would have committed B1
+
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::propose(chain[3]))));
   auto committed = fx.tx_commit->recv();
   CHECK(committed.has_value());
   CHECK(committed->round == 1);

@@ -91,4 +91,76 @@ TEST(four_nodes_commit_same_block) {
   for (auto& n : nodes) n->stop();
 }
 
+TEST(four_nodes_commit_under_3chain_rule) {
+  // Same quartet under chain_depth=3 (the reference's 3-chain data variant,
+  // benchmark/data/3-chain/): commits require a third consecutive round, so
+  // a committed block proves the deeper rule fires end to end.
+  std::system("rm -rf /tmp/.hs_e2e3 && mkdir -p /tmp/.hs_e2e3");
+  const std::string dir = "/tmp/.hs_e2e3/";
+
+  node::Committee committee;
+  committee.consensus = consensus_committee(9700);
+  committee.mempool = mempool_committee(9710);
+  committee.write(dir + "committee.json");
+  {
+    Json params = Json::object();
+    Json cons = Json::object();
+    cons.set("timeout_delay", Json(int64_t(10'000)));
+    cons.set("sync_retry_delay", Json(int64_t(10'000)));
+    cons.set("chain_depth", Json(int64_t(3)));
+    Json memp = Json::object();
+    memp.set("batch_size", Json(int64_t(64)));
+    memp.set("max_batch_delay", Json(int64_t(50)));
+    params.set("consensus", std::move(cons));
+    params.set("mempool", std::move(memp));
+    params.write_file(dir + "parameters.json");
+  }
+  auto ks = keys();
+  std::vector<std::unique_ptr<node::Node>> nodes;
+  for (size_t i = 0; i < 4; i++) {
+    node::Secret s;
+    s.name = ks[i].name;
+    s.secret = ks[i].secret;
+    std::string key_file = dir + "node-" + std::to_string(i) + ".json";
+    s.write(key_file);
+    nodes.push_back(node::Node::create(dir + "committee.json", key_file,
+                                       dir + "db-" + std::to_string(i),
+                                       dir + "parameters.json"));
+  }
+  for (size_t i = 0; i < 4; i++) {
+    auto addr = committee.mempool.transactions_address(ks[i].name);
+    auto sock = Socket::connect(*addr);
+    CHECK(sock.has_value());
+    Bytes tx(32, uint8_t(i + 1));
+    CHECK(sock->write_frame(tx));
+  }
+  std::vector<Digest> first_committed(4);
+  std::vector<std::thread> waiters;
+  std::atomic<int> failures{0};
+  for (size_t i = 0; i < 4; i++) {
+    waiters.emplace_back([&, i] {
+      auto ch = nodes[i]->commit_channel();
+      while (true) {
+        consensus::Block b;
+        auto status = ch->recv_until(
+            &b, std::chrono::steady_clock::now() + std::chrono::seconds(30));
+        if (status != RecvStatus::kOk) {
+          failures++;
+          return;
+        }
+        if (!b.payload.empty()) {
+          first_committed[i] = b.digest();
+          return;
+        }
+      }
+    });
+  }
+  for (auto& t : waiters) t.join();
+  CHECK(failures.load() == 0);
+  CHECK(first_committed[0] == first_committed[1]);
+  CHECK(first_committed[0] == first_committed[2]);
+  CHECK(first_committed[0] == first_committed[3]);
+  for (auto& n : nodes) n->stop();
+}
+
 int main() { return run_all(); }

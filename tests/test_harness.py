@@ -142,3 +142,16 @@ def test_bench_parameters_validation():
             "faults": 4, "nodes": 4, "rate": 1000, "tx_size": 512,
             "duration": 20,
         })
+
+
+def test_node_parameters_chain_depth():
+    """chain_depth: absent -> fine (2-chain default); 3 -> fine; 4 -> error
+    (native/src/consensus/config.hpp accepts only 2 or 3)."""
+    import pytest
+
+    data = NodeParameters.default().json
+    data["consensus"]["chain_depth"] = 3
+    NodeParameters(dict(data))
+    data["consensus"]["chain_depth"] = 4
+    with pytest.raises(Exception):
+        NodeParameters(dict(data))

@@ -37,6 +37,9 @@ class CoreImpl {
         aggregator_(committee_) {}
 
   void run() {
+    // Crash recovery first: a restarted replica resumes at its persisted
+    // round with its voting-safety watermark intact.
+    restore_state();
     // Bootstrap: timer armed; leader of round 1 proposes immediately
     // (core.rs:438-444).
     reset_timer();
@@ -49,6 +52,7 @@ class CoreImpl {
       if (status == RecvStatus::kClosed) return;
       if (status == RecvStatus::kTimeout) {
         local_timeout_round();
+        flush_state();
         continue;
       }
       VerifyResult result = VerifyResult::good();
@@ -72,6 +76,7 @@ class CoreImpl {
             LOG_WARN("consensus::core") << "unexpected protocol message";
         }
       }
+      flush_state();
       if (!result.ok()) {
         LOG_WARN("consensus::core") << result.error;
       }
@@ -95,7 +100,15 @@ class CoreImpl {
   // -- voting safety (core.rs:99-146) --------------------------------------
 
   void increase_last_voted_round(Round target) {
-    last_voted_round_ = std::max(last_voted_round_, target);
+    if (target > last_voted_round_) {
+      last_voted_round_ = target;
+      // Safety-critical ordering: the vote/timeout signed under this
+      // watermark must not leave the node before the watermark is in the
+      // WAL. persist + read-back barrier (the store thread handles
+      // commands in order, so the read completing proves the append ran).
+      persist_state();
+      store_.read(state_key());
+    }
   }
 
   std::optional<Vote> make_vote(const Block& block) {
@@ -136,6 +149,7 @@ class CoreImpl {
               [](const Block& a, const Block& b) { return a.round < b.round; });
 
     last_committed_round_ = block.round;
+    state_dirty_ = true;
 
     for (const Block& b : to_commit) {
       if (!b.payload.empty()) {
@@ -155,7 +169,10 @@ class CoreImpl {
   // -- round advancement ---------------------------------------------------
 
   void update_high_qc(const QC& qc) {
-    if (qc.round > high_qc_.round) high_qc_ = qc;
+    if (qc.round > high_qc_.round) {
+      high_qc_ = qc;
+      state_dirty_ = true;
+    }
   }
 
   void advance_round(Round round) {
@@ -164,6 +181,60 @@ class CoreImpl {
     round_ = round + 1;
     LOG_DEBUG("consensus::core") << "Moved to round " << round_;
     aggregator_.cleanup(round_);
+    state_dirty_ = true;
+  }
+
+  // -- crash-recovery state (EXCEEDS the reference: core.rs:112 leaves
+  // round/last_voted_round/high_qc volatile with an acknowledged TODO, so
+  // an upstream replica can double-vote after a crash+restart) -----------
+
+  static Bytes state_key() {
+    // 7 bytes: cannot collide with block/payload keys (32-byte digests).
+    return Bytes{'c', 's', 't', 'a', 't', 'e', '\x01'};
+  }
+
+  void persist_state() {
+    Writer w;
+    w.u64(round_);
+    w.u64(last_voted_round_);
+    w.u64(last_committed_round_);
+    high_qc_.serialize(&w);
+    store_.write(state_key(), std::move(w.out));
+    state_dirty_ = false;
+  }
+
+  // Liveness state (round, high QC, commit watermark) persists once per
+  // handled event, not once per mutation — losing the tail of it is
+  // benign (the replica resyncs), unlike the voting watermark above.
+  void flush_state() {
+    if (state_dirty_) persist_state();
+  }
+
+  void restore_state() {
+    auto bytes = store_.read(state_key());
+    if (!bytes) return;
+    Round round, last_voted, last_committed;
+    QC high_qc;
+    try {
+      Reader r(*bytes);
+      round = r.u64();
+      last_voted = r.u64();
+      last_committed = r.u64();
+      high_qc = QC::deserialize(&r);
+    } catch (const std::exception& e) {
+      // All-or-nothing: a torn/incompatible record must not leave
+      // partially restored state behind.
+      LOG_ERROR("consensus::core")
+          << "corrupt persisted state ignored: " << e.what();
+      return;
+    }
+    round_ = round;
+    last_voted_round_ = last_voted;
+    last_committed_round_ = last_committed;
+    high_qc_ = std::move(high_qc);
+    LOG_INFO("consensus::core")
+        << "Restored consensus state: round " << round_ << ", last voted "
+        << last_voted_round_ << ", high QC round " << high_qc_.round;
   }
 
   void process_qc(const QC& qc) {
@@ -352,6 +423,7 @@ class CoreImpl {
   std::shared_ptr<Synchronizer> synchronizer_;
   uint64_t timeout_delay_;
   uint32_t chain_depth_ = 2;
+  bool state_dirty_ = false;
   ChannelPtr<CoreEvent> rx_event_;
   ChannelPtr<ProposerMessage> tx_proposer_;
   ChannelPtr<Block> tx_commit_;

@@ -103,9 +103,11 @@ class CoreImpl {
     if (target > last_voted_round_) {
       last_voted_round_ = target;
       // Safety-critical ordering: the vote/timeout signed under this
-      // watermark must not leave the node before the watermark is in the
+      // watermark must not leave the node before the watermark reaches the
       // WAL. persist + read-back barrier (the store thread handles
       // commands in order, so the read completing proves the append ran).
+      // Scope: protects against process crashes; power-loss safety would
+      // need fdatasync per vote (see store.cpp wal_append).
       persist_state();
       store_.read(state_key());
     }

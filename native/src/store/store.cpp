@@ -1,6 +1,8 @@
 #include "store/store.hpp"
 
+#include <fcntl.h>
 #include <sys/stat.h>
+#include <unistd.h>
 
 #include <cstdio>
 #include <stdexcept>
@@ -12,7 +14,13 @@ namespace hotstuff {
 namespace {
 
 // WAL record: u32 LE key len | key | u32 LE value len | value.
-void wal_append(std::FILE* f, const Bytes& key, const Bytes& value) {
+// Returns the appended byte count.  `flush` pushes the record to the
+// kernel (process-crash durability; power-loss durability would need
+// fdatasync per record, which the consensus workload cannot afford —
+// matching the reference, whose RocksDB default WAL is also not fsync'd
+// per write).
+size_t wal_append(std::FILE* f, const Bytes& key, const Bytes& value,
+                  bool flush = true) {
   auto put_u32 = [&](uint32_t v) {
     uint8_t b[4] = {uint8_t(v), uint8_t(v >> 8), uint8_t(v >> 16),
                     uint8_t(v >> 24)};
@@ -22,7 +30,54 @@ void wal_append(std::FILE* f, const Bytes& key, const Bytes& value) {
   std::fwrite(key.data(), 1, key.size(), f);
   put_u32(static_cast<uint32_t>(value.size()));
   std::fwrite(value.data(), 1, value.size(), f);
+  if (flush) std::fflush(f);
+  return 8 + key.size() + value.size();
+}
+
+// Rewrite the WAL as a snapshot of the live map: write wal.tmp, sync,
+// atomically rename over the old file, sync the directory, reopen for
+// append.  On failure the old handle and counters stay untouched.
+struct CompactResult {
+  std::FILE* wal;
+  size_t snapshot_bytes = 0;
+  bool ok = false;
+};
+
+CompactResult wal_compact(
+    std::FILE* old_wal, const std::string& wal_path,
+    const std::string& dir_path,
+    const std::unordered_map<Bytes, Bytes, BytesHash>& map) {
+  const std::string tmp = wal_path + ".tmp";
+  std::FILE* f = std::fopen(tmp.c_str(), "wb");
+  if (!f) {
+    LOG_WARN("store") << "compaction skipped: cannot open " << tmp;
+    return {old_wal};
+  }
+  size_t bytes = 0;
+  for (const auto& [k, v] : map)
+    bytes += wal_append(f, k, v, /*flush=*/false);
   std::fflush(f);
+  ::fsync(::fileno(f));  // snapshot on disk before it replaces the WAL
+  std::fclose(f);
+  if (std::rename(tmp.c_str(), wal_path.c_str()) != 0) {
+    LOG_WARN("store") << "compaction skipped: rename failed";
+    std::remove(tmp.c_str());
+    return {old_wal};
+  }
+  int dfd = ::open(dir_path.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) {
+    ::fsync(dfd);  // persist the rename itself
+    ::close(dfd);
+  }
+  std::fclose(old_wal);
+  std::FILE* fresh = std::fopen(wal_path.c_str(), "ab");
+  if (!fresh) {
+    LOG_ERROR("store") << "WAL reopen failed after compaction; store "
+                          "continues memory-only";
+    return {nullptr, bytes, true};
+  }
+  LOG_INFO("store") << "WAL compacted to " << bytes << " bytes";
+  return {fresh, bytes, true};
 }
 
 void wal_replay(const std::string& path,
@@ -51,14 +106,15 @@ void wal_replay(const std::string& path,
 
 }  // namespace
 
-Store Store::open(const std::string& path) {
+Store Store::open(const std::string& path, int64_t compact_bytes) {
   auto ch = make_channel<Command>();
 
   std::FILE* wal = nullptr;
+  std::string wal_path;
   auto map = std::make_shared<std::unordered_map<Bytes, Bytes, BytesHash>>();
   if (!path.empty()) {
     ::mkdir(path.c_str(), 0755);
-    std::string wal_path = path + "/wal";
+    wal_path = path + "/wal";
     wal_replay(wal_path, map.get());
     wal = std::fopen(wal_path.c_str(), "ab");
     if (!wal) throw std::runtime_error("cannot open WAL at " + wal_path);
@@ -67,16 +123,45 @@ Store Store::open(const std::string& path) {
   Store s;
   s.ch_ = ch;
   s.worker_ = std::shared_ptr<std::thread>(
-      new std::thread([ch, map, wal] {
+      new std::thread([ch, map, wal, wal_path, path_dir = path,
+                       compact_bytes]() mutable {
         // Obligations: key -> oneshots fulfilled by a future write
         // (store/src/lib.rs:36-57 semantics).
         std::unordered_map<Bytes, std::vector<Oneshot<Bytes>>, BytesHash>
             obligations;
+        // Compaction accounting: bytes appended since the last rewrite,
+        // and the approximate live (retained) byte footprint.
+        size_t appended = 0, live = 0;
+        for (const auto& [k, v] : *map) live += 8 + k.size() + v.size();
+        if (wal) {
+          // "ab" streams report position 0 until the first write; seek to
+          // find the real replayed-file size (dead bytes included).
+          std::fseek(wal, 0, SEEK_END);
+          long pos = std::ftell(wal);
+          appended = pos > 0 ? size_t(pos) : live;
+        }
         while (auto cmd = ch->recv()) {
           switch (cmd->kind) {
             case Command::Kind::kWrite: {
-              if (wal) wal_append(wal, cmd->key, cmd->value);
+              if (wal) {
+                appended += wal_append(wal, cmd->key, cmd->value);
+                auto it0 = map->find(cmd->key);
+                if (it0 != map->end())
+                  live -= 8 + it0->first.size() + it0->second.size();
+                live += 8 + cmd->key.size() + cmd->value.size();
+              }
+              // Map update BEFORE any compaction: the snapshot must
+              // include the record just appended, or the rename drops it.
               (*map)[cmd->key] = cmd->value;
+              if (wal && compact_bytes > 0 &&
+                  appended > size_t(compact_bytes) && appended > 4 * live) {
+                auto res = wal_compact(wal, wal_path, path_dir, *map);
+                wal = res.wal;
+                if (res.ok) {  // failure keeps counters; retry later
+                  appended = res.snapshot_bytes;
+                  live = res.snapshot_bytes;
+                }
+              }
               auto it = obligations.find(cmd->key);
               if (it != obligations.end()) {
                 for (auto& waiter : it->second) waiter.set(cmd->value);

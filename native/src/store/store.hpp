@@ -23,7 +23,10 @@ class Store {
  public:
   // Opens (creating if needed) the store at `path` (a directory; the WAL
   // lives at path + "/wal"). Empty path = purely in-memory (tests).
-  static Store open(const std::string& path);
+  // The WAL compacts once appended bytes exceed `compact_bytes` AND 4x the
+  // live map size (compact_bytes <= 0 disables compaction).
+  static Store open(const std::string& path,
+                    int64_t compact_bytes = 64 * 1024 * 1024);
 
   Store() = default;  // null handle; open() returns the real one
 

@@ -104,17 +104,20 @@ VerifyResult TC::verify(const Committee& committee) const {
     return VerifyResult::bad("TC requires a quorum");
   }
   // Each timeout vote signed (round, its own high_qc round) — distinct
-  // digests per vote, verified host-side (TCs only form on view change, off
-  // the throughput path; reference does the same sequential loop,
-  // messages.rs:307-313).
+  // digests per vote. The reference verifies them sequentially
+  // (messages.rs:307-313); here they go through one multi-digest batch
+  // (one device launch with the sidecar installed, host loop otherwise).
+  std::vector<std::tuple<Digest, PublicKey, Signature>> items;
+  items.reserve(votes.size());
   for (const auto& [author, sig, high_qc_round] : votes) {
     Digest d = DigestBuilder()
                    .update_u64_le(round)
                    .update_u64_le(high_qc_round)
                    .finalize();
-    if (!sig.verify(d, author)) {
-      return VerifyResult::bad("invalid signature in TC");
-    }
+    items.emplace_back(d, author, sig);
+  }
+  if (!Signature::verify_batch_multi(items)) {
+    return VerifyResult::bad("invalid signature in TC");
   }
   return VerifyResult::good();
 }

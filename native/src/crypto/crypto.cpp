@@ -168,16 +168,34 @@ bool Signature::verify(const Digest& digest, const PublicKey& pk) const {
 bool Signature::verify_batch(
     const Digest& digest,
     const std::vector<std::pair<PublicKey, Signature>>& votes) {
-  TpuVerifier* tpu = TpuVerifier::instance();
   if (current_scheme() == Scheme::kBls) {
     // No host pairing exists in the C++ plane; the sidecar is mandatory
     // for BLS (asserted at boot) and a transport failure rejects.
+    TpuVerifier* tpu = TpuVerifier::instance();
     if (!tpu) return false;
     auto ok = tpu->bls_verify_votes(digest, votes);
     return ok.value_or(false);
   }
+  std::vector<std::tuple<Digest, PublicKey, Signature>> items;
+  items.reserve(votes.size());
+  for (const auto& [pk, sig] : votes) items.emplace_back(digest, pk, sig);
+  return verify_batch_multi(items);
+}
+
+bool Signature::verify_batch_multi(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
+  // BLS TCs carry per-vote BLS signatures over distinct digests; there is
+  // no aggregate shortcut for distinct messages in the sidecar protocol,
+  // and no host pairing — verify each via the per-signature path.
+  if (current_scheme() == Scheme::kBls) {
+    for (const auto& [d, pk, sig] : items) {
+      if (!sig.verify(d, pk)) return false;
+    }
+    return true;
+  }
+  TpuVerifier* tpu = TpuVerifier::instance();
   if (tpu && tpu->connected()) {
-    auto mask = tpu->verify_batch(digest, votes);
+    auto mask = tpu->verify_batch_multi(items);
     if (mask) {
       for (bool ok : *mask) {
         if (!ok) return false;
@@ -186,8 +204,8 @@ bool Signature::verify_batch(
     }
     // fall through to host loop on sidecar failure
   }
-  for (const auto& [pk, sig] : votes) {
-    if (!sig.verify(digest, pk)) return false;
+  for (const auto& [d, pk, sig] : items) {
+    if (!sig.verify(d, pk)) return false;
   }
   return true;
 }

@@ -142,6 +142,13 @@ struct Signature {
   static bool verify_batch(
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes);
+
+  // Batch verification where every vote signed its own digest (a TC's
+  // timeout votes). The reference verifies these one-by-one
+  // (messages.rs:307-313); here they share a single device launch when the
+  // TpuVerifier is installed.
+  static bool verify_batch_multi(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
 };
 
 struct KeyPair {

@@ -53,9 +53,8 @@ bool TpuVerifier::ensure_connected_locked() {
   return true;
 }
 
-std::optional<std::vector<bool>> TpuVerifier::verify_batch(
-    const Digest& digest,
-    const std::vector<std::pair<PublicKey, Signature>>& votes) {
+std::optional<std::vector<bool>> TpuVerifier::verify_batch_multi(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
   std::lock_guard<std::mutex> lk(m_);
   if (!ensure_connected_locked()) return std::nullopt;
 
@@ -64,10 +63,10 @@ std::optional<std::vector<bool>> TpuVerifier::verify_batch(
   uint32_t rid = next_id_++;
   w.u8(kOpVerifyBatch);
   w.u32(rid);
-  w.u32(static_cast<uint32_t>(votes.size()));
+  w.u32(static_cast<uint32_t>(items.size()));
   w.u8(32);  // msg_len lo (u16 LE)
   w.u8(0);   // msg_len hi
-  for (const auto& [pk, sig] : votes) {
+  for (const auto& [digest, pk, sig] : items) {
     if (sig.data.size() != 64) return std::nullopt;  // not an Ed25519 sig
     w.fixed(digest.data);
     w.fixed(pk.data);
@@ -98,7 +97,7 @@ std::optional<std::vector<bool>> TpuVerifier::verify_batch(
     uint8_t opcode = r.u8();
     uint32_t got_rid = r.u32();
     uint32_t n = r.u32();
-    if (opcode != kOpVerifyBatch || got_rid != rid || n != votes.size()) {
+    if (opcode != kOpVerifyBatch || got_rid != rid || n != items.size()) {
       LOG_WARN("crypto::sidecar") << "protocol mismatch from sidecar";
       sock_.close();
       return std::nullopt;

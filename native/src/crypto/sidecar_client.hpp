@@ -9,6 +9,7 @@
 #include <memory>
 #include <mutex>
 #include <optional>
+#include <tuple>
 #include <utility>
 #include <vector>
 
@@ -31,10 +32,12 @@ class TpuVerifier {
 
   bool connected();
 
-  // Returns nullopt on transport failure (caller falls back to host verify).
-  std::optional<std::vector<bool>> verify_batch(
-      const Digest& digest,
-      const std::vector<std::pair<PublicKey, Signature>>& votes);
+  // One coalesced launch, one digest PER record (QC votes share a digest;
+  // TC votes sign distinct (round, high_qc_round) digests — the wire
+  // format carries a message per record either way). Returns nullopt on
+  // transport failure (caller falls back to host verify).
+  std::optional<std::vector<bool>> verify_batch_multi(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
 
   // scheme=bls operations (pairing lives only in the sidecar; signing is
   // its host G2 scalar mult). These use a longer receive deadline than

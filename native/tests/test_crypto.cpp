@@ -132,4 +132,24 @@ TEST(bls_signature_paths_reject_without_sidecar) {
   CHECK(sig.verify(d, kp.name));
 }
 
+TEST(verify_batch_multi_distinct_digests) {
+  // The TC path: every signature over its own digest, one batch call.
+  auto kp1 = keypair_from_seed({{1}});
+  auto kp2 = keypair_from_seed({{2}});
+  Digest d1 = DigestBuilder().update_u64_le(7).update_u64_le(3).finalize();
+  Digest d2 = DigestBuilder().update_u64_le(7).update_u64_le(5).finalize();
+  Signature s1 = Signature::sign(d1, kp1.secret);
+  Signature s2 = Signature::sign(d2, kp2.secret);
+  CHECK(Signature::verify_batch_multi({{d1, kp1.name, s1},
+                                       {d2, kp2.name, s2}}));
+  // Swapped digests must fail.
+  CHECK(!Signature::verify_batch_multi({{d2, kp1.name, s1},
+                                        {d1, kp2.name, s2}}));
+  // One corrupted signature fails the whole batch.
+  Signature bad = s2;
+  bad.data[5] ^= 1;
+  CHECK(!Signature::verify_batch_multi({{d1, kp1.name, s1},
+                                        {d2, kp2.name, bad}}));
+}
+
 int main() { return run_all(); }

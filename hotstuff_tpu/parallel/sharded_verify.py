@@ -19,24 +19,48 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as Pspec
 
+from ..crypto.eddsa import MAX_SUBBATCH
 from ..ops import ed25519 as E
 from .mesh import BATCH_AXIS
 
 
-def _shard_body(a, r, s, k, present):
-    """present: (B,) int32 — 1 for a real, host-canonical vote; 0 for batch
-    padding or votes already rejected on host (non-canonical encodings)."""
-    mask = E.verify_compact(a, r, s, k) & (present > 0)
-    # QC verdict: count of present-but-invalid votes, psum-reduced over ICI.
-    bad = jnp.sum((present > 0) & ~mask).astype(jnp.int32)
-    bad_total = jax.lax.psum(bad, BATCH_AXIS)
-    return mask, bad_total
+def _make_shard_body(max_subbatch: int):
+    def _shard_body(a, r, s, k, present):
+        """present: (B,) int32 — 1 for a real, host-canonical vote; 0 for
+        batch padding or votes already rejected on host (non-canonical
+        encodings)."""
+        bs = a.shape[0]
+        if bs > max_subbatch:
+            # Per-shard chunked scan, same shape discipline as the
+            # single-chip bulk path (ops/ed25519.verify_packed_chunked):
+            # every conv stays at <= max_subbatch groups while the whole
+            # shard shares one program. Caller pads so bs divides evenly.
+            g = bs // max_subbatch
+
+            def body(_, xs):
+                aa, rr, ss, kk = xs
+                return None, E.verify_compact(aa, rr, ss, kk)
+
+            _, masks = jax.lax.scan(
+                body, None,
+                tuple(x.reshape(g, max_subbatch, *x.shape[1:])
+                      for x in (a, r, s, k)))
+            mask = masks.reshape(bs)
+        else:
+            mask = E.verify_compact(a, r, s, k)
+        mask = mask & (present > 0)
+        # QC verdict: count of present-but-invalid votes, psum over ICI.
+        bad = jnp.sum((present > 0) & ~mask).astype(jnp.int32)
+        bad_total = jax.lax.psum(bad, BATCH_AXIS)
+        return mask, bad_total
+    return _shard_body
 
 
-def make_sharded_verifier(mesh: Mesh):
+def make_sharded_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
     """Returns jitted fn over compact byte arrays + present mask (global
-    batch B, B % n_devices == 0) -> ((B,) bool mask, () int32 invalid vote
-    count).
+    batch B, B % n_devices == 0; shards larger than max_subbatch must
+    divide into max_subbatch chunks) -> ((B,) bool mask, () int32 invalid
+    vote count).
 
     Note: ``bad_total`` counts votes with present=1 whose signature fails on
     device; host-side encoding rejections must be folded into ``present`` by
@@ -47,7 +71,7 @@ def make_sharded_verifier(mesh: Mesh):
     # point, exponent accumulators) that VMA tracking would flag as unvarying
     # vs the varying body outputs; replication checking adds nothing here.
     fn = shard_map(
-        _shard_body,
+        _make_shard_body(max_subbatch),
         mesh=mesh,
         in_specs=(batched,) * 5,
         out_specs=(batched, Pspec()),
@@ -57,17 +81,22 @@ def make_sharded_verifier(mesh: Mesh):
 
 
 @functools.cache
-def _cached_verifier(mesh: Mesh):
-    return make_sharded_verifier(mesh)
+def _cached_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
+    return make_sharded_verifier(mesh, max_subbatch)
 
 
-def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False):
+def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
+                         max_subbatch: int = MAX_SUBBATCH):
     """Run a host-prepared batch (see crypto/eddsa.prepare_batch) across the
-    mesh.  Pads the batch to a multiple of the mesh size; padding and
+    mesh.  Pads the batch to a multiple of the mesh size (and, beyond
+    max_subbatch per shard, to whole per-shard chunks); padding and
     host-rejected votes are excluded from the device-side verdict count."""
     n = prep["a"].shape[0]
     n_dev = mesh.devices.size
-    m = ((n + n_dev - 1) // n_dev) * n_dev
+    quantum = n_dev
+    if n > n_dev * max_subbatch:
+        quantum = n_dev * max_subbatch
+    m = ((n + quantum - 1) // quantum) * quantum
     arrays = dict(prep)
     arrays["present"] = prep["host_ok"].astype(np.int32)
     out = []
@@ -76,7 +105,7 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False):
         if m != n:
             a = np.pad(a, [(0, m - n)] + [(0, 0)] * (a.ndim - 1))
         out.append(jnp.asarray(a))
-    mask, bad_total = _cached_verifier(mesh)(*out)
+    mask, bad_total = _cached_verifier(mesh, max_subbatch)(*out)
     mask = np.asarray(mask)[:n]
     if return_bad_total:
         return mask, int(bad_total)

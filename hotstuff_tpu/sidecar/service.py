@@ -132,15 +132,15 @@ class VerifyEngine:
             msgs += p.request.msgs
             pks += p.request.pks
             sigs += p.request.sigs
-        # The host/mesh paths verify per sub-batch; the default device path
-        # (eddsa.verify_batch) runs up to a whole launch-cap window as one
-        # chunked-scan dispatch, so the per-dispatch tunnel cost is paid
-        # once.  A single request larger than the cap (the coalescer only
-        # bounds *additional* requests) is still sliced here so no request
-        # can force an unwarmed compile shape or an unbounded device
+        # The host path verifies per sub-batch; the device paths (single
+        # chip via eddsa.verify_batch, mesh via verify_batch_sharded — both
+        # chunk internally) run up to a whole launch-cap window as one
+        # dispatch, so the per-dispatch tunnel cost is paid once.  A single
+        # request larger than the cap (the coalescer only bounds
+        # *additional* requests) is still sliced here so no request can
+        # force an unwarmed compile shape or an unbounded device
         # allocation.
-        step = (MAX_SUBBATCH if self._use_host or self._mesh is not None
-                else self._launch_cap)
+        step = MAX_SUBBATCH if self._use_host else self._launch_cap
         mask = []
         for i in range(0, len(msgs), step):
             j = i + step
@@ -285,13 +285,10 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         if warm_bls:
             _warmup_bls()
         if warm_bulk:
-            if engine._mesh is not None:
-                log.warning("--warm-bulk ignored: the mesh-sharded verify "
-                            "path has no chunked-scan program; launches "
-                            "stay capped at %d", MAX_SUBBATCH)
-            else:
-                _warmup_bulk(engine)
-                engine.enable_bulk()
+            # Works for both the single-device chunked scan and the mesh
+            # path (parallel/sharded_verify chunks per shard the same way).
+            _warmup_bulk(engine)
+            engine.enable_bulk()
     server = SidecarServer((host, port), engine)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
     if ready_event is not None:

@@ -44,3 +44,28 @@ def test_sharded_pads_ragged_batch():
     mesh = make_mesh(8)
     got = verify_batch_sharded(mesh, eddsa.prepare_batch(msgs, pks, sigs))
     assert got.shape == (11,) and got.all()
+
+
+def test_sharded_chunked_large_batch():
+    """Per-shard batches beyond the sub-batch cap run as a chunked scan
+    inside each shard (one program, conv groups bounded) — exercised with a
+    small cap so 8 devices x 4 chunks x 64 = 2048 votes cover the path."""
+    rng = np.random.default_rng(21)
+    base = []
+    for _ in range(12):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msg = rng.bytes(32)
+        base.append((msg, pk, ref.sign(sk, msg)))
+    n = 2048
+    msgs = [base[i % 12][0] for i in range(n)]
+    pks = [base[i % 12][1] for i in range(n)]
+    sigs = [base[i % 12][2] for i in range(n)]
+    sigs[777] = bytes(64)  # one invalid vote
+    mesh = make_mesh(8)
+    prep = eddsa.prepare_batch(msgs, pks, sigs)
+    mask, bad = verify_batch_sharded(mesh, prep, return_bad_total=True,
+                                     max_subbatch=64)
+    assert mask.shape == (n,)
+    assert not mask[777] and mask.sum() == n - 1
+    assert bad == 1

@@ -147,6 +147,18 @@ def main():
     watchdog.daemon = True
     watchdog.start()
 
+    # Persistent XLA compilation cache (same dir the sidecar uses): the
+    # driver runs this script in a cold process, and the chunked-verify
+    # program costs 30-60 s to compile through the tunnel.
+    import jax
+
+    cache_dir = os.environ.get("HOTSTUFF_TPU_XLA_CACHE",
+                               os.path.expanduser("~/.cache/hotstuff_tpu"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        pass
+
     from hotstuff_tpu.ops import field25519
 
     field25519.mul_selfcheck()  # trip fast if this backend's conv is inexact

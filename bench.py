@@ -150,14 +150,9 @@ def main():
     # Persistent XLA compilation cache (same dir the sidecar uses): the
     # driver runs this script in a cold process, and the chunked-verify
     # program costs 30-60 s to compile through the tunnel.
-    import jax
+    from hotstuff_tpu.utils.xla_cache import configure_xla_cache
 
-    cache_dir = os.environ.get("HOTSTUFF_TPU_XLA_CACHE",
-                               os.path.expanduser("~/.cache/hotstuff_tpu"))
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-    except Exception:
-        pass
+    configure_xla_cache()
 
     from hotstuff_tpu.ops import field25519
 

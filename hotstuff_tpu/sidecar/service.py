@@ -304,16 +304,9 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
 def _enable_compilation_cache():
     """Persist XLA compilations across sidecar restarts; the BLS pairing
     program alone is minutes of compile, paid once per cache dir."""
-    import os
+    from ..utils.xla_cache import configure_xla_cache
 
-    import jax
-
-    cache_dir = os.environ.get("HOTSTUFF_TPU_XLA_CACHE",
-                               os.path.expanduser("~/.cache/hotstuff_tpu"))
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-    except Exception:  # older jax without the option: lazy compiles only
-        log.warning("jax compilation cache unavailable")
+    configure_xla_cache()
 
 
 def _warmup_bls(n_pks: int = 3):

@@ -1,0 +1,25 @@
+"""Persistent XLA compilation cache shared by every TPU-touching entrypoint
+(sidecar, bench): cold processes reuse compiled programs instead of paying
+30-60 s per shape through the tunneled device."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("xla-cache")
+
+
+def configure_xla_cache() -> str | None:
+    """Point jax at the shared on-disk compilation cache; returns the dir,
+    or None if this jax build has no such option."""
+    import jax
+
+    cache_dir = os.environ.get("HOTSTUFF_TPU_XLA_CACHE",
+                               os.path.expanduser("~/.cache/hotstuff_tpu"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:  # older jax without the option: lazy compiles only
+        log.warning("jax compilation cache unavailable")
+        return None
+    return cache_dir

@@ -19,3 +19,108 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# Shared integration-test scaffolding (node/client/sidecar process testbed).
+# Used by test_integration*.py; lives here so the spawn/teardown and log
+# helpers exist exactly once.
+# ---------------------------------------------------------------------------
+
+import signal as _signal
+import socket as _socket
+import subprocess as _subprocess
+import time as _time
+
+import pytest as _pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NODE_BIN = os.path.join(REPO, "native", "build", "node")
+CLIENT_BIN = os.path.join(REPO, "native", "build", "client")
+
+
+def free_port():
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def count_in_log(path, needle):
+    try:
+        with open(path, "r", errors="replace") as f:
+            return f.read().count(needle)
+    except OSError:
+        return 0
+
+
+def wait_commits(log_files, minimum, deadline_s):
+    start = _time.monotonic()
+    while _time.monotonic() - start < deadline_s:
+        counts = [count_in_log(p, "Committed B") for p in log_files]
+        if all(c >= minimum for c in counts):
+            return counts
+        _time.sleep(0.5)
+    return [count_in_log(p, "Committed B") for p in log_files]
+
+
+def wait_sidecar_ping(port, deadline_s=30):
+    from hotstuff_tpu.sidecar.client import SidecarClient
+
+    start = _time.monotonic()
+    while _time.monotonic() - start < deadline_s:
+        try:
+            with SidecarClient(port=port, timeout=2.0) as c:
+                c.ping()
+            return True
+        except (OSError, ConnectionError):
+            _time.sleep(0.2)
+    return False
+
+
+def make_committee(tmp_path, nodes, timeout_delay_ms, batch_size=1000,
+                   sidecar_port=None, scheme=None):
+    """Generate keys + committee + parameters files; returns (keys,
+    committee, params)."""
+    from hotstuff_tpu.harness.config import Key, LocalCommittee, NodeParameters
+
+    keys = []
+    for i in range(nodes):
+        _subprocess.run([NODE_BIN, "keys", "--filename", f".node-{i}.json"],
+                        cwd=tmp_path, check=True)
+        keys.append(Key.from_file(str(tmp_path / f".node-{i}.json")))
+    committee = LocalCommittee([k.name for k in keys], free_port())
+    committee.print(str(tmp_path / ".committee.json"))
+    params = NodeParameters.default(
+        tpu_sidecar=(f"127.0.0.1:{sidecar_port}" if sidecar_port else None),
+        scheme=scheme)
+    params.json["consensus"]["timeout_delay"] = timeout_delay_ms
+    params.json["mempool"]["batch_size"] = batch_size
+    params.print(str(tmp_path / ".parameters.json"))
+    return keys, committee, params
+
+
+@_pytest.fixture
+def testbed(tmp_path):
+    procs = []
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def spawn(cmd, log_name):
+        log = open(tmp_path / log_name, "w")
+        p = _subprocess.Popen(cmd, cwd=tmp_path, stdout=log, stderr=log,
+                              env=env)
+        procs.append((p, log))
+        return p
+
+    yield tmp_path, spawn
+    for p, log in procs:
+        if p.poll() is None:
+            p.send_signal(_signal.SIGTERM)
+    for p, log in procs:
+        try:
+            p.wait(timeout=10)
+        except _subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        log.close()

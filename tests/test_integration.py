@@ -8,22 +8,18 @@ in native/src/crypto/sidecar_client.cpp).
 Reference parity: QC::verify -> Signature::verify_batch
 (consensus/src/messages.rs:197 -> crypto/src/lib.rs:210-223). CI-safe: the
 sidecar runs --host-crypto so no accelerator or jit warmup is involved.
+Process scaffolding (testbed fixture, log helpers) lives in conftest.py.
 """
 
 import os
-import signal
-import socket
-import subprocess
 import sys
-import time
 
 import pytest
 
-from hotstuff_tpu.harness.config import Key, LocalCommittee, NodeParameters
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-NODE_BIN = os.path.join(REPO, "native", "build", "node")
-CLIENT_BIN = os.path.join(REPO, "native", "build", "client")
+from conftest import (
+    CLIENT_BIN, NODE_BIN, count_in_log, free_port, make_committee,
+    wait_commits, wait_sidecar_ping,
+)
 
 pytestmark = pytest.mark.skipif(
     not (os.path.exists(NODE_BIN) and os.path.exists(CLIENT_BIN)),
@@ -33,95 +29,18 @@ NODES = 4
 TIMEOUT_DELAY_MS = 1000
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _wait_ping(port, deadline_s=30):
-    from hotstuff_tpu.sidecar.client import SidecarClient
-
-    start = time.monotonic()
-    while time.monotonic() - start < deadline_s:
-        try:
-            with SidecarClient(port=port, timeout=2.0) as c:
-                c.ping()
-            return True
-        except (OSError, ConnectionError):
-            time.sleep(0.2)
-    return False
-
-
-def _count(path, needle):
-    try:
-        with open(path, "r", errors="replace") as f:
-            return f.read().count(needle)
-    except OSError:
-        return 0
-
-
-def _wait_commits(log_files, minimum, deadline_s):
-    start = time.monotonic()
-    while time.monotonic() - start < deadline_s:
-        counts = [_count(p, "Committed B") for p in log_files]
-        if all(c >= minimum for c in counts):
-            return counts
-        time.sleep(0.5)
-    return [_count(p, "Committed B") for p in log_files]
-
-
-@pytest.fixture
-def testbed(tmp_path):
-    procs = []
-
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-
-    def spawn(cmd, log_name):
-        log = open(tmp_path / log_name, "w")
-        p = subprocess.Popen(cmd, cwd=tmp_path, stdout=log, stderr=log,
-                             env=env)
-        procs.append((p, log))
-        return p
-
-    yield tmp_path, spawn
-    for p, log in procs:
-        if p.poll() is None:
-            p.send_signal(signal.SIGTERM)
-    for p, log in procs:
-        try:
-            p.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            p.wait()
-        log.close()
-
-
 def test_sidecar_backed_consensus_and_failover(testbed):
     tmp_path, spawn = testbed
-    sidecar_port = _free_port()
-
-    # -- config (same layout LocalBench writes) ---------------------------
-    keys = []
-    for i in range(NODES):
-        subprocess.run([NODE_BIN, "keys", "--filename", f".node-{i}.json"],
-                       cwd=tmp_path, check=True)
-        keys.append(Key.from_file(str(tmp_path / f".node-{i}.json")))
-    committee = LocalCommittee([k.name for k in keys], _free_port())
-    committee.print(str(tmp_path / ".committee.json"))
-    params = NodeParameters.default(
-        tpu_sidecar=f"127.0.0.1:{sidecar_port}")
-    params.json["consensus"]["timeout_delay"] = TIMEOUT_DELAY_MS
-    params.json["mempool"]["batch_size"] = 1000
-    params.print(str(tmp_path / ".parameters.json"))
+    sidecar_port = free_port()
+    _, committee, _ = make_committee(tmp_path, NODES, TIMEOUT_DELAY_MS,
+                                     sidecar_port=sidecar_port)
 
     # -- sidecar first; nodes boot only once it answers PING --------------
     sidecar = spawn(
         [sys.executable, "-m", "hotstuff_tpu.sidecar", "--port",
          str(sidecar_port), "--host-crypto"],
         "sidecar.log")
-    assert _wait_ping(sidecar_port), "sidecar never became ready"
+    assert wait_sidecar_ping(sidecar_port), "sidecar never became ready"
 
     node_logs = []
     for i in range(NODES):
@@ -137,15 +56,15 @@ def test_sidecar_backed_consensus_and_failover(testbed):
               f"client-{i}.log")
 
     # -- phase 1: commits flow through the sidecar ------------------------
-    counts = _wait_commits(node_logs, minimum=3, deadline_s=60)
+    counts = wait_commits(node_logs, minimum=3, deadline_s=60)
     assert all(c >= 3 for c in counts), f"no commits with sidecar: {counts}"
-    assert all(_count(p, "connected to verify sidecar") >= 1
+    assert all(count_in_log(p, "connected to verify sidecar") >= 1
                for p in node_logs), "a node never used the sidecar"
 
     # -- phase 2: kill the sidecar; consensus must keep committing --------
     sidecar.kill()
     sidecar.wait()
-    before = [_count(p, "Committed B") for p in node_logs]
-    after = _wait_commits(node_logs, minimum=max(before) + 3, deadline_s=30)
+    before = [count_in_log(p, "Committed B") for p in node_logs]
+    after = wait_commits(node_logs, minimum=max(before) + 3, deadline_s=30)
     assert all(a > b for a, b in zip(after, before)), (
         f"consensus stalled after sidecar death: {before} -> {after}")

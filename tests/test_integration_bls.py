@@ -5,13 +5,10 @@ branch capability, selected at runtime via node parameters).
 Pairing verification runs ~1 s per check in the sidecar's host-crypto
 mode, so rounds take several seconds — the test asserts liveness (blocks
 commit), not throughput. Gated behind HOTSTUFF_TPU_SLOW_TESTS=1.
+Process scaffolding (testbed fixture, log helpers) lives in conftest.py.
 """
 
-import base64
-import json
 import os
-import signal
-import socket
 import subprocess
 import sys
 import time
@@ -21,9 +18,9 @@ import pytest
 from hotstuff_tpu.harness.config import (Key, LocalCommittee, NodeParameters,
                                          add_bls_keys)
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-NODE_BIN = os.path.join(REPO, "native", "build", "node")
-CLIENT_BIN = os.path.join(REPO, "native", "build", "client")
+from conftest import (
+    CLIENT_BIN, NODE_BIN, count_in_log, free_port, wait_sidecar_ping,
+)
 
 pytestmark = [
     pytest.mark.skipif(
@@ -38,64 +35,12 @@ NODES = 4
 TIMEOUT_DELAY_MS = 30_000
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _wait_ping(port, deadline_s=30):
-    from hotstuff_tpu.sidecar.client import SidecarClient
-
-    start = time.monotonic()
-    while time.monotonic() - start < deadline_s:
-        try:
-            with SidecarClient(port=port, timeout=2.0) as c:
-                c.ping()
-            return True
-        except (OSError, ConnectionError):
-            time.sleep(0.2)
-    return False
-
-
-def _count(path, needle):
-    try:
-        with open(path, "r", errors="replace") as f:
-            return f.read().count(needle)
-    except OSError:
-        return 0
-
-
-@pytest.fixture
-def testbed(tmp_path):
-    procs = []
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-
-    def spawn(cmd, log_name):
-        log = open(tmp_path / log_name, "w")
-        p = subprocess.Popen(cmd, cwd=tmp_path, stdout=log, stderr=log,
-                             env=env)
-        procs.append((p, log))
-        return p
-
-    yield tmp_path, spawn
-    for p, log in procs:
-        if p.poll() is None:
-            p.send_signal(signal.SIGTERM)
-    for p, log in procs:
-        try:
-            p.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            p.wait()
-        log.close()
-
-
 def test_bls_committee_commits(testbed):
     tmp_path, spawn = testbed
-    sidecar_port = _free_port()
+    sidecar_port = free_port()
 
+    # BLS needs per-node G1 pubkeys injected into the committee, so the
+    # config block stays bespoke rather than using conftest.make_committee.
     key_files = []
     keys = []
     for i in range(NODES):
@@ -105,7 +50,7 @@ def test_bls_committee_commits(testbed):
         keys.append(Key.from_file(key_files[-1]))
     names = [k.name for k in keys]
     bls_pubkeys = add_bls_keys(key_files, names)
-    committee = LocalCommittee(names, _free_port(), bls_pubkeys=bls_pubkeys)
+    committee = LocalCommittee(names, free_port(), bls_pubkeys=bls_pubkeys)
     committee.print(str(tmp_path / ".committee.json"))
     params = NodeParameters.default(
         tpu_sidecar=f"127.0.0.1:{sidecar_port}", scheme="bls")
@@ -117,7 +62,7 @@ def test_bls_committee_commits(testbed):
         [sys.executable, "-m", "hotstuff_tpu.sidecar", "--port",
          str(sidecar_port), "--host-crypto"],
         "sidecar.log")
-    assert _wait_ping(sidecar_port), "sidecar never became ready"
+    assert wait_sidecar_ping(sidecar_port), "sidecar never became ready"
 
     node_logs = []
     for i in range(NODES):
@@ -135,12 +80,13 @@ def test_bls_committee_commits(testbed):
     # Liveness under BLS: every node commits at least one payload block.
     deadline = time.monotonic() + 420
     while time.monotonic() < deadline:
-        counts = [_count(p, "Committed B") for p in node_logs]
+        counts = [count_in_log(p, "Committed B") for p in node_logs]
         if all(c >= 1 for c in counts):
             break
         time.sleep(5)
-    counts = [_count(p, "Committed B") for p in node_logs]
+    counts = [count_in_log(p, "Committed B") for p in node_logs]
     assert all(c >= 1 for c in counts), (
-        f"BLS committee failed to commit: {counts}; "
-        f"scheme lines: {[_count(p, 'Signature scheme: bls') for p in node_logs]}")
-    assert all(_count(p, "Signature scheme: bls") == 1 for p in node_logs)
+        f"BLS committee failed to commit: {counts}; scheme lines: "
+        f"{[count_in_log(p, 'Signature scheme: bls') for p in node_logs]}")
+    assert all(count_in_log(p, "Signature scheme: bls") == 1
+               for p in node_logs)

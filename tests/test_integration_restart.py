@@ -1,0 +1,81 @@
+"""Crash-restart integration: a replica killed mid-run (SIGKILL) restarts on
+its own store, restores its persisted voting state ("Restored consensus
+state" from native/src/consensus/core.cpp), resyncs via the pull-based sync
+path, and the committee keeps committing with it back.
+
+Capability beyond the reference: its benchmarks only model crash faults by
+never booting nodes (benchmark/local.py:77); restarted replicas are possible
+but untested there, and their volatile round state is lost
+(core.rs:112 TODO).  Host-verify mode: no sidecar or accelerator involved.
+"""
+
+import os
+import time
+
+import pytest
+
+from conftest import (
+    CLIENT_BIN, NODE_BIN, count_in_log, make_committee, wait_commits,
+)
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(NODE_BIN) and os.path.exists(CLIENT_BIN)),
+    reason="native binaries not built (cmake --build native/build)")
+
+NODES = 4
+TIMEOUT_DELAY_MS = 1000
+
+
+def test_killed_node_restarts_with_state_and_rejoins(testbed):
+    tmp_path, spawn = testbed
+    _, committee, _ = make_committee(tmp_path, NODES, TIMEOUT_DELAY_MS)
+
+    def start_node(i, log_name=None):
+        return spawn([NODE_BIN, "run", "--keys", f".node-{i}.json",
+                      "--committee", ".committee.json", "--store", f".db-{i}",
+                      "--parameters", ".parameters.json", "-v"],
+                     log_name or f"node-{i}.log")
+
+    node_logs = [tmp_path / f"node-{i}.log" for i in range(NODES)]
+    node_procs = [start_node(i) for i in range(NODES)]
+    for i, addr in enumerate(committee.front_addresses()):
+        spawn([CLIENT_BIN, addr, "--size", "64", "--rate", "250",
+               "--timeout", str(TIMEOUT_DELAY_MS),
+               "--nodes", *committee.front_addresses()],
+              f"client-{i}.log")
+
+    # Phase 1: healthy committee commits.
+    counts = wait_commits(node_logs, minimum=3, deadline_s=60)
+    assert all(c >= 3 for c in counts), f"no commits before crash: {counts}"
+
+    # Phase 2: SIGKILL replica 3 (no clean shutdown); the other 2f+1 = 3
+    # keep committing through its leader slots via view changes.
+    node_procs[3].kill()
+    node_procs[3].wait()
+    healthy_before = [count_in_log(p, "Committed B") for p in node_logs[:3]]
+    time.sleep(2 * TIMEOUT_DELAY_MS / 1000)
+
+    # Phase 3: restart replica 3 on the SAME store with a fresh log; it
+    # must restore its persisted round state and commit again.
+    restart_log = tmp_path / "node-3-restart.log"
+    start_node(3, "node-3-restart.log")
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if count_in_log(restart_log, "Restored consensus state") >= 1:
+            break
+        time.sleep(0.5)
+    assert count_in_log(restart_log, "Restored consensus state") >= 1, (
+        "restarted node did not restore persisted state")
+
+    before = count_in_log(restart_log, "Committed B")
+    after = wait_commits([restart_log], minimum=before + 3, deadline_s=60)
+    assert after[0] >= before + 3, (
+        f"restarted node stopped committing: {before} -> {after[0]}")
+
+    # The healthy replicas made progress through the crash AND the restart.
+    healthy_after = wait_commits(node_logs[:3],
+                                 minimum=max(healthy_before) + 1,
+                                 deadline_s=30)
+    assert all(a > b for a, b in zip(healthy_after, healthy_before)), (
+        f"healthy replicas stalled: {healthy_before} -> {healthy_after}")

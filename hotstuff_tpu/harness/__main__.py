@@ -30,11 +30,10 @@ def cmd_local(args):
         tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
                      if (args.tpu_sidecar or args.scheme == "bls")
                      else None),
-        scheme=args.scheme if args.scheme != "ed25519" else None)
+        scheme=args.scheme if args.scheme != "ed25519" else None,
+        chain=args.chain)
     node_params.json["mempool"]["batch_size"] = args.batch_size
     node_params.json["consensus"]["timeout_delay"] = args.timeout
-    if args.chain != 2:
-        node_params.json["consensus"]["chain_depth"] = args.chain
     try:
         ret = LocalBench(bench_params, node_params).run(debug=args.debug)
         print(ret.result())
@@ -118,12 +117,13 @@ def cmd_remote(args):
             "duration": args.duration,
             "runs": args.runs,
         })
+        node_params = NodeParameters.default(chain=args.chain)
         bench = Bench(settings, hosts, user=args.user)
         if args.install:
             bench.install()
         if args.update:
             bench.update()
-        bench.run(bench_params, NodeParameters.default(), debug=args.debug)
+        bench.run(bench_params, node_params, debug=args.debug)
     except ConfigError as e:
         Print.error(BenchError("Invalid benchmark parameters", e))
         sys.exit(1)
@@ -239,6 +239,8 @@ def main(argv=None):
     p.add_argument("--tx-size", type=int, default=512)
     p.add_argument("--duration", type=int, default=30)
     p.add_argument("--runs", type=int, default=1)
+    p.add_argument("--chain", type=int, choices=[2, 3], default=2,
+                   help="commit-rule depth: 2-chain (default) or 3-chain")
     p.add_argument("--install", action="store_true",
                    help="install toolchain on hosts first")
     p.add_argument("--update", action="store_true",

@@ -135,7 +135,7 @@ class NodeParameters:
             json.dump(self.json, f, indent=4, sort_keys=True)
 
     @classmethod
-    def default(cls, tpu_sidecar=None, scheme=None):
+    def default(cls, tpu_sidecar=None, scheme=None, chain=2):
         data = {
             "consensus": {"timeout_delay": 5_000, "sync_retry_delay": 10_000},
             "mempool": {
@@ -146,6 +146,8 @@ class NodeParameters:
                 "max_batch_delay": 100,
             },
         }
+        if chain != 2:
+            data["consensus"]["chain_depth"] = chain
         if tpu_sidecar:
             data["tpu_sidecar"] = tpu_sidecar
         if scheme:

@@ -224,7 +224,9 @@ class Bench:
                         parser = self._logs(hosts, bench_parameters.faults)
                         parser.print(PathMaker.result_file(
                             bench_parameters.faults, n, rate,
-                            bench_parameters.tx_size))
+                            bench_parameters.tx_size,
+                            chain=node_parameters.json["consensus"].get(
+                                "chain_depth", 2)))
                     except (ExecutionError, FabricError, ParseError) as e:
                         Print.error(BenchError("Benchmark failed", e))
                         continue

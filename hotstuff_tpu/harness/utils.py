@@ -68,10 +68,11 @@ class PathMaker:
         return "results"
 
     @staticmethod
-    def result_file(faults, nodes, rate, tx_size):
+    def result_file(faults, nodes, rate, tx_size, chain=2):
+        tag = "" if chain == 2 else f"{chain}chain-"
         return join(
             PathMaker.results_path(),
-            f"bench-{faults}-{nodes}-{rate}-{tx_size}.txt",
+            f"bench-{tag}{faults}-{nodes}-{rate}-{tx_size}.txt",
         )
 
     @staticmethod

@@ -91,6 +91,12 @@ class LogAggregator:
         self.max_latencies = max_latencies or []
         data = ""
         for filename in glob(join(PathMaker.results_path(), "bench-*.txt")):
+            # Chain-tagged files (bench-3chain-...) are a different commit
+            # rule with +1 round of latency; the SUMMARY grammar is frozen
+            # (no chain field), so keep them out of the default series
+            # instead of averaging two protocols into one record.
+            if search(r"bench-\d+chain-", os.path.basename(filename)):
+                continue
             with open(filename, "r") as f:
                 data += f.read()
 

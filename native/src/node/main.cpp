@@ -3,6 +3,7 @@
 //   node keys --filename FILE
 //   node run --keys FILE --committee FILE --store PATH [--parameters FILE] [-v...]
 //   node deploy NODES  (local in-process testbed on ports 25000+)
+#include <cctype>
 #include <chrono>
 #include <csignal>
 #include <cstring>
@@ -43,7 +44,7 @@ void drain_commits(node::Node& node) {
 
 struct Args {
   std::vector<std::string> positional;
-  std::string keys, committee, store, parameters, filename;
+  std::string keys, committee, store, parameters, filename, nodes;
   int verbosity = 0;
 
   static Args parse(int argc, char** argv) {
@@ -62,9 +63,14 @@ struct Args {
       else if (arg == "--store") a.store = next();
       else if (arg == "--parameters") a.parameters = next();
       else if (arg == "--filename") a.filename = next();
+      else if (arg == "--nodes") a.nodes = next();
       else if (arg[0] == '-' && arg.find_first_not_of('v', 1) ==
                std::string::npos && arg.size() > 1) {
         a.verbosity += int(arg.size()) - 1;
+      } else if (arg.size() > 1 && arg[0] == '-' &&
+                 !std::isdigit(static_cast<unsigned char>(arg[1]))) {
+        std::cerr << "unknown flag " << arg << "\n";
+        std::exit(2);
       } else a.positional.push_back(arg);
     }
     return a;
@@ -103,11 +109,23 @@ int cmd_run(const Args& args) {
 }
 
 int cmd_deploy(const Args& args) {
-  if (args.positional.size() < 2) {
-    std::cerr << "node deploy NODES\n";
+  std::string count = args.nodes;
+  if (count.empty() && args.positional.size() >= 2) {
+    count = args.positional[1];
+  }
+  size_t nodes = 0;
+  try {
+    size_t pos = 0;
+    nodes = std::stoul(count, &pos);
+    if (pos != count.size()) nodes = 0;  // trailing garbage: reject
+  } catch (const std::exception&) {
+    nodes = 0;
+  }
+  if (nodes < 1 || nodes > 128) {
+    std::cerr << "usage: node deploy NODES | node deploy --nodes N "
+                 "(1 <= N <= 128)\n";
     return 2;
   }
-  size_t nodes = std::stoul(args.positional[1]);
   uint16_t base_port = 25000;
 
   // Generate keys + committee (main.rs:94-154 analogue).

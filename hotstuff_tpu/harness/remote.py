@@ -15,7 +15,7 @@ from collections import OrderedDict
 from os.path import basename, join, splitext
 
 from .commands import CommandMaker
-from .config import Committee, Key, NodeParameters
+from .config import Committee, Key
 from .logs import LogParser, ParseError
 from .utils import BenchError, PathMaker, Print, progress_bar
 
@@ -143,10 +143,9 @@ class Bench:
         return committee
 
     def _run_single(self, hosts, committee, rate, tx_size, faults, duration,
-                    debug=False):
+                    timeout, debug=False):
         Print.info(f"Running {len(hosts)} nodes (rate {rate:,} tx/s)...")
         repo = self.settings.repo_name
-        timeout = NodeParameters.default().timeout_delay
 
         # Nodes minus faults; clients only on alive hosts, waiting only on
         # alive fronts (a dead front in --nodes would block the client's
@@ -220,7 +219,8 @@ class Bench:
                             hosts, committee, rate,
                             bench_parameters.tx_size,
                             bench_parameters.faults,
-                            bench_parameters.duration, debug)
+                            bench_parameters.duration,
+                            node_parameters.timeout_delay, debug)
                         parser = self._logs(hosts, bench_parameters.faults)
                         parser.print(PathMaker.result_file(
                             bench_parameters.faults, n, rate,

@@ -95,7 +95,7 @@ def test_run_single_spawns_nodes_and_clients(settings, tmp_path, monkeypatch):
     monkeypatch.setattr(_time, "sleep", lambda s: None)
 
     bench._run_single(hosts, FakeCommittee(), rate=1000, tx_size=512,
-                      faults=1, duration=0)
+                      faults=1, duration=0, timeout=5_000)
     bg = [c for _, c in runner.commands if c.startswith("BG[")]
     node_cmds = [c for c in bg if "./node run" in c]
     client_cmds = [c for c in bg if "./client " in c]

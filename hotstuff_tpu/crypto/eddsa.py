@@ -24,7 +24,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import ed25519 as E
-from ..ops import field25519 as F
 
 P = E.P
 L = E.L

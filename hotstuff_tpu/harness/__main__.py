@@ -15,7 +15,7 @@ import sys
 def cmd_local(args):
     from .config import BenchParameters, NodeParameters
     from .local import LocalBench
-    from .utils import BenchError, PathMaker, Print
+    from .utils import BenchError, Print
 
     bench_params = BenchParameters({
         "faults": args.faults,

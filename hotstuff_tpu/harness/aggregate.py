@@ -11,9 +11,8 @@ from __future__ import annotations
 import os
 from collections import defaultdict
 from glob import glob
-from itertools import groupby
 from os.path import join
-from re import findall, search
+from re import search
 from statistics import mean, stdev
 
 from .utils import PathMaker

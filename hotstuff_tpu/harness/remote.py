@@ -11,8 +11,7 @@ instance.py and is gated on boto3 availability.
 from __future__ import annotations
 
 import subprocess
-from collections import OrderedDict
-from os.path import basename, join, splitext
+from os.path import join
 
 from .commands import CommandMaker
 from .config import Committee, Key

@@ -6,10 +6,8 @@ verify_invalid_signature, verify_valid_batch, verify_invalid_batch) in the
 reference repo.
 """
 
-import os
 
 import numpy as np
-import pytest
 
 from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
 

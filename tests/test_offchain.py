@@ -4,7 +4,6 @@ and the benchmark harness plumbing."""
 
 import hashlib
 
-import pytest
 
 from hotstuff_tpu.offchain import bls12381 as bls
 from hotstuff_tpu.offchain import ecdsa, eddsa, schnorr, secp256k1

@@ -10,7 +10,6 @@ import pytest
 from hotstuff_tpu.harness.aggregate import LogAggregator
 from hotstuff_tpu.harness.remote import Bench, RemoteRunner
 from hotstuff_tpu.harness.settings import Settings, SettingsError
-from hotstuff_tpu.harness.utils import PathMaker
 
 
 SETTINGS = {

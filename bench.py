@@ -137,15 +137,40 @@ def main():
     import os
     import threading
 
-    def _abort():
+    def _fail(reason):
         print(json.dumps({"metric": "ed25519-batch-verify", "value": 0,
                           "unit": "sigs/sec", "vs_baseline": 0,
-                          "error": "watchdog: TPU unresponsive for 900s"}))
+                          "error": reason}))
         os._exit(3)
+
+    def _abort():
+        _fail("watchdog: TPU unresponsive for 900s")
 
     watchdog = threading.Timer(900.0, _abort)
     watchdog.daemon = True
     watchdog.start()
+
+    # Fast-fail probe: a wedged tunnel hangs ANY device call indefinitely
+    # (observed: an 8x8 matmul never returning), and only a subprocess can
+    # be timed out reliably. Retry briefly in case the wedge is transient,
+    # then emit the error line instead of burning the whole watchdog.
+    import subprocess
+    import sys
+
+    probe = ("import jax, jax.numpy as jnp, numpy as np;"
+             "np.asarray(jnp.ones((8, 8)) @ jnp.ones((8, 8)))")
+    for attempt in range(4):
+        try:
+            subprocess.run([sys.executable, "-c", probe], timeout=75,
+                           check=True, capture_output=True)
+            break
+        except subprocess.TimeoutExpired:
+            if attempt == 3:
+                _fail("device probe timed out 4x: TPU tunnel wedged")
+        except subprocess.CalledProcessError as e:
+            if attempt == 3:
+                tail = (e.stderr or b"").decode("utf-8", "replace")[-300:]
+                _fail(f"device probe failed 4x: {tail}")
 
     # Persistent XLA compilation cache (same dir the sidecar uses): the
     # driver runs this script in a cold process, and the chunked-verify

@@ -136,8 +136,9 @@ TEST(reliable_send_replays_across_listener_crashes) {
           dropped++;   // die without ACK: forces reconnect + replay
           continue;
         }
+        acked++;  // before the write: the sender can observe the ACK (and
+                  // the test finish) before a post-write increment runs
         sock->write_frame(reinterpret_cast<const uint8_t*>("Ack"), 3);
-        acked++;
       }
     }
   });

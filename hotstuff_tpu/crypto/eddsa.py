@@ -57,6 +57,30 @@ def _lt_L(s_bytes: np.ndarray) -> np.ndarray:
     return nonzero.any(axis=1) & (lead < 0)
 
 
+# The eight small-order (8-torsion) points have five distinct y values, and
+# the set is closed under negation — so comparing the sign-cleared y against
+# this table is an exact small-order test for canonically-encoded points
+# (non-canonical y >= p is rejected separately by _ge_p).  dalek's
+# verify_strict rejects small-order A and R (crypto/src/lib.rs:204-208);
+# without the check, pk = identity encoding plus sig = ([S]B || S) verifies
+# ANY message, a universal forgery that breaks vote attribution.
+_SMALL_ORDER_Y = np.frombuffer(b"".join(
+    y.to_bytes(32, "little")
+    for y in (
+        0,       # order-4 pair (x = +-sqrt(-1))
+        1,       # identity
+        P - 1,   # (0, -1), order 2
+        # order-8 pairs: y8 and p - y8
+        0x7A03AC9277FDC74EC6CC392CFA53202A0F67100D760B3CBA4FD84D3D706A17C7,
+        0x05FC536D880238B13933C6D305ACDFD5F098EFF289F4C345B027B2C28F95E826,
+    )), np.uint8).reshape(5, 32)
+
+
+def _small_order(y_bytes: np.ndarray) -> np.ndarray:
+    """(B, 32) u8 sign-cleared y encodings: rows that are 8-torsion."""
+    return (y_bytes[:, None, :] == _SMALL_ORDER_Y[None]).all(-1).any(-1)
+
+
 def prepare_batch(msgs, pks, sigs):
     """Lists of (msg bytes, pk 32B, sig 64B) -> dict of device-ready arrays.
 
@@ -90,7 +114,8 @@ def prepare_batch(msgs, pks, sigs):
     ry_b = sig_arr[:, :32].copy()
     ry_b[:, 31] &= 0x7F
     s_bytes = np.ascontiguousarray(sig_arr[:, 32:])
-    host_ok = (len_ok & ~_ge_p(ay_b) & ~_ge_p(ry_b) & _lt_L(s_bytes))
+    host_ok = (len_ok & ~_ge_p(ay_b) & ~_ge_p(ry_b) & _lt_L(s_bytes)
+               & ~_small_order(ay_b) & ~_small_order(ry_b))
 
     # challenge scalars k = SHA512(R||A||M) mod L (host hashing, C-speed).
     # One contiguous bytearray + a single frombuffer at the end: per-row

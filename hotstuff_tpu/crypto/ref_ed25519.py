@@ -104,14 +104,28 @@ def sign(seed: bytes, msg: bytes) -> bytes:
     return r_enc + s.to_bytes(32, "little")
 
 
+def is_small_order(pt) -> bool:
+    """True for the 8-torsion points ([8]P == identity)."""
+    return pt_equal(scalar_mult(8, pt), IDENT)
+
+
 def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
-    """Host reference verifier: [S]B == R + [k]A (cofactorless, strict)."""
+    """Host reference verifier: [S]B == R + [k]A (cofactorless, strict).
+
+    Strictness matches dalek's ``verify_strict`` (the reference's
+    single-signature path, crypto/src/lib.rs:204-208): small-order A or R
+    is rejected — with A small-order, ``sig = R||S`` where R = [S]B - [k]A
+    verifies ANY message (for A = identity, any R = [S]B works), so
+    accepting such keys breaks vote attribution in the committee.
+    """
     if len(sig) != 64 or len(pk) != 32:
         return False
     a_pt = decode_point(pk)
     r_pt = decode_point(sig[:32])
     s = int.from_bytes(sig[32:], "little")
     if a_pt is None or r_pt is None or s >= L:
+        return False
+    if is_small_order(a_pt) or is_small_order(r_pt):
         return False
     k = _h(sig[:32] + pk + msg) % L
     return pt_equal(scalar_mult(s, B), pt_add(r_pt, scalar_mult(k, a_pt)))

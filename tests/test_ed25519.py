@@ -73,6 +73,89 @@ def test_noncanonical_rejected():
     assert not mask.any()
 
 
+def test_small_order_universal_forgery_rejected():
+    """verify_strict parity (crypto/src/lib.rs:204-208): with pk A = the
+    identity encoding, sig = ([S]B || S) satisfies [S]B == R + [k]A for ANY
+    message — a universal forgery unless small-order keys are rejected."""
+    s = 12345
+    r_enc = ref.encode_point(ref.scalar_mult(s, ref.B))
+    forged = r_enc + s.to_bytes(32, "little")
+    identity_pk = (1).to_bytes(32, "little")
+    for msg in (b"any message at all", b"another one"):
+        # cofactorless equation holds...
+        a_pt = ref.decode_point(identity_pk)
+        r_pt = ref.decode_point(forged[:32])
+        k = ref._h(forged[:32] + identity_pk + msg) % ref.L
+        assert ref.pt_equal(ref.scalar_mult(s, ref.B),
+                            ref.pt_add(r_pt, ref.scalar_mult(k, a_pt)))
+        # ...but both verifiers must reject it.
+        assert not ref.verify(identity_pk, msg, forged)
+        assert not eddsa.verify(identity_pk, msg, forged)
+
+
+def test_small_order_r_identity_forgery_rejected():
+    """R = identity with S = k*a mod L satisfies the cofactorless equation
+    ([S]B == [k]A) for an honest key — the one R-side case the small-order
+    check changes from accept to reject."""
+    seed = b"\x09" * 32
+    sk, pk = ref.generate_keypair(seed)
+    import hashlib
+    a = ref._clamp(int.from_bytes(hashlib.sha512(seed).digest()[:32],
+                                  "little"))
+    ident = ref.encode_point(ref.IDENT)
+    msg = b"r-identity forgery"
+    k = ref._h(ident + pk + msg) % ref.L
+    s = k * a % ref.L
+    forged = ident + s.to_bytes(32, "little")
+    assert ref.pt_equal(ref.scalar_mult(s, ref.B),
+                        ref.scalar_mult(k, ref.decode_point(pk)))
+    assert not ref.verify(pk, msg, forged)
+    assert not eddsa.verify(pk, msg, forged)
+
+
+def test_small_order_table_matches_derived_torsion():
+    """Pin _SMALL_ORDER_Y to the 8-torsion subgroup derived from reference
+    arithmetic: a typo'd or missing row fails here, not in production."""
+    # Find an order-8 generator: [L]P for any curve point lies in the
+    # torsion subgroup; scan deterministic y encodings until one has
+    # full order 8, then enumerate its multiples.
+    gen = None
+    y = 2
+    while gen is None:
+        pt = ref.decode_point(y.to_bytes(32, "little"))
+        y += 1
+        if pt is None:
+            continue
+        t = ref.scalar_mult(ref.L, pt)
+        if not ref.pt_equal(ref.scalar_mult(4, t), ref.IDENT):
+            gen = t
+    derived = set()
+    for i in range(8):
+        enc = bytearray(ref.encode_point(ref.scalar_mult(i, gen)))
+        enc[31] &= 0x7F
+        derived.add(bytes(enc))
+    assert derived == {bytes(row) for row in eddsa._SMALL_ORDER_Y}
+
+
+def test_small_order_encodings_rejected_everywhere():
+    """All 14 canonical-or-sign-flipped small-order encodings are rejected
+    as A and as R, on host prep and in the reference verifier."""
+    torsion = []
+    for row in eddsa._SMALL_ORDER_Y:
+        for sign in (0, 0x80):
+            enc = bytearray(bytes(row))
+            enc[31] |= sign
+            if ref.decode_point(bytes(enc)) is not None:
+                torsion.append(bytes(enc))
+    assert len(torsion) >= 8
+    (msg, pk, sig), = make_sigs(1, seed=7)
+    for enc in torsion:
+        prep = eddsa.prepare_batch([msg, msg], [enc, pk],
+                                   [sig, enc + sig[32:]])
+        assert not prep["host_ok"].any(), enc.hex()
+        assert not ref.verify(enc, msg, sig)
+
+
 def test_batch_padding_and_single():
     triples = make_sigs(3, seed=3)
     msgs, pks, sigs = map(list, zip(*triples))

@@ -6,8 +6,12 @@ verify_aggregate via bplib, and off-chain-benchmarking/production using
 filecoin's bls-signatures). Neither library exists in this image, so this
 is a from-scratch pure-Python BLS12-381: Fq/Fq2/Fq12 tower, G1/G2 curves,
 optimal-ate pairing (Miller loop in Fq12 with the sextic-twist embedding),
-and the filecoin convention of 48-byte G1 public keys with 96-byte G2
-signatures. Verification batches all Miller loops into a single final
+and filecoin's group assignment (public keys in G1, signatures in G2) —
+encoded UNCOMPRESSED here (96-byte G1, 192-byte G2; filecoin's compressed
+48/96-byte forms would need Fq2 square roots on every decode).  Decoding
+enforces on-curve AND prime-order subgroup membership, matching
+bls-signatures' deserialize semantics.  Verification batches all Miller
+loops into a single final
 exponentiation (product-of-pairings), which is also the shape a future
 device port wants.
 
@@ -17,6 +21,7 @@ bilinearity, non-degeneracy, subgroup orders, and signature roundtrips.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import secrets
 
@@ -249,7 +254,7 @@ def g1_add(p1, p2):
 
 
 def g1_mul(pt, k):
-    return _mul(pt, k % R, _fq)
+    return _jac_mul(pt, k % R, _fq)
 
 
 def g1_neg(pt):
@@ -261,11 +266,83 @@ def g2_add(p1, p2):
 
 
 def g2_mul(pt, k):
-    return _mul(pt, k % R, _fq2)
+    return _jac_mul(pt, k % R, _fq2)
 
 
 def g2_neg(pt):
     return None if pt is None else (pt[0], fq2_neg(pt[1]))
+
+
+def _jac_double(P, ops):
+    """Jacobian doubling on y^2 = x^3 + b (a = 0): 2M + 5S, no inversion."""
+    X, Y, Z = P
+    mul, sub, sc = ops.mul, ops.sub, ops.scalar
+    A = mul(X, X)
+    B = mul(Y, Y)
+    C = mul(B, B)
+    D = sc(sub(sub(mul(ops.add(X, B), ops.add(X, B)), A), C), 2)
+    E = sc(A, 3)
+    X3 = sub(mul(E, E), sc(D, 2))
+    Y3 = sub(mul(E, sub(D, X3)), sc(C, 8))
+    Z3 = sc(mul(Y, Z), 2)
+    return (X3, Y3, Z3)
+
+
+def _jac_add_affine(P, q, ops):
+    """Mixed Jacobian + affine addition; returns None for the identity."""
+    X1, Y1, Z1 = P
+    x2, y2 = q
+    mul, sub = ops.mul, ops.sub
+    Z1Z1 = mul(Z1, Z1)
+    U2 = mul(x2, Z1Z1)
+    S2 = mul(y2, mul(Z1, Z1Z1))
+    H = sub(U2, X1)
+    r = sub(S2, Y1)
+    if H == ops.zero:
+        if r == ops.zero:
+            return _jac_double(P, ops)
+        return None
+    HH = mul(H, H)
+    HHH = mul(H, HH)
+    V = mul(X1, HH)
+    X3 = sub(sub(mul(r, r), HHH), ops.scalar(V, 2))
+    Y3 = sub(mul(r, sub(V, X3)), mul(Y1, HHH))
+    Z3 = mul(Z1, H)
+    return (X3, Y3, Z3)
+
+
+def _jac_mul(pt, k, ops):
+    """Affine [k]pt via Jacobian left-to-right double-and-add: one field
+    inversion total instead of one per bit — this is what makes the [R]P
+    subgroup membership test affordable in pure python."""
+    if pt is None or k == 0:
+        return None
+    acc = None
+    for bit in bin(k)[2:]:
+        if acc is not None:
+            acc = _jac_double(acc, ops)
+            if acc[2] == ops.zero:
+                acc = None
+        if bit == "1":
+            acc = (pt[0], pt[1], ops.one) if acc is None \
+                else _jac_add_affine(acc, pt, ops)
+    if acc is None or acc[2] == ops.zero:
+        return None
+    zinv = ops.inv(acc[2])
+    zinv2 = ops.mul(zinv, zinv)
+    return (ops.mul(acc[0], zinv2), ops.mul(acc[1], ops.mul(zinv2, zinv)))
+
+
+def g1_in_subgroup(pt) -> bool:
+    """Prime-order subgroup membership ([R]P == identity). The filecoin
+    bls-signatures crate the reference benches against enforces this on
+    every deserialize (off-chain-benchmarking/production/Cargo.toml:10);
+    aggregate verification over cofactor-component points is undefined."""
+    return pt is None or (g1_on_curve(pt) and _jac_mul(pt, R, _fq) is None)
+
+
+def g2_in_subgroup(pt) -> bool:
+    return pt is None or (g2_on_curve(pt) and _jac_mul(pt, R, _fq2) is None)
 
 
 def g1_on_curve(pt) -> bool:
@@ -383,7 +460,7 @@ def g1_encode(pt) -> bytes:
     return pt[0].to_bytes(48, "big") + pt[1].to_bytes(48, "big")
 
 
-def g1_decode(data: bytes):
+def _g1_decode_uncached(data: bytes):
     if data[0] == 0x40:
         return None
     x = int.from_bytes(data[:48], "big")
@@ -391,7 +468,22 @@ def g1_decode(data: bytes):
     pt = (x, y)
     if not g1_on_curve(pt):
         raise ValueError("not on G1")
+    if _jac_mul(pt, R, _fq) is not None:
+        raise ValueError("G1 point not in the prime-order subgroup")
     return pt
+
+
+def g1_decode(data: bytes):
+    """Decode + validate (on-curve AND prime-order subgroup, matching
+    filecoin bls-signatures deserialize semantics). Cached: committee
+    public keys repeat on every verify, and the [R]P membership test is
+    the expensive part of decoding."""
+    return _g1_decode_cached(bytes(data))
+
+
+@functools.lru_cache(maxsize=4096)
+def _g1_decode_cached(data: bytes):
+    return _g1_decode_uncached(data)
 
 
 def g2_encode(pt) -> bytes:
@@ -402,7 +494,11 @@ def g2_encode(pt) -> bytes:
             + y[1].to_bytes(48, "big") + y[0].to_bytes(48, "big"))
 
 
-def g2_decode(data: bytes):
+def g2_decode_lax(data: bytes):
+    """Decode with the on-curve check only (no subgroup test).  For callers
+    aggregating many fresh signatures who subgroup-check the single
+    aggregate instead: the verified pairing statement depends only on the
+    aggregate, so that costs one [R]P ladder instead of N."""
     if data[0] == 0x40:
         return None
     x = (int.from_bytes(data[48:96], "big"),
@@ -412,6 +508,13 @@ def g2_decode(data: bytes):
     pt = (x, y)
     if not g2_on_curve(pt):
         raise ValueError("not on G2")
+    return pt
+
+
+def g2_decode(data: bytes):
+    pt = g2_decode_lax(data)
+    if pt is not None and _jac_mul(pt, R, _fq2) is not None:
+        raise ValueError("G2 point not in the prime-order subgroup")
     return pt
 
 
@@ -490,7 +593,7 @@ def hash_to_g2(msg: bytes):
         y2 = fq2_add(fq2_mul(fq2_mul(x, x), x), _fq2.b)
         y = _fq2_sqrt(y2)
         if y is not None:
-            pt = _mul((x, y), _G2_COFACTOR, _fq2)
+            pt = _jac_mul((x, y), _G2_COFACTOR, _fq2)
             if pt is not None:
                 return pt
         counter += 1

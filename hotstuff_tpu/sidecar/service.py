@@ -166,7 +166,16 @@ class VerifyEngine:
             if isinstance(req, proto.BlsVotesRequest):
                 # C++ nodes ship per-vote signatures; aggregate them here
                 # (host G2 adds), then run the same common-message check.
-                agg = bls.aggregate([bls.g2_decode(s) for s in req.sigs])
+                # Fresh per-vote sigs get on-curve checks only; the single
+                # aggregate gets the [R]P subgroup test — the pairing
+                # statement depends only on the aggregate, so this is the
+                # same soundness at 1/N the host cost (per-vote subgroup
+                # ladders can't be cached the way committee keys can).
+                agg = bls.aggregate(
+                    [bls.g2_decode_lax(s) for s in req.sigs])
+                if not bls.g2_in_subgroup(agg):
+                    item.reply_fn([False])
+                    return
             else:
                 agg = bls.g2_decode(req.agg_sig)
             pks = [bls.g1_decode(p) for p in req.pks]

@@ -3,6 +3,7 @@ Schnorr roundtrips, BLS12-381 pairing algebra + signatures + aggregation,
 and the benchmark harness plumbing."""
 
 import hashlib
+import pytest
 
 
 from hotstuff_tpu.offchain import bls12381 as bls
@@ -132,6 +133,68 @@ def test_bls_encoding_roundtrip():
     assert bls.g2_decode(bls.g2_encode(sig)) == sig
     assert len(bls.g1_encode(pk)) == 96
     assert len(bls.g2_encode(sig)) == 192
+
+
+def _g1_curve_point_outside_subgroup():
+    x = 5
+    while True:
+        rhs = (x * x * x + 4) % bls.Q
+        y = pow(rhs, (bls.Q + 1) // 4, bls.Q)
+        if y * y % bls.Q == rhs:
+            pt = (x, y)
+            if not bls.g1_in_subgroup(pt):
+                return pt
+        x += 1
+
+
+def _g2_curve_point_outside_subgroup():
+    xa = 1
+    while True:
+        xx = (xa, 0)
+        rhs = bls.fq2_add(bls.fq2_mul(bls.fq2_mul(xx, xx), xx), bls._fq2.b)
+        y = bls._fq2_sqrt(rhs)
+        if y is not None:
+            pt = (xx, y)
+            if not bls.g2_in_subgroup(pt):
+                return pt
+        xa += 1
+
+
+def test_bls_wrong_subgroup_rejected_on_decode():
+    """filecoin bls-signatures parity (production/Cargo.toml:10): on-curve
+    points with a cofactor component must fail deserialization — aggregate
+    verification over them is undefined."""
+    g1_rogue = _g1_curve_point_outside_subgroup()
+    assert bls.g1_on_curve(g1_rogue)
+    with pytest.raises(ValueError, match="subgroup"):
+        bls.g1_decode(bls.g1_encode(g1_rogue))
+    # cofactor-clearing the same point makes it decodable
+    h1 = 0x396C8C005555E1568C00AAAB0000AAAB  # (x-1)^2 / 3
+    cleared = bls._jac_mul(g1_rogue, h1, bls._fq)
+    assert bls.g1_decode(bls.g1_encode(cleared)) == cleared
+
+    g2_rogue = _g2_curve_point_outside_subgroup()
+    assert bls.g2_on_curve(g2_rogue)
+    with pytest.raises(ValueError, match="subgroup"):
+        bls.g2_decode(bls.g2_encode(g2_rogue))
+    cleared2 = bls._jac_mul(g2_rogue, bls._G2_COFACTOR, bls._fq2)
+    assert bls.g2_decode(bls.g2_encode(cleared2)) == cleared2
+
+    # infinity encodings still decode to None
+    assert bls.g1_decode(bls.g1_encode(None)) is None
+    assert bls.g2_decode(bls.g2_encode(None)) is None
+
+
+def test_bls_jacobian_mul_matches_affine():
+    """Pin the inversion-free Jacobian ladder (used by the subgroup checks)
+    to the affine reference arithmetic."""
+    for ops, gen in ((bls._fq, bls.g1_generator()),
+                     (bls._fq2, bls.g2_generator())):
+        for k in (1, 2, 3, 5, 255, 65537, 2**64 + 3, bls.R - 1):
+            assert bls._jac_mul(gen, k, ops) == bls._mul(gen, k, ops)
+        assert bls._jac_mul(gen, bls.R, ops) is None
+        assert bls._jac_mul(gen, 0, ops) is None
+        assert bls._jac_mul(None, 7, ops) is None
 
 
 # ---------------------------------------------------------------------------

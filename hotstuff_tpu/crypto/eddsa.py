@@ -31,11 +31,20 @@ L = E.L
 _MIN_BUCKET = 8
 
 
-def _bucket(n: int) -> int:
-    b = _MIN_BUCKET
+def next_pow2(n: int, lo: int = 1) -> int:
+    """Smallest power-of-two multiple of ``lo`` that is >= n (lo itself a
+    power of two).  THE bucketing rule for compiled batch shapes: the
+    single-device path, the mesh per-shard path, and the sidecar warmup
+    must all agree on it, or a runtime batch can hit a shape warmup never
+    compiled (a mid-traffic XLA compile stall)."""
+    b = lo
     while b < n:
         b *= 2
     return b
+
+
+def _bucket(n: int) -> int:
+    return next_pow2(n, _MIN_BUCKET)
 
 
 _L_BYTES = np.frombuffer(L.to_bytes(32, "little"), np.uint8).astype(np.int16)
@@ -173,7 +182,7 @@ def verify_prepared_rows(packed: np.ndarray, n: int, *,
         return np.asarray(E.verify_packed_jit(jnp.asarray(packed)))[:n]
     g = -(-n // MAX_SUBBATCH)
     if pad:  # bound the number of compiled scan lengths: next power of two
-        g = 1 << (g - 1).bit_length()
+        g = next_pow2(g)
     m = g * MAX_SUBBATCH
     if m != n:
         packed = np.pad(packed, [(0, m - n), (0, 0)])

@@ -23,12 +23,15 @@ def cmd_local(args):
         "rate": [args.rate],
         "tx_size": args.tx_size,
         "duration": args.duration,
-        "tpu_sidecar": args.tpu_sidecar or args.scheme == "bls",
+        "tpu_sidecar": (args.tpu_sidecar or args.sidecar_host_crypto
+                        or args.scheme == "bls"),
+        "sidecar_host_crypto": args.sidecar_host_crypto,
         "scheme": args.scheme,
     })
     node_params = NodeParameters.default(
         tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
-                     if (args.tpu_sidecar or args.scheme == "bls")
+                     if (args.tpu_sidecar or args.sidecar_host_crypto
+                         or args.scheme == "bls")
                      else None),
         scheme=args.scheme if args.scheme != "ed25519" else None,
         chain=args.chain)
@@ -199,6 +202,10 @@ def main(argv=None):
     p.add_argument("--batch-size", type=int, default=15_000)
     p.add_argument("--timeout", type=int, default=1_000)
     p.add_argument("--duration", type=int, default=30, help="seconds")
+    p.add_argument("--sidecar-host-crypto", action="store_true",
+                   help="run the sidecar with --host-crypto (no device; "
+                        "also the automatic fallback when the device "
+                        "sidecar never becomes ready)")
     p.add_argument("--tpu-sidecar", action="store_true",
                    help="route QC verification through the TPU sidecar")
     p.add_argument("--chain", type=int, choices=[2, 3], default=2,

@@ -198,6 +198,8 @@ class BenchParameters:
             self.duration = int(json_input["duration"])
             self.runs = int(json_input.get("runs", 1))
             self.tpu_sidecar = bool(json_input.get("tpu_sidecar", False))
+            self.sidecar_host_crypto = bool(
+                json_input.get("sidecar_host_crypto", False))
             self.scheme = str(json_input.get("scheme", "ed25519"))
         except KeyError as e:
             raise ConfigError(f"Malformed bench parameters: missing key {e}")

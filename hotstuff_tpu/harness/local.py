@@ -33,6 +33,8 @@ class LocalBench:
         self.faults = bench_parameters.faults
         self.duration = bench_parameters.duration
         self.tpu_sidecar = getattr(bench_parameters, "tpu_sidecar", False)
+        self.sidecar_host_crypto = getattr(
+            bench_parameters, "sidecar_host_crypto", False)
         self.scheme = getattr(bench_parameters, "scheme", "ed25519")
         if self.scheme == "bls":
             self.tpu_sidecar = True  # no host pairing in the C++ plane
@@ -41,10 +43,11 @@ class LocalBench:
                          if self.tpu_sidecar else None),
             scheme=self.scheme if self.scheme != "ed25519" else None)
         self._procs = []
+        self._degraded = False
 
-    def _background_run(self, command, log_file):
+    def _background_run(self, command, log_file, append=False):
         name = command.split()[0]
-        cmd = f"{command} 2> {log_file}"
+        cmd = f"{command} 2{'>>' if append else '>'} {log_file}"
         proc = subprocess.Popen(
             ["/bin/sh", "-c", cmd], preexec_fn=os.setsid)
         self._procs.append((name, proc))
@@ -78,11 +81,53 @@ class LocalBench:
             except (ProcessLookupError, PermissionError):
                 pass
         self._procs = []
-        subprocess.run(
-            ["/bin/sh", "-c",
-             "pkill -f '\\./node run' 2>/dev/null; "
-             "pkill -f '\\./client 127' 2>/dev/null; true"],
-            check=False)
+        # Stale-state discipline (benchmark/local.py:31-37): also sweep by
+        # pattern for processes from previous runs this harness no longer
+        # tracks — including the sidecar, which a wedged device can leave
+        # hung past its process group's SIGTERM.  Each pkill is exec'd
+        # directly: under `sh -c "pkill ...; pkill ..."` the first pattern
+        # matches the wrapper shell's own cmdline and kills the rest of
+        # the chain before it runs.
+        for args in (["pkill", "-f", r"\./node run"],
+                     ["pkill", "-f", r"\./client 127"],
+                     ["pkill", "-9", "-f", r"hotstuff_tpu\.sidecar"]):
+            subprocess.run(args, check=False, capture_output=True)
+
+    def _boot_sidecar(self, host_crypto: bool):
+        """Boot the verify sidecar and wait for readiness.  If the device
+        path never comes up (wedged TPU tunnel: jit warmup blocks forever),
+        kill it and degrade to a --host-crypto sidecar with a loud warning
+        — a host-mode result beats a dead bench."""
+        mode = " (HOST crypto)" if host_crypto else ""
+        Print.info(f"Booting TPU verify sidecar...{mode}")
+        warm_bls = " --warm-bls" if self.scheme == "bls" else ""
+        hc = " --host-crypto" if host_crypto else ""
+        # The degraded reboot appends to the log: the dead device
+        # sidecar's output is the evidence needed to diagnose the wedge.
+        self._background_run(
+            f"python -m hotstuff_tpu.sidecar "
+            f"--port {self.SIDECAR_PORT}{warm_bls}{hc}",
+            PathMaker.sidecar_log_file(),
+            append=self._degraded)
+        # The BLS pairing program is a multi-minute first compile on the
+        # device (cached across restarts via the XLA compilation cache);
+        # host-crypto warmup compiles nothing.
+        if host_crypto:
+            deadline = 120
+        else:
+            deadline = 900 if self.scheme == "bls" else 300
+        try:
+            self._wait_sidecar_ready(deadline_s=deadline)
+        except BenchError:
+            self._kill_nodes()
+            if host_crypto:
+                raise
+            Print.warn(
+                "TPU sidecar never became ready (wedged device tunnel?); "
+                "DEGRADING to a host-crypto sidecar. This run will NOT "
+                "measure the device verify path.")
+            self._degraded = True
+            self._boot_sidecar(host_crypto=True)
 
     def run(self, debug=False):
         assert isinstance(debug, bool)
@@ -130,16 +175,7 @@ class LocalBench:
             # node booted earlier would merely fall back to host verify, but
             # the whole point of this mode is to measure the device path.
             if self.tpu_sidecar:
-                Print.info("Booting TPU verify sidecar...")
-                warm_bls = " --warm-bls" if self.scheme == "bls" else ""
-                self._background_run(
-                    f"python -m hotstuff_tpu.sidecar "
-                    f"--port {self.SIDECAR_PORT}{warm_bls}",
-                    PathMaker.sidecar_log_file())
-                # The BLS pairing program is a multi-minute first compile
-                # (cached across restarts via the XLA compilation cache).
-                self._wait_sidecar_ready(
-                    deadline_s=900 if self.scheme == "bls" else 300)
+                self._boot_sidecar(host_crypto=self.sidecar_host_crypto)
 
             # Do not boot faulty nodes (crash faults, local.py:75-76 in the
             # reference); clients only target alive nodes and split the rate
@@ -176,8 +212,20 @@ class LocalBench:
 
             # Parse logs and return the summary.
             Print.info("Parsing logs...")
-            return LogParser.process(PathMaker.logs_path(),
-                                     faults=self.faults)
+            parser = LogParser.process(PathMaker.logs_path(),
+                                       faults=self.faults)
+            if self._degraded:
+                # Mark the persisted result: host-mode numbers must never
+                # masquerade as device-path data in later aggregation.
+                parser.notes.append(
+                    "Sidecar mode: host-crypto (DEGRADED - device "
+                    "path was unavailable)")
+            return parser
+        except BenchError:
+            # e.g. sidecar readiness failure after the host-crypto retry:
+            # sweep everything (incl. a hung sidecar) before propagating.
+            self._kill_nodes()
+            raise
         except (subprocess.SubprocessError, ParseError) as e:
             self._kill_nodes()
             raise BenchError("Failed to run benchmark", e)

@@ -36,6 +36,11 @@ class LogParser:
             raise ParseError("missing client or node logs")
 
         self.faults = faults
+        # Free-form annotations appended to the CONFIG section of the
+        # summary (e.g. the harness marking a degraded host-crypto run).
+        # Extra lines are invisible to the frozen result-grammar parsers,
+        # which match labelled fields only.
+        self.notes = []
         if isinstance(faults, int):
             self.committee_size = len(nodes) + int(faults)
         else:
@@ -237,6 +242,7 @@ class LogParser:
             f" Mempool batch size: {batch_size:,} B\n"
             f" Mempool max batch delay: "
             f"{cfg['mempool']['max_batch_delay']:,} ms\n"
+            + "".join(f" {note}\n" for note in self.notes) +
             "\n"
             " + RESULTS:\n"
             f" Consensus TPS: {round(consensus_tps):,} tx/s\n"

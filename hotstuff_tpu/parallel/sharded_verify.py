@@ -19,7 +19,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as Pspec
 
-from ..crypto.eddsa import MAX_SUBBATCH
+from ..crypto.eddsa import MAX_SUBBATCH, next_pow2
 from ..ops import ed25519 as E
 from .mesh import BATCH_AXIS
 
@@ -93,10 +93,17 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
     host-rejected votes are excluded from the device-side verdict count."""
     n = prep["a"].shape[0]
     n_dev = mesh.devices.size
-    quantum = n_dev
-    if n > n_dev * max_subbatch:
-        quantum = n_dev * max_subbatch
-    m = ((n + quantum - 1) // quantum) * quantum
+    # Bucket the per-shard size to a power of two (mirroring
+    # crypto/eddsa.verify_prepared_rows): the sidecar pre-compiles exactly
+    # the power-of-two shapes, so any other per-shard size (e.g. 3000 sigs
+    # on 8 devices -> 375-row shards) would hit a first-time XLA compile on
+    # the engine thread mid-traffic — the stall warmup exists to prevent.
+    per_shard = -(-n // n_dev)
+    if per_shard <= max_subbatch:
+        m = n_dev * min(next_pow2(per_shard), max_subbatch)
+    else:
+        g = next_pow2(-(-per_shard // max_subbatch))
+        m = n_dev * max_subbatch * g
     arrays = dict(prep)
     arrays["present"] = prep["host_ok"].astype(np.int32)
     out = []

@@ -294,8 +294,9 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         if warm_bls:
             _warmup_bls()
         if warm_bulk:
-            # Works for both the single-device chunked scan and the mesh
-            # path (parallel/sharded_verify chunks per shard the same way).
+            # Covers both the single-device chunked scan and the mesh path:
+            # verify_batch_sharded buckets per-shard sizes to powers of two,
+            # so every launchable mesh batch maps onto a shape warmed here.
             _warmup_bulk(engine)
             engine.enable_bulk()
     server = SidecarServer((host, port), engine)

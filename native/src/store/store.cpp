@@ -35,8 +35,13 @@ size_t wal_append(std::FILE* f, const Bytes& key, const Bytes& value,
 }
 
 // Rewrite the WAL as a snapshot of the live map: write wal.tmp, sync,
-// atomically rename over the old file, sync the directory, reopen for
-// append.  On failure the old handle and counters stay untouched.
+// open the fresh append handle on the snapshot, atomically rename it over
+// the old file, sync the directory.  Every fallible step happens BEFORE
+// the rename (the append fd follows the inode through it), so failure can
+// only skip the compaction and keep the old handle — never strand the
+// store memory-only, which would let the consensus core's vote-watermark
+// persistence "succeed" against the in-memory map and double-vote after a
+// crash.
 struct CompactResult {
   std::FILE* wal;
   size_t snapshot_bytes = 0;
@@ -59,8 +64,15 @@ CompactResult wal_compact(
   std::fflush(f);
   ::fsync(::fileno(f));  // snapshot on disk before it replaces the WAL
   std::fclose(f);
+  std::FILE* fresh = std::fopen(tmp.c_str(), "ab");
+  if (!fresh) {
+    LOG_WARN("store") << "compaction skipped: cannot reopen snapshot";
+    std::remove(tmp.c_str());
+    return {old_wal};
+  }
   if (std::rename(tmp.c_str(), wal_path.c_str()) != 0) {
     LOG_WARN("store") << "compaction skipped: rename failed";
+    std::fclose(fresh);
     std::remove(tmp.c_str());
     return {old_wal};
   }
@@ -70,12 +82,6 @@ CompactResult wal_compact(
     ::close(dfd);
   }
   std::fclose(old_wal);
-  std::FILE* fresh = std::fopen(wal_path.c_str(), "ab");
-  if (!fresh) {
-    LOG_ERROR("store") << "WAL reopen failed after compaction; store "
-                          "continues memory-only";
-    return {nullptr, bytes, true};
-  }
   LOG_INFO("store") << "WAL compacted to " << bytes << " bytes";
   return {fresh, bytes, true};
 }

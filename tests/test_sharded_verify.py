@@ -61,7 +61,9 @@ def test_sharded_chunked_large_batch():
     msgs = [base[i % 12][0] for i in range(n)]
     pks = [base[i % 12][1] for i in range(n)]
     sigs = [base[i % 12][2] for i in range(n)]
-    sigs[777] = bytes(64)  # one invalid vote
+    # One invalid vote that survives host canonicality (valid encodings,
+    # wrong equation) so the DEVICE must find it: flip a bit in S.
+    sigs[777] = sigs[777][:33] + bytes([sigs[777][33] ^ 1]) + sigs[777][34:]
     mesh = make_mesh(8)
     prep = eddsa.prepare_batch(msgs, pks, sigs)
     mask, bad = verify_batch_sharded(mesh, prep, return_bad_total=True,

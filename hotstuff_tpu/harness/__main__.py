@@ -17,22 +17,21 @@ def cmd_local(args):
     from .local import LocalBench
     from .utils import BenchError, Print
 
+    use_sidecar = (args.tpu_sidecar or args.sidecar_host_crypto
+                   or args.scheme == "bls")
     bench_params = BenchParameters({
         "faults": args.faults,
         "nodes": [args.nodes],
         "rate": [args.rate],
         "tx_size": args.tx_size,
         "duration": args.duration,
-        "tpu_sidecar": (args.tpu_sidecar or args.sidecar_host_crypto
-                        or args.scheme == "bls"),
+        "tpu_sidecar": use_sidecar,
         "sidecar_host_crypto": args.sidecar_host_crypto,
         "scheme": args.scheme,
     })
     node_params = NodeParameters.default(
         tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
-                     if (args.tpu_sidecar or args.sidecar_host_crypto
-                         or args.scheme == "bls")
-                     else None),
+                     if use_sidecar else None),
         scheme=args.scheme if args.scheme != "ed25519" else None,
         chain=args.chain)
     node_params.json["mempool"]["batch_size"] = args.batch_size

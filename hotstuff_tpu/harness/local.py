@@ -35,6 +35,8 @@ class LocalBench:
         self.tpu_sidecar = getattr(bench_parameters, "tpu_sidecar", False)
         self.sidecar_host_crypto = getattr(
             bench_parameters, "sidecar_host_crypto", False)
+        if self.sidecar_host_crypto:
+            self.tpu_sidecar = True  # host-crypto still runs the sidecar
         self.scheme = getattr(bench_parameters, "scheme", "ed25519")
         if self.scheme == "bls":
             self.tpu_sidecar = True  # no host pairing in the C++ plane

@@ -19,7 +19,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as Pspec
 
-from ..crypto.eddsa import MAX_SUBBATCH, next_pow2
+from ..crypto.eddsa import _MIN_BUCKET, MAX_SUBBATCH, next_pow2
 from ..ops import ed25519 as E
 from .mesh import BATCH_AXIS
 
@@ -100,7 +100,12 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
     # the engine thread mid-traffic — the stall warmup exists to prevent.
     per_shard = -(-n // n_dev)
     if per_shard <= max_subbatch:
-        m = n_dev * min(next_pow2(per_shard), max_subbatch)
+        # Floor at the smallest per-shard shape warmup compiles: warmed
+        # global sizes start at _MIN_BUCKET, i.e. _MIN_BUCKET/n_dev rows
+        # per shard (tiny lone requests on small meshes would otherwise
+        # still hit a cold shape).
+        lo = max(1, _MIN_BUCKET // n_dev)
+        m = n_dev * min(next_pow2(per_shard, lo), max_subbatch)
     else:
         g = next_pow2(-(-per_shard // max_subbatch))
         m = n_dev * max_subbatch * g

@@ -207,3 +207,44 @@ def test_chunked_batch_over_subbatch_cap():
     assert mask.shape == (n,)
     assert not mask[eddsa.MAX_SUBBATCH + 7]
     assert mask.sum() == n - 1
+
+
+def test_ab_flag_variants_match_reference():
+    """Every import-time A/B switch (scripts/eval_device.py knobs) must
+    produce reference-identical verdicts: a correctness bug in a flagged
+    code path would otherwise surface only mid-A/B on a live device."""
+    import importlib
+    import os
+
+    from hotstuff_tpu.ops import ed25519 as E
+
+    flags = {
+        "HOTSTUFF_TPU_STACK_MULS": "0",
+        "HOTSTUFF_TPU_ONEHOT_SELECT": "0",
+        "HOTSTUFF_TPU_TUPLE_POINTS": "0",
+        "HOTSTUFF_TPU_JOINT_DECOMPRESS": "1",
+    }
+    triples = make_sigs(6, seed=31)
+    msgs, pks, sigs = map(list, zip(*triples))
+    sigs[2] = sigs[2][:40] + bytes([sigs[2][40] ^ 4]) + sigs[2][41:]
+    msgs[4] = b"tampered"
+    expect = [ref.verify(pk, m, s) for m, pk, s in zip(msgs, pks, sigs)]
+    assert expect == [True, True, False, True, False, True]
+    prep = eddsa.prepare_batch(msgs, pks, sigs)
+    assert prep["host_ok"].all()
+
+    saved = {k: os.environ.get(k) for k in flags}
+    try:
+        for flag, default in flags.items():
+            os.environ[flag] = "0" if default == "1" else "1"
+            E2 = importlib.reload(E)
+            got = eddsa.verify_prepared_rows(prep["packed"], len(msgs))
+            assert list(got) == expect, f"{flag} variant diverges"
+            os.environ[flag] = default
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        importlib.reload(E)

@@ -6,6 +6,7 @@ coverage essential here.)
 """
 
 import json
+import os
 
 import pytest
 
@@ -162,6 +163,10 @@ def test_node_parameters_chain_depth():
 # sidecar process; the device sidecar must degrade to host crypto)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.skipif(
+    os.environ.get("HOTSTUFF_TPU_NO_PKILL_TESTS") == "1",
+    reason="machine-wide pkill sweep; opt out on shared machines running "
+           "a real bench/sidecar")
 def test_kill_nodes_sweeps_orphaned_sidecar():
     """_kill_nodes must reap sidecar processes it no longer tracks (a
     wedged device leaves them hung past their process group's SIGTERM)."""

@@ -102,7 +102,13 @@ class LocalBench:
         — a host-mode result beats a dead bench."""
         mode = " (HOST crypto)" if host_crypto else ""
         Print.info(f"Booting TPU verify sidecar...{mode}")
-        warm_bls = " --warm-bls" if self.scheme == "bls" else ""
+        warm_bls = ""
+        if self.scheme == "bls":
+            # Warm both BLS shapes: the 2-pairing QC check and the
+            # quorum-size multi-digest TC check (one compiled program per
+            # vote count; unwarmed counts verify on host).
+            quorum = 2 * ((self.nodes - 1) // 3) + 1
+            warm_bls = f" --warm-bls --warm-bls-multi {quorum}"
         hc = " --host-crypto" if host_crypto else ""
         # The degraded reboot appends to the log: the dead device
         # sidecar's output is the evidence needed to diagnose the wedge.

@@ -262,3 +262,23 @@ def verify_aggregate_common(pks, msg: bytes, agg_sig) -> bool:
     lines = np.stack([miller_lines(apk, h),
                       miller_lines(neg_g1, agg_sig)])
     return bool(np.asarray(pairings_check_jit(jnp.asarray(lines))))
+
+
+def verify_aggregate_multi(pks, msgs, agg_sig) -> bool:
+    """Distinct-message aggregate verify (the TC shape: 2f+1 timeout votes
+    over per-round digests, consensus/src/messages.rs:307-313):
+    prod e(pk_i, H(m_i)) * e(-g1, agg) == 1, all n+1 Miller loops batched
+    under ONE final exponentiation on device.  Compiles one program per
+    vote count; a committee's TC size is fixed at 2f+1, so that is a
+    single shape in practice."""
+    if len(pks) != len(msgs) or not pks:
+        return False
+    if agg_sig is None or not host.g2_on_curve(agg_sig):
+        return False
+    rows = []
+    for pk, msg in zip(pks, msgs):
+        if pk is None or not host.g1_on_curve(pk):
+            return False
+        rows.append(miller_lines(pk, host.hash_to_g2(msg)))
+    rows.append(miller_lines(host.g1_neg(host.g1_generator()), agg_sig))
+    return bool(np.asarray(pairings_check_jit(jnp.asarray(np.stack(rows)))))

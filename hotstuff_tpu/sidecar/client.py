@@ -55,6 +55,14 @@ class SidecarClient:
         body = self._await(rid)
         return bool(body and body[0])
 
+    def bls_verify_multi(self, msgs, pks, sigs) -> bool:
+        """Multi-digest BLS verify (the TC shape): n (digest, pk, sig)
+        triples checked as one product of pairings in ONE round-trip."""
+        rid = self._send(
+            lambda r: proto.encode_bls_multi_request(r, msgs, pks, sigs))
+        body = self._await(rid)
+        return bool(body and body[0])
+
     def bls_sign(self, msg: bytes, sk: bytes) -> bytes:
         """BLS sign via the sidecar's host signer -> 192 B G2 signature.
         Raises on failure (the service replies with an empty body)."""

@@ -44,6 +44,11 @@ OP_BLS_SIGN = 4
 # the sidecar aggregates the signatures itself, then runs the same
 # common-message 2-pairing check. Reply: one 0/1 byte.
 OP_BLS_VERIFY_VOTES = 5
+# Multi-digest variant (the TC shape: per-vote signatures over DISTINCT
+# digests, consensus/src/messages.rs:307-313): one RPC, verified as
+# prod e(pk_i, H(m_i)) == e(g1, sum sig_i) under a single final
+# exponentiation. Reply: one 0/1 byte.
+OP_BLS_VERIFY_MULTI = 6
 
 _HDR = struct.Struct("<BIIH")  # opcode, request id, count, msg_len
 _REPLY_HDR = struct.Struct("<BII")
@@ -82,6 +87,14 @@ class BlsSignRequest:
 class BlsVotesRequest:
     request_id: int
     msg: bytes
+    pks: list             # n x 96 B uncompressed G1
+    sigs: list            # n x 192 B uncompressed G2
+
+
+@dataclass
+class BlsMultiRequest:
+    request_id: int
+    msgs: list            # n x msg_len digests (distinct per vote)
     pks: list             # n x 96 B uncompressed G1
     sigs: list            # n x 192 B uncompressed G2
 
@@ -129,11 +142,23 @@ def encode_bls_votes_request(request_id: int, msg: bytes, pks,
     return struct.pack(">I", len(payload)) + payload
 
 
+def encode_bls_multi_request(request_id: int, msgs, pks, sigs) -> bytes:
+    n = len(msgs)
+    assert len(pks) == n and len(sigs) == n
+    msg_len = len(msgs[0]) if n else 0
+    assert all(len(m) == msg_len for m in msgs)
+    recs = b"".join(m + p + s for m, p, s in zip(msgs, pks, sigs))
+    payload = (_HDR.pack(OP_BLS_VERIFY_MULTI, request_id, n, msg_len)
+               + recs)
+    return struct.pack(">I", len(payload)) + payload
+
+
 def decode_request(payload: bytes):
     """payload (no length prefix) -> (opcode, request dataclass)."""
     opcode, request_id, n, msg_len = _HDR.unpack_from(payload, 0)
     if opcode not in (OP_VERIFY_BATCH, OP_PING, OP_BLS_VERIFY_AGG,
-                      OP_BLS_SIGN, OP_BLS_VERIFY_VOTES):
+                      OP_BLS_SIGN, OP_BLS_VERIFY_VOTES,
+                      OP_BLS_VERIFY_MULTI):
         raise ValueError(f"unknown opcode {opcode}")
     if opcode == OP_PING:
         return opcode, VerifyRequest(request_id, [], [], [])
@@ -168,6 +193,18 @@ def decode_request(payload: bytes):
             pks.append(payload[base:base + BLS_PK_LEN])
             sigs.append(payload[base + BLS_PK_LEN:base + rec])
         return opcode, BlsVotesRequest(request_id, msg, pks, sigs)
+    if opcode == OP_BLS_VERIFY_MULTI:
+        off = _HDR.size
+        rec = msg_len + BLS_PK_LEN + BLS_SIG_LEN
+        if len(payload) != off + n * rec:
+            raise ValueError("bad BLS multi frame")
+        msgs, pks, sigs = [], [], []
+        for i in range(n):
+            base = off + i * rec
+            msgs.append(payload[base:base + msg_len])
+            pks.append(payload[base + msg_len:base + msg_len + BLS_PK_LEN])
+            sigs.append(payload[base + msg_len + BLS_PK_LEN:base + rec])
+        return opcode, BlsMultiRequest(request_id, msgs, pks, sigs)
     rec = msg_len + 32 + 64
     off = _HDR.size
     if len(payload) != off + n * rec:

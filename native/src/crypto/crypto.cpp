@@ -184,14 +184,16 @@ bool Signature::verify_batch(
 
 bool Signature::verify_batch_multi(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
-  // BLS TCs carry per-vote BLS signatures over distinct digests; there is
-  // no aggregate shortcut for distinct messages in the sidecar protocol,
-  // and no host pairing — verify each via the per-signature path.
+  // BLS TCs carry per-vote BLS signatures over distinct digests: ONE
+  // multi-digest sidecar round-trip, verified device-side as a single
+  // product of pairings (TC verify parity: consensus/src/messages.rs:
+  // 307-313).  No host pairing exists, so transport failure rejects.
   if (current_scheme() == Scheme::kBls) {
-    for (const auto& [d, pk, sig] : items) {
-      if (!sig.verify(d, pk)) return false;
-    }
-    return true;
+    if (items.empty()) return true;
+    TpuVerifier* tpu = TpuVerifier::instance();
+    if (!tpu) return false;
+    auto ok = tpu->bls_verify_multi(items);
+    return ok.value_or(false);
   }
   TpuVerifier* tpu = TpuVerifier::instance();
   if (tpu && tpu->connected()) {

@@ -11,6 +11,7 @@ constexpr uint8_t kOpVerifyBatch = 1;
 constexpr uint8_t kOpBlsVerifyAgg = 3;
 constexpr uint8_t kOpBlsSign = 4;
 constexpr uint8_t kOpBlsVerifyVotes = 5;
+constexpr uint8_t kOpBlsVerifyMulti = 6;
 constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
@@ -164,6 +165,39 @@ std::optional<Bytes> TpuVerifier::bls_sign(const Digest& digest,
   }
 }
 
+// Append one committee vote record (pk looked up in BlsContext, then
+// signature) to `w`; false = unknown authority or malformed signature.
+bool TpuVerifier::append_bls_record_(BlsContext* bls, Writer* w,
+                                     const PublicKey& pk,
+                                     const Signature& sig) {
+  auto it = bls->public_keys.find(pk);
+  if (it == bls->public_keys.end() || it->second.size() != kBlsPkLen ||
+      sig.data.size() != kBlsSigLen) {
+    return false;
+  }
+  w->out.insert(w->out.end(), it->second.begin(), it->second.end());
+  w->out.insert(w->out.end(), sig.data.begin(), sig.data.end());
+  return true;
+}
+
+// Exchange `w` under the BLS deadline and parse the single 0/1-byte reply.
+std::optional<bool> TpuVerifier::bls_bool_exchange_locked_(
+    const Writer& w, uint8_t opcode, uint32_t rid) {
+  auto reply = bls_roundtrip_locked_(w.out);
+  if (!reply) return std::nullopt;
+  try {
+    Reader r(*reply);
+    uint8_t got_op = r.u8();
+    uint32_t got_rid = r.u32();
+    uint32_t n = r.u32();
+    if (got_op != opcode || got_rid != rid || n != 1) return std::nullopt;
+    return r.u8() != 0;
+  } catch (const SerdeError&) {
+    sock_.close();
+    return std::nullopt;
+  }
+}
+
 std::optional<bool> TpuVerifier::bls_verify_votes(
     const Digest& digest,
     const std::vector<std::pair<PublicKey, Signature>>& votes) {
@@ -177,32 +211,30 @@ std::optional<bool> TpuVerifier::bls_verify_votes(
   w.u32(static_cast<uint32_t>(votes.size()));
   w.u8(32);  // msg_len lo (u16 LE)
   w.u8(0);
-  w.fixed(digest.data);
+  w.fixed(digest.data);  // one shared digest for the whole QC
   for (const auto& [pk, sig] : votes) {
-    auto it = bls->public_keys.find(pk);
-    if (it == bls->public_keys.end() ||
-        it->second.size() != kBlsPkLen ||
-        sig.data.size() != kBlsSigLen) {
-      return false;  // unknown authority or malformed signature: reject
-    }
-    w.out.insert(w.out.end(), it->second.begin(), it->second.end());
-    w.out.insert(w.out.end(), sig.data.begin(), sig.data.end());
+    if (!append_bls_record_(bls, &w, pk, sig)) return false;
   }
-  auto reply = bls_roundtrip_locked_(w.out);
-  if (!reply) return std::nullopt;
-  try {
-    Reader r(*reply);
-    uint8_t opcode = r.u8();
-    uint32_t got_rid = r.u32();
-    uint32_t n = r.u32();
-    if (opcode != kOpBlsVerifyVotes || got_rid != rid || n != 1) {
-      return std::nullopt;
-    }
-    return r.u8() != 0;
-  } catch (const SerdeError&) {
-    sock_.close();
-    return std::nullopt;
+  return bls_bool_exchange_locked_(w, kOpBlsVerifyVotes, rid);
+}
+
+std::optional<bool> TpuVerifier::bls_verify_multi(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
+  BlsContext* bls = BlsContext::instance();
+  if (!bls) return std::nullopt;
+  std::lock_guard<std::mutex> lk(m_);
+  Writer w;
+  uint32_t rid = next_id_++;
+  w.u8(kOpBlsVerifyMulti);
+  w.u32(rid);
+  w.u32(static_cast<uint32_t>(items.size()));
+  w.u8(32);  // msg_len lo (u16 LE)
+  w.u8(0);
+  for (const auto& [digest, pk, sig] : items) {
+    w.fixed(digest.data);  // one digest PER record (the TC shape)
+    if (!append_bls_record_(bls, &w, pk, sig)) return false;
   }
+  return bls_bool_exchange_locked_(w, kOpBlsVerifyMulti, rid);
 }
 
 }  // namespace hotstuff

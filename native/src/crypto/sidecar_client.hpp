@@ -20,6 +20,8 @@ namespace hotstuff {
 struct Digest;
 struct PublicKey;
 struct Signature;
+struct BlsContext;
+class Writer;
 
 class TpuVerifier {
  public:
@@ -46,6 +48,19 @@ class TpuVerifier {
   std::optional<bool> bls_verify_votes(
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes);
+  // Distinct digest per vote (the TC shape): ONE round-trip, verified
+  // device-side as a single product of pairings.
+  std::optional<bool> bls_verify_multi(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
+
+ private:
+  bool append_bls_record_(BlsContext* bls, Writer* w, const PublicKey& pk,
+                          const Signature& sig);
+  std::optional<bool> bls_bool_exchange_locked_(const Writer& w,
+                                                uint8_t opcode,
+                                                uint32_t rid);
+
+ public:
 
   // Deadlines (ms). Every sidecar interaction is bounded: a slow or wedged
   // device process makes verify_batch return nullopt (host fallback), never

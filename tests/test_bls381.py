@@ -106,3 +106,20 @@ def test_aggregate_verify_device_end_to_end():
     assert D.verify_aggregate_common(list(pks), msg, agg)
     bad = host.aggregate(sigs[:3] + [host.sign(sks[0], b"other")])
     assert not D.verify_aggregate_common(list(pks), msg, bad)
+
+
+@pytest.mark.skipif(os.environ.get("HOTSTUFF_TPU_SLOW_TESTS") != "1",
+                    reason="~4 min XLA compile; set HOTSTUFF_TPU_SLOW_TESTS=1")
+def test_aggregate_verify_multi_device_end_to_end():
+    """Distinct-digest product-of-pairings (the TC verify shape)."""
+    sks, pks = zip(*[host.key_gen(bytes([i]) * 32) for i in range(1, 4)])
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    sigs = [host.sign(s, m) for s, m in zip(sks, msgs)]
+    agg = host.aggregate(sigs)
+    assert D.verify_aggregate_multi(list(pks), msgs, agg)
+    # wrong digest on one vote breaks the product
+    bad = host.aggregate(sigs[:2] + [host.sign(sks[2], b"x" * 32)])
+    assert not D.verify_aggregate_multi(list(pks), msgs, bad)
+    # mismatched lengths and empty input reject without device work
+    assert not D.verify_aggregate_multi(list(pks), msgs[:2], agg)
+    assert not D.verify_aggregate_multi([], [], agg)

@@ -130,3 +130,33 @@ def test_sidecar_bls_sign_and_aggregate_verify(host_server):
         # garbage bytes reject instead of crashing the connection
         assert not client.bls_verify_aggregate(msg, b"\x01" * 192, pk_enc)
         assert client.ping()  # connection still healthy
+
+
+def test_sidecar_bls_multi_digest_verify(host_server):
+    """The TC wire shape (OP_BLS_VERIFY_MULTI): per-vote signatures over
+    DISTINCT digests verified in one round-trip (round-3 verdict: this
+    used to be N per-signature RPCs at view-change time)."""
+    from hotstuff_tpu.offchain import bls12381 as bls
+
+    port = host_server.server_address[1]
+    keys = [bls.key_gen(bytes([i]) * 32) for i in range(1, 5)]
+    msgs = [bytes([i]) * 32 for i in range(4)]  # distinct per-vote digests
+    pk_enc = [bls.g1_encode(pk) for _, pk in keys]
+    sig_enc = [bls.g2_encode(bls.sign(sk, m))
+               for (sk, _), m in zip(keys, msgs)]
+    with SidecarClient(port=port) as client:
+        assert client.bls_verify_multi(msgs, pk_enc, sig_enc)
+        # one signature over the wrong digest rejects the whole TC
+        bad = list(sig_enc)
+        bad[2] = bls.g2_encode(bls.sign(keys[2][0], b"wrong" * 7))
+        assert not client.bls_verify_multi(msgs, pk_enc, bad)
+        # signature order can't matter (the aggregate is a sum) ...
+        assert client.bls_verify_multi(msgs, pk_enc,
+                                       sig_enc[::-1])
+        # ... but the pk<->digest pairing does: swapped keys reject
+        swapped_pks = [pk_enc[1], pk_enc[0]] + pk_enc[2:]
+        assert not client.bls_verify_multi(msgs, swapped_pks, sig_enc)
+        # garbage signature bytes reject instead of crashing
+        assert not client.bls_verify_multi(msgs, pk_enc,
+                                           [b"\x02" * 192] * 4)
+        assert client.ping()

@@ -35,6 +35,7 @@ def cmd_local(args):
         scheme=args.scheme if args.scheme != "ed25519" else None,
         chain=args.chain)
     node_params.json["mempool"]["batch_size"] = args.batch_size
+    node_params.json["mempool"]["max_batch_delay"] = args.batch_delay
     node_params.json["consensus"]["timeout_delay"] = args.timeout
     try:
         ret = LocalBench(bench_params, node_params).run(debug=args.debug)
@@ -199,6 +200,8 @@ def main(argv=None):
     p.add_argument("--rate", type=int, default=100_000)
     p.add_argument("--tx-size", type=int, default=512)
     p.add_argument("--batch-size", type=int, default=15_000)
+    p.add_argument("--batch-delay", type=int, default=100,
+                   help="mempool max batch delay (ms)")
     p.add_argument("--timeout", type=int, default=1_000)
     p.add_argument("--duration", type=int, default=30, help="seconds")
     p.add_argument("--sidecar-host-crypto", action="store_true",

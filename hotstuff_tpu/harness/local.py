@@ -49,7 +49,10 @@ class LocalBench:
 
     def _background_run(self, command, log_file, append=False):
         name = command.split()[0]
-        cmd = f"{command} 2{'>>' if append else '>'} {log_file}"
+        # stdout -> /dev/null: children must not inherit the harness's
+        # stdout pipe, or an orphaned node keeps a killed harness's caller
+        # blocked on that pipe forever (logs go to stderr).
+        cmd = f"{command} > /dev/null 2{'>>' if append else '>'} {log_file}"
         proc = subprocess.Popen(
             ["/bin/sh", "-c", cmd], preexec_fn=os.setsid)
         self._procs.append((name, proc))

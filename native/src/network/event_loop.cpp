@@ -1,0 +1,413 @@
+#include "network/event_loop.hpp"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <condition_variable>
+#include <cerrno>
+#include <cstring>
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+
+namespace {
+
+constexpr size_t kMaxFrame = 8u << 20;  // reference LengthDelimitedCodec cap
+constexpr size_t kReadChunk = 64 * 1024;
+
+void set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+EventLoop::EventLoop() {
+  epfd_ = epoll_create1(EPOLL_CLOEXEC);
+  wakeup_fd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // reserved id for the wakeup eventfd
+  epoll_ctl(epfd_, EPOLL_CTL_ADD, wakeup_fd_, &ev);
+  thread_ = std::thread([this] { run(); });
+}
+
+EventLoop::~EventLoop() {
+  post([this] { stopping_ = true; });
+  if (thread_.joinable()) thread_.join();
+  for (auto& [_, c] : conns_) ::close(c.fd);
+  for (auto& [_, l] : listeners_) ::close(l.fd);
+  for (auto& [_, p] : connecting_) ::close(p.fd);
+  ::close(wakeup_fd_);
+  ::close(epfd_);
+}
+
+EventLoop& EventLoop::instance() {
+  // Intentionally leaked: the reactor must outlive every component that
+  // might still post teardown work during static destruction.
+  static EventLoop* loop = new EventLoop();
+  return *loop;
+}
+
+void EventLoop::post(Task fn) {
+  {
+    std::lock_guard<std::mutex> lk(tasks_m_);
+    tasks_.push_back(std::move(fn));
+  }
+  uint64_t one = 1;
+  [[maybe_unused]] ssize_t n = ::write(wakeup_fd_, &one, sizeof(one));
+}
+
+void EventLoop::post_wait(Task fn) {
+  if (std::this_thread::get_id() == thread_.get_id()) {
+    fn();  // already on the loop; waiting would deadlock
+    return;
+  }
+  std::mutex m;
+  std::condition_variable cv;
+  bool done = false;
+  post([&] {
+    fn();
+    std::lock_guard<std::mutex> lk(m);
+    done = true;
+    cv.notify_one();
+  });
+  std::unique_lock<std::mutex> lk(m);
+  cv.wait(lk, [&] { return done; });
+}
+
+void EventLoop::run_after(std::chrono::milliseconds delay, Task fn) {
+  post([this, delay, fn = std::move(fn)]() mutable {
+    timers_.push(Timer{std::chrono::steady_clock::now() + delay,
+                       next_timer_seq_++, std::move(fn)});
+  });
+}
+
+uint64_t EventLoop::adopt(int fd, FrameCb on_frame, ClosedCb on_closed) {
+  set_nonblocking(fd);
+  set_nodelay(fd);
+  uint64_t id = next_id_++;
+  Conn c;
+  c.fd = fd;
+  c.on_frame = std::move(on_frame);
+  c.on_closed = std::move(on_closed);
+  conns_.emplace(id, std::move(c));
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = id;
+  epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  return id;
+}
+
+uint64_t EventLoop::add_listener(int fd, AcceptCb on_accept) {
+  set_nonblocking(fd);
+  uint64_t id = next_id_++;
+  listeners_.emplace(id, Listener_{fd, std::move(on_accept)});
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = id;
+  epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  return id;
+}
+
+void EventLoop::connect(const Address& addr, int timeout_ms, ConnectCb done) {
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(addr.port);
+  if (inet_pton(AF_INET, addr.host.c_str(), &sa.sin_addr) != 1) {
+    done(-1);
+    return;
+  }
+  int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC | SOCK_NONBLOCK, 0);
+  if (fd < 0) {
+    done(-1);
+    return;
+  }
+  int rc = ::connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa));
+  if (rc == 0) {
+    done(fd);
+    return;
+  }
+  if (errno != EINPROGRESS) {
+    ::close(fd);
+    done(-1);
+    return;
+  }
+  uint64_t id = next_id_++;
+  uint64_t seq = next_timer_seq_++;
+  connecting_.emplace(id, Connecting{fd, std::move(done), seq});
+  epoll_event ev{};
+  ev.events = EPOLLOUT;
+  ev.data.u64 = id;
+  epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+  timers_.push(Timer{
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms),
+      seq, [this, id] {
+        auto it = connecting_.find(id);
+        if (it == connecting_.end()) return;
+        ConnectCb cb = std::move(it->second.done);
+        epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+        ::close(it->second.fd);
+        connecting_.erase(it);
+        cb(-1);
+      }});
+}
+
+bool EventLoop::send(uint64_t conn_id, std::shared_ptr<const Bytes> payload,
+                     size_t max_queue) {
+  auto it = conns_.find(conn_id);
+  if (it == conns_.end()) return false;
+  Conn& c = it->second;
+  if (max_queue > 0 && c.out.size() >= max_queue) return false;
+  size_t len = payload->size();
+  if (len > kMaxFrame) return false;
+  OutFrame f;
+  f.hdr[0] = uint8_t(len >> 24);
+  f.hdr[1] = uint8_t(len >> 16);
+  f.hdr[2] = uint8_t(len >> 8);
+  f.hdr[3] = uint8_t(len);
+  f.payload = std::move(payload);
+  c.out.push_back(std::move(f));
+  flush(conn_id, &c);
+  // flush may have destroyed the connection on a hard error; the frame
+  // was accepted either way (best-effort boundary, like a kernel buffer).
+  return true;
+}
+
+void EventLoop::close(uint64_t id) { destroy(id, /*run_closed_cb=*/false); }
+
+void EventLoop::destroy(uint64_t id, bool run_closed_cb) {
+  if (auto it = conns_.find(id); it != conns_.end()) {
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+    ::close(it->second.fd);
+    ClosedCb cb = std::move(it->second.on_closed);
+    conns_.erase(it);
+    if (run_closed_cb && cb) cb(id);
+    return;
+  }
+  if (auto it = listeners_.find(id); it != listeners_.end()) {
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+    ::close(it->second.fd);
+    listeners_.erase(it);
+    return;
+  }
+  if (auto it = connecting_.find(id); it != connecting_.end()) {
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+    ::close(it->second.fd);
+    connecting_.erase(it);
+  }
+}
+
+void EventLoop::update_interest(uint64_t id, Conn* c) {
+  bool want = !c->out.empty();
+  if (want == c->want_write) return;
+  c->want_write = want;
+  epoll_event ev{};
+  ev.events = EPOLLIN | (want ? EPOLLOUT : 0u);
+  ev.data.u64 = id;
+  epoll_ctl(epfd_, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void EventLoop::flush(uint64_t id, Conn* c) {
+  while (!c->out.empty()) {
+    OutFrame& f = c->out.front();
+    size_t total = 4 + f.payload->size();
+    const uint8_t* src;
+    size_t avail;
+    if (f.off < 4) {
+      src = f.hdr + f.off;
+      avail = 4 - f.off;
+    } else {
+      src = f.payload->data() + (f.off - 4);
+      avail = total - f.off;
+    }
+    ssize_t n = ::send(c->fd, src, avail, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      destroy(id, /*run_closed_cb=*/true);
+      return;
+    }
+    f.off += size_t(n);
+    if (f.off == total) c->out.pop_front();
+  }
+  update_interest(id, c);
+}
+
+void EventLoop::handle_readable(uint64_t id, Conn* c) {
+  uint8_t buf[kReadChunk];
+  while (true) {
+    ssize_t n = ::recv(c->fd, buf, sizeof(buf), 0);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      destroy(id, /*run_closed_cb=*/true);
+      return;
+    }
+    if (n == 0) {
+      destroy(id, /*run_closed_cb=*/true);
+      return;
+    }
+    c->in.insert(c->in.end(), buf, buf + n);
+    // Parse every complete frame in the buffer.
+    size_t pos = 0;
+    while (c->in.size() - pos >= 4) {
+      size_t len = (size_t(c->in[pos]) << 24) | (size_t(c->in[pos + 1]) << 16) |
+                   (size_t(c->in[pos + 2]) << 8) | size_t(c->in[pos + 3]);
+      if (len > kMaxFrame) {
+        destroy(id, /*run_closed_cb=*/true);
+        return;
+      }
+      if (c->in.size() - pos - 4 < len) break;
+      Bytes frame(c->in.begin() + pos + 4, c->in.begin() + pos + 4 + len);
+      pos += 4 + len;
+      c->on_frame(id, std::move(frame));
+      // The callback may have closed this connection (handler returned
+      // false); stop touching freed state if so.
+      auto it = conns_.find(id);
+      if (it == conns_.end() || &it->second != c) return;
+    }
+    if (pos) c->in.erase(c->in.begin(), c->in.begin() + pos);
+    if (size_t(n) < sizeof(buf)) break;  // drained the socket
+  }
+}
+
+void EventLoop::handle_event(uint64_t id, uint32_t events) {
+  if (auto it = connecting_.find(id); it != connecting_.end()) {
+    int fd = it->second.fd;
+    ConnectCb cb = std::move(it->second.done);
+    uint64_t seq = it->second.timer_seq;
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+    connecting_.erase(it);
+    cancel_timer(seq);
+    int err = 0;
+    socklen_t len = sizeof(err);
+    getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+    if ((events & (EPOLLERR | EPOLLHUP)) || err != 0) {
+      ::close(fd);
+      cb(-1);
+    } else {
+      cb(fd);
+    }
+    return;
+  }
+  if (auto it = listeners_.find(id); it != listeners_.end()) {
+    while (true) {
+      int fd = ::accept4(it->second.fd, nullptr, nullptr, SOCK_CLOEXEC);
+      if (fd < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+          break;
+        }
+        // Persistent accept failure (EMFILE/ENFILE): the level-triggered
+        // readiness would spin the reactor at 100% CPU, so disarm the
+        // listener and re-arm after a short backoff.
+        int lfd = it->second.fd;
+        epoll_event ev{};
+        ev.events = 0;
+        ev.data.u64 = id;
+        epoll_ctl(epfd_, EPOLL_CTL_MOD, lfd, &ev);
+        timers_.push(Timer{
+            std::chrono::steady_clock::now() + std::chrono::milliseconds(50),
+            next_timer_seq_++, [this, id] {
+              auto again = listeners_.find(id);
+              if (again == listeners_.end()) return;
+              epoll_event rev{};
+              rev.events = EPOLLIN;
+              rev.data.u64 = id;
+              epoll_ctl(epfd_, EPOLL_CTL_MOD, again->second.fd, &rev);
+            }});
+        break;
+      }
+      it->second.on_accept(fd);
+      if (listeners_.find(id) == listeners_.end()) return;  // cb closed us
+    }
+    return;
+  }
+  if (auto it = conns_.find(id); it != conns_.end()) {
+    Conn* c = &it->second;
+    if (events & (EPOLLERR | EPOLLHUP)) {
+      // Drain what the kernel still has for us before tearing down.
+      handle_readable(id, c);
+      auto again = conns_.find(id);
+      if (again != conns_.end()) destroy(id, /*run_closed_cb=*/true);
+      return;
+    }
+    if (events & EPOLLIN) {
+      handle_readable(id, c);
+      auto again = conns_.find(id);
+      if (again == conns_.end()) return;
+      c = &again->second;
+    }
+    if (events & EPOLLOUT) flush(id, c);
+  }
+}
+
+void EventLoop::cancel_timer(uint64_t seq) {
+  cancelled_timers_.push_back(seq);
+}
+
+int EventLoop::next_timeout_ms() const {
+  if (timers_.empty()) return 500;
+  auto now = std::chrono::steady_clock::now();
+  auto when = timers_.top().when;
+  if (when <= now) return 0;
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(when - now);
+  return int(std::min<long long>(ms.count() + 1, 500));
+}
+
+void EventLoop::fire_due_timers() {
+  auto now = std::chrono::steady_clock::now();
+  while (!timers_.empty() && timers_.top().when <= now) {
+    Timer t = timers_.top();
+    timers_.pop();
+    auto c = std::find(cancelled_timers_.begin(), cancelled_timers_.end(),
+                       t.seq);
+    if (c != cancelled_timers_.end()) {
+      cancelled_timers_.erase(c);
+      continue;
+    }
+    t.fn();
+  }
+}
+
+void EventLoop::run() {
+  constexpr int kMaxEvents = 128;
+  epoll_event events[kMaxEvents];
+  while (!stopping_) {
+    int n = epoll_wait(epfd_, events, kMaxEvents, next_timeout_ms());
+    if (n < 0 && errno != EINTR) break;
+    for (int i = 0; i < n; i++) {
+      uint64_t id = events[i].data.u64;
+      if (id == 0) {
+        uint64_t drain;
+        while (::read(wakeup_fd_, &drain, sizeof(drain)) > 0) {
+        }
+        continue;
+      }
+      handle_event(id, events[i].events);
+    }
+    // Run posted tasks (after events so sends see fresh conn state).
+    std::deque<Task> tasks;
+    {
+      std::lock_guard<std::mutex> lk(tasks_m_);
+      tasks.swap(tasks_);
+    }
+    for (auto& t : tasks) t();
+    fire_due_timers();
+  }
+}
+
+}  // namespace hotstuff

@@ -1,0 +1,133 @@
+// Single-threaded epoll reactor: every data-plane socket of a node — all
+// inbound connections, outbound peer connections and listeners — is
+// multiplexed on ONE thread, the way the reference multiplexes its
+// per-connection tasks on the tokio runtime (network/src/receiver.rs:31-89,
+// simple_sender.rs:105-143).  This replaces the thread-per-connection
+// design, which collapsed on single-host committees (≈5 threads/peer ×
+// 20 nodes ≈ 2000 runnable threads on one vCPU).
+//
+// Threading contract: `start/stop/post/run_after_any` are thread-safe;
+// every other method must be called ON the loop thread (from a posted
+// task or a callback).  Callbacks run on the loop thread and must not
+// block for long — channel pushes are fine, blocking IO is not.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common/bytes.hpp"
+#include "network/socket.hpp"
+
+namespace hotstuff {
+
+class EventLoop {
+ public:
+  using Task = std::function<void()>;
+  // A connection's frame/closed callbacks.  on_frame receives whole
+  // de-framed payloads (4-byte big-endian length prefix stripped).
+  using FrameCb = std::function<void(uint64_t conn_id, Bytes frame)>;
+  using ClosedCb = std::function<void(uint64_t conn_id)>;
+  using AcceptCb = std::function<void(int fd)>;          // takes ownership
+  using ConnectCb = std::function<void(int fd)>;         // -1 on failure
+
+  EventLoop();
+  ~EventLoop();
+  EventLoop(const EventLoop&) = delete;
+
+  // Process-wide reactor (lazily started).  One loop serves every node in
+  // the process — the in-process deploy testbed runs several — so it is
+  // never stopped; component teardown closes its own ids instead.
+  static EventLoop& instance();
+
+  // -- thread-safe -----------------------------------------------------
+  void post(Task fn);
+  // Schedule `fn` on the loop thread after `delay`.
+  void run_after(std::chrono::milliseconds delay, Task fn);
+  // Post `fn` and block until the loop ran it (teardown barrier).
+  void post_wait(Task fn);
+
+  // -- loop-thread only ------------------------------------------------
+  // Adopt a connected (or in-progress) fd as a framed connection.
+  uint64_t adopt(int fd, FrameCb on_frame, ClosedCb on_closed);
+  // Register a listening fd; on_accept receives each accepted fd.
+  uint64_t add_listener(int fd, AcceptCb on_accept);
+  // Begin a non-blocking connect; `done` runs on the loop thread with a
+  // connected fd, or -1 on refusal/timeout.
+  void connect(const Address& addr, int timeout_ms, ConnectCb done);
+  // Queue a frame (length prefix added here).  False if the id is gone or
+  // `max_queue` (> 0) frames are already backlogged on the connection.
+  bool send(uint64_t conn_id, std::shared_ptr<const Bytes> payload,
+            size_t max_queue = 0);
+  // Close an id (connection or listener); runs no ClosedCb (explicit
+  // close means the owner already knows).
+  void close(uint64_t id);
+
+ private:
+  struct OutFrame {
+    uint8_t hdr[4];
+    std::shared_ptr<const Bytes> payload;
+    size_t off = 0;  // 0..4+payload->size()
+  };
+  struct Conn {
+    int fd = -1;
+    Bytes in;
+    std::deque<OutFrame> out;
+    FrameCb on_frame;
+    ClosedCb on_closed;
+    bool want_write = false;
+  };
+  struct Listener_ {
+    int fd = -1;
+    AcceptCb on_accept;
+  };
+  struct Connecting {
+    int fd = -1;
+    ConnectCb done;
+    uint64_t timer_seq = 0;
+  };
+  struct Timer {
+    std::chrono::steady_clock::time_point when;
+    uint64_t seq;
+    Task fn;
+    bool operator>(const Timer& o) const {
+      return when != o.when ? when > o.when : seq > o.seq;
+    }
+  };
+
+  void run();
+  void handle_event(uint64_t id, uint32_t events);
+  void handle_readable(uint64_t id, Conn* c);
+  void flush(uint64_t id, Conn* c);
+  void update_interest(uint64_t id, Conn* c);
+  void destroy(uint64_t id, bool run_closed_cb);
+  void cancel_timer(uint64_t seq);
+  int next_timeout_ms() const;
+  void fire_due_timers();
+
+  int epfd_ = -1;
+  int wakeup_fd_ = -1;  // eventfd for post()
+  std::thread thread_;
+  bool stopping_ = false;
+
+  uint64_t next_id_ = 1;
+  uint64_t next_timer_seq_ = 1;
+  std::unordered_map<uint64_t, Conn> conns_;
+  std::unordered_map<uint64_t, Listener_> listeners_;
+  std::unordered_map<uint64_t, Connecting> connecting_;
+  std::priority_queue<Timer, std::vector<Timer>, std::greater<Timer>>
+      timers_;
+  std::vector<uint64_t> cancelled_timers_;
+
+  std::mutex tasks_m_;
+  std::deque<Task> tasks_;
+};
+
+}  // namespace hotstuff

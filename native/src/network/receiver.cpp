@@ -1,5 +1,7 @@
 #include "network/receiver.hpp"
 
+#include <unistd.h>
+
 #include "common/log.hpp"
 
 namespace hotstuff {
@@ -11,81 +13,59 @@ bool NetworkReceiver::spawn(const Address& address, MessageHandler handler,
     LOG_ERROR(log_module) << "failed to bind " << address.str();
     return false;
   }
-  listener_ = std::move(*l);
+  port_ = l->port();
+  int listen_fd = l->release();
   LOG_DEBUG(log_module) << "Listening on " << address.str();
 
-  auto registry = registry_;
-  accept_thread_ = std::thread([this, registry, handler, log_module] {
-    while (!stopping_.load()) {
-      auto sock = listener_.accept();
-      if (!sock) {
-        if (stopping_.load()) return;
-        // Persistent accept failures (e.g. EMFILE) must not busy-spin.
-        std::this_thread::sleep_for(std::chrono::milliseconds(50));
-        continue;
+  EventLoop* loop = &EventLoop::instance();
+  auto state = state_;
+  loop->post_wait([this, loop, state, listen_fd, handler, log_module] {
+    listener_id_ = loop->add_listener(listen_fd, [loop, state, handler,
+                                                  log_module](int fd) {
+      if (state->stopped) {
+        ::close(fd);
+        return;
       }
-      auto sp = std::make_shared<Socket>(std::move(*sock));
-      uint64_t id;
-      {
-        std::lock_guard<std::mutex> lk(registry->m);
-        id = registry->next_id++;
-        registry->conns.emplace(id, sp);
-      }
-      // Joinable: the thread parks its own handle in the graveyard when it
-      // exits (reaped below / in stop()), so long-running nodes don't
-      // accumulate per-connection state yet every thread gets joined.
-      std::thread conn_thread([registry, id, sp, handler] {
-        ConnectionWriter writer(sp.get());
-        Bytes frame;
-        while (sp->read_frame(&frame)) {
-          if (!handler(writer, std::move(frame))) break;
-          frame.clear();
-        }
-        std::lock_guard<std::mutex> lk(registry->m);
-        registry->conns.erase(id);
-        auto it = registry->threads.find(id);
-        if (it != registry->threads.end()) {
-          registry->graveyard.push_back(std::move(it->second));
-          registry->threads.erase(it);
-        }
-      });
-      {
-        std::lock_guard<std::mutex> lk(registry->m);
-        // The thread may have already finished and found no handle to
-        // park; only register it if its connection is still live — else
-        // straight to the graveyard.
-        if (registry->conns.count(id)) {
-          registry->threads.emplace(id, std::move(conn_thread));
-        } else {
-          registry->graveyard.push_back(std::move(conn_thread));
-        }
-        // Reap finished threads (join returns immediately for them).
-        for (auto& t : registry->graveyard) t.join();
-        registry->graveyard.clear();
-      }
-    }
+      uint64_t id = loop->adopt(
+          fd,
+          // on_frame: dispatch through the handler; false drops the conn.
+          [loop, state, handler](uint64_t cid, Bytes frame) {
+            ConnectionWriter writer(loop, cid);
+            bool keep = true;
+            try {
+              keep = handler(writer, std::move(frame));
+            } catch (const std::exception& e) {
+              // Handlers guard their own parse paths; this is the
+              // last-resort belt so attacker bytes can't take the
+              // reactor down.
+              keep = false;
+            }
+            if (!keep) {
+              state->conns.erase(cid);
+              loop->close(cid);
+            }
+          },
+          // on_closed (peer EOF / error)
+          [state](uint64_t cid) { state->conns.erase(cid); });
+      state->conns.insert(id);
+    });
   });
+  spawned_ = true;
   return true;
 }
 
 void NetworkReceiver::stop() {
-  if (stopping_.exchange(true)) return;
-  listener_.shutdown();
-  if (accept_thread_.joinable()) accept_thread_.join();
-  listener_.close();
-  // Shut down live connections and join every connection thread. Callers
-  // must close the channels the handler feeds BEFORE stopping the receiver,
-  // or a handler blocked in a full channel send would stall the join.
-  std::vector<std::thread> to_join;
-  {
-    std::lock_guard<std::mutex> lk(registry_->m);
-    for (auto& [_, s] : registry_->conns) s->shutdown();
-    for (auto& [_, t] : registry_->threads) to_join.push_back(std::move(t));
-    registry_->threads.clear();
-    for (auto& t : registry_->graveyard) to_join.push_back(std::move(t));
-    registry_->graveyard.clear();
-  }
-  for (auto& t : to_join) t.join();
+  if (!spawned_) return;
+  spawned_ = false;
+  EventLoop* loop = &EventLoop::instance();
+  auto state = state_;
+  uint64_t listener_id = listener_id_;
+  loop->post_wait([loop, state, listener_id] {
+    state->stopped = true;
+    loop->close(listener_id);
+    for (uint64_t id : state->conns) loop->close(id);
+    state->conns.clear();
+  });
 }
 
 }  // namespace hotstuff

@@ -1,46 +1,45 @@
-// Network receiver: accept loop + one reader thread per connection, each
-// message dispatched through a MessageHandler that may write reply frames
-// (ACKs) back on the same connection — the reference's Receiver<Handler>
-// (network/src/receiver.rs:31-89) in thread form.
+// Network receiver: a listener plus all of its inbound connections
+// multiplexed on the process-wide epoll EventLoop, each message dispatched
+// through a MessageHandler that may write reply frames (ACKs) back on the
+// same connection — the reference's Receiver<Handler>
+// (network/src/receiver.rs:31-89) as reactor callbacks instead of
+// thread-per-connection.
 #pragma once
 
-#include <atomic>
 #include <functional>
 #include <memory>
-#include <mutex>
-#include <thread>
-#include <unordered_map>
-#include <vector>
+#include <string>
+#include <unordered_set>
 
 #include "common/bytes.hpp"
+#include "network/event_loop.hpp"
 #include "network/socket.hpp"
 
 namespace hotstuff {
 
 // Reply-capable view of a connection handed to handlers (the Writer half of
-// the reference's split framed transport).
+// the reference's split framed transport).  Valid only during the handler
+// call (handlers in this codebase ACK synchronously; none retain it).
 class ConnectionWriter {
  public:
-  explicit ConnectionWriter(Socket* sock) : sock_(sock) {}
+  ConnectionWriter(EventLoop* loop, uint64_t conn_id)
+      : loop_(loop), conn_id_(conn_id) {}
 
   bool send(const Bytes& frame) {
-    std::lock_guard<std::mutex> lk(m_);
-    return sock_->write_frame(frame);
+    return loop_->send(conn_id_, std::make_shared<const Bytes>(frame));
   }
   bool send(const std::string& s) {
-    std::lock_guard<std::mutex> lk(m_);
-    return sock_->write_frame(reinterpret_cast<const uint8_t*>(s.data()),
-                              s.size());
+    return loop_->send(conn_id_, std::make_shared<const Bytes>(
+                                     s.begin(), s.end()));
   }
 
  private:
-  std::mutex m_;
-  Socket* sock_;
+  EventLoop* loop_;
+  uint64_t conn_id_;
 };
 
 // dispatch(writer, message): return false to drop the connection.
-using MessageHandler =
-    std::function<bool(ConnectionWriter&, Bytes)>;
+using MessageHandler = std::function<bool(ConnectionWriter&, Bytes)>;
 
 class NetworkReceiver {
  public:
@@ -48,32 +47,26 @@ class NetworkReceiver {
   ~NetworkReceiver() { stop(); }
   NetworkReceiver(const NetworkReceiver&) = delete;
 
-  // Binds and spawns the accept loop. Returns false if bind fails.
+  // Binds and registers the accept callback on the EventLoop. Returns
+  // false if bind fails.
   bool spawn(const Address& address, MessageHandler handler,
              const std::string& log_module = "network::receiver");
 
-  uint16_t port() const { return listener_.port(); }
+  uint16_t port() const { return port_; }
   void stop();
 
  private:
-  // Live connection sockets + their (joinable) threads. A connection thread
-  // that finishes moves its own thread handle to the graveyard, which the
-  // accept loop reaps opportunistically and stop() drains; stop() therefore
-  // joins every connection thread ever spawned — no detached thread can
-  // outlive the receiver (the round-1/2 shutdown segfault family).
-  struct ConnRegistry {
-    std::mutex m;
-    uint64_t next_id = 0;
-    std::unordered_map<uint64_t, std::shared_ptr<Socket>> conns;
-    std::unordered_map<uint64_t, std::thread> threads;
-    std::vector<std::thread> graveyard;
+  // Loop-thread-only connection registry; shared so late callbacks after
+  // stop() hit a flagged state instead of a dangling receiver.
+  struct State {
+    std::unordered_set<uint64_t> conns;
+    bool stopped = false;
   };
 
-  Listener listener_;
-  std::thread accept_thread_;
-  std::atomic<bool> stopping_{false};
-  std::shared_ptr<ConnRegistry> registry_ =
-      std::make_shared<ConnRegistry>();
+  uint16_t port_ = 0;
+  uint64_t listener_id_ = 0;
+  bool spawned_ = false;
+  std::shared_ptr<State> state_ = std::make_shared<State>();
 };
 
 }  // namespace hotstuff

@@ -1,12 +1,12 @@
 #include "network/reliable_sender.hpp"
 
-#include <atomic>
+#include <unistd.h>
+
 #include <chrono>
 #include <deque>
-#include <mutex>
-#include <thread>
 
 #include "common/log.hpp"
+#include "network/event_loop.hpp"
 
 namespace hotstuff {
 
@@ -14,186 +14,178 @@ namespace {
 constexpr auto kInitialBackoff = std::chrono::milliseconds(200);
 constexpr auto kMaxBackoff = std::chrono::milliseconds(60'000);
 constexpr int kConnectTimeoutMs = 5000;
+// Cap on un-ACKed + queued messages per peer (the thread-based design's
+// bounded channel): beyond it new sends cancel immediately (empty ACK) —
+// a peer 1000 messages behind is as good as gone, and quorum waiters
+// count the OTHER replicas' ACKs.
+constexpr size_t kMaxOutstanding = kChannelCapacity;
 }  // namespace
 
-// One long-lived connection task per peer. The writer loop pulls from the
-// queue and sends; a per-socket reader matches incoming ACK frames to the
-// oldest in-flight message (FIFO, as the reference's pending_replies deque,
-// reliable_sender.rs:214-238). On any socket error both halves tear down,
-// un-ACKed messages are queued for retransmission, and the connect loop
-// backs off exponentially.
-struct ReliableSender::Connection {
+// Loop-thread-only per-peer state machine, the reference's ReliableSender
+// Connection task (network/src/reliable_sender.rs:31-248) as reactor
+// callbacks: FIFO ACK matching, exponential reconnect backoff, un-ACKed
+// retransmission on reconnect, and cancellation (empty ACK) of everything
+// outstanding at teardown.
+struct ReliableSender::State {
   struct Msg {
-    // Shared so broadcast fan-out and the pending/retransmit queues never
-    // deep-copy the payload (the reference's refcounted bytes::Bytes).
     std::shared_ptr<const Bytes> data;
     CancelHandler ack;
   };
+  struct Peer {
+    enum class St { kIdle, kConnecting, kLive, kBackoff };
+    St st = St::kIdle;
+    uint64_t conn_id = 0;
+    std::deque<Msg> queue;    // waiting to be written (incl. retransmit)
+    std::deque<Msg> pending;  // written, awaiting ACK (FIFO)
+    std::chrono::milliseconds backoff = kInitialBackoff;
+  };
 
-  explicit Connection(const Address& addr)
-      : address(addr), queue(kChannelCapacity) {}
+  EventLoop* loop = &EventLoop::instance();
+  std::unordered_map<Address, Peer, AddressHash> peers;
+  bool stopped = false;
 
-  void start() {
-    thread = std::thread([this] { run(); });
-  }
-
-  void run() {
-    auto backoff = kInitialBackoff;
-    std::deque<Msg> retransmit;
-    bool closed = false;
-    while (!closed) {
-      // -- connect (with backoff) ----------------------------------------
-      auto sock_opt = Socket::connect(address, kConnectTimeoutMs);
-      if (!sock_opt) {
-        LOG_DEBUG("network::reliable_sender")
-            << "failed to connect to " << address.str() << "; retrying in "
-            << backoff.count() << " ms";
-        // Interruptible backoff: new messages arriving while disconnected
-        // are stashed for the retransmit pass, and a closed queue
-        // (teardown) ends the loop instead of sleeping out the backoff.
-        Msg stash;
-        auto status = queue.recv_until(
-            &stash, std::chrono::steady_clock::now() + backoff);
-        if (status == RecvStatus::kOk) {
-          retransmit.push_back(std::move(stash));
-        } else if (status == RecvStatus::kClosed) {
-          closed = true;
-        }
-        backoff = std::min(backoff * 2, kMaxBackoff);
-        continue;
-      }
-      backoff = kInitialBackoff;
-      LOG_DEBUG("network::reliable_sender")
-          << "Outgoing connection established with " << address.str();
-
-      auto sock = std::make_shared<Socket>(std::move(*sock_opt));
-      {
-        // Publish the live socket so ~ReliableSender can shutdown() it and
-        // unblock a writer stuck in write_frame against a wedged peer.
-        std::lock_guard<std::mutex> lk(live_sock_m);
-        live_sock = sock;
-      }
-      // Close the teardown/connect race: if ~ReliableSender ran its
-      // shutdown pass while we were inside connect() (live_sock was null,
-      // nothing to cut), we must not start writing on a socket nobody can
-      // shut down. stopping is set before that pass, so checking it after
-      // publishing covers both interleavings.
-      if (stopping.load()) {
-        sock->shutdown();
-        break;
-      }
-      auto pending = std::make_shared<std::deque<Msg>>();
-      auto pending_m = std::make_shared<std::mutex>();
-      auto broken = std::make_shared<std::atomic<bool>>(false);
-
-      // -- reader: match ACK frames to in-flight messages ----------------
-      std::thread reader([sock, pending, pending_m, broken] {
-        Bytes frame;
-        while (sock->read_frame(&frame)) {
-          std::lock_guard<std::mutex> lk(*pending_m);
-          if (!pending->empty()) {
-            pending->front().ack.set(std::move(frame));
-            pending->pop_front();
-          }
-          frame.clear();
-        }
-        broken->store(true);
-        sock->shutdown();
-      });
-
-      // -- retransmit backlog from the previous socket -------------------
-      bool ok = true;
-      while (ok && !retransmit.empty()) {
-        Msg m = std::move(retransmit.front());
-        retransmit.pop_front();
-        auto data = m.data;
-        {
-          std::lock_guard<std::mutex> lk(*pending_m);
-          pending->push_back(std::move(m));
-        }
-        ok = sock->write_frame(*data);
-      }
-
-      // -- writer loop ---------------------------------------------------
-      while (ok && !broken->load()) {
-        Msg m;
-        auto status = queue.recv_until(
-            &m, std::chrono::steady_clock::now() +
-                    std::chrono::milliseconds(100));
-        if (status == RecvStatus::kClosed) {
-          closed = true;
-          break;
-        }
-        if (status == RecvStatus::kTimeout) continue;
-        auto data = m.data;
-        {
-          std::lock_guard<std::mutex> lk(*pending_m);
-          pending->push_back(std::move(m));
-        }
-        ok = sock->write_frame(*data);
-      }
-
-      // -- teardown: recover un-ACKed messages ---------------------------
-      {
-        std::lock_guard<std::mutex> lk(live_sock_m);
-        live_sock.reset();
-      }
-      sock->shutdown();
-      reader.join();
-      {
-        std::lock_guard<std::mutex> lk(*pending_m);
-        for (auto& m : *pending) retransmit.push_back(std::move(m));
-        pending->clear();
-      }
-      LOG_DEBUG("network::reliable_sender")
-          << "connection to " << address.str() << " dropped; "
-          << retransmit.size() << " message(s) to retransmit";
+  void submit(const std::shared_ptr<State>& self, const Address& addr,
+              Msg msg) {
+    if (stopped) {
+      msg.ack.set(Bytes{});
+      return;
     }
-    // Teardown: cancel every outstanding send by fulfilling its ack with
-    // empty bytes, so QuorumWaiter/Proposer stake-waits can't hang on
-    // messages that will never be delivered.
-    for (auto& m : retransmit) m.ack.set(Bytes{});
-    Msg leftover;
-    while (queue.try_recv(&leftover)) leftover.ack.set(Bytes{});
+    if (msg.data->size() > (8u << 20)) {
+      // An unframeable payload would sit in pending forever and shift
+      // the FIFO ACK matching; cancel it up front.
+      msg.ack.set(Bytes{});
+      return;
+    }
+    Peer& p = peers[addr];
+    if (p.queue.size() + p.pending.size() >= kMaxOutstanding) {
+      LOG_DEBUG("network::reliable_sender")
+          << "backlog full for " << addr.str() << "; cancelling send";
+      msg.ack.set(Bytes{});
+      return;
+    }
+    switch (p.st) {
+      case Peer::St::kLive:
+        write(p, std::move(msg));
+        return;
+      case Peer::St::kConnecting:
+      case Peer::St::kBackoff:
+        p.queue.push_back(std::move(msg));
+        return;
+      case Peer::St::kIdle:
+        p.queue.push_back(std::move(msg));
+        start_connect(self, addr);
+        return;
+    }
   }
 
-  void shutdown_live_socket() {
-    std::lock_guard<std::mutex> lk(live_sock_m);
-    if (live_sock) live_sock->shutdown();
+  // Pushes to pending BEFORE the send: a hard send error destroys the
+  // connection and runs on_disconnected reentrantly, which recovers
+  // pending (including this message) into the queue — so nothing is
+  // stranded and FIFO order is preserved.  False = the connection died.
+  bool write(Peer& p, Msg msg) {
+    auto data = msg.data;
+    p.pending.push_back(std::move(msg));
+    return loop->send(p.conn_id, std::move(data)) &&
+           p.st == Peer::St::kLive;
   }
 
-  Address address;
-  Channel<Msg> queue;
-  std::thread thread;
-  std::atomic<bool> stopping{false};
-  std::mutex live_sock_m;
-  std::shared_ptr<Socket> live_sock;
+  void start_connect(const std::shared_ptr<State>& self, Address addr) {
+    Peer& p = peers[addr];
+    p.st = Peer::St::kConnecting;
+    loop->connect(addr, kConnectTimeoutMs, [self, addr](int fd) {
+      self->on_connected(self, addr, fd);
+    });
+  }
+
+  void on_connected(const std::shared_ptr<State>& self, const Address& addr,
+                    int fd) {
+    Peer& p = peers[addr];
+    if (stopped) {
+      if (fd >= 0) ::close(fd);
+      return;
+    }
+    if (fd < 0) {
+      LOG_DEBUG("network::reliable_sender")
+          << "failed to connect to " << addr.str() << "; retrying in "
+          << p.backoff.count() << " ms";
+      schedule_reconnect(self, addr);
+      return;
+    }
+    LOG_DEBUG("network::reliable_sender")
+        << "Outgoing connection established with " << addr.str();
+    p.st = Peer::St::kLive;
+    p.backoff = kInitialBackoff;
+    p.conn_id = loop->adopt(
+        fd,
+        // ACK frames match the oldest in-flight message (FIFO, the
+        // reference's pending_replies deque, reliable_sender.rs:214-238).
+        [self, addr](uint64_t, Bytes frame) {
+          Peer& q = self->peers[addr];
+          if (!q.pending.empty()) {
+            q.pending.front().ack.set(std::move(frame));
+            q.pending.pop_front();
+          }
+        },
+        [self, addr](uint64_t) { self->on_disconnected(self, addr); });
+    // Drain the backlog (retransmits first — submit appends to the back).
+    // Stop the moment the connection dies mid-drain: on_disconnected has
+    // already recovered pending into the queue, and continuing would
+    // re-pend messages against a stale conn id.
+    while (p.st == Peer::St::kLive && !p.queue.empty()) {
+      Msg m = std::move(p.queue.front());
+      p.queue.pop_front();
+      if (!write(p, std::move(m))) break;
+    }
+  }
+
+  void on_disconnected(const std::shared_ptr<State>& self,
+                       const Address& addr) {
+    Peer& p = peers[addr];
+    // Un-ACKed messages go back to the FRONT of the queue, before anything
+    // submitted while we were live, preserving send order on reconnect.
+    while (!p.pending.empty()) {
+      p.queue.push_front(std::move(p.pending.back()));
+      p.pending.pop_back();
+    }
+    LOG_DEBUG("network::reliable_sender")
+        << "connection to " << addr.str() << " dropped; " << p.queue.size()
+        << " message(s) to retransmit";
+    schedule_reconnect(self, addr);
+  }
+
+  void schedule_reconnect(const std::shared_ptr<State>& self, Address addr) {
+    Peer& p = peers[addr];
+    p.st = Peer::St::kBackoff;
+    auto delay = p.backoff;
+    p.backoff = std::min(p.backoff * 2, kMaxBackoff);
+    loop->run_after(delay, [self, addr] {
+      if (self->stopped) return;
+      Peer& q = self->peers[addr];
+      if (q.st == Peer::St::kBackoff) self->start_connect(self, addr);
+    });
+  }
+
+  void teardown() {
+    stopped = true;
+    for (auto& [_, p] : peers) {
+      if (p.st == Peer::St::kLive) loop->close(p.conn_id);
+      // Cancel every outstanding send (empty ACK) so QuorumWaiter/Proposer
+      // stake-waits can't hang on messages that will never be delivered.
+      for (auto& m : p.pending) m.ack.set(Bytes{});
+      for (auto& m : p.queue) m.ack.set(Bytes{});
+      p.pending.clear();
+      p.queue.clear();
+    }
+    peers.clear();
+  }
 };
 
 ReliableSender::ReliableSender(std::shared_ptr<std::atomic<bool>> stop)
-    : stop_(std::move(stop)) {}
+    : stop_(std::move(stop)), state_(std::make_shared<State>()) {}
 
 ReliableSender::~ReliableSender() {
-  for (auto& [_, conn] : connections_) {
-    conn->stopping.store(true);
-    conn->queue.close();
-  }
-  // A writer blocked inside write_frame (peer TCP-connected but not
-  // reading) cannot observe the closed queue; cut the socket under it.
-  for (auto& [_, conn] : connections_) conn->shutdown_live_socket();
-  for (auto& [_, conn] : connections_) {
-    if (conn->thread.joinable()) conn->thread.join();
-  }
-}
-
-std::shared_ptr<ReliableSender::Connection> ReliableSender::get_or_spawn(
-    const Address& address) {
-  auto it = connections_.find(address);
-  if (it != connections_.end()) return it->second;
-  auto conn = std::make_shared<Connection>(address);
-  conn->start();
-  connections_[address] = conn;
-  return conn;
+  auto state = state_;
+  state->loop->post_wait([state] { state->teardown(); });
 }
 
 CancelHandler ReliableSender::send(const Address& address, Bytes data) {
@@ -203,22 +195,18 @@ CancelHandler ReliableSender::send(const Address& address, Bytes data) {
 
 CancelHandler ReliableSender::send_shared(
     const Address& address, std::shared_ptr<const Bytes> data) {
-  auto conn = get_or_spawn(address);
-  Connection::Msg m;
+  State::Msg m;
   m.data = std::move(data);
   CancelHandler handler = m.ack;
-  // Bounded, stop-aware send: a full queue (peer long gone, 1000-message
-  // backlog) must not wedge the calling actor past teardown.
-  while (true) {
-    auto status = conn->queue.send_until(
-        &m, std::chrono::steady_clock::now() +
-                std::chrono::milliseconds(100));
-    if (status == RecvStatus::kOk) return handler;
-    if (status == RecvStatus::kClosed || (stop_ && stop_->load())) {
-      handler.set(Bytes{});  // cancelled — waiters must not hang on this
-      return handler;
-    }
+  if (stop_ && stop_->load()) {
+    handler.set(Bytes{});  // stopping: cancelled, waiters must not hang
+    return handler;
   }
+  auto state = state_;
+  state->loop->post([state, address, m = std::move(m)]() mutable {
+    state->submit(state, address, std::move(m));
+  });
+  return handler;
 }
 
 std::vector<CancelHandler> ReliableSender::broadcast(

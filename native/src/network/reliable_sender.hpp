@@ -1,14 +1,13 @@
 // Reliable sender: every message returns a CancelHandler (oneshot fulfilled
-// with the peer's ACK bytes); per-peer connections retry with exponential
-// backoff (200 ms doubling to 60 s) and retransmit un-ACKed messages on
-// reconnection — the reference's ReliableSender state machine
-// (network/src/reliable_sender.rs:31-248).
+// with the peer's ACK bytes); per-peer connection state machines live on
+// the process-wide EventLoop, retry with exponential backoff (200 ms
+// doubling to 60 s) and retransmit un-ACKed messages on reconnection —
+// the reference's ReliableSender (network/src/reliable_sender.rs:31-248)
+// as reactor callbacks instead of two threads per peer.
 #pragma once
 
 #include <atomic>
 #include <memory>
-#include <random>
-#include <unordered_map>
 #include <vector>
 
 #include "common/bytes.hpp"
@@ -21,13 +20,11 @@ using CancelHandler = Oneshot<Bytes>;
 
 class ReliableSender {
  public:
-  // `stop` (optional) makes send() interruptible: a send blocked on a full
-  // per-peer queue re-checks it every 100 ms and cancels (empty-ACK) once
-  // set, so an actor mid-send can always reach its own teardown.
+  // `stop` (optional): once set, new sends cancel (empty ACK) immediately
+  // instead of queueing, so an actor mid-send always reaches teardown.
   explicit ReliableSender(
       std::shared_ptr<std::atomic<bool>> stop = nullptr);
-  // Closes every per-peer queue and joins the connection threads; any
-  // outstanding CancelHandler is fulfilled with empty bytes so quorum
+  // Cancels every outstanding CancelHandler with empty bytes so quorum
   // waiters can never block on an ACK that will not come (the reference
   // gets the same from dropped oneshot senders, reliable_sender.rs:25).
   ~ReliableSender();
@@ -41,12 +38,10 @@ class ReliableSender {
                                        const Bytes& data);
 
  private:
-  struct Connection;
-  std::shared_ptr<Connection> get_or_spawn(const Address& address);
+  struct State;
 
-  std::unordered_map<Address, std::shared_ptr<Connection>, AddressHash>
-      connections_;
   std::shared_ptr<std::atomic<bool>> stop_;
+  std::shared_ptr<State> state_;
 };
 
 }  // namespace hotstuff

@@ -1,136 +1,142 @@
 #include "network/simple_sender.hpp"
 
+#include <unistd.h>
+
 #include <algorithm>
-#include <atomic>
-#include <thread>
+#include <deque>
 
 #include "common/log.hpp"
+#include "network/event_loop.hpp"
 
 namespace hotstuff {
 
 namespace {
-// Bound the connect syscall so a vanished peer can't pin a connection
-// thread (and its joiner) for the kernel's multi-minute TCP timeout.
+// Bound the connect attempt so a vanished peer can't pin reconnect state
+// past the point anyone cares.
 constexpr int kConnectTimeoutMs = 5000;
+// Per-peer outbound backlog cap, matching the bounded channel of the
+// thread-based design: beyond it messages drop (best-effort semantics,
+// simple_sender.rs:105-143).
+constexpr size_t kMaxQueue = kChannelCapacity;
 }  // namespace
 
-// A connection drains its queue into one socket. On any socket error the
-// connection marks itself dead and drops remaining queued messages; the
-// next send() to that address spawns a fresh connection (reference
-// Connection::run returns on error, simple_sender.rs:105-143).
-struct SimpleSender::Connection {
-  explicit Connection(const Address& addr)
-      : address(addr), queue(kChannelCapacity) {}
+// Loop-thread-only state. A peer is (re)connected lazily on send; failure
+// drops everything queued and the next send retries — matching the
+// reference's Connection::run returning on error.
+struct SimpleSender::State {
+  struct Peer {
+    enum class St { kConnecting, kLive, kDead };
+    St st = St::kDead;
+    uint64_t conn_id = 0;
+    std::deque<std::shared_ptr<const Bytes>> pending;  // while connecting
+  };
 
-  ~Connection() { stop_and_join(); }
+  EventLoop* loop = &EventLoop::instance();
+  std::unordered_map<Address, Peer, AddressHash> peers;
+  bool stopped = false;
 
-  void start() {
-    writer_thread = std::thread([this] { run(); });
+  void send(const std::shared_ptr<State>& self, const Address& addr,
+            std::shared_ptr<const Bytes> data) {
+    if (stopped) return;
+    Peer& p = peers[addr];
+    switch (p.st) {
+      case Peer::St::kLive:
+        if (!loop->send(p.conn_id, std::move(data), kMaxQueue)) {
+          LOG_DEBUG("network::simple_sender")
+              << "dropping message to " << addr.str();
+        }
+        return;
+      case Peer::St::kConnecting:
+        if (p.pending.size() >= kMaxQueue) {
+          LOG_DEBUG("network::simple_sender")
+              << "dropping message to " << addr.str();
+          return;
+        }
+        p.pending.push_back(std::move(data));
+        return;
+      case Peer::St::kDead:
+        p.st = Peer::St::kConnecting;
+        p.pending.clear();
+        p.pending.push_back(std::move(data));
+        connect(self, addr);
+        return;
+    }
   }
 
-  void run() {
-    auto sock_opt = Socket::connect(address, kConnectTimeoutMs);
-    if (!sock_opt) {
-      LOG_WARN("network::simple_sender")
-          << "failed to connect to " << address.str();
-      dead.store(true);
-      queue.close();
-      return;
-    }
-    {
-      // Serialize the fd hand-off against a concurrent stop_and_join()
-      // shutdown (the owner may reap this connection while we connect).
-      std::lock_guard<std::mutex> lk(sock_m);
-      sock = std::move(*sock_opt);
-    }
-    // Close the teardown/connect race: stop_and_join()'s shutdown may have
-    // hit the pre-connect placeholder fd while we were inside connect().
-    // dead is set before that shutdown, so checking it after the hand-off
-    // covers both interleavings — without this, the writer would drain
-    // already-queued frames into a socket nobody can cut.
-    if (dead.load()) {
-      std::lock_guard<std::mutex> lk(sock_m);
-      sock.shutdown();
-      return;
-    }
-    LOG_DEBUG("network::simple_sender")
-        << "Outgoing connection established with " << address.str();
-
-    // Sink replies so the peer's ACK writes never fill the TCP buffer.
-    reader_thread = std::thread([this] {
-      Bytes frame;
-      while (sock.read_frame(&frame)) {
+  void connect(const std::shared_ptr<State>& self, Address addr) {
+    loop->connect(addr, kConnectTimeoutMs, [self, addr](int fd) {
+      Peer& p = self->peers[addr];
+      if (self->stopped) {
+        if (fd >= 0) ::close(fd);
+        return;
       }
-      dead.store(true);
-      queue.close();  // wake the writer
-    });
-
-    while (auto data = queue.recv()) {
-      if (dead.load() || !sock.write_frame(*data)) {
+      if (fd < 0) {
         LOG_WARN("network::simple_sender")
-            << "failed to send message to " << address.str();
-        break;
+            << "failed to connect to " << addr.str();
+        p.st = Peer::St::kDead;
+        p.pending.clear();
+        return;
       }
-    }
-    dead.store(true);
-    queue.close();
-    std::lock_guard<std::mutex> lk(sock_m);
-    sock.shutdown();  // wake the reader
+      LOG_DEBUG("network::simple_sender")
+          << "Outgoing connection established with " << addr.str();
+      p.st = Peer::St::kLive;
+      uint64_t cid = self->loop->adopt(
+          fd,
+          // Sink replies so the peer's ACK writes never fill its buffer.
+          [](uint64_t, Bytes) {},
+          [self, addr](uint64_t) {
+            // Peer closed (EOF at teardown is the common case; a failed
+            // in-flight write lands here too). Best-effort semantics:
+            // drop state, reconnect lazily on the next send.
+            Peer& q = self->peers[addr];
+            LOG_DEBUG("network::simple_sender")
+                << "connection to " << addr.str() << " closed";
+            q.st = Peer::St::kDead;
+            q.pending.clear();
+          });
+      p.conn_id = cid;
+      // Drain a MOVED backlog: a hard send error runs on_closed
+      // reentrantly, and that callback clears p.pending — clearing the
+      // deque being iterated would be UB.
+      auto backlog = std::move(p.pending);
+      p.pending.clear();
+      for (auto& d : backlog) {
+        if (!self->loop->send(cid, std::move(d))) break;  // died mid-drain
+      }
+    });
   }
-
-  // Idempotent; joining the writer first guarantees reader_thread is fully
-  // constructed (the writer creates it) before we join it.
-  void stop_and_join() {
-    dead.store(true);  // before the shutdown: see the post-connect check
-    queue.close();
-    {
-      std::lock_guard<std::mutex> lk(sock_m);
-      sock.shutdown();
-    }
-    if (writer_thread.joinable()) writer_thread.join();
-    if (reader_thread.joinable()) reader_thread.join();
-  }
-
-  Address address;
-  Channel<Bytes> queue;
-  std::mutex sock_m;  // guards fd hand-off/shutdown, not steady-state IO
-  Socket sock;
-  std::atomic<bool> dead{false};
-  std::thread writer_thread;
-  std::thread reader_thread;
 };
 
-SimpleSender::SimpleSender() : rng_(std::random_device{}()) {}
+SimpleSender::SimpleSender()
+    : rng_(std::random_device{}()), state_(std::make_shared<State>()) {}
 
 SimpleSender::~SimpleSender() {
-  for (auto& [_, conn] : connections_) conn->stop_and_join();
-}
-
-std::shared_ptr<SimpleSender::Connection> SimpleSender::get_or_spawn(
-    const Address& address) {
-  auto it = connections_.find(address);
-  if (it != connections_.end() && !it->second->dead.load()) {
-    return it->second;
-  }
-  if (it != connections_.end()) it->second->stop_and_join();
-  auto conn = std::make_shared<Connection>(address);
-  conn->start();
-  connections_[address] = conn;  // old entry (if any) joined above
-  return conn;
+  auto state = state_;
+  state->loop->post_wait([state] {
+    state->stopped = true;
+    for (auto& [_, p] : state->peers) {
+      if (p.st == State::Peer::St::kLive) state->loop->close(p.conn_id);
+      p.pending.clear();
+    }
+    state->peers.clear();
+  });
 }
 
 void SimpleSender::send(const Address& address, Bytes data) {
-  auto conn = get_or_spawn(address);
-  if (!conn->queue.try_send(std::move(data))) {
-    // Queue full or connection died — best-effort: drop.
-    LOG_DEBUG("network::simple_sender")
-        << "dropping message to " << address.str();
-  }
+  auto state = state_;
+  auto shared = std::make_shared<const Bytes>(std::move(data));
+  state->loop->post([state, address, shared] {
+    state->send(state, address, shared);
+  });
 }
 
 void SimpleSender::broadcast(const std::vector<Address>& addresses,
                              const Bytes& data) {
-  for (const auto& a : addresses) send(a, data);
+  auto shared = std::make_shared<const Bytes>(data);
+  auto state = state_;
+  for (const auto& a : addresses) {
+    state->loop->post([state, a, shared] { state->send(state, a, shared); });
+  }
 }
 
 void SimpleSender::lucky_broadcast(std::vector<Address> addresses,

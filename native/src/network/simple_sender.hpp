@@ -1,15 +1,13 @@
-// Best-effort sender: one connection task per peer fed by a bounded queue,
-// incoming frames (ACKs) sunk by a reader thread; failed peers drop queued
-// messages and reconnect lazily on the next send — matching the reference's
-// SimpleSender/Connection semantics (network/src/simple_sender.rs:22-143).
-// All connection threads are joinable: the destructor closes every queue,
-// shuts the sockets, and joins, so a SimpleSender never leaks a thread past
-// its owner (tokio gives the reference this for free on runtime drop).
+// Best-effort sender: one multiplexed connection per peer on the
+// process-wide EventLoop, bounded per-peer backlog, incoming frames (ACKs)
+// sunk on arrival; failed peers drop queued messages and reconnect lazily
+// on the next send — matching the reference's SimpleSender/Connection
+// semantics (network/src/simple_sender.rs:22-143) without its
+// two-threads-per-peer cost.
 #pragma once
 
 #include <memory>
 #include <random>
-#include <unordered_map>
 #include <vector>
 
 #include "common/bytes.hpp"
@@ -33,12 +31,10 @@ class SimpleSender {
                        size_t nodes);
 
  private:
-  struct Connection;
-  std::shared_ptr<Connection> get_or_spawn(const Address& address);
+  struct State;
 
-  std::unordered_map<Address, std::shared_ptr<Connection>, AddressHash>
-      connections_;
   std::mt19937 rng_;
+  std::shared_ptr<State> state_;
 };
 
 }  // namespace hotstuff

@@ -98,6 +98,13 @@ class Listener {
   static std::optional<Listener> bind(const Address& addr);
 
   std::optional<Socket> accept();
+  // Hand the listening fd to another owner (the EventLoop); this object
+  // forgets it.
+  int release() {
+    int fd = fd_;
+    fd_ = -1;
+    return fd;
+  }
   uint16_t port() const { return port_; }
   bool valid() const { return fd_ >= 0; }
   void close();

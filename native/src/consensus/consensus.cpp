@@ -29,15 +29,26 @@ std::unique_ptr<Consensus> Consensus::spawn(
   if (!c->receiver_.spawn(
           *address,
           [tx_core, tx_helper](ConnectionWriter& writer, Bytes msg) {
+            // Handlers run on the shared reactor thread: channel pushes
+            // must be try_send — a blocking send on a full channel would
+            // stall every connection in the process.  Dropping under
+            // overload is the async network model; the synchronizer's
+            // sync requests and peer re-broadcasts recover.
             try {
               ConsensusMessage m = ConsensusMessage::deserialize(msg);
               if (m.kind == ConsensusMessage::Kind::kSyncRequest) {
-                tx_helper->send({m.sync_digest, m.sync_from});
+                if (!tx_helper->try_send({m.sync_digest, m.sync_from})) {
+                  LOG_WARN("consensus::consensus")
+                      << "helper overloaded; dropping sync request";
+                }
               } else {
                 if (m.kind == ConsensusMessage::Kind::kPropose) {
                   writer.send(std::string("Ack"));
                 }
-                tx_core->send(CoreEvent::msg(std::move(m)));
+                if (!tx_core->try_send(CoreEvent::msg(std::move(m)))) {
+                  LOG_WARN("consensus::consensus")
+                      << "core overloaded; dropping consensus message";
+                }
               }
             } catch (const std::exception& e) {
               // Anything thrown while parsing attacker-controlled bytes

@@ -56,7 +56,9 @@ void make_block(const PublicKey& name, const Committee& committee,
   auto total = std::make_shared<Stake>(committee.stake(name));
   for (size_t i = 0; i < peers.size(); i++) {
     Stake stake = committee.stake(peers[i].first);
-    handlers[i].on_ready([m, cv, total, stake](const Bytes&) {
+    handlers[i].on_ready([m, cv, total, stake](const Bytes& reply) {
+      // Empty bytes = cancelled send (teardown/full backlog), not an ACK.
+      if (reply.empty()) return;
       std::lock_guard<std::mutex> lk(*m);
       *total += stake;
       cv->notify_one();

@@ -45,7 +45,14 @@ std::unique_ptr<Mempool> Mempool::spawn(
   if (!mp->tx_receiver_.spawn(
           *tx_address,
           [tx_batch_maker](ConnectionWriter&, Bytes msg) {
-            tx_batch_maker->send(std::move(msg));
+            // Reactor-thread handler: try_send only (see peer handler).
+            // Load-shedding client transactions under a 1000-deep backlog
+            // replaces the TCP backpressure the per-connection-thread
+            // design applied.
+            if (!tx_batch_maker->try_send(std::move(msg))) {
+              LOG_DEBUG("mempool::mempool")
+                  << "batch maker overloaded; shedding transaction";
+            }
             return true;
           },
           "mempool::tx_receiver")) {
@@ -78,12 +85,22 @@ std::unique_ptr<Mempool> Mempool::spawn(
           [tx_peer_processor, tx_helper](ConnectionWriter& writer,
                                          Bytes msg) {
             writer.send(std::string("Ack"));
+            // Reactor-thread handler: blocking channel sends would stall
+            // the whole process's data plane; drop under overload (the
+            // sender's ReliableSender retransmits un-ACKed batches, and
+            // sync requests are re-issued on a timer).
             try {
               MempoolMessage m = MempoolMessage::deserialize(msg);
               if (m.kind == MempoolMessage::Kind::kBatch) {
-                tx_peer_processor->send(std::move(msg));
+                if (!tx_peer_processor->try_send(std::move(msg))) {
+                  LOG_WARN("mempool::mempool")
+                      << "processor overloaded; dropping batch";
+                }
               } else {
-                tx_helper->send({std::move(m.missing), m.origin});
+                if (!tx_helper->try_send({std::move(m.missing), m.origin})) {
+                  LOG_WARN("mempool::mempool")
+                      << "helper overloaded; dropping sync request";
+                }
               }
             } catch (const std::exception& e) {
               // Parse errors on peer bytes must not escape the connection

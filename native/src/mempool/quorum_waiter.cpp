@@ -23,7 +23,11 @@ std::thread QuorumWaiter::spawn(Committee committee, Stake my_stake,
       auto total = std::make_shared<Stake>(my_stake);
       for (const auto& [name, handler] : msg->handlers) {
         Stake stake = committee.stake(name);
-        handler.on_ready([m, cv, total, stake](const Bytes&) {
+        handler.on_ready([m, cv, total, stake](const Bytes& reply) {
+          // Empty bytes mean CANCELLED (teardown or full backlog), not a
+          // peer ACK — counting those would certify batch availability
+          // for peers that never received it.
+          if (reply.empty()) return;
           std::lock_guard<std::mutex> lk(*m);
           *total += stake;
           cv->notify_one();

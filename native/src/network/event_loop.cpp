@@ -20,7 +20,7 @@ namespace hotstuff {
 
 namespace {
 
-constexpr size_t kMaxFrame = 8u << 20;  // reference LengthDelimitedCodec cap
+constexpr size_t kMaxFrame = EventLoop::kMaxFrame;
 constexpr size_t kReadChunk = 64 * 1024;
 
 void set_nonblocking(int fd) {
@@ -38,6 +38,11 @@ void set_nodelay(int fd) {
 EventLoop::EventLoop() {
   epfd_ = epoll_create1(EPOLL_CLOEXEC);
   wakeup_fd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  if (epfd_ < 0 || wakeup_fd_ < 0) {
+    // A reactor that silently failed to set up would hang every
+    // post_wait in the process; fail loudly at first network use.
+    throw std::runtime_error("EventLoop: epoll/eventfd setup failed");
+  }
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.u64 = 0;  // reserved id for the wakeup eventfd

@@ -6,10 +6,11 @@
 // design, which collapsed on single-host committees (≈5 threads/peer ×
 // 20 nodes ≈ 2000 runnable threads on one vCPU).
 //
-// Threading contract: `start/stop/post/run_after_any` are thread-safe;
-// every other method must be called ON the loop thread (from a posted
-// task or a callback).  Callbacks run on the loop thread and must not
-// block for long — channel pushes are fine, blocking IO is not.
+// Threading contract: `post/post_wait/run_after` are thread-safe; every
+// other method must be called ON the loop thread (from a posted task or
+// a callback).  Callbacks run on the loop thread and must never block:
+// channel pushes must be try_send (a blocking send on a full channel
+// would stall every connection in the process), blocking IO is out.
 #pragma once
 
 #include <chrono>
@@ -30,6 +31,11 @@ namespace hotstuff {
 
 class EventLoop {
  public:
+  // Frame cap, matching the reference's LengthDelimitedCodec limit
+  // (8 MiB); oversized inbound frames drop the connection, oversized
+  // sends are refused.
+  static constexpr size_t kMaxFrame = 8u << 20;
+
   using Task = std::function<void()>;
   // A connection's frame/closed callbacks.  on_frame receives whole
   // de-framed payloads (4-byte big-endian length prefix stripped).

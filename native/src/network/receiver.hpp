@@ -22,15 +22,22 @@ namespace hotstuff {
 // call (handlers in this codebase ACK synchronously; none retain it).
 class ConnectionWriter {
  public:
+  // Reply backlog cap: a peer that sends but never reads would otherwise
+  // grow the connection's out-queue without bound.  Dropped ACKs are
+  // recovered by the sender's retransmission.
+  static constexpr size_t kMaxReplyQueue = 1000;
+
   ConnectionWriter(EventLoop* loop, uint64_t conn_id)
       : loop_(loop), conn_id_(conn_id) {}
 
   bool send(const Bytes& frame) {
-    return loop_->send(conn_id_, std::make_shared<const Bytes>(frame));
+    return loop_->send(conn_id_, std::make_shared<const Bytes>(frame),
+                       kMaxReplyQueue);
   }
   bool send(const std::string& s) {
-    return loop_->send(conn_id_, std::make_shared<const Bytes>(
-                                     s.begin(), s.end()));
+    return loop_->send(conn_id_,
+                       std::make_shared<const Bytes>(s.begin(), s.end()),
+                       kMaxReplyQueue);
   }
 
  private:

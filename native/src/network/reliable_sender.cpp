@@ -50,7 +50,7 @@ struct ReliableSender::State {
       msg.ack.set(Bytes{});
       return;
     }
-    if (msg.data->size() > (8u << 20)) {
+    if (msg.data->size() > EventLoop::kMaxFrame) {
       // An unframeable payload would sit in pending forever and shift
       // the FIFO ACK matching; cancel it up front.
       msg.ack.set(Bytes{});

@@ -1,0 +1,22 @@
+#!/bin/sh
+# End-to-end smoke of the REAL remote-bench code path (harness/remote.py:
+# config generation, scp upload, nohup/setsid background node+client
+# launch over "ssh", kill, log download, parse) using the local-exec
+# ssh/scp shims in fake_ssh/ — this image ships no ssh client or sshd.
+# The "fleet" is four loopback IPs (127.0.0.1-4, distinct bind addresses
+# on lo); each host gets its own fake home under .remote-smoke/<ip>/ with
+# a repo/ "checkout" (binary symlinks), so collocated hosts cannot
+# clobber each other's configs or logs.
+set -e
+cd "$(dirname "$0")/.."
+cmake --build native/build -j > /dev/null
+rm -rf .remote-smoke
+for ip in 127.0.0.1 127.0.0.2 127.0.0.3 127.0.0.4; do
+  mkdir -p ".remote-smoke/$ip/repo/logs"
+  ln -sf "$PWD/native/build/node" ".remote-smoke/$ip/repo/node"
+  ln -sf "$PWD/native/build/client" ".remote-smoke/$ip/repo/client"
+done
+FAKE_SSH_HOME_BASE="$PWD/.remote-smoke" \
+  PATH="$PWD/scripts/fake_ssh:$PATH" exec python -m hotstuff_tpu.harness \
+  remote --settings scripts/remote_smoke_settings.json \
+  --nodes 4 --rate "${1:-7000}" --duration "${2:-15}"

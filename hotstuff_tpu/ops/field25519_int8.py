@@ -1,0 +1,168 @@
+"""GF(2^255 - 19) with radix-2^5 limbs and an int8 depthwise-conv multiply.
+
+PROFILE.md's #1 remaining lever: the production engine (field25519.py)
+multiplies 32 radix-2^8 limbs through a float32 depthwise convolution —
+exact because partial-product sums stay under 2^23, but every f32 MXU
+pass costs bf16x3 emulation.  This module re-limbs the field so the same
+convolution can feed the MXU's native int8 pipeline.
+
+Radix choice (why 2^5 and not the 2^7 first guess): an int8-strict weak
+form needs every limb to re-enter [0, 127] after finitely many parallel
+carry steps, and the carry out of the top limb wraps to limb 0 scaled by
+2^(b*N) mod p.  For radix 2^7 (37 limbs, 259 bits) that scale is
+19 * 2^4 = 304 >= 2^7, so limb 0 plateaus at ~127 + 304 and NEVER fits
+int8 — the uniform-radix-2^7 design is unimplementable.  Radix 2^5 tiles
+255 = 5 * 51 exactly, making the wrap scale exactly 19 < 2^5: interval
+analysis shows five carry steps take post-multiply coefficients
+(< 2^22) to limbs <= 31 + 19 = 50.
+
+* 51 limbs of 5 bits, weak invariant limbs <= 63 (mul outputs satisfy
+  <= 50); every weak limb is a lossless int8 cast.
+* The (1, n, 51) x (n, 1, 51) depthwise conv accumulates in int32
+  (preferred_element_type): partial-product sums <= 51 * 63^2 < 2^18,
+  exact by integer arithmetic — no precision knob, unlike the f32 path.
+* Post-fold coefficients < 2^22 (int32-safe); five parallel carry steps
+  restore the weak form.
+
+The open question — why this is an A/B and not the default — is whether
+XLA's int8 conv at feature_group_count ~1024 beats the f32 path on a
+real chip with 2.5x the MACs (51^2 vs 32^2 taps).
+scripts/ab_int8_mul.py measures both engines' mul-chain slopes;
+PROFILE.md records the verdict.
+
+Reference parity: same workload as field25519.py (the limb substrate of
+crypto/src/lib.rs:210-223 batch verification).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NLIMBS = 51
+LIMB_BITS = 5
+LIMB_MASK = (1 << LIMB_BITS) - 1
+P = 2**255 - 19
+
+# 2^(5*51) = 2^255 ≡ 19 (mod p): the wrap scale that makes int8-strict
+# weak normalization possible at all (see module docstring).
+_WRAP = (1 << (LIMB_BITS * NLIMBS)) % P
+assert _WRAP == 19
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """Python int -> (51,) int32 canonical 5-bit limbs."""
+    x = int(x) % (1 << (LIMB_BITS * NLIMBS))
+    return np.array([(x >> (LIMB_BITS * i)) & LIMB_MASK
+                     for i in range(NLIMBS)], dtype=np.int32)
+
+
+def from_limbs(limbs) -> int:
+    limbs = np.asarray(limbs).reshape(NLIMBS)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(limbs))
+
+
+def batch_to_limbs(xs) -> np.ndarray:
+    return np.stack([to_limbs(x) for x in xs])
+
+
+def batch_from_limbs(arr) -> list:
+    return [from_limbs(row) for row in np.asarray(arr)]
+
+
+def _carry_step(x: jnp.ndarray) -> jnp.ndarray:
+    """Keep 5 low bits, pass the rest one limb up; the top limb's carry
+    wraps to limb 0 scaled by 19.  Value preserved mod p."""
+    lo = x & LIMB_MASK
+    hi = x >> LIMB_BITS
+    wrapped = jnp.roll(hi, 1, axis=-1)
+    scale = jnp.ones((NLIMBS,), dtype=jnp.int32).at[0].set(_WRAP)
+    return lo + wrapped * scale
+
+
+def weak_normalize(x: jnp.ndarray, steps: int) -> jnp.ndarray:
+    for _ in range(steps):
+        x = _carry_step(x)
+    return x
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a * b mod p (weak in, weak out) via an INT8 depthwise convolution.
+
+    Inputs must satisfy the weak invariant (limbs <= 63): cast to int8 is
+    lossless.  int32 accumulation makes the product exact by
+    construction.  Five carry steps restore limbs <= 50."""
+    batch_shape = a.shape[:-1]
+    n = 1
+    for d in batch_shape:
+        n *= d
+    lhs = a.reshape(1, n, NLIMBS).astype(jnp.int8)
+    rhs = jnp.flip(b.reshape(n, 1, NLIMBS), -1).astype(jnp.int8)
+    coeffs = jax.lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1,), padding=[(NLIMBS - 1, NLIMBS - 1)],
+        dimension_numbers=("NCH", "OIH", "NCH"),
+        feature_group_count=n,
+        preferred_element_type=jnp.int32,
+    ).reshape(*batch_shape, 2 * NLIMBS - 1)
+    lo, hi = coeffs[..., :NLIMBS], coeffs[..., NLIMBS:]
+    folded = lo + _WRAP * jnp.pad(hi, [(0, 0)] * (hi.ndim - 1) + [(0, 1)])
+    return weak_normalize(folded, 5)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def _sequential_carry(x: jnp.ndarray):
+    limbs = []
+    carry = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        t = x[..., i] + carry
+        limbs.append(t & LIMB_MASK)
+        carry = t >> LIMB_BITS
+    return jnp.stack(limbs, axis=-1), carry
+
+
+_P_DIGITS = [(P >> (LIMB_BITS * i)) & LIMB_MASK for i in range(NLIMBS)]
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    p_digits = jnp.asarray(_P_DIGITS, dtype=jnp.int32)
+    limbs = []
+    borrow = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        d = x[..., i] - p_digits[i] - borrow
+        borrow = (d < 0).astype(jnp.int32)
+        limbs.append(d + (borrow << LIMB_BITS))
+    sub_res = jnp.stack(limbs, axis=-1)
+    keep = (borrow > 0)[..., None]
+    return jnp.where(keep, x, sub_res)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Weak element -> canonical limbs (5-bit, value in [0, p))."""
+    x, carry = _sequential_carry(x)
+    x = x.at[..., 0].add(_WRAP * carry)
+    x, carry = _sequential_carry(x)
+    x = x.at[..., 0].add(_WRAP * carry)
+    x = _cond_sub_p(x)
+    return _cond_sub_p(x)
+
+
+def mul_selfcheck(batch: int = 256, seed: int = 0) -> None:
+    """Exactness proof on the CURRENT backend over adversarial weak limbs
+    (all-63 rows included).  Integer arithmetic end to end, so a failure
+    means the backend's int8 conv itself is broken."""
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, 64, (batch, NLIMBS))
+    b = rng.integers(0, 64, (batch, NLIMBS))
+    a[0, :] = 63
+    b[0, :] = 63
+    got = batch_from_limbs(np.asarray(
+        canonical(mul(jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32)))))
+    want = [(x * y) % P for x, y in zip(batch_from_limbs(a),
+                                        batch_from_limbs(b))]
+    if got != want:
+        raise AssertionError("int8 radix-2^5 multiply is not exact "
+                             "on this backend")

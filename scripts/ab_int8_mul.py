@@ -1,0 +1,81 @@
+"""A/B: f32 radix-2^8 conv multiply vs int8 radix-2^5 conv multiply.
+
+Measures the slope (per-mul marginal cost) of K-long jitted mul chains
+over a (1024, NLIMBS) batch on the default JAX device — the tunnel-
+measurement discipline from scripts/PROFILE.md: per-dispatch fixed cost
+is removed by differencing two chain lengths, and each timing is
+best-of-trials so neighbor load doesn't pollute the comparison.
+
+The Ed25519 ladder is mul-dominated, so the slope ratio here bounds the
+end-to-end speedup the int8 redesign could deliver (PROFILE.md lever #1).
+
+Prints one JSON line with both slopes and the ratio.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = 1024
+K_SHORT, K_LONG = 8, 40
+TRIALS = 5
+
+
+def chain(mod, k):
+    def f(x):
+        def body(acc, _):
+            return mod.mul(acc, acc), None
+
+        out, _ = jax.lax.scan(body, x, None, length=k)
+        return out
+
+    return jax.jit(f)
+
+
+def best_seconds(fn, x):
+    out = fn(x)
+    jax.block_until_ready(out)  # compile + warm
+    best = float("inf")
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def slope_us(mod):
+    rng = np.random.default_rng(7)
+    bound = 512 if mod.LIMB_BITS == 8 else 64
+    x = jnp.asarray(rng.integers(0, bound, (BATCH, mod.NLIMBS)), jnp.int32)
+    t_short = best_seconds(chain(mod, K_SHORT), x)
+    t_long = best_seconds(chain(mod, K_LONG), x)
+    return (t_long - t_short) / (K_LONG - K_SHORT) * 1e6
+
+
+def main():
+    from hotstuff_tpu.ops import field25519 as f32e
+    from hotstuff_tpu.ops import field25519_int8 as i8e
+
+    f32e.mul_selfcheck()
+    i8e.mul_selfcheck()
+
+    s_f32 = slope_us(f32e)
+    s_i8 = slope_us(i8e)
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "batch": BATCH,
+        "f32_r8_us_per_mul": round(s_f32, 2),
+        "int8_r5_us_per_mul": round(s_i8, 2),
+        "int8_speedup": round(s_f32 / s_i8, 3) if s_i8 > 0 else None,
+        "note": "slope of K-mul chains, best of %d trials; both engines "
+                "passed exactness self-checks first" % TRIALS,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Exactness tests for the int8 radix-2^5 field engine (the PROFILE.md
+lever-#1 A/B candidate; scripts/ab_int8_mul.py measures its speed)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from hotstuff_tpu.ops import field25519_int8 as F
+
+
+def test_limb_roundtrip():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        x = int.from_bytes(rng.bytes(32), "little") % (2**255)
+        assert F.from_limbs(F.to_limbs(x)) == x
+
+
+def test_mul_selfcheck_passes():
+    F.mul_selfcheck()
+
+
+def test_mul_random_and_adversarial():
+    rng = np.random.default_rng(3)
+    xs = [int.from_bytes(rng.bytes(32), "little") % F.P for _ in range(64)]
+    ys = [int.from_bytes(rng.bytes(32), "little") % F.P for _ in range(64)]
+    xs[0], ys[0] = F.P - 1, F.P - 1
+    xs[1], ys[1] = 0, 12345
+    a = jnp.asarray(F.batch_to_limbs(xs))
+    b = jnp.asarray(F.batch_to_limbs(ys))
+    got = F.batch_from_limbs(np.asarray(F.canonical(F.mul(a, b))))
+    assert got == [(x * y) % F.P for x, y in zip(xs, ys)]
+
+
+def test_mul_chain_stays_weak_and_exact():
+    """Deep mul chains: outputs must keep satisfying the weak invariant
+    (limbs <= 63, losslessly int8-castable) at every step."""
+    rng = np.random.default_rng(4)
+    xs = [int.from_bytes(rng.bytes(32), "little") % F.P for _ in range(16)]
+    acc_dev = jnp.asarray(F.batch_to_limbs(xs))
+    acc_host = list(xs)
+    for _ in range(12):
+        acc_dev = F.mul(acc_dev, acc_dev)
+        arr = np.asarray(acc_dev)
+        assert arr.max() <= 63 and arr.min() >= 0, "weak invariant broken"
+        acc_host = [(v * v) % F.P for v in acc_host]
+    got = F.batch_from_limbs(np.asarray(F.canonical(acc_dev)))
+    assert got == acc_host
+
+
+def test_canonical_reduces_mod_p():
+    # The representation spans exactly 255 bits (5 * 51), so candidates
+    # must be < 2^255 (unlike the r8 engine's 256-bit space).
+    vals = [0, 1, F.P - 1, F.P, F.P + 1, 2**255 - 1]
+    a = jnp.asarray(F.batch_to_limbs(vals))
+    got = F.batch_from_limbs(np.asarray(F.canonical(a)))
+    assert got == [v % F.P for v in vals]

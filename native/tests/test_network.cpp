@@ -227,6 +227,8 @@ TEST(reactor_multiplexes_many_connections) {
   for (int i = 0; i < kConns; i++) {
     auto s = Socket::connect(addr);
     CHECK(s.has_value());
+    // Bounded reads: a multiplexing regression must FAIL, not hang.
+    s->set_recv_timeout(10000);
     socks.push_back(std::move(*s));
   }
   for (int i = 0; i < kConns; i++) {

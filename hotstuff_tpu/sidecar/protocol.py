@@ -154,8 +154,14 @@ def encode_bls_multi_request(request_id: int, msgs, pks, sigs) -> bytes:
 
 
 def decode_request(payload: bytes):
-    """payload (no length prefix) -> (opcode, request dataclass)."""
-    opcode, request_id, n, msg_len = _HDR.unpack_from(payload, 0)
+    """payload (no length prefix) -> (opcode, request dataclass).
+
+    Contract: any malformed frame raises ValueError (callers close the
+    connection on it); nothing else escapes."""
+    try:
+        opcode, request_id, n, msg_len = _HDR.unpack_from(payload, 0)
+    except struct.error as e:
+        raise ValueError(f"short frame: {e}")
     if opcode not in (OP_VERIFY_BATCH, OP_PING, OP_BLS_VERIFY_AGG,
                       OP_BLS_SIGN, OP_BLS_VERIFY_VOTES,
                       OP_BLS_VERIFY_MULTI):

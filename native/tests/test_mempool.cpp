@@ -120,6 +120,47 @@ TEST(quorum_waiter_waits_for_stake) {
   actor.join();
 }
 
+TEST(quorum_waiter_ignores_cancelled_acks) {
+  // Empty-byte fulfilment means CANCELLED (sender teardown / full
+  // backlog), not a peer ACK: counting it would certify batch
+  // availability for peers that never received the batch.
+  auto committee = mempool_committee(7320);
+  auto myself = keys()[0].name;
+  auto rx_msg = make_channel<QuorumWaiterMessage>();
+  auto tx_batch = make_channel<Bytes>();
+  auto stop = std::make_shared<std::atomic<bool>>(false);
+  auto actor = QuorumWaiter::spawn(committee, committee.stake(myself), rx_msg,
+                                   tx_batch, stop);
+
+  QuorumWaiterMessage msg;
+  msg.batch = Bytes{9, 9};
+  std::vector<CancelHandler> handlers;
+  for (const auto& [name, _] : committee.broadcast_addresses(myself)) {
+    CancelHandler h;
+    handlers.push_back(h);
+    msg.handlers.emplace_back(name, h);
+  }
+  rx_msg->send(std::move(msg));
+
+  // One CANCELLED send (empty bytes) plus one real ACK is stake 2 — the
+  // pre-fix bug would count the cancel and hit quorum (3) here.
+  CHECK(handlers.size() == 3);  // 4-node committee: 3 peers
+  handlers[0].set(Bytes{});
+  handlers[1].set(to_bytes("Ack"));
+  Bytes out;
+  CHECK(tx_batch->recv_until(&out, std::chrono::steady_clock::now() +
+                                       std::chrono::milliseconds(200)) ==
+        RecvStatus::kTimeout);
+  // A second real ACK reaches quorum (our stake 1 + 2 = 2f+1 = 3).
+  handlers[2].set(to_bytes("Ack"));
+  auto got = tx_batch->recv();
+  CHECK(got.has_value());
+  CHECK(*got == (Bytes{9, 9}));
+  rx_msg->close();
+  tx_batch->close();
+  actor.join();
+}
+
 TEST(processor_hashes_and_stores) {
   Store store = Store::open("");
   auto rx_batch = make_channel<Bytes>();

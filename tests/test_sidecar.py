@@ -160,3 +160,55 @@ def test_sidecar_bls_multi_digest_verify(host_server):
         assert not client.bls_verify_multi(msgs, pk_enc,
                                            [b"\x02" * 192] * 4)
         assert client.ping()
+
+
+def test_protocol_decode_survives_hostile_bytes():
+    """Wire-decode fuzz (python counterpart of native test_serde's
+    hostile-bytes pass): decode_request raises ValueError on EVERY
+    malformed frame — truncations, trailing bytes, hostile counts,
+    random garbage — and decodes intact frames; nothing else escapes."""
+    import struct
+
+    rng = np.random.default_rng(99)
+
+    good_frames = [
+        proto.encode_request(1, [b"m" * 32] * 3, [b"p" * 32] * 3,
+                             [b"s" * 64] * 3),
+        proto.encode_bls_agg_request(3, b"d" * 32, b"g" * 192,
+                                     [b"k" * 96] * 2),
+        proto.encode_bls_sign_request(4, b"d" * 32, b"x" * 48),
+        proto.encode_bls_votes_request(5, b"d" * 32, [b"k" * 96] * 2,
+                                       [b"g" * 192] * 2),
+        proto.encode_bls_multi_request(6, [b"d" * 32] * 2, [b"k" * 96] * 2,
+                                       [b"g" * 192] * 2),
+    ]
+    for frame in good_frames:
+        payload = frame[4:]
+        opcode, req = proto.decode_request(payload)  # intact decodes
+        assert req.request_id == opcode  # encoders above used rid == op
+        # every strict truncation and any trailing garbage must reject
+        for cut in range(len(payload)):
+            with pytest.raises(ValueError):
+                proto.decode_request(payload[:cut])
+        with pytest.raises(ValueError):
+            proto.decode_request(payload + b"\x00" * 5)
+
+    # PING carries no records; trailing bytes are explicitly tolerated
+    opcode, req = proto.decode_request(proto.encode_ping(2)[4:] + b"\x00")
+    assert opcode == proto.OP_PING
+
+    # random garbage: ValueError or (rarely) a well-formed parse, nothing else
+    for size in (0, 1, 4, 10, 11, 64, 333):
+        try:
+            proto.decode_request(bytes(rng.bytes(size)))
+        except ValueError:
+            pass
+
+    # hostile record counts far beyond the actual frame size must reject
+    # BEFORE any allocation sized by the count (uses the real header
+    # struct so this tracks wire-format changes)
+    for op in (proto.OP_VERIFY_BATCH, proto.OP_BLS_VERIFY_AGG,
+               proto.OP_BLS_VERIFY_VOTES, proto.OP_BLS_VERIFY_MULTI):
+        hostile = proto._HDR.pack(op, 7, 0xFFFFFF, 32) + b"\x01" * 64
+        with pytest.raises(ValueError):
+            proto.decode_request(hostile)

@@ -227,27 +227,55 @@ void EventLoop::update_interest(uint64_t id, Conn* c) {
 }
 
 void EventLoop::flush(uint64_t id, Conn* c) {
+  // Gather header+payload pairs across queued frames into one writev:
+  // the consensus workload sends many small frames (votes, ACKs) per
+  // wakeup, and one syscall per fragment was the dominant per-message
+  // cost at the 60k tx/s single-host ceiling.
+  constexpr int kMaxIov = 64;
   while (!c->out.empty()) {
-    OutFrame& f = c->out.front();
-    size_t total = 4 + f.payload->size();
-    const uint8_t* src;
-    size_t avail;
-    if (f.off < 4) {
-      src = f.hdr + f.off;
-      avail = 4 - f.off;
-    } else {
-      src = f.payload->data() + (f.off - 4);
-      avail = total - f.off;
+    iovec iov[kMaxIov];
+    int iovs = 0;
+    size_t want = 0;
+    for (auto it = c->out.begin();
+         it != c->out.end() && iovs + 2 <= kMaxIov; ++it) {
+      size_t total = 4 + it->payload->size();
+      if (it->off < 4) {
+        iov[iovs].iov_base = const_cast<uint8_t*>(it->hdr + it->off);
+        iov[iovs].iov_len = 4 - it->off;
+        want += iov[iovs].iov_len;
+        iovs++;
+        iov[iovs].iov_base = const_cast<uint8_t*>(it->payload->data());
+        iov[iovs].iov_len = it->payload->size();
+      } else {
+        iov[iovs].iov_base =
+            const_cast<uint8_t*>(it->payload->data() + (it->off - 4));
+        iov[iovs].iov_len = total - it->off;
+      }
+      want += iov[iovs].iov_len;
+      iovs++;
     }
-    ssize_t n = ::send(c->fd, src, avail, MSG_NOSIGNAL);
+    msghdr mh{};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = size_t(iovs);
+    ssize_t n = ::sendmsg(c->fd, &mh, MSG_NOSIGNAL);
     if (n < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       if (errno == EINTR) continue;
       destroy(id, /*run_closed_cb=*/true);
       return;
     }
-    f.off += size_t(n);
-    if (f.off == total) c->out.pop_front();
+    // Consume n bytes across the queued frames.
+    size_t left = size_t(n);
+    while (left > 0 && !c->out.empty()) {
+      OutFrame& f = c->out.front();
+      size_t total = 4 + f.payload->size();
+      size_t take = std::min(left, total - f.off);
+      f.off += take;
+      left -= take;
+      if (f.off == total) c->out.pop_front();
+    }
+    // Short write: the kernel buffer is full — wait for EPOLLOUT.
+    if (size_t(n) < want) break;
   }
   update_interest(id, c);
 }

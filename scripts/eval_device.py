@@ -1,6 +1,10 @@
 """Reliable device-cost eval for verify_packed: slope between G=2 and G=10
 chunked-scan calls (cancels fixed tunnel overhead), min over trials
 (cancels latency spikes).  Prints one number: device ms per 1024-batch.
+
+--trace DIR additionally captures a jax.profiler trace of one chunked
+dispatch (SURVEY §5.1: device-side profiling for the verify kernel) for
+TensorBoard / xprof inspection.
 """
 
 from __future__ import annotations
@@ -21,10 +25,14 @@ from hotstuff_tpu.ops import ed25519 as E
 N = 1024
 
 
+def make_big(packed_np, G):
+    return jnp.asarray(np.broadcast_to(packed_np, (G, N, 128)).copy())
+
+
 def measure(packed_np, G, trials=5, reps=3):
     verify_chunked = E.verify_packed_chunked_jit  # the shipped program
 
-    big = jnp.asarray(np.broadcast_to(packed_np, (G, N, 128)).copy())
+    big = make_big(packed_np, G)
     out = verify_chunked(big)
     assert np.asarray(out).all()
     best = float("inf")
@@ -38,6 +46,14 @@ def measure(packed_np, G, trials=5, reps=3):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", metavar="DIR",
+                    help="also write a jax.profiler trace of one chunked "
+                         "dispatch to DIR")
+    args = ap.parse_args()
+
     rng = np.random.default_rng(7)
     msgs, pks, sigs = [], [], []
     for _ in range(N):
@@ -55,6 +71,14 @@ def main():
     slope = (t10 - t2) / 8
     print(f"G2 {t2*1e3:.2f} ms, G10 {t10*1e3:.2f} ms")
     print(f"DEVICE {slope*1e3:.2f} ms/1024  ({N/slope:,.0f} sigs/s ceiling)")
+
+    if args.trace:
+        # Trace the G=10 shape measure() already compiled, so the capture
+        # holds ONE warm device dispatch — not a cold XLA compile.
+        big = make_big(packed_np, 10)
+        with jax.profiler.trace(args.trace):
+            np.asarray(E.verify_packed_chunked_jit(big))
+        print(f"profiler trace written to {args.trace}")
 
 
 if __name__ == "__main__":

@@ -212,3 +212,38 @@ def test_protocol_decode_survives_hostile_bytes():
         hostile = proto._HDR.pack(op, 7, 0xFFFFFF, 32) + b"\x01" * 64
         with pytest.raises(ValueError):
             proto.decode_request(hostile)
+
+
+def test_engine_mesh_mode_buckets_to_warmed_shapes(monkeypatch):
+    """VerifyEngine(mesh_devices=8) on the virtual CPU mesh: requests of
+    awkward sizes must verify correctly AND pad to power-of-two per-shard
+    shapes (the round-3 advisor's mid-traffic compile hazard — only
+    warmed shapes may reach the device program)."""
+    from hotstuff_tpu.parallel import sharded_verify as sv
+
+    launched = []
+    real = sv._cached_verifier
+
+    def spying(mesh, max_subbatch=sv.MAX_SUBBATCH):
+        fn = real(mesh, max_subbatch)
+
+        def wrapper(*arrays):
+            launched.append(arrays[0].shape[0])
+            return fn(*arrays)
+
+        return wrapper
+
+    monkeypatch.setattr(sv, "_cached_verifier", spying)
+    engine = VerifyEngine(mesh_devices=8)
+    try:
+        # n=3 -> per-shard 1 (floored at _MIN_BUCKET/8) -> m=8;
+        # n=13 -> per-shard 2 -> m=16: always n_dev * power-of-two.
+        for n, tamper, want_m in ((3, {1}, 8), (8, set(), 8),
+                                  (13, {0, 12}, 16)):
+            launched.clear()
+            msgs, pks, sigs = _sigs(n, tamper=tamper)
+            got = engine._verify(msgs, pks, sigs)
+            assert list(got) == [i not in tamper for i in range(n)]
+            assert launched == [want_m], (n, launched)
+    finally:
+        engine.stop()

@@ -264,6 +264,25 @@ def verify_aggregate_common(pks, msg: bytes, agg_sig) -> bool:
     return bool(np.asarray(pairings_check_jit(jnp.asarray(lines))))
 
 
+def multi_pairing_rows(pks, msgs, agg_sig):
+    """Validate a distinct-message aggregate statement and build its n+1
+    Miller-line rows (votes + the -g1/agg row). Returns None if any input
+    is malformed — the ONE validation both the single-chip and the
+    mesh-sharded verifier share, so they can never accept different
+    inputs."""
+    if len(pks) != len(msgs) or not pks:
+        return None
+    if agg_sig is None or not host.g2_on_curve(agg_sig):
+        return None
+    rows = []
+    for pk, msg in zip(pks, msgs):
+        if pk is None or not host.g1_on_curve(pk):
+            return None
+        rows.append(miller_lines(pk, host.hash_to_g2(msg)))
+    rows.append(miller_lines(host.g1_neg(host.g1_generator()), agg_sig))
+    return rows
+
+
 def verify_aggregate_multi(pks, msgs, agg_sig) -> bool:
     """Distinct-message aggregate verify (the TC shape: 2f+1 timeout votes
     over per-round digests, consensus/src/messages.rs:307-313):
@@ -271,14 +290,7 @@ def verify_aggregate_multi(pks, msgs, agg_sig) -> bool:
     under ONE final exponentiation on device.  Compiles one program per
     vote count; a committee's TC size is fixed at 2f+1, so that is a
     single shape in practice."""
-    if len(pks) != len(msgs) or not pks:
+    rows = multi_pairing_rows(pks, msgs, agg_sig)
+    if rows is None:
         return False
-    if agg_sig is None or not host.g2_on_curve(agg_sig):
-        return False
-    rows = []
-    for pk, msg in zip(pks, msgs):
-        if pk is None or not host.g1_on_curve(pk):
-            return False
-        rows.append(miller_lines(pk, host.hash_to_g2(msg)))
-    rows.append(miller_lines(host.g1_neg(host.g1_generator()), agg_sig))
     return bool(np.asarray(pairings_check_jit(jnp.asarray(np.stack(rows)))))

@@ -109,8 +109,15 @@ class LocalBench:
         if self.scheme == "bls":
             # Warm both BLS shapes: the 2-pairing QC check and the
             # quorum-size multi-digest TC check (one compiled program per
-            # vote count; unwarmed counts verify on host).
-            quorum = 2 * ((self.nodes - 1) // 3) + 1
+            # vote count; unwarmed counts verify on host).  The vote count
+            # MUST use the node's own quorum formula (2n/3+1 with unit
+            # stakes, native/src/consensus/config.hpp — NOT 2f+1 from
+            # n=3f+1, which disagrees for n not of that form, e.g. n=20)
+            # or every TC verify falls back to host pairing mid-traffic.
+            # The certificate-minimality guard (messages.cpp) rejects
+            # over-quorum TCs, so this one shape covers every TC a
+            # well-formed run can carry.
+            quorum = 2 * self.nodes // 3 + 1
             warm_bls = f" --warm-bls --warm-bls-multi {quorum}"
         hc = " --host-crypto" if host_crypto else ""
         # The degraded reboot appends to the log: the dead device

@@ -90,20 +90,41 @@ class VerifyEngine:
 
     # -- consumer ----------------------------------------------------------
 
+    # Ed25519 launches kept in flight before the oldest result is fetched.
+    # The tunneled device charges a fixed ~15-20 ms per dispatch that
+    # OVERLAPS device execution of the previous launch — but only if the
+    # engine dispatches launch i+1 before fetching launch i's mask.  Depth
+    # 2 covers dispatch ~= execute; deeper only adds reply latency.
+    PIPELINE_DEPTH = 2
+
     def _run(self):
+        import collections
+
+        inflight = collections.deque()  # (batch, fetch_fn)
         while not self._stopped.is_set():
             if self._carry is not None:
                 item, self._carry = self._carry, None
+            elif inflight:
+                # Work is pending on the device: don't block on the queue;
+                # drain the oldest launch if nothing new is waiting.
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    self._drain_one(inflight)
+                    continue
             else:
                 item = self._queue.get()
             if item is None:
                 continue
             # BLS requests run individually (a QC aggregate is one check;
-            # there is nothing to coalesce) on the same device thread.
+            # there is nothing to coalesce) on the same device thread,
+            # after all in-flight Ed25519 launches drain.
             if isinstance(item.request, (proto.BlsAggRequest,
                                          proto.BlsSignRequest,
                                          proto.BlsVotesRequest,
                                          proto.BlsMultiRequest)):
+                while inflight:
+                    self._drain_one(inflight)
                 try:
                     self._execute_bls(item)
                 except Exception:
@@ -126,36 +147,63 @@ class VerifyEngine:
                 batch.append(nxt)
                 total += len(nxt.request.msgs)
             try:
-                self._execute(batch)
+                inflight.append((batch, self._submit(batch)))
             except Exception:
-                log.exception("verify batch failed")
+                log.exception("verify batch dispatch failed")
                 for p in batch:
                     p.reply_fn([False] * len(p.request.msgs))
+            while len(inflight) >= self.PIPELINE_DEPTH:
+                self._drain_one(inflight)
+        # Shutdown: every accepted request still gets its reply (clients
+        # would otherwise block until their recv deadline and report a
+        # spurious transport failure).
+        while inflight:
+            self._drain_one(inflight)
 
-    def _execute(self, batch):
+    def _drain_one(self, inflight):
+        batch, fetch = inflight.popleft()
+        try:
+            mask = fetch()
+        except Exception:
+            log.exception("verify batch failed")
+            for p in batch:
+                p.reply_fn([False] * len(p.request.msgs))
+            return
+        off = 0
+        for p in batch:
+            n = len(p.request.msgs)
+            p.reply_fn([bool(b) for b in mask[off:off + n]])
+            off += n
+
+    def _submit(self, batch):
+        """Dispatch one coalesced batch; returns fetch() -> concatenated
+        mask.  The host path computes eagerly; the device paths dispatch
+        asynchronously so the next launch can overlap this one."""
         msgs, pks, sigs = [], [], []
         for p in batch:
             msgs += p.request.msgs
             pks += p.request.pks
             sigs += p.request.sigs
         # The host path verifies per sub-batch; the device paths (single
-        # chip via eddsa.verify_batch, mesh via verify_batch_sharded — both
-        # chunk internally) run up to a whole launch-cap window as one
-        # dispatch, so the per-dispatch tunnel cost is paid once.  A single
-        # request larger than the cap (the coalescer only bounds
-        # *additional* requests) is still sliced here so no request can
-        # force an unwarmed compile shape or an unbounded device
-        # allocation.
+        # chip via eddsa.verify_batch_submit, mesh via
+        # verify_batch_sharded — both chunk internally) run up to a whole
+        # launch-cap window as one dispatch, so the per-dispatch tunnel
+        # cost is paid once.  A single request larger than the cap (the
+        # coalescer only bounds *additional* requests) is still sliced
+        # here so no request can force an unwarmed compile shape or an
+        # unbounded device allocation.
         step = MAX_SUBBATCH if self._use_host else self._launch_cap
-        mask = []
-        for i in range(0, len(msgs), step):
-            j = i + step
-            mask.extend(self._verify(msgs[i:j], pks[i:j], sigs[i:j]))
-        off = 0
-        for p in batch:
-            n = len(p.request.msgs)
-            p.reply_fn([bool(b) for b in mask[off:off + n]])
-            off += n
+        fetchers = [self._verify_submit(msgs[i:i + step], pks[i:i + step],
+                                        sigs[i:i + step])
+                    for i in range(0, len(msgs), step)]
+
+        def fetch():
+            mask = []
+            for f in fetchers:
+                mask.extend(f())
+            return mask
+
+        return fetch
 
     def _execute_bls(self, item):
         from ..offchain import bls12381 as bls
@@ -224,23 +272,29 @@ class VerifyEngine:
             ok = dbls.verify_aggregate_common(pks, req.msg, agg)
         item.reply_fn([bool(ok)])
 
-    def _verify(self, msgs, pks, sigs) -> np.ndarray:
+    def _verify_submit(self, msgs, pks, sigs):
+        """Dispatch one slice; returns fetch() -> (n,) bool mask."""
         if not msgs:
-            return np.zeros((0,), bool)
+            return lambda: np.zeros((0,), bool)
         if self._use_host:
             from ..crypto import ref_ed25519 as ref
 
-            return np.array([ref.verify(p, m, s)
-                             for m, p, s in zip(msgs, pks, sigs)])
+            res = np.array([ref.verify(p, m, s)
+                            for m, p, s in zip(msgs, pks, sigs)])
+            return lambda: res
         if self._mesh is not None:
             from ..crypto.eddsa import prepare_batch
             from ..parallel.sharded_verify import verify_batch_sharded
 
-            return verify_batch_sharded(self._mesh, prepare_batch(
+            res = verify_batch_sharded(self._mesh, prepare_batch(
                 msgs, pks, sigs))
+            return lambda: res
         from ..crypto import eddsa
 
-        return eddsa.verify_batch(msgs, pks, sigs)
+        return eddsa.verify_batch_submit(msgs, pks, sigs)
+
+    def _verify(self, msgs, pks, sigs) -> np.ndarray:
+        return np.asarray(self._verify_submit(msgs, pks, sigs)())
 
 
 class _Handler(socketserver.BaseRequestHandler):

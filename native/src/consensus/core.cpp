@@ -1,10 +1,14 @@
 #include "consensus/core.hpp"
 
 #include <algorithm>
+#include <atomic>
 #include <deque>
+#include <map>
+#include <set>
 #include <thread>
 
 #include "common/log.hpp"
+#include "crypto/sidecar_client.hpp"
 
 namespace hotstuff {
 namespace consensus {
@@ -58,6 +62,8 @@ class CoreImpl {
       VerifyResult result = VerifyResult::good();
       if (event.kind == CoreEvent::Kind::kLoopback) {
         result = process_block(event.block);
+      } else if (event.kind == CoreEvent::Kind::kVerdict) {
+        result = handle_verdict(event.block, event.verdict);
       } else {
         switch (event.message.kind) {
           case ConsensusMessage::Kind::kPropose:
@@ -282,7 +288,12 @@ class CoreImpl {
 
   VerifyResult handle_timeout(const Timeout& timeout) {
     if (timeout.round < round_) return VerifyResult::good();
-    VerifyResult valid = timeout.verify(committee_);
+    // Own signature first, then the embedded high QC through the verified
+    // cache: during a view change the 2f+1 timeouts typically all carry
+    // the same high QC — one signature batch instead of 2f+1.
+    VerifyResult valid = timeout.verify_own(committee_);
+    if (!valid.ok()) return valid;
+    valid = verify_qc_cached(timeout.high_qc);
     if (!valid.ok()) return valid;
 
     process_qc(timeout.high_qc);
@@ -290,6 +301,9 @@ class CoreImpl {
     auto added = aggregator_.add_timeout(timeout);
     if (!added.error.empty()) return VerifyResult::bad(added.error);
     if (added.tc) {
+      // Formed from individually verified timeouts (see the QC analogue in
+      // handle_vote).
+      cert_insert(added.tc->content_digest());
       advance_round(added.tc->round);
       std::vector<Address> addresses;
       for (const auto& [_, addr] : committee_.broadcast_addresses(name_)) {
@@ -309,7 +323,7 @@ class CoreImpl {
     // any peer — or one corrupted frame — advance our round arbitrarily
     // (observed in round 2 as a node jumping to round 97 during a stalled
     // run). Verify before trusting the round number.
-    VerifyResult valid = tc.verify(committee_);
+    VerifyResult valid = verify_tc_cached(tc);
     if (!valid.ok()) return valid;
     advance_round(tc.round);
     if (name_ == leader_elector_->get_leader(round_)) {
@@ -328,6 +342,9 @@ class CoreImpl {
     auto added = aggregator_.add_vote(vote);
     if (!added.error.empty()) return VerifyResult::bad(added.error);
     if (added.qc) {
+      // Formed from individually verified votes: no re-verification needed
+      // when these exact bytes come back embedded in a proposal.
+      cert_insert(added.qc->content_digest());
       process_qc(*added.qc);
       if (name_ == leader_elector_->get_leader(round_)) {
         generate_proposal(std::nullopt);
@@ -392,15 +409,141 @@ class CoreImpl {
     return VerifyResult::good();
   }
 
-  VerifyResult handle_proposal(const Block& block) {
-    // Leader check (core.rs:399-406).
-    if (block.author != leader_elector_->get_leader(block.round)) {
-      return VerifyResult::bad("wrong leader for round " +
-                               std::to_string(block.round));
-    }
-    VerifyResult valid = block.verify(committee_);
-    if (!valid.ok()) return valid;
+  // -- certificate-verification cache + async dispatch ---------------------
 
+  // Remembers certificates whose signature batches already verified, so a
+  // certificate is verified once per node, not once per message carrying
+  // it.  Keys are content digests over the FULL serialized certificate —
+  // any byte difference (notably a tampered vote set under an unchanged
+  // (hash, round)) misses the cache and re-verifies.  During a view
+  // change the 2f+1 timeouts typically embed byte-identical copies of the
+  // same high QC (everyone forwards the bytes they received), so this
+  // still collapses 2f+1 re-verifications into one — the difference
+  // between O(n) and O(n^2) signature work at N=100.
+  bool cert_cached(const Digest& d) const {
+    return verified_certs_.count(d) != 0;
+  }
+
+  void cert_insert(const Digest& d) {
+    if (!verified_certs_.insert(d).second) return;
+    verified_certs_fifo_.push_back(d);
+    if (verified_certs_fifo_.size() > kCertCacheCap) {
+      verified_certs_.erase(verified_certs_fifo_.front());
+      verified_certs_fifo_.pop_front();
+    }
+  }
+
+  VerifyResult verify_qc_cached(const QC& qc) {
+    if (qc.is_genesis()) return VerifyResult::good();
+    Digest d = qc.content_digest();
+    if (cert_cached(d)) return VerifyResult::good();
+    VerifyResult r = qc.verify(committee_);
+    if (r.ok()) cert_insert(d);
+    return r;
+  }
+
+  VerifyResult verify_tc_cached(const TC& tc) {
+    Digest d = tc.content_digest();
+    if (cert_cached(d)) return VerifyResult::good();
+    VerifyResult r = tc.verify(committee_);
+    if (r.ok()) cert_insert(d);
+    return r;
+  }
+
+  // Attempts to dispatch the proposal's outstanding certificate signature
+  // batches to the device asynchronously.  Returns true if dispatched (the
+  // proposal is suspended; a kVerdict event resumes it), false if the
+  // caller must verify synchronously.  Structural checks and the block's
+  // own (cheap, host) signature were already done by handle_proposal.
+  //
+  // The completion callbacks run on the sidecar reply thread: they push
+  // the verdict into the Core's own event channel and nothing else.
+  // try_send: if the Core's queue is full the verdict is dropped and the
+  // proposal stays suspended until its pending entry expires — the
+  // leader's re-proposal or a sync request then re-verifies, identical to
+  // dropping any other message under overload.
+  bool try_dispatch_verify(const Block& block, bool need_qc, bool need_tc) {
+    if (!Signature::async_available()) return false;
+    auto ch = rx_event_;
+    if (current_scheme() == Scheme::kBls) {
+      // QC and TC go as SEPARATE ops: the sidecar pre-compiles the
+      // common-digest pairing (QC shape) and the quorum-size multi-digest
+      // pairing (TC shape) individually; one concatenated multi-digest
+      // batch of 2x quorum would be an unwarmed shape, pushing an honest
+      // view-change proposal onto the slow host pairing path.
+      TpuVerifier* tpu = TpuVerifier::instance();
+      if (!tpu) return false;
+      struct Join {
+        std::atomic<int> remaining;
+        std::atomic<bool> all_ok{true};
+        ChannelPtr<CoreEvent> ch;
+        Block block;
+      };
+      auto join = std::make_shared<Join>();
+      join->remaining = (need_qc ? 1 : 0) + (need_tc ? 1 : 0);
+      join->ch = ch;
+      join->block = block;
+      auto complete = [join](std::optional<bool> ok) {
+        // Transport failure is a definitive reject under BLS (no host
+        // pairing exists) — same policy as the synchronous path.
+        if (!ok.value_or(false)) join->all_ok = false;
+        if (join->remaining.fetch_sub(1) == 1) {
+          CoreEvent e = CoreEvent::verdict_of(join->block,
+                                              join->all_ok.load());
+          join->ch->try_send(std::move(e));
+        }
+      };
+      if (need_qc) {
+        tpu->bls_verify_votes_async(block.qc.digest(), block.qc.votes,
+                                    complete);
+      }
+      if (need_tc) {
+        tpu->bls_verify_multi_async(block.tc->vote_items(), complete);
+      }
+      return true;
+    }
+    // Ed25519: one combined multi-digest batch (padded power-of-two
+    // buckets; every shape is pre-warmed).
+    std::vector<std::tuple<Digest, PublicKey, Signature>> items;
+    if (need_qc) {
+      auto qi = block.qc.vote_items();
+      items.insert(items.end(), qi.begin(), qi.end());
+    }
+    if (need_tc) {
+      auto ti = block.tc->vote_items();
+      items.insert(items.end(), ti.begin(), ti.end());
+    }
+    Block copy = block;
+    Signature::verify_batch_multi_async(
+        std::move(items), [ch, copy](std::optional<bool> ok) mutable {
+          CoreEvent e = CoreEvent::verdict_of(std::move(copy), ok);
+          ch->try_send(std::move(e));
+        });
+    return true;
+  }
+
+  // Completion loopback of an async certificate verification.
+  VerifyResult handle_verdict(const Block& block,
+                              std::optional<bool> verdict) {
+    pending_verify_.erase(block.digest());
+    if (!verdict.has_value()) {
+      // Transport failure: the sidecar is backed off, so the synchronous
+      // path below resolves on the host without re-stalling the Core.
+      LOG_WARN("consensus::core")
+          << "async verify transport failure; re-verifying on host";
+      return handle_proposal(block);
+    }
+    if (!*verdict) {
+      return VerifyResult::bad("invalid certificate signatures in block " +
+                               block.digest().to_base64());
+    }
+    if (!block.qc.is_genesis()) cert_insert(block.qc.content_digest());
+    if (block.tc) cert_insert(block.tc->content_digest());
+    return proposal_postverify(block);
+  }
+
+  // Everything handle_proposal does after the block is fully verified.
+  VerifyResult proposal_postverify(const Block& block) {
     process_qc(block.qc);
     if (block.tc) advance_round(block.tc->round);
 
@@ -412,6 +555,82 @@ class CoreImpl {
       return VerifyResult::good();
     }
     return process_block(block);
+  }
+
+  VerifyResult handle_proposal(const Block& block) {
+    // Leader check (core.rs:399-406).
+    if (block.author != leader_elector_->get_leader(block.round)) {
+      return VerifyResult::bad("wrong leader for round " +
+                               std::to_string(block.round));
+    }
+    Digest bd = block.digest();
+    auto pending = pending_verify_.find(bd);
+    if (pending != pending_verify_.end()) {
+      // Fresh: duplicate of an in-flight proposal, drop it.  Stale (the
+      // verdict event was lost, e.g. dropped by a full event queue): the
+      // re-delivered proposal takes over and re-verifies.
+      if (std::chrono::steady_clock::now() < pending->second) {
+        return VerifyResult::good();
+      }
+      pending_verify_.erase(pending);
+    }
+
+    // Host-cheap checks first: author, the block's own signature, and the
+    // certificates' structural (stake/reuse/quorum) rules.
+    if (committee_.stake(block.author) == 0) {
+      return VerifyResult::bad("unknown block author: " +
+                               block.author.to_base64());
+    }
+    if (current_scheme() != Scheme::kBls &&
+        !block.signature.verify(bd, block.author)) {
+      return VerifyResult::bad("invalid block signature");
+    }
+    bool need_qc =
+        !block.qc.is_genesis() && !cert_cached(block.qc.content_digest());
+    bool need_tc = block.tc && !cert_cached(block.tc->content_digest());
+    if (need_qc) {
+      VerifyResult r = block.qc.verify_structure(committee_);
+      if (!r.ok()) return r;
+    }
+    if (need_tc) {
+      VerifyResult r = block.tc->verify_structure(committee_);
+      if (!r.ok()) return r;
+    }
+
+    // Under scheme=bls the block's own signature is a pairing too — it
+    // stays on the synchronous path below (one extra sidecar op per block;
+    // the QC/TC batches are what scale with committee size).
+    if (current_scheme() == Scheme::kBls &&
+        !block.signature.verify(bd, block.author)) {
+      return VerifyResult::bad("invalid block signature");
+    }
+
+    if ((need_qc || need_tc) &&
+        try_dispatch_verify(block, need_qc, need_tc)) {
+      // The expiry covers a lost verdict event: transport failures arrive
+      // well inside the scheme's sidecar deadline, so anything older is
+      // gone for good and the next delivery of the block must re-verify.
+      int deadline_ms = current_scheme() == Scheme::kBls
+                            ? 2 * TpuVerifier::kBlsRecvTimeoutMs
+                            : 2 * TpuVerifier::kRecvTimeoutMs;
+      pending_verify_[bd] = std::chrono::steady_clock::now() +
+                            std::chrono::milliseconds(deadline_ms);
+      LOG_DEBUG("consensus::core")
+          << "Processing of " << bd.to_base64()
+          << " suspended: certificate verify in flight";
+      return VerifyResult::good();
+    }
+
+    // Synchronous path (no sidecar / at pipeline cap / nothing to check).
+    if (need_qc) {
+      VerifyResult r = verify_qc_cached(block.qc);
+      if (!r.ok()) return r;
+    }
+    if (need_tc) {
+      VerifyResult r = verify_tc_cached(*block.tc);
+      if (!r.ok()) return r;
+    }
+    return proposal_postverify(block);
   }
 
   // -- state ---------------------------------------------------------------
@@ -437,6 +656,14 @@ class CoreImpl {
   Aggregator aggregator_;
   SimpleSender network_;
   std::chrono::steady_clock::time_point timer_deadline_;
+
+  // Async-verify bookkeeping: block digests with a device verdict in
+  // flight (value = expiry, after which a re-delivered copy re-verifies),
+  // and the FIFO-bounded set of certificates already verified.
+  static constexpr size_t kCertCacheCap = 1024;
+  std::map<Digest, std::chrono::steady_clock::time_point> pending_verify_;
+  std::set<Digest> verified_certs_;
+  std::deque<Digest> verified_certs_fifo_;
 };
 
 }  // namespace

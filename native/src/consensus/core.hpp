@@ -19,12 +19,21 @@ namespace hotstuff {
 namespace consensus {
 
 // Unified input event for the core's select loop (rx_message + rx_loopback
-// of the reference, core.rs:438-467).
+// of the reference, core.rs:438-467).  kVerdict is the completion loopback
+// of an ASYNC certificate verification: the Core dispatches a proposal's
+// QC/TC signature batch to the device, keeps processing other events, and
+// resumes the suspended proposal when the verdict arrives — the same
+// suspend/resume shape as a missing-parent sync (core.rs:348-354), applied
+// to the verify latency the reference pays synchronously
+// (messages.rs:180-198).
 struct CoreEvent {
-  enum class Kind { kMessage, kLoopback };
+  enum class Kind { kMessage, kLoopback, kVerdict };
   Kind kind = Kind::kMessage;
   ConsensusMessage message;  // kMessage
-  Block block;               // kLoopback
+  Block block;               // kLoopback, kVerdict
+  // kVerdict: true/false = device verdict on the block's certificates;
+  // nullopt = transport failure, re-verify synchronously (host fallback).
+  std::optional<bool> verdict;
 
   static CoreEvent loopback(Block b) {
     CoreEvent e;
@@ -36,6 +45,13 @@ struct CoreEvent {
     CoreEvent e;
     e.kind = Kind::kMessage;
     e.message = std::move(m);
+    return e;
+  }
+  static CoreEvent verdict_of(Block b, std::optional<bool> ok) {
+    CoreEvent e;
+    e.kind = Kind::kVerdict;
+    e.block = std::move(b);
+    e.verdict = ok;
     return e;
   }
 };

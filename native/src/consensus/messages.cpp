@@ -19,23 +19,84 @@ Digest QC::digest() const {
   return DigestBuilder().update(hash.data).update_u64_le(round).finalize();
 }
 
-VerifyResult QC::verify(const Committee& committee) const {
+namespace {
+
+// Stake/reuse/quorum accounting shared by QC and TC structural checks.
+// `label` tags error strings ("QC"/"TC").
+//
+// Beyond the reference (messages.rs:184-195), when all voting stakes are
+// equal this also rejects NON-MINIMAL certificates (more votes than the
+// quorum needs): a Byzantine leader can otherwise pad a certificate to all
+// n votes, a shape the verify sidecar never pre-compiled, forcing every
+// honest verifier onto the slow host path at once — a cheap targeted
+// stall.  Honest aggregators seal at exactly the quorum under equal
+// stakes, so the guard never fires on honest traffic; with mixed stakes
+// minimality isn't well-defined (an aggregator may legitimately overshoot
+// depending on arrival order), so the guard deactivates.
+template <typename VoteList, typename GetAuthority>
+VerifyResult check_vote_stakes(const VoteList& votes, GetAuthority author_of,
+                               const Committee& committee,
+                               const char* label) {
   Stake weight = 0;
+  Stake min_stake = 0;
+  bool equal_stakes = true;
   std::set<PublicKey> used;
-  for (const auto& [name, _] : votes) {
+  for (const auto& v : votes) {
+    const PublicKey& name = author_of(v);
     if (used.count(name)) {
-      return VerifyResult::bad("authority reuse in QC: " + name.to_base64());
+      return VerifyResult::bad(std::string("authority reuse in ") + label +
+                               ": " + name.to_base64());
     }
     Stake stake = committee.stake(name);
     if (stake == 0) {
-      return VerifyResult::bad("unknown authority in QC: " + name.to_base64());
+      return VerifyResult::bad(std::string("unknown authority in ") + label +
+                               ": " + name.to_base64());
     }
     used.insert(name);
     weight += stake;
+    if (min_stake == 0) {
+      min_stake = stake;
+    } else if (stake != min_stake) {
+      equal_stakes = false;
+    }
   }
   if (weight < committee.quorum_threshold()) {
-    return VerifyResult::bad("QC requires a quorum");
+    return VerifyResult::bad(std::string(label) + " requires a quorum");
   }
+  if (equal_stakes && min_stake > 0 &&
+      weight - min_stake >= committee.quorum_threshold()) {
+    return VerifyResult::bad(std::string(label) +
+                             " carries more votes than a quorum");
+  }
+  return VerifyResult::good();
+}
+
+}  // namespace
+
+VerifyResult QC::verify_structure(const Committee& committee) const {
+  return check_vote_stakes(
+      votes, [](const auto& v) -> const PublicKey& { return v.first; },
+      committee, "QC");
+}
+
+std::vector<std::tuple<Digest, PublicKey, Signature>> QC::vote_items()
+    const {
+  Digest d = digest();
+  std::vector<std::tuple<Digest, PublicKey, Signature>> items;
+  items.reserve(votes.size());
+  for (const auto& [pk, sig] : votes) items.emplace_back(d, pk, sig);
+  return items;
+}
+
+Digest QC::content_digest() const {
+  Writer w;
+  serialize(&w);
+  return DigestBuilder().update(w.out).finalize();
+}
+
+VerifyResult QC::verify(const Committee& committee) const {
+  VerifyResult r = verify_structure(committee);
+  if (!r.ok()) return r;
   // The TPU kernel target: batch-verify the quorum's signatures over the
   // vote digest (crypto/src/lib.rs:210-223 analogue; device dispatch in
   // Signature::verify_batch).
@@ -84,29 +145,17 @@ std::vector<Round> TC::high_qc_rounds() const {
   return rounds;
 }
 
-VerifyResult TC::verify(const Committee& committee) const {
-  Stake weight = 0;
-  std::set<PublicKey> used;
-  for (const auto& [name, sig, hqr] : votes) {
-    (void)sig;
-    (void)hqr;
-    if (used.count(name)) {
-      return VerifyResult::bad("authority reuse in TC: " + name.to_base64());
-    }
-    Stake stake = committee.stake(name);
-    if (stake == 0) {
-      return VerifyResult::bad("unknown authority in TC: " + name.to_base64());
-    }
-    used.insert(name);
-    weight += stake;
-  }
-  if (weight < committee.quorum_threshold()) {
-    return VerifyResult::bad("TC requires a quorum");
-  }
+VerifyResult TC::verify_structure(const Committee& committee) const {
+  return check_vote_stakes(
+      votes,
+      [](const auto& v) -> const PublicKey& { return std::get<0>(v); },
+      committee, "TC");
+}
+
+std::vector<std::tuple<Digest, PublicKey, Signature>> TC::vote_items()
+    const {
   // Each timeout vote signed (round, its own high_qc round) — distinct
-  // digests per vote. The reference verifies them sequentially
-  // (messages.rs:307-313); here they go through one multi-digest batch
-  // (one device launch with the sidecar installed, host loop otherwise).
+  // digests per vote (messages.rs:307-313).
   std::vector<std::tuple<Digest, PublicKey, Signature>> items;
   items.reserve(votes.size());
   for (const auto& [author, sig, high_qc_round] : votes) {
@@ -116,7 +165,22 @@ VerifyResult TC::verify(const Committee& committee) const {
                    .finalize();
     items.emplace_back(d, author, sig);
   }
-  if (!Signature::verify_batch_multi(items)) {
+  return items;
+}
+
+Digest TC::content_digest() const {
+  Writer w;
+  serialize(&w);
+  return DigestBuilder().update(w.out).finalize();
+}
+
+VerifyResult TC::verify(const Committee& committee) const {
+  VerifyResult r = verify_structure(committee);
+  if (!r.ok()) return r;
+  // The reference verifies timeout votes sequentially (messages.rs:
+  // 307-313); here they go through one multi-digest batch (one device
+  // launch with the sidecar installed, host loop otherwise).
+  if (!Signature::verify_batch_multi(vote_items())) {
     return VerifyResult::bad("invalid signature in TC");
   }
   return VerifyResult::good();
@@ -272,15 +336,21 @@ Digest Timeout::digest() const {
       .finalize();
 }
 
-VerifyResult Timeout::verify(const Committee& committee) const {
+VerifyResult Timeout::verify_own(const Committee& committee) const {
   if (committee.stake(author) == 0) {
     return VerifyResult::bad("unknown timeout author: " + author.to_base64());
   }
   if (!signature.verify(digest(), author)) {
     return VerifyResult::bad("invalid timeout signature");
   }
+  return VerifyResult::good();
+}
+
+VerifyResult Timeout::verify(const Committee& committee) const {
+  VerifyResult r = verify_own(committee);
+  if (!r.ok()) return r;
   if (!high_qc.is_genesis()) {
-    VerifyResult r = high_qc.verify(committee);
+    r = high_qc.verify(committee);
     if (!r.ok()) return r;
   }
   return VerifyResult::good();

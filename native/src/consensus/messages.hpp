@@ -41,6 +41,19 @@ struct QC {
 
   Digest digest() const;  // what each vote signed
   VerifyResult verify(const Committee& committee) const;
+  // Stake/reuse/quorum checks only — everything but the signature batch.
+  // Lets the Core run the cheap host checks synchronously and dispatch the
+  // signature batch to the device asynchronously.
+  VerifyResult verify_structure(const Committee& committee) const;
+  // The (digest, pk, sig) records the signature batch must verify (all
+  // votes share this QC's digest()).
+  std::vector<std::tuple<Digest, PublicKey, Signature>> vote_items() const;
+  // Hash over the full serialized QC — the verified-certificate cache
+  // key.  Deliberately NOT digest(): that covers only (hash, round), and
+  // a byte-tampered vote set with the same (hash, round) must MISS the
+  // cache so it is re-verified (and rejected) rather than persisted and
+  // served to syncing peers.
+  Digest content_digest() const;
 
   void serialize(Writer* w) const;
   static QC deserialize(Reader* r);
@@ -52,6 +65,16 @@ struct TC {
 
   std::vector<Round> high_qc_rounds() const;
   VerifyResult verify(const Committee& committee) const;
+  // Stake/reuse/quorum checks only (see QC::verify_structure).
+  VerifyResult verify_structure(const Committee& committee) const;
+  // The (digest, pk, sig) records the signature batch must verify — each
+  // timeout vote signed its own (round, high_qc_round) digest.
+  std::vector<std::tuple<Digest, PublicKey, Signature>> vote_items() const;
+  // Hash over the full serialized TC (round + complete vote set) — the
+  // verified-TC cache key.  Unlike QC::digest(), which covers only the
+  // semantic content (hash, round), a TC's high_qc_rounds feed the voting
+  // safety rule, so the cache must key on everything.
+  Digest content_digest() const;
 
   void serialize(Writer* w) const;
   static TC deserialize(Reader* r);
@@ -111,6 +134,11 @@ struct Timeout {
 
   Digest digest() const;
   VerifyResult verify(const Committee& committee) const;
+  // Author + signature checks only — without the embedded high_qc, which
+  // the Core verifies through its verified-QC cache (during a view change
+  // all 2f+1 timeouts typically carry the SAME high QC; re-verifying it
+  // per timeout is O(n^2) signature work at committee scale).
+  VerifyResult verify_own(const Committee& committee) const;
 
   void serialize(Writer* w) const;
   static Timeout deserialize(Reader* r);

@@ -149,11 +149,85 @@ Signature Signature::sign(const Digest& digest, const SecretKey& sk) {
   return sig;
 }
 
+namespace {
+
+// Small-order (8-torsion) rejection, mirroring the device path's
+// verify_strict parity (hotstuff_tpu/crypto/eddsa.py _SMALL_ORDER_Y) so a
+// node whose sidecar is down reaches the same verdict as one using the
+// device path: OpenSSL's EVP_DigestVerify accepts small-order A/R per
+// RFC 8032, under which the identity pk plus sig = ([S]B || S) verifies
+// ANY message — a universal forgery that breaks vote attribution.
+//
+// The eight 8-torsion points have five distinct y values and the set is
+// closed under negation, so reducing the sign-cleared 255-bit y mod p and
+// comparing against the five values is an exact test over ALL encodings
+// (canonical and non-canonical alike — the closure dalek's checked list of
+// excluded point encodings enumerates explicitly).
+bool is_small_order_encoding(const uint8_t* enc32) {
+  // y = little-endian value of the encoding with the sign bit cleared.
+  std::array<uint8_t, 32> y;
+  std::memcpy(y.data(), enc32, 32);
+  y[31] &= 0x7f;
+  // Reduce mod p = 2^255 - 19: y < 2^255 < 2p, so at most one subtract.
+  static constexpr std::array<uint8_t, 32> kP = {
+      0xed, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
+  auto ge = [](const std::array<uint8_t, 32>& a,
+               const std::array<uint8_t, 32>& b) {
+    for (int i = 31; i >= 0; i--) {
+      if (a[i] != b[i]) return a[i] > b[i];
+    }
+    return true;
+  };
+  if (ge(y, kP)) {
+    int borrow = 0;
+    for (int i = 0; i < 32; i++) {
+      int d = int(y[i]) - int(kP[i]) - borrow;
+      borrow = d < 0;
+      y[i] = uint8_t(d & 0xff);
+    }
+  }
+  // The five 8-torsion y values: 0, 1, p-1, y8, p-y8 (eddsa.py:76-85).
+  static constexpr std::array<std::array<uint8_t, 32>, 5> kTorsionY = {{
+      {0},
+      {1},
+      {0xec, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+       0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+       0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+       0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f},
+      // 0x7A03AC9277FDC74EC6CC392CFA53202A0F67100D760B3CBA4FD84D3D706A17C7
+      {0xc7, 0x17, 0x6a, 0x70, 0x3d, 0x4d, 0xd8, 0x4f,
+       0xba, 0x3c, 0x0b, 0x76, 0x0d, 0x10, 0x67, 0x0f,
+       0x2a, 0x20, 0x53, 0xfa, 0x2c, 0x39, 0xcc, 0xc6,
+       0x4e, 0xc7, 0xfd, 0x77, 0x92, 0xac, 0x03, 0x7a},
+      // 0x05FC536D880238B13933C6D305ACDFD5F098EFF289F4C345B027B2C28F95E826
+      {0x26, 0xe8, 0x95, 0x8f, 0xc2, 0xb2, 0x27, 0xb0,
+       0x45, 0xc3, 0xf4, 0x89, 0xf2, 0xef, 0x98, 0xf0,
+       0xd5, 0xdf, 0xac, 0x05, 0xd3, 0xc6, 0x33, 0x39,
+       0xb1, 0x38, 0x02, 0x88, 0x6d, 0x53, 0xfc, 0x05},
+  }};
+  for (const auto& t : kTorsionY) {
+    if (y == t) return true;
+  }
+  return false;
+}
+
+}  // namespace
+
 bool Signature::verify(const Digest& digest, const PublicKey& pk) const {
   if (current_scheme() == Scheme::kBls) {
     return verify_batch(digest, {{pk, *this}});
   }
   if (data.size() != 64) return false;
+  // verify_strict parity with the device path (and dalek's verify_strict,
+  // crypto/src/lib.rs:204-208): reject small-order A and R before OpenSSL,
+  // which would otherwise accept them per plain RFC 8032.
+  if (is_small_order_encoding(pk.data.data()) ||
+      is_small_order_encoding(data.data())) {
+    return false;
+  }
   PkeyGuard key{EVP_PKEY_new_raw_public_key(kEvpPkeyEd25519, nullptr,
                                             pk.data.data(), 32)};
   if (!key.p) return false;
@@ -210,6 +284,55 @@ bool Signature::verify_batch_multi(
     if (!sig.verify(d, pk)) return false;
   }
   return true;
+}
+
+bool Signature::async_available() {
+  TpuVerifier* tpu = TpuVerifier::instance();
+  if (!tpu) return false;
+  // Bound the pipeline depth: past this, backpressure to the synchronous
+  // path beats queueing more work behind a busy engine.
+  if (tpu->inflight() >= 64) return false;
+  if (current_scheme() == Scheme::kBls && !BlsContext::instance()) {
+    return false;
+  }
+  // Both schemes require a live connection: for BLS a transport failure is
+  // a definitive reject, so dispatching async while the sidecar is down
+  // would turn an outage into spurious "invalid certificate" verdicts.
+  return tpu->connected();
+}
+
+void Signature::verify_batch_multi_async(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    AsyncCallback cb) {
+  TpuVerifier* tpu = TpuVerifier::instance();
+  if (!tpu) {
+    cb(std::nullopt);
+    return;
+  }
+  if (current_scheme() == Scheme::kBls) {
+    // No host pairing exists in C++: transport failure is a definitive
+    // reject (same policy as the synchronous path above), so map nullopt
+    // to false rather than asking the caller to retry.
+    tpu->bls_verify_multi_async(items, [cb = std::move(cb)](
+                                           std::optional<bool> ok) {
+      cb(ok.value_or(false));
+    });
+    return;
+  }
+  tpu->verify_batch_multi_async(
+      items, [cb = std::move(cb)](std::optional<std::vector<bool>> mask) {
+        if (!mask) {
+          cb(std::nullopt);  // transport failure: caller re-verifies sync
+          return;
+        }
+        for (bool ok : *mask) {
+          if (!ok) {
+            cb(false);
+            return;
+          }
+        }
+        cb(true);
+      });
 }
 
 KeyPair generate_keypair() {

@@ -8,8 +8,10 @@
 #pragma once
 
 #include <array>
+#include <functional>
 #include <map>
 #include <memory>
+#include <optional>
 #include <thread>
 #include <utility>
 #include <vector>
@@ -149,6 +151,23 @@ struct Signature {
   // TpuVerifier is installed.
   static bool verify_batch_multi(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
+
+  // True when a device verifier is installed, connected, and has spare
+  // in-flight budget — i.e. verify_batch_multi_async will actually
+  // pipeline to the device rather than fail over.
+  static bool async_available();
+
+  // Asynchronous batch verification: the callback fires exactly once from
+  // the sidecar reply path — with the overall verdict, or nullopt on
+  // transport failure (caller should then re-verify synchronously, which
+  // falls back to the host path).  This is what lets the consensus Core
+  // suspend a proposal on a pending device verify instead of eating the
+  // device round-trip on its own thread (SURVEY.md §7; the reference's
+  // QC::verify is synchronous, consensus/src/messages.rs:180-198).
+  using AsyncCallback = std::function<void(std::optional<bool>)>;
+  static void verify_batch_multi_async(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      AsyncCallback cb);
 };
 
 struct KeyPair {

@@ -1,5 +1,10 @@
 #include "crypto/sidecar_client.hpp"
 
+#include <poll.h>
+
+#include <thread>
+
+#include "common/channel.hpp"
 #include "common/log.hpp"
 #include "common/serde.hpp"
 #include "crypto/crypto.hpp"
@@ -8,7 +13,7 @@ namespace hotstuff {
 
 namespace {
 constexpr uint8_t kOpVerifyBatch = 1;
-constexpr uint8_t kOpBlsVerifyAgg = 3;
+constexpr uint8_t kOpBlsVerifyAgg = 3;  // NOLINT (wire constant, unused here)
 constexpr uint8_t kOpBlsSign = 4;
 constexpr uint8_t kOpBlsVerifyVotes = 5;
 constexpr uint8_t kOpBlsVerifyMulti = 6;
@@ -16,9 +21,32 @@ constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
 std::unique_ptr<TpuVerifier> g_instance;
+
+void write_header(Writer* w, uint8_t opcode, uint32_t rid, uint32_t count) {
+  w->u8(opcode);
+  w->u32(rid);
+  w->u32(count);
+  w->u8(32);  // msg_len lo (u16 LE): digests are 32 bytes
+  w->u8(0);   // msg_len hi
+}
 }  // namespace
 
-TpuVerifier::TpuVerifier(const Address& addr) : addr_(addr) {}
+TpuVerifier::TpuVerifier(const Address& addr)
+    : addr_(addr), inner_(std::make_shared<Inner>()) {}
+
+TpuVerifier::~TpuVerifier() {
+  std::vector<FrameCallback> cbs;
+  {
+    std::lock_guard<std::mutex> lk(inner_->m);
+    inner_->gen++;  // stale readers exit without touching the socket
+    for (auto& [rid, p] : inner_->pending) cbs.push_back(std::move(p.cb));
+    inner_->pending.clear();
+    // Wakes a reader blocked in poll/read; the Socket fd itself is closed
+    // by ~Inner once the last reader drops its shared_ptr.
+    inner_->sock.shutdown();
+  }
+  for (auto& cb : cbs) cb(std::nullopt);
+}
 
 TpuVerifier* TpuVerifier::instance() { return g_instance.get(); }
 
@@ -27,146 +55,229 @@ void TpuVerifier::install(std::unique_ptr<TpuVerifier> v) {
 }
 
 bool TpuVerifier::connected() {
-  std::lock_guard<std::mutex> lk(m_);
-  return ensure_connected_locked();
+  std::lock_guard<std::mutex> lk(inner_->m);
+  return ensure_connected_locked_();
 }
 
-bool TpuVerifier::ensure_connected_locked() {
-  if (sock_.valid()) return true;
-  if (std::chrono::steady_clock::now() < backoff_until_) return false;
+size_t TpuVerifier::inflight() const {
+  std::lock_guard<std::mutex> lk(inner_->m);
+  return inner_->pending.size();
+}
+
+bool TpuVerifier::ensure_connected_locked_() {
+  Inner& in = *inner_;
+  if (in.sock.valid()) return true;
+  if (std::chrono::steady_clock::now() < in.backoff_until) return false;
   auto s = Socket::connect(addr_, kConnectTimeoutMs);
   if (!s) {
-    backoff_until_ = std::chrono::steady_clock::now() +
-                     std::chrono::milliseconds(kBackoffMs);
-    if (!ever_connected_) return false;
+    in.backoff_until = std::chrono::steady_clock::now() +
+                       std::chrono::milliseconds(kBackoffMs);
+    if (!in.ever_connected) return false;
     LOG_WARN("crypto::sidecar") << "lost connection to verify sidecar "
                                 << addr_.str();
-    ever_connected_ = false;
+    in.ever_connected = false;
     return false;
   }
-  sock_ = std::move(*s);
-  sock_.set_recv_timeout(kRecvTimeoutMs);
-  if (!ever_connected_) {
+  in.sock = std::move(*s);
+  // Backstop only: the reader polls with its own timeout; this bounds a
+  // pathological partial frame.
+  in.sock.set_recv_timeout(kRecvTimeoutMs);
+  in.gen++;
+  in.last_rx = std::chrono::steady_clock::now();
+  if (!in.ever_connected) {
     LOG_INFO("crypto::sidecar") << "connected to verify sidecar "
                                 << addr_.str();
   }
-  ever_connected_ = true;
+  in.ever_connected = true;
+  std::thread(reader_loop_, inner_, in.gen, in.sock.fd()).detach();
   return true;
 }
 
-std::optional<std::vector<bool>> TpuVerifier::verify_batch_multi(
-    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
-  std::lock_guard<std::mutex> lk(m_);
-  if (!ensure_connected_locked()) return std::nullopt;
+// Fails every pending request and closes the socket. The reader of `gen`
+// is the only caller while its socket lives, so close here cannot race a
+// concurrent read; writers write under the same lock.
+void TpuVerifier::fail_all_(const std::shared_ptr<Inner>& inner,
+                            uint64_t gen, const char* why) {
+  std::vector<FrameCallback> cbs;
+  {
+    std::lock_guard<std::mutex> lk(inner->m);
+    if (inner->gen != gen) return;  // a newer connection took over
+    if (!inner->pending.empty()) {
+      LOG_WARN("crypto::sidecar")
+          << "failing " << inner->pending.size()
+          << " in-flight sidecar request(s): " << why;
+    }
+    for (auto& [rid, p] : inner->pending) cbs.push_back(std::move(p.cb));
+    inner->pending.clear();
+    inner->sock.close();
+    inner->backoff_until = std::chrono::steady_clock::now() +
+                           std::chrono::milliseconds(kBackoffMs);
+  }
+  for (auto& cb : cbs) cb(std::nullopt);
+}
 
-  // Request: u8 opcode | u32 rid | u32 count | u16 msg_len | records.
+void TpuVerifier::reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
+                               int fd) {
+  for (;;) {
+    {
+      std::lock_guard<std::mutex> lk(inner->m);
+      if (inner->gen != gen || !inner->sock.valid()) return;
+    }
+    pollfd p{fd, POLLIN, 0};
+    int rc = ::poll(&p, 1, 200);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      fail_all_(inner, gen, "poll error");
+      return;
+    }
+    // Deadline sweep EVERY iteration (not only on poll timeout): under
+    // continuous reply traffic an orphaned request — one the sidecar
+    // never answers — must still expire, or a sync wrapper blocked on it
+    // waits forever.  Expire overdue requests individually; if nothing at
+    // all has arrived for a full receive window while requests are
+    // overdue, the connection (or the engine behind it) is wedged.
+    auto now = std::chrono::steady_clock::now();
+    {
+      std::vector<FrameCallback> expired;
+      bool wedged = false;
+      {
+        std::lock_guard<std::mutex> lk(inner->m);
+        if (inner->gen != gen) return;
+        for (auto it = inner->pending.begin(); it != inner->pending.end();) {
+          if (now > it->second.deadline) {
+            expired.push_back(std::move(it->second.cb));
+            it = inner->pending.erase(it);
+          } else {
+            ++it;
+          }
+        }
+        wedged = !expired.empty() &&
+                 now - inner->last_rx >
+                     std::chrono::milliseconds(kRecvTimeoutMs);
+      }
+      for (auto& cb : expired) cb(std::nullopt);
+      if (wedged) {
+        fail_all_(inner, gen, "no replies within deadline");
+        return;
+      }
+    }
+    if (rc == 0) continue;
+    Bytes reply;
+    // Safe without the lock: this reader is the only thread reading, and
+    // only this reader closes the gen's socket (writers only shutdown()).
+    if (!inner->sock.read_frame(&reply)) {
+      fail_all_(inner, gen, "connection closed by sidecar");
+      return;
+    }
+    FrameCallback cb;
+    {
+      std::lock_guard<std::mutex> lk(inner->m);
+      if (inner->gen != gen) return;
+      inner->last_rx = now;
+      if (reply.size() >= 5) {
+        uint32_t rid = static_cast<uint32_t>(reply[1]) |
+                       static_cast<uint32_t>(reply[2]) << 8 |
+                       static_cast<uint32_t>(reply[3]) << 16 |
+                       static_cast<uint32_t>(reply[4]) << 24;
+        auto it = inner->pending.find(rid);
+        if (it != inner->pending.end()) {
+          cb = std::move(it->second.cb);
+          inner->pending.erase(it);
+        }
+      }
+    }
+    if (cb) {
+      cb(std::move(reply));
+    } else {
+      LOG_DEBUG("crypto::sidecar") << "dropping late/unknown sidecar reply";
+    }
+  }
+}
+
+void TpuVerifier::submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
+                          int deadline_ms, FrameCallback cb) {
+  bool fail = false;
+  {
+    std::lock_guard<std::mutex> lk(inner_->m);
+    if (!ensure_connected_locked_()) {
+      fail = true;
+    } else {
+      PendingReq req;
+      req.opcode = opcode;
+      req.deadline = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(deadline_ms);
+      req.cb = std::move(cb);
+      inner_->pending.emplace(rid, std::move(req));
+      if (!inner_->sock.write_frame(frame)) {
+        // The reader owns teardown: wake it and let fail_all_ invoke the
+        // callback we just registered (along with any other pendings).
+        inner_->sock.shutdown();
+      }
+    }
+  }
+  if (fail) cb(std::nullopt);
+}
+
+// -- Ed25519 ---------------------------------------------------------------
+
+void TpuVerifier::verify_batch_multi_async(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    MaskCallback cb) {
   Writer w;
-  uint32_t rid = next_id_++;
-  w.u8(kOpVerifyBatch);
-  w.u32(rid);
-  w.u32(static_cast<uint32_t>(items.size()));
-  w.u8(32);  // msg_len lo (u16 LE)
-  w.u8(0);   // msg_len hi
+  uint32_t rid;
+  {
+    std::lock_guard<std::mutex> lk(inner_->m);
+    rid = inner_->next_id++;
+  }
+  write_header(&w, kOpVerifyBatch, rid, static_cast<uint32_t>(items.size()));
   for (const auto& [digest, pk, sig] : items) {
-    if (sig.data.size() != 64) return std::nullopt;  // not an Ed25519 sig
+    if (sig.data.size() != 64) {  // not an Ed25519 sig
+      cb(std::nullopt);
+      return;
+    }
     w.fixed(digest.data);
     w.fixed(pk.data);
     w.out.insert(w.out.end(), sig.data.begin(), sig.data.end());
   }
-  if (!sock_.write_frame(w.out)) {
-    sock_.close();
-    backoff_until_ = std::chrono::steady_clock::now() +
-                     std::chrono::milliseconds(kBackoffMs);
-    return std::nullopt;
-  }
+  size_t n_items = items.size();
+  submit_(kOpVerifyBatch, w.out, rid, kRecvTimeoutMs,
+          [cb = std::move(cb), rid, n_items](std::optional<Bytes> reply) {
+            if (!reply) {
+              cb(std::nullopt);
+              return;
+            }
+            try {
+              Reader r(*reply);
+              uint8_t opcode = r.u8();
+              uint32_t got_rid = r.u32();
+              uint32_t n = r.u32();
+              if (opcode != kOpVerifyBatch || got_rid != rid ||
+                  n != n_items) {
+                LOG_WARN("crypto::sidecar") << "protocol mismatch from sidecar";
+                cb(std::nullopt);
+                return;
+              }
+              std::vector<bool> mask(n);
+              for (uint32_t i = 0; i < n; i++) mask[i] = r.u8() != 0;
+              cb(std::move(mask));
+            } catch (const SerdeError&) {
+              cb(std::nullopt);
+            }
+          });
+}
 
-  // Bounded wait (SO_RCVTIMEO set at connect): a wedged sidecar costs at
-  // most kRecvTimeoutMs once per backoff window, then the caller's host
-  // fallback takes over. Closing the socket also discards any late reply,
-  // so request/reply framing can never desynchronize.
-  Bytes reply;
-  if (!sock_.read_frame(&reply)) {
-    LOG_WARN("crypto::sidecar")
-        << "sidecar read failed/timed out; falling back to host verify";
-    sock_.close();
-    backoff_until_ = std::chrono::steady_clock::now() +
-                     std::chrono::milliseconds(kBackoffMs);
-    return std::nullopt;
-  }
-  try {
-    Reader r(reply);
-    uint8_t opcode = r.u8();
-    uint32_t got_rid = r.u32();
-    uint32_t n = r.u32();
-    if (opcode != kOpVerifyBatch || got_rid != rid || n != items.size()) {
-      LOG_WARN("crypto::sidecar") << "protocol mismatch from sidecar";
-      sock_.close();
-      return std::nullopt;
-    }
-    std::vector<bool> mask(n);
-    for (uint32_t i = 0; i < n; i++) mask[i] = r.u8() != 0;
-    return mask;
-  } catch (const SerdeError&) {
-    sock_.close();
-    return std::nullopt;
-  }
+std::optional<std::vector<bool>> TpuVerifier::verify_batch_multi(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
+  Oneshot<std::optional<std::vector<bool>>> done;
+  verify_batch_multi_async(
+      items, [done](std::optional<std::vector<bool>> mask) {
+        done.set(std::move(mask));
+      });
+  return done.wait();  // bounded: every submitted callback fires by deadline
 }
 
 // -- BLS operations ---------------------------------------------------------
 
-// One request/reply exchange under the (longer) BLS deadline; resets the
-// socket on any failure so framing can't desynchronize.
-std::optional<Bytes> TpuVerifier::bls_roundtrip_locked_(const Bytes& frame) {
-  if (!ensure_connected_locked()) return std::nullopt;
-  sock_.set_recv_timeout(kBlsRecvTimeoutMs);
-  bool ok = sock_.write_frame(frame);
-  Bytes reply;
-  if (ok) ok = sock_.read_frame(&reply);
-  sock_.set_recv_timeout(kRecvTimeoutMs);
-  if (!ok) {
-    LOG_WARN("crypto::sidecar") << "BLS sidecar exchange failed";
-    sock_.close();
-    backoff_until_ = std::chrono::steady_clock::now() +
-                     std::chrono::milliseconds(kBackoffMs);
-    return std::nullopt;
-  }
-  return reply;
-}
-
-std::optional<Bytes> TpuVerifier::bls_sign(const Digest& digest,
-                                           const Bytes& sk48) {
-  if (sk48.size() != kBlsSkLen) return std::nullopt;
-  std::lock_guard<std::mutex> lk(m_);
-  Writer w;
-  uint32_t rid = next_id_++;
-  w.u8(kOpBlsSign);
-  w.u32(rid);
-  w.u32(1);
-  w.u8(32);  // msg_len lo (u16 LE)
-  w.u8(0);
-  w.fixed(digest.data);
-  w.out.insert(w.out.end(), sk48.begin(), sk48.end());
-  auto reply = bls_roundtrip_locked_(w.out);
-  if (!reply) return std::nullopt;
-  try {
-    Reader r(*reply);
-    uint8_t opcode = r.u8();
-    uint32_t got_rid = r.u32();
-    uint32_t n = r.u32();
-    if (opcode != kOpBlsSign || got_rid != rid || n != kBlsSigLen) {
-      return std::nullopt;
-    }
-    Bytes sig(kBlsSigLen);
-    for (auto& b : sig) b = r.u8();
-    return sig;
-  } catch (const SerdeError&) {
-    sock_.close();
-    return std::nullopt;
-  }
-}
-
-// Append one committee vote record (pk looked up in BlsContext, then
-// signature) to `w`; false = unknown authority or malformed signature.
 bool TpuVerifier::append_bls_record_(BlsContext* bls, Writer* w,
                                      const PublicKey& pk,
                                      const Signature& sig) {
@@ -180,61 +291,145 @@ bool TpuVerifier::append_bls_record_(BlsContext* bls, Writer* w,
   return true;
 }
 
-// Exchange `w` under the BLS deadline and parse the single 0/1-byte reply.
-std::optional<bool> TpuVerifier::bls_bool_exchange_locked_(
-    const Writer& w, uint8_t opcode, uint32_t rid) {
-  auto reply = bls_roundtrip_locked_(w.out);
-  if (!reply) return std::nullopt;
+namespace {
+// Parses the single 0/1-byte reply of the BLS verify opcodes.
+void parse_bool_reply(uint8_t opcode, uint32_t rid,
+                      const TpuVerifier::BoolCallback& cb,
+                      std::optional<Bytes> reply) {
+  if (!reply) {
+    cb(std::nullopt);
+    return;
+  }
   try {
     Reader r(*reply);
     uint8_t got_op = r.u8();
     uint32_t got_rid = r.u32();
     uint32_t n = r.u32();
-    if (got_op != opcode || got_rid != rid || n != 1) return std::nullopt;
-    return r.u8() != 0;
+    if (got_op != opcode || got_rid != rid || n != 1) {
+      cb(std::nullopt);
+      return;
+    }
+    cb(r.u8() != 0);
   } catch (const SerdeError&) {
-    sock_.close();
-    return std::nullopt;
+    cb(std::nullopt);
   }
+}
+}  // namespace
+
+std::optional<Bytes> TpuVerifier::bls_sign(const Digest& digest,
+                                           const Bytes& sk48) {
+  if (sk48.size() != kBlsSkLen) return std::nullopt;
+  Writer w;
+  uint32_t rid;
+  {
+    std::lock_guard<std::mutex> lk(inner_->m);
+    rid = inner_->next_id++;
+  }
+  write_header(&w, kOpBlsSign, rid, 1);
+  w.fixed(digest.data);
+  w.out.insert(w.out.end(), sk48.begin(), sk48.end());
+  Oneshot<std::optional<Bytes>> done;
+  submit_(kOpBlsSign, w.out, rid, kBlsRecvTimeoutMs,
+          [done, rid](std::optional<Bytes> reply) {
+            if (!reply) {
+              done.set(std::nullopt);
+              return;
+            }
+            try {
+              Reader r(*reply);
+              uint8_t opcode = r.u8();
+              uint32_t got_rid = r.u32();
+              uint32_t n = r.u32();
+              if (opcode != kOpBlsSign || got_rid != rid || n != kBlsSigLen) {
+                done.set(std::nullopt);
+                return;
+              }
+              Bytes sig(kBlsSigLen);
+              for (auto& b : sig) b = r.u8();
+              done.set(std::move(sig));
+            } catch (const SerdeError&) {
+              done.set(std::nullopt);
+            }
+          });
+  return done.wait();
+}
+
+void TpuVerifier::bls_verify_votes_async(
+    const Digest& digest,
+    const std::vector<std::pair<PublicKey, Signature>>& votes,
+    BoolCallback cb) {
+  BlsContext* bls = BlsContext::instance();
+  if (!bls) {
+    cb(std::nullopt);
+    return;
+  }
+  Writer w;
+  uint32_t rid;
+  {
+    std::lock_guard<std::mutex> lk(inner_->m);
+    rid = inner_->next_id++;
+  }
+  write_header(&w, kOpBlsVerifyVotes, rid,
+               static_cast<uint32_t>(votes.size()));
+  w.fixed(digest.data);  // one shared digest for the whole QC
+  for (const auto& [pk, sig] : votes) {
+    if (!append_bls_record_(bls, &w, pk, sig)) {
+      cb(false);  // unknown authority / malformed sig: definitively invalid
+      return;
+    }
+  }
+  submit_(kOpBlsVerifyVotes, w.out, rid, kBlsRecvTimeoutMs,
+          [cb = std::move(cb), rid](std::optional<Bytes> reply) {
+            parse_bool_reply(kOpBlsVerifyVotes, rid, cb, std::move(reply));
+          });
 }
 
 std::optional<bool> TpuVerifier::bls_verify_votes(
     const Digest& digest,
     const std::vector<std::pair<PublicKey, Signature>>& votes) {
+  Oneshot<std::optional<bool>> done;
+  bls_verify_votes_async(digest, votes, [done](std::optional<bool> ok) {
+    done.set(std::move(ok));
+  });
+  return done.wait();
+}
+
+void TpuVerifier::bls_verify_multi_async(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    BoolCallback cb) {
   BlsContext* bls = BlsContext::instance();
-  if (!bls) return std::nullopt;
-  std::lock_guard<std::mutex> lk(m_);
-  Writer w;
-  uint32_t rid = next_id_++;
-  w.u8(kOpBlsVerifyVotes);
-  w.u32(rid);
-  w.u32(static_cast<uint32_t>(votes.size()));
-  w.u8(32);  // msg_len lo (u16 LE)
-  w.u8(0);
-  w.fixed(digest.data);  // one shared digest for the whole QC
-  for (const auto& [pk, sig] : votes) {
-    if (!append_bls_record_(bls, &w, pk, sig)) return false;
+  if (!bls) {
+    cb(std::nullopt);
+    return;
   }
-  return bls_bool_exchange_locked_(w, kOpBlsVerifyVotes, rid);
+  Writer w;
+  uint32_t rid;
+  {
+    std::lock_guard<std::mutex> lk(inner_->m);
+    rid = inner_->next_id++;
+  }
+  write_header(&w, kOpBlsVerifyMulti, rid,
+               static_cast<uint32_t>(items.size()));
+  for (const auto& [digest, pk, sig] : items) {
+    w.fixed(digest.data);  // one digest PER record (the TC shape)
+    if (!append_bls_record_(bls, &w, pk, sig)) {
+      cb(false);
+      return;
+    }
+  }
+  submit_(kOpBlsVerifyMulti, w.out, rid, kBlsRecvTimeoutMs,
+          [cb = std::move(cb), rid](std::optional<Bytes> reply) {
+            parse_bool_reply(kOpBlsVerifyMulti, rid, cb, std::move(reply));
+          });
 }
 
 std::optional<bool> TpuVerifier::bls_verify_multi(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
-  BlsContext* bls = BlsContext::instance();
-  if (!bls) return std::nullopt;
-  std::lock_guard<std::mutex> lk(m_);
-  Writer w;
-  uint32_t rid = next_id_++;
-  w.u8(kOpBlsVerifyMulti);
-  w.u32(rid);
-  w.u32(static_cast<uint32_t>(items.size()));
-  w.u8(32);  // msg_len lo (u16 LE)
-  w.u8(0);
-  for (const auto& [digest, pk, sig] : items) {
-    w.fixed(digest.data);  // one digest PER record (the TC shape)
-    if (!append_bls_record_(bls, &w, pk, sig)) return false;
-  }
-  return bls_bool_exchange_locked_(w, kOpBlsVerifyMulti, rid);
+  Oneshot<std::optional<bool>> done;
+  bls_verify_multi_async(items, [done](std::optional<bool> ok) {
+    done.set(std::move(ok));
+  });
+  return done.wait();
 }
 
 }  // namespace hotstuff

@@ -3,13 +3,24 @@
 // verification ships (digest, pk, sig) records to the JAX process over
 // localhost TCP and gets back a validity mask — replacing the in-process
 // dalek::verify_batch call of the reference (crypto/src/lib.rs:210-223).
+//
+// The client PIPELINES: requests carry an id the sidecar echoes back
+// (sidecar/protocol.py frame layout), so any number of verifications can be
+// in flight at once.  A dedicated reader thread matches replies to pending
+// callbacks; submitting never waits for earlier replies.  This is what lets
+// the consensus Core suspend a proposal on a pending device verify and keep
+// processing votes (the async analogue of the reference's synchronous
+// QC::verify at consensus/src/messages.rs:180-198).
 #pragma once
 
 #include <chrono>
+#include <cstdint>
+#include <functional>
 #include <memory>
 #include <mutex>
 #include <optional>
 #include <tuple>
+#include <unordered_map>
 #include <utility>
 #include <vector>
 
@@ -26,6 +37,7 @@ class Writer;
 class TpuVerifier {
  public:
   explicit TpuVerifier(const Address& addr);
+  ~TpuVerifier();
 
   // Process-wide instance used by Signature::verify_batch. Install once at
   // node startup (Node::new does when parameters carry a sidecar address).
@@ -33,6 +45,8 @@ class TpuVerifier {
   static void install(std::unique_ptr<TpuVerifier> v);
 
   bool connected();
+  // Number of requests currently awaiting a sidecar reply.
+  size_t inflight() const;
 
   // One coalesced launch, one digest PER record (QC votes share a digest;
   // TC votes sign distinct (round, high_qc_round) digests — the wire
@@ -41,30 +55,39 @@ class TpuVerifier {
   std::optional<std::vector<bool>> verify_batch_multi(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
 
+  // Asynchronous form: the callback is invoked EXACTLY once — with the
+  // validity mask on a reply, or nullopt on transport failure/timeout —
+  // from either this call (immediate failure) or the reader thread.  Keep
+  // callbacks tiny (a channel push): they run on the reply path.
+  using MaskCallback =
+      std::function<void(std::optional<std::vector<bool>>)>;
+  void verify_batch_multi_async(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      MaskCallback cb);
+
   // scheme=bls operations (pairing lives only in the sidecar; signing is
-  // its host G2 scalar mult). These use a longer receive deadline than
-  // Ed25519 batches — a pairing is milliseconds-to-seconds, not micro.
+  // its host G2 scalar mult). These use a longer deadline than Ed25519
+  // batches — a pairing is milliseconds-to-seconds, not micro.
+  using BoolCallback = std::function<void(std::optional<bool>)>;
   std::optional<Bytes> bls_sign(const Digest& digest, const Bytes& sk48);
   std::optional<bool> bls_verify_votes(
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes);
+  void bls_verify_votes_async(
+      const Digest& digest,
+      const std::vector<std::pair<PublicKey, Signature>>& votes,
+      BoolCallback cb);
   // Distinct digest per vote (the TC shape): ONE round-trip, verified
   // device-side as a single product of pairings.
   std::optional<bool> bls_verify_multi(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
-
- private:
-  bool append_bls_record_(BlsContext* bls, Writer* w, const PublicKey& pk,
-                          const Signature& sig);
-  std::optional<bool> bls_bool_exchange_locked_(const Writer& w,
-                                                uint8_t opcode,
-                                                uint32_t rid);
-
- public:
+  void bls_verify_multi_async(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      BoolCallback cb);
 
   // Deadlines (ms). Every sidecar interaction is bounded: a slow or wedged
-  // device process makes verify_batch return nullopt (host fallback), never
-  // stalls the consensus Core thread (SURVEY.md §7 latency discipline).
+  // device process fails the pending request (host fallback), never stalls
+  // a consensus thread indefinitely (SURVEY.md §7 latency discipline).
   static constexpr int kConnectTimeoutMs = 250;
   static constexpr int kRecvTimeoutMs = 1000;
   static constexpr int kBlsRecvTimeoutMs = 60'000;
@@ -73,15 +96,42 @@ class TpuVerifier {
   static constexpr int kBackoffMs = 2000;
 
  private:
-  bool ensure_connected_locked();
-  std::optional<Bytes> bls_roundtrip_locked_(const Bytes& frame);
+  // Reply callback: full reply frame bytes, or nullopt on failure.
+  using FrameCallback = std::function<void(std::optional<Bytes>)>;
+
+  struct PendingReq {
+    uint8_t opcode = 0;
+    std::chrono::steady_clock::time_point deadline;
+    FrameCallback cb;
+  };
+
+  // Connection state shared with (detached) reader threads, so a reader
+  // draining a dead socket can never touch a destroyed client.
+  struct Inner {
+    mutable std::mutex m;
+    Socket sock;
+    uint64_t gen = 0;  // bumped per socket lifetime; stale readers exit
+    std::unordered_map<uint32_t, PendingReq> pending;
+    uint32_t next_id = 0;
+    bool ever_connected = false;
+    std::chrono::steady_clock::time_point backoff_until{};
+    std::chrono::steady_clock::time_point last_rx{};
+  };
+
+  static void reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
+                           int fd);
+  static void fail_all_(const std::shared_ptr<Inner>& inner, uint64_t gen,
+                        const char* why);
+  bool ensure_connected_locked_();
+  // Registers cb and writes the frame; on any failure invokes cb(nullopt)
+  // before returning. Thread-safe; never blocks on the sidecar's reply.
+  void submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
+               int deadline_ms, FrameCallback cb);
+  bool append_bls_record_(BlsContext* bls, Writer* w, const PublicKey& pk,
+                          const Signature& sig);
 
   Address addr_;
-  std::mutex m_;
-  Socket sock_;
-  uint32_t next_id_ = 0;
-  bool ever_connected_ = false;
-  std::chrono::steady_clock::time_point backoff_until_{};
+  std::shared_ptr<Inner> inner_;
 };
 
 }  // namespace hotstuff

@@ -66,6 +66,8 @@ class VerifyEngine:
         # may launch on device — others verify on host so a surprise TC
         # size can never wedge this thread mid-traffic.
         self._bls_multi_warmed: set[int] = set()
+        # (msg, pk, sig) -> bool verdict; see _cache_verdict.
+        self._verdicts: dict = {}
         self._mesh = None
         if mesh_devices and mesh_devices > 1:
             from ..parallel.mesh import make_mesh
@@ -178,12 +180,35 @@ class VerifyEngine:
     def _submit(self, batch):
         """Dispatch one coalesced batch; returns fetch() -> concatenated
         mask.  The host path computes eagerly; the device paths dispatch
-        asynchronously so the next launch can overlap this one."""
+        asynchronously so the next launch can overlap this one.
+
+        Verdict cache: signature validity is a pure function of the
+        (msg, pk, sig) bytes, so records already verified are answered
+        from a bounded FIFO cache without touching the device.  On a
+        shared sidecar (the local testbed runs up to 100 replicas against
+        ONE sidecar process) every replica verifies the same QC — the
+        cache turns N identical quorum verifications per block into one
+        device launch plus N-1 lookups."""
         msgs, pks, sigs = [], [], []
         for p in batch:
             msgs += p.request.msgs
             pks += p.request.pks
             sigs += p.request.sigs
+        records = list(zip(msgs, pks, sigs))
+        cached = [self._verdicts.get(r) for r in records]
+        # Dedup WITHIN the batch too: the headline scenario is N replicas
+        # verifying the same QC concurrently, whose identical records land
+        # in ONE coalesced batch — before anything is cached.  Each unique
+        # missed record is dispatched once and fanned out to every index
+        # that carried it.
+        uniq: dict = {}
+        for i, c in enumerate(cached):
+            if c is None:
+                uniq.setdefault(records[i], []).append(i)
+        uniq_records = list(uniq.keys())
+        m_msgs = [r[0] for r in uniq_records]
+        m_pks = [r[1] for r in uniq_records]
+        m_sigs = [r[2] for r in uniq_records]
         # The host path verifies per sub-batch; the device paths (single
         # chip via eddsa.verify_batch_submit, mesh via
         # verify_batch_sharded — both chunk internally) run up to a whole
@@ -193,17 +218,37 @@ class VerifyEngine:
         # here so no request can force an unwarmed compile shape or an
         # unbounded device allocation.
         step = MAX_SUBBATCH if self._use_host else self._launch_cap
-        fetchers = [self._verify_submit(msgs[i:i + step], pks[i:i + step],
-                                        sigs[i:i + step])
-                    for i in range(0, len(msgs), step)]
+        fetchers = [self._verify_submit(m_msgs[i:i + step],
+                                        m_pks[i:i + step],
+                                        m_sigs[i:i + step])
+                    for i in range(0, len(m_msgs), step)]
 
         def fetch():
-            mask = []
+            fresh = []
             for f in fetchers:
-                mask.extend(f())
+                fresh.extend(f())
+            mask = list(cached)
+            for record, ok in zip(uniq_records, fresh):
+                ok = bool(ok)
+                self._cache_verdict(record, ok)
+                for i in uniq[record]:
+                    mask[i] = ok
             return mask
 
         return fetch
+
+    # Verdict-cache capacity: ~224 B/record key; 64k entries ~ 15 MB.
+    VERDICT_CACHE_CAP = 64 * 1024
+
+    def _cache_verdict(self, record, ok: bool):
+        # Bounded FIFO (dicts preserve insertion order); False verdicts
+        # are cached too — validity is deterministic in the record bytes,
+        # so a poisoned entry can only ever answer for the same forged
+        # bytes, and the cap bounds an attacker to evicting, not growing.
+        if record not in self._verdicts:
+            while len(self._verdicts) >= self.VERDICT_CACHE_CAP:
+                self._verdicts.pop(next(iter(self._verdicts)))
+        self._verdicts[record] = ok
 
     def _execute_bls(self, item):
         from ..offchain import bls12381 as bls

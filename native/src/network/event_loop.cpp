@@ -195,6 +195,17 @@ bool EventLoop::send(uint64_t conn_id, std::shared_ptr<const Bytes> payload,
 void EventLoop::close(uint64_t id) { destroy(id, /*run_closed_cb=*/false); }
 
 void EventLoop::destroy(uint64_t id, bool run_closed_cb) {
+  // A connection's on_frame callback may itself trigger destruction of
+  // its own connection (e.g. the handler's Ack reply hits a dead peer and
+  // flush takes the hard-error path).  Destroying NOW would free the
+  // std::function currently executing on this stack — a use-after-free
+  // on its captures (caught by ASan under mass-teardown load).  Defer to
+  // the callback's caller instead.
+  if (id == in_callback_id_) {
+    defer_destroy_ = true;
+    defer_run_closed_ |= run_closed_cb;
+    return;
+  }
   if (auto it = conns_.find(id); it != conns_.end()) {
     epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
     ::close(it->second.fd);
@@ -307,7 +318,18 @@ void EventLoop::handle_readable(uint64_t id, Conn* c) {
       if (c->in.size() - pos - 4 < len) break;
       Bytes frame(c->in.begin() + pos + 4, c->in.begin() + pos + 4 + len);
       pos += 4 + len;
+      // Guard the callback's own closure: any destroy(id) triggered from
+      // inside it (its Ack reply failing, a handler-initiated close) is
+      // deferred until the callback has returned.
+      in_callback_id_ = id;
+      defer_destroy_ = false;
+      defer_run_closed_ = false;
       c->on_frame(id, std::move(frame));
+      in_callback_id_ = 0;
+      if (defer_destroy_) {
+        destroy(id, defer_run_closed_);
+        return;
+      }
       // The callback may have closed this connection (handler returned
       // false); stop touching freed state if so.
       auto it = conns_.find(id);

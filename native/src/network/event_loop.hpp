@@ -125,6 +125,12 @@ class EventLoop {
 
   uint64_t next_id_ = 1;
   uint64_t next_timer_seq_ = 1;
+  // Id of the connection whose on_frame callback is currently executing
+  // (0 = none; ids start at 1): destroy() of that id is deferred until
+  // the callback returns (see destroy()).
+  uint64_t in_callback_id_ = 0;
+  bool defer_destroy_ = false;
+  bool defer_run_closed_ = false;
   std::unordered_map<uint64_t, Conn> conns_;
   std::unordered_map<uint64_t, Listener_> listeners_;
   std::unordered_map<uint64_t, Connecting> connecting_;

@@ -5,6 +5,7 @@
 #include <unistd.h>
 
 #include <cstdio>
+#include <list>
 #include <stdexcept>
 
 #include "common/log.hpp"
@@ -14,160 +15,349 @@ namespace hotstuff {
 namespace {
 
 // WAL record: u32 LE key len | key | u32 LE value len | value.
-// Returns the appended byte count.  `flush` pushes the record to the
-// kernel (process-crash durability; power-loss durability would need
-// fdatasync per record, which the consensus workload cannot afford —
-// matching the reference, whose RocksDB default WAL is also not fsync'd
-// per write).
-size_t wal_append(std::FILE* f, const Bytes& key, const Bytes& value,
-                  bool flush = true) {
+// Returns the appended byte count, or nullopt if any write failed
+// (ENOSPC/EIO): the offset index must never point at a record that is
+// not provably on disk.  `flush` pushes the record to the kernel
+// (process-crash durability; power-loss durability would need fdatasync
+// per record, which the consensus workload cannot afford — matching the
+// reference, whose RocksDB default WAL is also not fsync'd per write).
+// Flushing is also what makes spilled values pread-able: evicted reads
+// go through the page cache, never through this stream's user-space
+// buffer.
+std::optional<size_t> wal_append(std::FILE* f, const Bytes& key,
+                                 const Bytes& value, bool flush = true) {
+  bool ok = true;
   auto put_u32 = [&](uint32_t v) {
     uint8_t b[4] = {uint8_t(v), uint8_t(v >> 8), uint8_t(v >> 16),
                     uint8_t(v >> 24)};
-    std::fwrite(b, 1, 4, f);
+    ok &= std::fwrite(b, 1, 4, f) == 4;
   };
   put_u32(static_cast<uint32_t>(key.size()));
-  std::fwrite(key.data(), 1, key.size(), f);
+  ok &= std::fwrite(key.data(), 1, key.size(), f) == key.size();
   put_u32(static_cast<uint32_t>(value.size()));
-  std::fwrite(value.data(), 1, value.size(), f);
-  if (flush) std::fflush(f);
+  ok &= std::fwrite(value.data(), 1, value.size(), f) == value.size();
+  if (flush) ok &= std::fflush(f) == 0;
+  if (!ok) return std::nullopt;
   return 8 + key.size() + value.size();
 }
 
-// Rewrite the WAL as a snapshot of the live map: write wal.tmp, sync,
-// open the fresh append handle on the snapshot, atomically rename it over
-// the old file, sync the directory.  Every fallible step happens BEFORE
-// the rename (the append fd follows the inode through it), so failure can
-// only skip the compaction and keep the old handle — never strand the
-// store memory-only, which would let the consensus core's vote-watermark
-// persistence "succeed" against the in-memory map and double-vote after a
-// crash.
-struct CompactResult {
-  std::FILE* wal;
-  size_t snapshot_bytes = 0;
-  bool ok = false;
-};
+// All storage state, owned by the worker thread after open().
+//
+// Memory model (the RocksDB-role requirement, store/src/lib.rs:28): the
+// INDEX (key -> WAL offset of the value) is the only per-key state that
+// must stay in memory; VALUES live in an LRU cache bounded by
+// `resident_cap` and spill to the WAL — a read of an evicted value is one
+// pread.  A state larger than RAM therefore stays fully readable with
+// bounded RSS.
+class Backing {
+ public:
+  Backing(const std::string& path, int64_t compact_bytes,
+          int64_t resident_cap)
+      : compact_bytes_(compact_bytes),
+        resident_cap_(resident_cap > 0 ? size_t(resident_cap) : 0) {
+    if (path.empty()) return;  // purely in-memory (tests)
+    ::mkdir(path.c_str(), 0755);
+    dir_path_ = path;
+    wal_path_ = path + "/wal";
+    replay_();
+    wal_ = std::fopen(wal_path_.c_str(), "ab");
+    if (!wal_) throw std::runtime_error("cannot open WAL at " + wal_path_);
+    read_fd_ = ::open(wal_path_.c_str(), O_RDONLY);
+    if (read_fd_ < 0) {
+      std::fclose(wal_);
+      throw std::runtime_error("cannot open WAL for reads at " + wal_path_);
+    }
+  }
 
-CompactResult wal_compact(
-    std::FILE* old_wal, const std::string& wal_path,
-    const std::string& dir_path,
-    const std::unordered_map<Bytes, Bytes, BytesHash>& map) {
-  const std::string tmp = wal_path + ".tmp";
-  std::FILE* f = std::fopen(tmp.c_str(), "wb");
-  if (!f) {
-    LOG_WARN("store") << "compaction skipped: cannot open " << tmp;
-    return {old_wal};
+  ~Backing() {
+    if (wal_) std::fclose(wal_);
+    if (read_fd_ >= 0) ::close(read_fd_);
   }
-  size_t bytes = 0;
-  for (const auto& [k, v] : map)
-    bytes += wal_append(f, k, v, /*flush=*/false);
-  std::fflush(f);
-  ::fsync(::fileno(f));  // snapshot on disk before it replaces the WAL
-  std::fclose(f);
-  std::FILE* fresh = std::fopen(tmp.c_str(), "ab");
-  if (!fresh) {
-    LOG_WARN("store") << "compaction skipped: cannot reopen snapshot";
-    std::remove(tmp.c_str());
-    return {old_wal};
-  }
-  if (std::rename(tmp.c_str(), wal_path.c_str()) != 0) {
-    LOG_WARN("store") << "compaction skipped: rename failed";
-    std::fclose(fresh);
-    std::remove(tmp.c_str());
-    return {old_wal};
-  }
-  int dfd = ::open(dir_path.c_str(), O_RDONLY | O_DIRECTORY);
-  if (dfd >= 0) {
-    ::fsync(dfd);  // persist the rename itself
-    ::close(dfd);
-  }
-  std::fclose(old_wal);
-  LOG_INFO("store") << "WAL compacted to " << bytes << " bytes";
-  return {fresh, bytes, true};
-}
 
-void wal_replay(const std::string& path,
-                std::unordered_map<Bytes, Bytes, BytesHash>* map) {
-  std::FILE* f = std::fopen(path.c_str(), "rb");
-  if (!f) return;
-  auto get_u32 = [&](uint32_t* v) {
-    uint8_t b[4];
-    if (std::fread(b, 1, 4, f) != 4) return false;
-    *v = uint32_t(b[0]) | (uint32_t(b[1]) << 8) | (uint32_t(b[2]) << 16) |
-         (uint32_t(b[3]) << 24);
-    return true;
+  Backing(const Backing&) = delete;
+  Backing& operator=(const Backing&) = delete;
+
+  bool disk_backed() const { return wal_ != nullptr; }
+
+  void put(const Bytes& key, const Bytes& value) {
+    if (disk_backed() && !wal_failed_) {
+      uint64_t value_off = appended_ + 8 + key.size();
+      auto appended = wal_append(wal_, key, value);
+      if (!appended) {
+        // Disk full / IO error: a partial record may be on disk, so any
+        // further append would land at an unknowable offset.  Degrade to
+        // memory-only — eviction and compaction stop, reads stay correct
+        // (pre-failure offsets are still valid; post-failure values pin
+        // in the resident cache) — and say so LOUDLY: durability of new
+        // writes is gone until restart.
+        LOG_ERROR("store")
+            << "WAL append failed (disk full?); degrading to memory-only "
+               "writes — new records are NOT crash-durable";
+        wal_failed_ = true;
+      } else {
+        appended_ += *appended;
+        auto it = index_.find(key);
+        if (it != index_.end()) {
+          live_ -= 8 + key.size() + it->second.len;
+          it->second = {value_off, uint32_t(value.size())};
+        } else {
+          index_.emplace(key,
+                         IndexEntry{value_off, uint32_t(value.size())});
+        }
+        live_ += 8 + key.size() + value.size();
+      }
+    }
+    cache_put_(key, value);
+    maybe_compact_();
+  }
+
+  std::optional<Bytes> get(const Bytes& key) {
+    auto it = resident_.find(key);
+    if (it != resident_.end()) {
+      lru_.splice(lru_.begin(), lru_, it->second.pos);  // touch
+      return it->second.value;
+    }
+    if (!disk_backed()) return std::nullopt;
+    auto iit = index_.find(key);
+    if (iit == index_.end()) return std::nullopt;
+    Bytes value(iit->second.len);
+    if (!pread_all_(read_fd_, value.data(), value.size(), iit->second.off)) {
+      LOG_ERROR("store") << "WAL pread failed for spilled value";
+      return std::nullopt;
+    }
+    cache_put_(key, value);  // hot again: re-admit
+    return value;
+  }
+
+  Store::Stats stats() const {
+    Store::Stats s;
+    s.keys = disk_backed() ? index_.size() : resident_.size();
+    s.resident_bytes = resident_bytes_;
+    s.wal_bytes = appended_;
+    return s;
+  }
+
+ private:
+  struct IndexEntry {
+    uint64_t off;  // byte offset of the VALUE within the WAL
+    uint32_t len;
   };
-  while (true) {
-    uint32_t klen, vlen;
-    if (!get_u32(&klen)) break;
-    Bytes key(klen);
-    if (std::fread(key.data(), 1, klen, f) != klen) break;
-    if (!get_u32(&vlen)) break;
-    Bytes value(vlen);
-    if (std::fread(value.data(), 1, vlen, f) != vlen) break;
-    (*map)[std::move(key)] = std::move(value);
+  struct Resident {
+    Bytes value;
+    std::list<Bytes>::iterator pos;  // position in lru_
+  };
+
+  static bool pread_all_(int fd, uint8_t* buf, size_t len, uint64_t off) {
+    size_t done = 0;
+    while (done < len) {
+      ssize_t n = ::pread(fd, buf + done, len - done, off + done);
+      if (n <= 0) return false;
+      done += size_t(n);
+    }
+    return true;
   }
-  std::fclose(f);
-}
+
+  void cache_put_(const Bytes& key, const Bytes& value) {
+    auto it = resident_.find(key);
+    if (it != resident_.end()) {
+      resident_bytes_ -= it->second.value.size();
+      resident_bytes_ += value.size();
+      it->second.value = value;
+      lru_.splice(lru_.begin(), lru_, it->second.pos);
+    } else {
+      lru_.push_front(key);
+      resident_.emplace(key, Resident{value, lru_.begin()});
+      resident_bytes_ += value.size();
+    }
+    // Evict only when the WAL holds the bytes; the in-memory store keeps
+    // everything (dropping would lose data), and a failed WAL pins every
+    // post-failure value (its index offset may be stale or absent).
+    if (disk_backed() && !wal_failed_ && resident_cap_ > 0) {
+      while (resident_bytes_ > resident_cap_ && resident_.size() > 1) {
+        const Bytes& victim = lru_.back();
+        auto vit = resident_.find(victim);
+        resident_bytes_ -= vit->second.value.size();
+        resident_.erase(vit);
+        lru_.pop_back();
+      }
+    }
+  }
+
+  // Sequential replay building the offset index (and warming the resident
+  // cache, newest wins).  Truncates a torn tail — a crash mid-append —
+  // back to the last complete record, so post-restart appends extend a
+  // clean log instead of burying themselves behind garbage.
+  void replay_() {
+    std::FILE* f = std::fopen(wal_path_.c_str(), "rb");
+    if (!f) return;
+    auto get_u32 = [&](uint32_t* v) {
+      uint8_t b[4];
+      if (std::fread(b, 1, 4, f) != 4) return false;
+      *v = uint32_t(b[0]) | (uint32_t(b[1]) << 8) | (uint32_t(b[2]) << 16) |
+           (uint32_t(b[3]) << 24);
+      return true;
+    };
+    uint64_t cursor = 0;
+    while (true) {
+      uint32_t klen, vlen;
+      if (!get_u32(&klen)) break;
+      Bytes key(klen);
+      if (std::fread(key.data(), 1, klen, f) != klen) break;
+      if (!get_u32(&vlen)) break;
+      Bytes value(vlen);
+      if (std::fread(value.data(), 1, vlen, f) != vlen) break;
+      uint64_t value_off = cursor + 8 + klen;
+      cursor += 8 + klen + vlen;
+      auto it = index_.find(key);
+      if (it != index_.end()) {
+        live_ -= 8 + key.size() + it->second.len;
+        it->second = {value_off, vlen};
+      } else {
+        index_.emplace(std::move(key), IndexEntry{value_off, vlen});
+      }
+      live_ += 8 + klen + vlen;
+    }
+    long end = std::ftell(f);
+    std::fclose(f);
+    if (end > 0 && uint64_t(end) != cursor) {
+      LOG_WARN("store") << "truncating torn WAL tail ("
+                        << (uint64_t(end) - cursor) << " bytes)";
+      if (::truncate(wal_path_.c_str(), off_t(cursor)) != 0) {
+        // Appending after un-removed garbage would shift every future
+        // offset by the tail length — an unusable-but-undetected store.
+        // Refuse to open instead.
+        throw std::runtime_error("cannot truncate torn WAL tail at " +
+                                 wal_path_);
+      }
+    }
+    appended_ = cursor;
+    // Warm the cache with the most recent values (bounded): replaying
+    // values again via get() is fine, so just leave the cache cold —
+    // consensus touches recent keys, which re-admit on first read.
+  }
+
+  // Rewrite the WAL as a snapshot of live state: write wal.tmp (values
+  // from the resident cache or pread from the old WAL), sync, open the
+  // fresh append handle, atomically rename, sync the directory, reopen
+  // the read fd, swap the index.  Every fallible step happens BEFORE the
+  // rename, so failure can only skip the compaction and keep the old
+  // handle — never strand the store memory-only, which would let the
+  // consensus core's vote-watermark persistence "succeed" against the
+  // cache and double-vote after a crash.
+  void maybe_compact_() {
+    if (!disk_backed() || wal_failed_ || compact_bytes_ <= 0) return;
+    if (appended_ <= size_t(compact_bytes_) || appended_ <= 4 * live_) {
+      return;
+    }
+    const std::string tmp = wal_path_ + ".tmp";
+    std::FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) {
+      LOG_WARN("store") << "compaction skipped: cannot open " << tmp;
+      return;
+    }
+    std::unordered_map<Bytes, IndexEntry, BytesHash> new_index;
+    new_index.reserve(index_.size());
+    size_t bytes = 0;
+    for (const auto& [key, entry] : index_) {
+      const Bytes* value;
+      Bytes spilled;
+      auto rit = resident_.find(key);
+      if (rit != resident_.end()) {
+        value = &rit->second.value;
+      } else {
+        spilled.resize(entry.len);
+        if (!pread_all_(read_fd_, spilled.data(), spilled.size(),
+                        entry.off)) {
+          LOG_WARN("store") << "compaction skipped: spilled value unreadable";
+          std::fclose(f);
+          std::remove(tmp.c_str());
+          return;
+        }
+        value = &spilled;
+      }
+      new_index.emplace(key, IndexEntry{bytes + 8 + key.size(),
+                                        uint32_t(value->size())});
+      auto appended = wal_append(f, key, *value, /*flush=*/false);
+      if (!appended) {
+        LOG_WARN("store") << "compaction skipped: snapshot write failed";
+        std::fclose(f);
+        std::remove(tmp.c_str());
+        return;
+      }
+      bytes += *appended;
+    }
+    std::fflush(f);
+    ::fsync(::fileno(f));  // snapshot on disk before it replaces the WAL
+    std::fclose(f);
+    std::FILE* fresh = std::fopen(tmp.c_str(), "ab");
+    if (!fresh) {
+      LOG_WARN("store") << "compaction skipped: cannot reopen snapshot";
+      std::remove(tmp.c_str());
+      return;
+    }
+    int fresh_read = ::open(tmp.c_str(), O_RDONLY);
+    if (fresh_read < 0) {
+      LOG_WARN("store") << "compaction skipped: cannot reopen for reads";
+      std::fclose(fresh);
+      std::remove(tmp.c_str());
+      return;
+    }
+    if (std::rename(tmp.c_str(), wal_path_.c_str()) != 0) {
+      LOG_WARN("store") << "compaction skipped: rename failed";
+      std::fclose(fresh);
+      ::close(fresh_read);
+      std::remove(tmp.c_str());
+      return;
+    }
+    int dfd = ::open(dir_path_.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+      ::fsync(dfd);  // persist the rename itself
+      ::close(dfd);
+    }
+    std::fclose(wal_);
+    ::close(read_fd_);
+    wal_ = fresh;
+    read_fd_ = fresh_read;  // fd follows the inode through the rename
+    index_ = std::move(new_index);
+    appended_ = bytes;
+    live_ = bytes;
+    LOG_INFO("store") << "WAL compacted to " << bytes << " bytes";
+  }
+
+  std::string wal_path_, dir_path_;
+  std::FILE* wal_ = nullptr;
+  int read_fd_ = -1;
+  int64_t compact_bytes_;
+  size_t resident_cap_;
+  size_t appended_ = 0;  // WAL file size
+  size_t live_ = 0;      // bytes of live (latest-version) records
+  bool wal_failed_ = false;  // see put(): degrade-to-memory-only latch
+  size_t resident_bytes_ = 0;
+  std::unordered_map<Bytes, IndexEntry, BytesHash> index_;
+  std::unordered_map<Bytes, Resident, BytesHash> resident_;
+  std::list<Bytes> lru_;  // front = most recently used
+};
 
 }  // namespace
 
-Store Store::open(const std::string& path, int64_t compact_bytes) {
+Store Store::open(const std::string& path, int64_t compact_bytes,
+                  int64_t resident_bytes) {
   auto ch = make_channel<Command>();
-
-  std::FILE* wal = nullptr;
-  std::string wal_path;
-  auto map = std::make_shared<std::unordered_map<Bytes, Bytes, BytesHash>>();
-  if (!path.empty()) {
-    ::mkdir(path.c_str(), 0755);
-    wal_path = path + "/wal";
-    wal_replay(wal_path, map.get());
-    wal = std::fopen(wal_path.c_str(), "ab");
-    if (!wal) throw std::runtime_error("cannot open WAL at " + wal_path);
-  }
+  auto backing =
+      std::make_shared<Backing>(path, compact_bytes, resident_bytes);
 
   Store s;
   s.ch_ = ch;
   s.worker_ = std::shared_ptr<std::thread>(
-      new std::thread([ch, map, wal, wal_path, path_dir = path,
-                       compact_bytes]() mutable {
+      new std::thread([ch, backing] {
         // Obligations: key -> oneshots fulfilled by a future write
         // (store/src/lib.rs:36-57 semantics).
         std::unordered_map<Bytes, std::vector<Oneshot<Bytes>>, BytesHash>
             obligations;
-        // Compaction accounting: bytes appended since the last rewrite,
-        // and the approximate live (retained) byte footprint.
-        size_t appended = 0, live = 0;
-        for (const auto& [k, v] : *map) live += 8 + k.size() + v.size();
-        if (wal) {
-          // "ab" streams report position 0 until the first write; seek to
-          // find the real replayed-file size (dead bytes included).
-          std::fseek(wal, 0, SEEK_END);
-          long pos = std::ftell(wal);
-          appended = pos > 0 ? size_t(pos) : live;
-        }
         while (auto cmd = ch->recv()) {
           switch (cmd->kind) {
             case Command::Kind::kWrite: {
-              if (wal) {
-                appended += wal_append(wal, cmd->key, cmd->value);
-                auto it0 = map->find(cmd->key);
-                if (it0 != map->end())
-                  live -= 8 + it0->first.size() + it0->second.size();
-                live += 8 + cmd->key.size() + cmd->value.size();
-              }
-              // Map update BEFORE any compaction: the snapshot must
-              // include the record just appended, or the rename drops it.
-              (*map)[cmd->key] = cmd->value;
-              if (wal && compact_bytes > 0 &&
-                  appended > size_t(compact_bytes) && appended > 4 * live) {
-                auto res = wal_compact(wal, wal_path, path_dir, *map);
-                wal = res.wal;
-                if (res.ok) {  // failure keeps counters; retry later
-                  appended = res.snapshot_bytes;
-                  live = res.snapshot_bytes;
-                }
-              }
+              backing->put(cmd->key, cmd->value);
               auto it = obligations.find(cmd->key);
               if (it != obligations.end()) {
                 for (auto& waiter : it->second) waiter.set(cmd->value);
@@ -176,24 +366,24 @@ Store Store::open(const std::string& path, int64_t compact_bytes) {
               break;
             }
             case Command::Kind::kRead: {
-              auto it = map->find(cmd->key);
-              cmd->read_reply.set(it == map->end()
-                                      ? std::nullopt
-                                      : std::optional<Bytes>(it->second));
+              cmd->read_reply.set(backing->get(cmd->key));
               break;
             }
             case Command::Kind::kNotifyRead: {
-              auto it = map->find(cmd->key);
-              if (it != map->end()) {
-                cmd->notify_reply.set(it->second);
+              auto value = backing->get(cmd->key);
+              if (value) {
+                cmd->notify_reply.set(std::move(*value));
               } else {
                 obligations[cmd->key].push_back(cmd->notify_reply);
               }
               break;
             }
+            case Command::Kind::kStats: {
+              cmd->stats_reply.set(backing->stats());
+              break;
+            }
           }
         }
-        if (wal) std::fclose(wal);
       }),
       [ch](std::thread* t) {
         ch->close();
@@ -227,6 +417,14 @@ Oneshot<Bytes> Store::notify_read(const Bytes& key) {
   auto reply = cmd.notify_reply;
   ch_->send(std::move(cmd));
   return reply;
+}
+
+Store::Stats Store::stats() {
+  Command cmd;
+  cmd.kind = Command::Kind::kStats;
+  auto reply = cmd.stats_reply;
+  if (!ch_->send(std::move(cmd))) return {};
+  return reply.wait();
 }
 
 }  // namespace hotstuff

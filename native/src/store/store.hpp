@@ -2,9 +2,12 @@
 // a channel — the same shape as the reference's Store task wrapping RocksDB
 // (store/src/lib.rs:15-93), including the notify_read obligation contract
 // (register a waiter for a key; fulfilled by a later write).  Backing medium
-// is an in-memory map with an append-only write-ahead log replayed on open
-// (this image has no RocksDB; durability semantics — every batch/block
-// persisted before use — are preserved).
+// is an append-only write-ahead log with an in-memory OFFSET INDEX and an
+// LRU-bounded resident value cache: state larger than RAM stays readable
+// (values spill to the WAL and are pread back on demand), preserving the
+// RocksDB role the reference relies on (this image has no RocksDB;
+// durability semantics — every batch/block persisted before use — are
+// preserved).
 #pragma once
 
 #include <memory>
@@ -21,12 +24,24 @@ namespace hotstuff {
 
 class Store {
  public:
+  // Resident-cache and compaction telemetry (testing/observability).
+  struct Stats {
+    size_t keys = 0;            // total keys (index size)
+    size_t resident_bytes = 0;  // bytes of values held in memory
+    size_t wal_bytes = 0;       // current WAL file size
+  };
+
   // Opens (creating if needed) the store at `path` (a directory; the WAL
   // lives at path + "/wal"). Empty path = purely in-memory (tests).
   // The WAL compacts once appended bytes exceed `compact_bytes` AND 4x the
   // live map size (compact_bytes <= 0 disables compaction).
+  // `resident_bytes` caps the in-memory value cache when disk-backed:
+  // least-recently-used values are dropped from memory (NOT from disk)
+  // past the cap, so a long benchmark's RSS stays bounded while every
+  // key remains readable.  <= 0 disables the cap.
   static Store open(const std::string& path,
-                    int64_t compact_bytes = 64 * 1024 * 1024);
+                    int64_t compact_bytes = 64 * 1024 * 1024,
+                    int64_t resident_bytes = 128 * 1024 * 1024);
 
   Store() = default;  // null handle; open() returns the real one
 
@@ -37,15 +52,18 @@ class Store {
   // (immediately if it already does).
   Oneshot<Bytes> notify_read(const Bytes& key);
 
+  Stats stats();
+
   bool valid() const { return static_cast<bool>(ch_); }
 
  private:
   struct Command {
-    enum class Kind { kWrite, kRead, kNotifyRead } kind;
+    enum class Kind { kWrite, kRead, kNotifyRead, kStats } kind;
     Bytes key;
     Bytes value;                          // write
     Oneshot<std::optional<Bytes>> read_reply;  // read
     Oneshot<Bytes> notify_reply;          // notify_read
+    Oneshot<Stats> stats_reply;           // stats
   };
 
   ChannelPtr<Command> ch_;

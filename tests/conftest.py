@@ -20,6 +20,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache (the same dir the sidecar and bench
+# use): the suite's wall-clock is dominated by lax.scan ladder compiles
+# that are identical run to run — cache them across sessions.  The
+# min-compile-time floor keeps trivial programs out of the cache dir.
+from hotstuff_tpu.utils.xla_cache import configure_xla_cache  # noqa: E402
+
+configure_xla_cache()
+try:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # older jax: default threshold applies
+    pass
+
 
 # ---------------------------------------------------------------------------
 # Shared integration-test scaffolding (node/client/sidecar process testbed).

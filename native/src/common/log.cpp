@@ -1,5 +1,7 @@
 #include "common/log.hpp"
 
+#include <pthread.h>
+
 #include <atomic>
 #include <chrono>
 #include <cstdio>
@@ -44,6 +46,10 @@ void log_write(LogLevel level, const std::string& module,
   std::fprintf(stderr, "[%s %s %s] %s\n", ts, level_name(level),
                module.c_str(), message.c_str());
   std::fflush(stderr);
+}
+
+void set_thread_name(const char* name) {
+  pthread_setname_np(pthread_self(), name);
 }
 
 }  // namespace hotstuff

@@ -21,6 +21,10 @@ LogLevel log_level();
 void log_write(LogLevel level, const std::string& module,
                const std::string& message);
 
+// Label the calling thread (<= 15 chars) so per-subsystem CPU can be
+// attributed from /proc/<pid>/task/*/stat at benchmark scale.
+void set_thread_name(const char* name);
+
 struct LogLine {
   LogLevel level;
   std::string module;

@@ -678,6 +678,7 @@ std::thread Core::spawn(PublicKey name, Committee committee,
                         ChannelPtr<ProposerMessage> tx_proposer,
                         ChannelPtr<Block> tx_commit) {
   return std::thread([=] {
+    set_thread_name("core");
     CoreImpl core(name, std::move(committee), std::move(signature_service),
                   std::move(store), std::move(leader_elector),
                   std::move(mempool_driver), std::move(synchronizer),

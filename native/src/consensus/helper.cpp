@@ -12,6 +12,7 @@ std::thread Helper::spawn(Committee committee, Store store,
                    ChannelPtr<std::pair<Digest, PublicKey>> rx_request) {
   return std::thread([committee = std::move(committee), store,
                rx_request]() mutable {
+    set_thread_name("cons-helper");
     SimpleSender network;
     while (auto req = rx_request->recv()) {
       const auto& [digest, origin] = *req;

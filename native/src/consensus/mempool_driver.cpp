@@ -21,6 +21,7 @@ MempoolDriver::MempoolDriver(
       tx_payload_waiter_(make_channel<WaiterMessage>(SIZE_MAX)) {
   auto rx = tx_payload_waiter_;
   thread_ = std::thread([store, rx, tx_loopback]() mutable {
+    set_thread_name("payload-wait");
     struct Pending {
       Round round;
       Block block;

@@ -34,6 +34,7 @@ Synchronizer::Synchronizer(PublicKey name, Committee committee, Store store,
   auto inner = inner_;
   thread_ = std::thread([name, committee = std::move(committee), store,
                          tx_loopback, sync_retry_delay, inner]() mutable {
+    set_thread_name("cons-sync");
     SimpleSender network;
     std::set<Digest> pending;              // block digests being resolved
     std::map<Digest, uint64_t> requests;   // parent digest -> request ts

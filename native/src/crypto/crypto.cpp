@@ -364,6 +364,7 @@ SignatureService::SignatureService(const SecretKey& sk)
   SecretKey key = sk;
   worker_ = std::shared_ptr<std::thread>(
       new std::thread([ch, key] {
+        set_thread_name("sig-service");
         while (auto req = ch->recv()) {
           req->reply.set(Signature::sign(req->digest, key));
         }

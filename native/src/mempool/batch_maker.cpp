@@ -63,6 +63,7 @@ std::thread BatchMaker::spawn(
   return std::thread([batch_size, max_batch_delay, rx_transaction, tx_message,
                peers = std::move(mempool_addresses),
                stop = std::move(stop)] {
+    set_thread_name("batch-maker");
     ReliableSender network(stop);
     Batch current;
     size_t current_size = 0;

@@ -12,6 +12,7 @@ std::thread Helper::spawn(
     Committee committee, Store store,
     ChannelPtr<std::pair<std::vector<Digest>, PublicKey>> rx_request) {
   return std::thread([committee = std::move(committee), store, rx_request]() mutable {
+    set_thread_name("mp-helper");
     SimpleSender network;
     while (auto req = rx_request->recv()) {
       const auto& [digests, origin] = *req;

@@ -1,5 +1,7 @@
 #include "mempool/processor.hpp"
 
+#include "common/log.hpp"
+
 #include <thread>
 
 namespace hotstuff {
@@ -8,6 +10,7 @@ namespace mempool {
 std::thread Processor::spawn(Store store, ChannelPtr<Bytes> rx_batch,
                       ChannelPtr<Digest> tx_digest) {
   return std::thread([store, rx_batch, tx_digest]() mutable {
+    set_thread_name("mp-processor");
     while (auto batch = rx_batch->recv()) {
       Digest digest = sha512_digest(*batch);
       store.write(digest.to_bytes(), *batch);

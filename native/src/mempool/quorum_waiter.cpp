@@ -1,5 +1,7 @@
 #include "mempool/quorum_waiter.hpp"
 
+#include "common/log.hpp"
+
 #include <chrono>
 #include <condition_variable>
 #include <mutex>
@@ -14,6 +16,7 @@ std::thread QuorumWaiter::spawn(Committee committee, Stake my_stake,
                                 std::shared_ptr<std::atomic<bool>> stop) {
   return std::thread([committee = std::move(committee), my_stake, rx_message,
                       tx_batch, stop] {
+    set_thread_name("quorum-wait");
     while (auto msg = rx_message->recv()) {
       // Stake accumulates as ACKs arrive in any order (the reference's
       // FuturesUnordered wait, quorum_waiter.rs:60-86): each handler's

@@ -26,6 +26,7 @@ std::thread Synchronizer::spawn(PublicKey name, Committee committee, Store store
                          ChannelPtr<ConsensusMempoolMessage> rx_message) {
   return std::thread([name, committee = std::move(committee), store, gc_depth,
                sync_retry_delay, sync_retry_nodes, rx_message]() mutable {
+    set_thread_name("mp-sync");
     SimpleSender network;
     // Internal completion channel: notify_read callbacks push the digest
     // that arrived (replacing the reference's FuturesUnordered stream).

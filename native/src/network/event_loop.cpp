@@ -47,7 +47,7 @@ EventLoop::EventLoop() {
   ev.events = EPOLLIN;
   ev.data.u64 = 0;  // reserved id for the wakeup eventfd
   epoll_ctl(epfd_, EPOLL_CTL_ADD, wakeup_fd_, &ev);
-  thread_ = std::thread([this] { run(); });
+  thread_ = std::thread([this] { set_thread_name("reactor"); run(); });
 }
 
 EventLoop::~EventLoop() {

@@ -12,7 +12,12 @@ namespace hotstuff {
 
 namespace {
 constexpr auto kInitialBackoff = std::chrono::milliseconds(200);
-constexpr auto kMaxBackoff = std::chrono::milliseconds(60'000);
+// Reconnect probes are one SYN each: capping the backoff at 5 s (not the
+// reference's effectively-unbounded doubling) costs a dead peer ~0.2
+// connect attempts/s, and recovers a 100-node single-host boot storm —
+// with a 60 s cap, a sender that failed a handful of early connects
+// sleeps through entire view-change cycles after its peer is up.
+constexpr auto kMaxBackoff = std::chrono::milliseconds(5'000);
 constexpr int kConnectTimeoutMs = 5000;
 // Cap on un-ACKed + queued messages per peer (the thread-based design's
 // bounded channel): beyond it new sends cancel immediately (empty ACK) —

@@ -18,6 +18,15 @@ constexpr int kConnectTimeoutMs = 5000;
 // thread-based design: beyond it messages drop (best-effort semantics,
 // simple_sender.rs:105-143).
 constexpr size_t kMaxQueue = kChannelCapacity;
+// A failed connect retries (with capped backoff) while queued messages
+// exist, instead of dropping them.  At 100-node single-host scale the
+// boot is a connect storm: listeners come up over many seconds, and a
+// once-per-round message (a vote) dropped on one early failed connect
+// costs the whole committee a view change.  Bounded so a genuinely dead
+// peer still converges to kDead/drop (best-effort semantics preserved).
+constexpr int kMaxConnectRetries = 40;
+constexpr auto kConnectRetryBase = std::chrono::milliseconds(250);
+constexpr auto kConnectRetryCap = std::chrono::milliseconds(2000);
 }  // namespace
 
 // Loop-thread-only state. A peer is (re)connected lazily on send; failure
@@ -28,6 +37,7 @@ struct SimpleSender::State {
     enum class St { kConnecting, kLive, kDead };
     St st = St::kDead;
     uint64_t conn_id = 0;
+    int connect_fails = 0;
     std::deque<std::shared_ptr<const Bytes>> pending;  // while connecting
   };
 
@@ -71,15 +81,31 @@ struct SimpleSender::State {
         return;
       }
       if (fd < 0) {
+        if (!p.pending.empty() && p.connect_fails < kMaxConnectRetries) {
+          auto delay = std::min(kConnectRetryBase * (1 + p.connect_fails),
+                                kConnectRetryCap);
+          ++p.connect_fails;
+          self->loop->run_after(delay, [self, addr] {
+            if (self->stopped) return;
+            // Invariant: while a retry timer is pending the peer stays
+            // kConnecting with a non-empty queue (sends only enqueue,
+            // on_closed requires a live conn, and give-up/success run
+            // only in the connect callback below).
+            self->connect(self, addr);
+          });
+          return;  // stays kConnecting; sends keep queueing (capped)
+        }
         LOG_WARN("network::simple_sender")
             << "failed to connect to " << addr.str();
         p.st = Peer::St::kDead;
+        p.connect_fails = 0;
         p.pending.clear();
         return;
       }
       LOG_DEBUG("network::simple_sender")
           << "Outgoing connection established with " << addr.str();
       p.st = Peer::St::kLive;
+      p.connect_fails = 0;
       uint64_t cid = self->loop->adopt(
           fd,
           // Sink replies so the peer's ACK writes never fill its buffer.

@@ -158,7 +158,12 @@ int main(int argc, char** argv) {
       TpuVerifier::install(std::make_unique<TpuVerifier>(*addr));
     } else if (std::strcmp(argv[i], "--iters-budget-ms") == 0 &&
                i + 1 < argc) {
-      budget_ms = std::stod(argv[++i]);
+      try {
+        budget_ms = std::stod(argv[++i]);
+      } catch (const std::exception&) {
+        std::fprintf(stderr, "bad --iters-budget-ms value\n");
+        return 1;
+      }
     } else {
       std::fprintf(stderr,
                    "usage: offchain_bench [--sidecar host:port] "

@@ -286,9 +286,17 @@ class Backing {
       }
       bytes += *appended;
     }
-    std::fflush(f);
-    ::fsync(::fileno(f));  // snapshot on disk before it replaces the WAL
-    std::fclose(f);
+    // Buffered writes surface ENOSPC/EIO only at flush time; an unchecked
+    // failure here would rename a TRUNCATED snapshot over the live WAL
+    // while new_index's offsets assume every byte landed — live reads of
+    // evicted values would then pread past EOF on durably-acked data.
+    bool flushed = std::fflush(f) == 0 && ::fsync(::fileno(f)) == 0;
+    flushed = (std::fclose(f) == 0) && flushed;
+    if (!flushed) {
+      LOG_WARN("store") << "compaction skipped: snapshot flush failed";
+      std::remove(tmp.c_str());
+      return;
+    }
     std::FILE* fresh = std::fopen(tmp.c_str(), "ab");
     if (!fresh) {
       LOG_WARN("store") << "compaction skipped: cannot reopen snapshot";
@@ -350,6 +358,7 @@ Store Store::open(const std::string& path, int64_t compact_bytes,
   s.ch_ = ch;
   s.worker_ = std::shared_ptr<std::thread>(
       new std::thread([ch, backing] {
+        set_thread_name("store");
         // Obligations: key -> oneshots fulfilled by a future write
         // (store/src/lib.rs:36-57 semantics).
         std::unordered_map<Bytes, std::vector<Oneshot<Bytes>>, BytesHash>

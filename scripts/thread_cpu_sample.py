@@ -1,0 +1,106 @@
+"""Sample per-thread CPU over a window and attribute it by thread name.
+
+Usage: python scripts/thread_cpu_sample.py <seconds> [pattern]
+
+Walks /proc/<pid>/task/<tid>/stat for every process whose cmdline matches
+`pattern` (default: "./node run" benchmark processes), takes two snapshots
+<seconds> apart, and prints CPU-seconds consumed per thread comm — the
+attribution that tells a 100-validator single-host run where its one vCPU
+actually went (threads are named at spawn via set_thread_name, see
+native/src/common/log.cpp).
+"""
+
+import os
+import sys
+import time
+from collections import defaultdict
+
+
+def match_pids(pattern: str):
+    pids = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode()
+        except OSError:
+            continue
+        if pattern in cmd:
+            pids.append(int(pid))
+    return pids
+
+
+def snapshot(pids):
+    """comm -> cumulative (utime+stime) jiffies over all matching threads."""
+    acc = defaultdict(int)
+    nthreads = 0
+    for pid in pids:
+        try:
+            tids = os.listdir(f"/proc/{pid}/task")
+        except OSError:
+            continue
+        for tid in tids:
+            try:
+                with open(f"/proc/{pid}/task/{tid}/stat") as f:
+                    raw = f.read()
+            except OSError:
+                continue
+            # comm is parenthesised and may contain spaces; split around it.
+            lp, rp = raw.find("("), raw.rfind(")")
+            comm = raw[lp + 1:rp]
+            fields = raw[rp + 2:].split()
+            utime, stime = int(fields[11]), int(fields[12])
+            acc[comm] += utime + stime
+            nthreads += 1
+    return acc, nthreads
+
+
+def main():
+    seconds = float(sys.argv[1]) if len(sys.argv) > 1 else 20.0
+    # Default avoids a literal "./node" in OUR argv: the harness sweeps
+    # stale benchmark processes with `pkill -f "\./node run"`, and a
+    # pattern argument containing that string makes the sampler (or the
+    # shell that launched it) collateral damage of the sweep.
+    pattern = sys.argv[2] if len(sys.argv) > 2 else "node run --keys"
+    hz = os.sysconf("SC_CLK_TCK")
+
+    # Launch this BEFORE the benchmark window: on a saturated 1-vCPU host
+    # a fresh Python interpreter can take minutes just to start, so the
+    # sampler must already be resident, polling for its targets.  Raise
+    # priority so the sampling itself isn't starved by the processes it
+    # measures.
+    try:
+        os.nice(-10)
+    except OSError:
+        pass
+    deadline = time.monotonic() + 900
+    while True:
+        pids = match_pids(pattern)
+        if pids:
+            break
+        if time.monotonic() > deadline:
+            print(f"no processes match {pattern!r}", file=sys.stderr)
+            sys.exit(1)
+        time.sleep(2)
+    # Let the run reach steady state before the measured window.
+    time.sleep(20)
+    before, nt0 = snapshot(pids)
+    t0 = time.monotonic()
+    time.sleep(seconds)
+    after, nt1 = snapshot(match_pids(pattern))
+    dt = time.monotonic() - t0
+
+    deltas = {c: (after.get(c, 0) - before.get(c, 0)) / hz
+              for c in set(after) | set(before)}
+    total = sum(deltas.values())
+    print(f"# {len(pids)} procs, {nt1} threads, window {dt:.1f}s, "
+          f"total CPU {total:.2f}s ({100 * total / dt:.0f}% of one core)")
+    for comm, cpu in sorted(deltas.items(), key=lambda kv: -kv[1]):
+        if cpu <= 0:
+            continue
+        print(f"{comm:18s} {cpu:8.2f}s  {100 * cpu / max(total, 1e-9):5.1f}%")
+
+
+if __name__ == "__main__":
+    main()

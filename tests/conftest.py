@@ -34,6 +34,34 @@ except Exception:  # older jax: default threshold applies
 
 
 # ---------------------------------------------------------------------------
+# Slow-lane gating: tests marked @pytest.mark.slow (the two multichip
+# dryruns, which duplicate the driver's own per-round dryrun_multichip
+# check, and the exhaustive A/B flag-variant sweep) are skipped unless
+# HOTSTUFF_TPU_SLOW_TESTS=1.  They account for ~215 s of a ~385 s
+# warm-cache full run; the default lane stays under 5 minutes while CI's
+# dedicated job exports the env and runs everything.
+# ---------------------------------------------------------------------------
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight test, skipped unless HOTSTUFF_TPU_SLOW_TESTS=1")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    if os.environ.get("HOTSTUFF_TPU_SLOW_TESTS") == "1":
+        return
+    skip = pytest.mark.skip(
+        reason="slow lane: set HOTSTUFF_TPU_SLOW_TESTS=1 to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
+# ---------------------------------------------------------------------------
 # Shared integration-test scaffolding (node/client/sidecar process testbed).
 # Used by test_integration*.py; lives here so the spawn/teardown and log
 # helpers exist exactly once.

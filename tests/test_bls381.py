@@ -96,8 +96,7 @@ def test_miller_lines_match_host_miller():
     assert f_dev == host.fq12_inv(f_host)  # host returns the inverse
 
 
-@pytest.mark.skipif(os.environ.get("HOTSTUFF_TPU_SLOW_TESTS") != "1",
-                    reason="~4 min XLA compile; set HOTSTUFF_TPU_SLOW_TESTS=1")
+@pytest.mark.slow  # ~4 min XLA compile
 def test_aggregate_verify_device_end_to_end():
     msg = b"quorum certificate digest"
     sks, pks = zip(*[host.key_gen(bytes([i]) * 32) for i in range(1, 5)])
@@ -108,8 +107,7 @@ def test_aggregate_verify_device_end_to_end():
     assert not D.verify_aggregate_common(list(pks), msg, bad)
 
 
-@pytest.mark.skipif(os.environ.get("HOTSTUFF_TPU_SLOW_TESTS") != "1",
-                    reason="~4 min XLA compile; set HOTSTUFF_TPU_SLOW_TESTS=1")
+@pytest.mark.slow  # ~4 min XLA compile
 def test_aggregate_verify_multi_device_end_to_end():
     """Distinct-digest product-of-pairings (the TC verify shape)."""
     sks, pks = zip(*[host.key_gen(bytes([i]) * 32) for i in range(1, 4)])

@@ -8,6 +8,7 @@ reference repo.
 
 
 import numpy as np
+import pytest
 
 from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
 
@@ -209,6 +210,7 @@ def test_chunked_batch_over_subbatch_cap():
     assert mask.sum() == n - 1
 
 
+@pytest.mark.slow  # ~44 s: recompiles the ladder per flag combination
 def test_ab_flag_variants_match_reference():
     """Every import-time A/B switch (scripts/eval_device.py knobs) must
     produce reference-identical verdicts: a correctness bug in a flagged

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 
 def test_entry_jits():
@@ -14,12 +15,14 @@ def test_entry_jits():
     assert list(mask) == [True, True, True, True, False, True, True, True]
 
 
+@pytest.mark.slow  # ~84 s; the driver runs dryrun_multichip itself every round
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # ~87 s
 def test_dryrun_multichip_subprocess_reexec():
     """Cover the branch the driver actually hits: this process has only 8
     virtual devices, so asking for 16 must re-exec a fresh child with

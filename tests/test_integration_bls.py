@@ -26,9 +26,7 @@ pytestmark = [
     pytest.mark.skipif(
         not (os.path.exists(NODE_BIN) and os.path.exists(CLIENT_BIN)),
         reason="native binaries not built"),
-    pytest.mark.skipif(
-        os.environ.get("HOTSTUFF_TPU_SLOW_TESTS") != "1",
-        reason="multi-minute BLS consensus run; set HOTSTUFF_TPU_SLOW_TESTS=1"),
+    pytest.mark.slow,  # multi-minute BLS consensus run
 ]
 
 NODES = 4

@@ -9,10 +9,7 @@ import pytest
 from hotstuff_tpu.offchain import bls12381 as host
 from hotstuff_tpu.parallel.mesh import make_mesh
 
-pytestmark = pytest.mark.skipif(
-    os.environ.get("HOTSTUFF_TPU_SLOW_TESTS") != "1",
-    reason="multi-minute Miller-loop compile on CPU; "
-           "set HOTSTUFF_TPU_SLOW_TESTS=1")
+pytestmark = pytest.mark.slow  # multi-minute Miller-loop compile on CPU
 
 
 def test_sharded_multi_digest_matches_host():

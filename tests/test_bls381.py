@@ -4,7 +4,6 @@ of XLA compile on CPU) the full aggregate pairing check against the host
 reference (offchain/bls12381.py).
 """
 
-import os
 
 import numpy as np
 import pytest

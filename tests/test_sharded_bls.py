@@ -2,7 +2,6 @@
 on the virtual CPU mesh: verdict parity with the host reference and the
 single-chip device path, across padding shapes."""
 
-import os
 
 import pytest
 

@@ -247,3 +247,41 @@ def test_engine_mesh_mode_buckets_to_warmed_shapes(monkeypatch):
             assert launched == [want_m], (n, launched)
     finally:
         engine.stop()
+
+
+def test_bls_verdict_cache_dedups_pairings(host_server):
+    """N replicas verifying one certificate must cost one pairing: the
+    second identical BLS verify answers from the verdict cache (on the
+    connection thread - no engine hop), for positive AND negative
+    verdicts, without poisoning different requests."""
+    from unittest.mock import patch
+
+    from hotstuff_tpu.offchain import bls12381 as bls
+
+    port = host_server.server_address[1]
+    engine = host_server.engine
+    keys = [bls.key_gen(bytes([40 + i]) * 32) for i in range(1, 4)]
+    msg = b"cache me" * 4
+    pk_enc = [bls.g1_encode(pk) for _, pk in keys]
+    agg = bls.g2_encode(bls.aggregate(
+        [bls.sign(sk, msg) for sk, _ in keys]))
+    with SidecarClient(port=port) as client:
+        assert client.bls_verify_aggregate(msg, agg, pk_enc)
+        # Replay: the engine must not pair again.  verify_aggregate_common
+        # is the host pairing entry - a second call would go through it.
+        with patch.object(bls, "verify_aggregate_common",
+                          side_effect=AssertionError("paired twice")):
+            assert client.bls_verify_aggregate(msg, agg, pk_enc)
+        # Negative verdicts cache too, and only for their exact bytes.
+        bad = bls.g2_encode(bls.sign(keys[0][0], b"forged" * 5))
+        assert not client.bls_verify_aggregate(msg, bad, pk_enc)
+        with patch.object(bls, "verify_aggregate_common",
+                          side_effect=AssertionError("paired twice")):
+            assert not client.bls_verify_aggregate(msg, bad, pk_enc)
+        # Distinct request still verifies correctly (cache miss).
+        msg2 = b"other msg" * 3
+        agg2 = bls.g2_encode(bls.aggregate(
+            [bls.sign(sk, msg2) for sk, _ in keys]))
+        assert client.bls_verify_aggregate(msg2, agg2, pk_enc)
+    assert any(k and isinstance(k, tuple) and k[0] == "ba"
+               for k in engine._verdicts)

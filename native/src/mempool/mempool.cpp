@@ -21,7 +21,6 @@ std::unique_ptr<Mempool> Mempool::spawn(
   auto tx_batch_maker = make_channel<Transaction>();
   auto tx_quorum_waiter = make_channel<QuorumWaiterMessage>();
   auto tx_processor = make_channel<Bytes>();       // our own acked batches
-  auto tx_peer_processor = make_channel<Bytes>();  // peers' batches
   auto tx_helper =
       make_channel<std::pair<std::vector<Digest>, PublicKey>>();
 
@@ -30,7 +29,6 @@ std::unique_ptr<Mempool> Mempool::spawn(
   mp->closers_.push_back([tx_batch_maker] { tx_batch_maker->close(); });
   mp->closers_.push_back([tx_quorum_waiter] { tx_quorum_waiter->close(); });
   mp->closers_.push_back([tx_processor] { tx_processor->close(); });
-  mp->closers_.push_back([tx_peer_processor] { tx_peer_processor->close(); });
   mp->closers_.push_back([tx_helper] { tx_helper->close(); });
   mp->closers_.push_back([rx_consensus] { rx_consensus->close(); });
 
@@ -71,28 +69,48 @@ std::unique_ptr<Mempool> Mempool::spawn(
                                              tx_quorum_waiter, tx_processor,
                                              mp->stop_flag_));
 
-  // Two processors as in the reference (mempool.rs:147-151, 185-189): one
-  // for our quorum-acked batches, one for batches received from peers.
+  // Our quorum-acked batches keep a processor thread (fed off-reactor by
+  // the QuorumWaiter; mempool.rs:147-151).  The PEER-batch processor
+  // (mempool.rs:185-189) is inlined into the receiver callback below:
+  // at committee size N every sealed batch is processed N-1 times across
+  // the host, and the extra channel hop per reception (enqueue + worker
+  // wakeup) was a measured ~20% of the core at the 50..100-node scale
+  // (scripts/PROFILE.md round-5b) for ~25 us of actual work (SHA-512 of
+  // one batch).
   mp->threads_.push_back(Processor::spawn(store, tx_processor, tx_consensus));
-  mp->threads_.push_back(
-      Processor::spawn(store, tx_peer_processor, tx_consensus));
 
   // Peer ingress (:mempool). ACK every message, then route by type
   // (mempool.rs:225-243).
   auto peer_address = committee.mempool_address(name);
   if (!mp->peer_receiver_.spawn(
           *peer_address,
-          [tx_peer_processor, tx_helper](ConnectionWriter& writer,
-                                         Bytes msg) {
+          [store, tx_consensus, tx_processor,
+           tx_helper](ConnectionWriter& writer, Bytes msg) mutable {
             writer.send(std::string("Ack"));
             // Reactor-thread handler: blocking channel sends would stall
             // the whole process's data plane; drop under overload (the
-            // sender's ReliableSender retransmits un-ACKed batches, and
-            // sync requests are re-issued on a timer).
+            // sender's ReliableSender retransmits un-ACKed batches, the
+            // payload synchronizer re-fetches missing batches, and sync
+            // requests are re-issued on a timer).
             try {
               MempoolMessage m = MempoolMessage::deserialize(msg);
               if (m.kind == MempoolMessage::Kind::kBatch) {
-                if (!tx_peer_processor->try_send(std::move(msg))) {
+                // Inline peer-batch processing (store + digest to
+                // consensus); ~25 us of SHA-512 on the reactor thread.
+                Digest digest = Processor::digest_of(msg);
+                if (store.try_write(digest.to_bytes(), &msg)) {
+                  if (!tx_consensus->try_send(digest)) {
+                    LOG_WARN("mempool::mempool")
+                        << "consensus digest queue full; dropping digest";
+                  }
+                } else if (!tx_processor->try_send(std::move(msg))) {
+                  // Overflow lane: a stalled store worker (WAL compaction
+                  // rewrites the whole log synchronously) must not cost
+                  // every peer's batches for the stall duration — the
+                  // processor actor absorbs up to a channel of them and
+                  // BLOCKS in store.write off-reactor, the pre-inline
+                  // behavior.  Only both-full drops (recovered via batch
+                  // sync).
                   LOG_WARN("mempool::mempool")
                       << "processor overloaded; dropping batch";
                 }

@@ -12,7 +12,7 @@ std::thread Processor::spawn(Store store, ChannelPtr<Bytes> rx_batch,
   return std::thread([store, rx_batch, tx_digest]() mutable {
     set_thread_name("mp-processor");
     while (auto batch = rx_batch->recv()) {
-      Digest digest = sha512_digest(*batch);
+      Digest digest = Processor::digest_of(*batch);
       store.write(digest.to_bytes(), *batch);
       tx_digest->send(digest);
     }

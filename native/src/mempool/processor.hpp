@@ -17,6 +17,16 @@ class Processor {
   // Returns the actor thread; exits when rx_batch is closed and drained.
   static std::thread spawn(Store store, ChannelPtr<Bytes> rx_batch,
                     ChannelPtr<Digest> tx_digest);
+
+  // ONE source of truth for batch identity, shared by this actor and the
+  // reactor-inlined peer path (mempool.cpp): the digest of the FULL
+  // serialized message is both the store key and the payload handle
+  // consensus carries in block payloads — if these ever diverged between
+  // the own-batch and peer-batch paths, synchronizers would request
+  // batches under keys peers never stored.
+  static Digest digest_of(const Bytes& serialized_batch) {
+    return sha512_digest(serialized_batch);
+  }
 };
 
 }  // namespace mempool

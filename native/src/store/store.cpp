@@ -410,6 +410,19 @@ void Store::write(const Bytes& key, const Bytes& value) {
   ch_->send(std::move(cmd));
 }
 
+bool Store::try_write(const Bytes& key, Bytes* value) {
+  Command cmd;
+  cmd.kind = Command::Kind::kWrite;
+  cmd.key = key;
+  cmd.value = std::move(*value);
+  if (ch_->send_until(&cmd, std::chrono::steady_clock::now()) ==
+      RecvStatus::kOk) {
+    return true;
+  }
+  *value = std::move(cmd.value);  // send_until does not consume on timeout
+  return false;
+}
+
 std::optional<Bytes> Store::read(const Bytes& key) {
   Command cmd;
   cmd.kind = Command::Kind::kRead;

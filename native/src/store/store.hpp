@@ -46,6 +46,12 @@ class Store {
   Store() = default;  // null handle; open() returns the real one
 
   void write(const Bytes& key, const Bytes& value);
+  // Non-blocking write for reactor-thread callers: false = store actor
+  // backlogged (command channel full), nothing enqueued and *value is
+  // left INTACT so the caller can divert it to an overflow lane.  A
+  // reactor must never block on the store; on success the value is moved,
+  // not copied (it can be ~500 KB of batch).
+  bool try_write(const Bytes& key, Bytes* value);
   std::optional<Bytes> read(const Bytes& key);
 
   // Returns a oneshot fulfilled with the value as soon as the key exists

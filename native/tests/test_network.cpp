@@ -111,6 +111,36 @@ TEST(reliable_send_retries_until_listener_appears) {
   t.join();
 }
 
+TEST(simple_send_retries_connect_while_queued) {
+  // Boot-storm shape: the message is sent BEFORE the listener exists.
+  // The bounded connect-retry (simple_sender.cpp) must keep the queued
+  // message alive and deliver it once the listener appears — a vote is
+  // sent exactly once, and dropping it on one failed connect used to
+  // cost a 100-node committee its round 1-3 view changes.
+  uint16_t port;
+  {
+    auto probe = Listener::bind(Address{"127.0.0.1", 0});
+    CHECK(probe.has_value());
+    port = probe->port();
+  }
+  Address addr{"127.0.0.1", port};
+  SimpleSender sender;
+  sender.send(addr, Bytes{7, 7, 7});  // no listener yet: connect fails
+  std::this_thread::sleep_for(std::chrono::milliseconds(400));
+  auto delivered = make_channel<Bytes>();
+  auto l = Listener::bind(addr);
+  CHECK(l.has_value());
+  auto t = listener(std::move(*l),
+                    [delivered](Bytes b) { delivered->send(std::move(b)); });
+  Bytes got_msg;
+  auto status = delivered->recv_until(
+      &got_msg, std::chrono::steady_clock::now() +
+                    std::chrono::seconds(15));
+  CHECK(status == RecvStatus::kOk);
+  CHECK(got_msg == (Bytes{7, 7, 7}));
+  t.join();
+}
+
 TEST(reliable_send_replays_across_listener_crashes) {
   // Reconnect/replay stress (the state machine SURVEY.md calls out as a
   // hard part): a flaky peer accepts ONE message per connection lifetime

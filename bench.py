@@ -190,20 +190,31 @@ def tpu_throughput(msgs, pks, sigs, on_trial=None) -> float:
     # behind round i+2's prep — prep+transfer serialized on one thread is
     # exactly the bottleneck that capped the 2-stage pipeline at ~80k.
     best = 0.0
+    # HOTSTUFF_TPU_XFER_STREAMS=2 runs two concurrent h2d transfers —
+    # worth it ONLY if scripts/exp_xfer_streams.py shows the tunnel's
+    # ~13 MB/s is a per-stream (TCP window) limit rather than the link's
+    # physical rate; with a physical limit two streams just split it.
+    try:
+        xfer_streams = max(
+            1, int(os.environ.get("HOTSTUFF_TPU_XFER_STREAMS", "1").strip()))
+    except ValueError:
+        raise SystemExit("HOTSTUFF_TPU_XFER_STREAMS must be an integer")
     with ThreadPoolExecutor(1) as prep_pool, \
-         ThreadPoolExecutor(1) as xfer_pool:
+         ThreadPoolExecutor(xfer_streams) as xfer_pool:
+        lead = xfer_streams  # transfers in flight ahead of compute
         for _ in range(TRIALS):
             t0 = time.perf_counter()
-            preps = [prep_pool.submit(prep_round) for _ in range(2)]
+            preps = [prep_pool.submit(prep_round) for _ in range(1 + lead)]
             devs = [xfer_pool.submit(
-                lambda f=preps[0]: jax.device_put(f.result()))]
+                        lambda f=preps[i]: jax.device_put(f.result()))
+                    for i in range(lead)]
             verdicts = []
             for r in range(ROUNDS):
-                if r + 2 < ROUNDS:
+                if r + 1 + lead < ROUNDS:
                     preps.append(prep_pool.submit(prep_round))
-                if r + 1 < ROUNDS:
+                if r + lead < ROUNDS:
                     devs.append(xfer_pool.submit(
-                        lambda f=preps[r + 1]: jax.device_put(f.result())))
+                        lambda f=preps[r + lead]: jax.device_put(f.result())))
                 verdicts.append(verify_all(devs[r].result()))
             oks = [bool(np.asarray(v)) for v in verdicts]  # forces the work
             dt = time.perf_counter() - t0

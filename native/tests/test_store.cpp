@@ -177,4 +177,43 @@ TEST(store_bounded_memory_survives_compaction) {
   std::system(("rm -rf " + path).c_str());
 }
 
+TEST(channel_send_until_no_consume_on_timeout) {
+  // Foundation of Store::try_write's failure contract: a send_until
+  // that times out on a full channel must leave *value intact (moved
+  // back nowhere - never consumed), so the caller can divert the bytes
+  // to an overflow lane.
+  auto ch = make_channel<Bytes>(1);
+  CHECK(ch->try_send(Bytes{1}));  // fill to capacity
+  Bytes v(1024, 42);
+  auto st = ch->send_until(&v, std::chrono::steady_clock::now());
+  CHECK(st == RecvStatus::kTimeout);
+  CHECK(v == Bytes(1024, 42));  // untouched
+  Bytes drained;
+  CHECK(ch->try_recv(&drained));
+  st = ch->send_until(&v, std::chrono::steady_clock::now());
+  CHECK(st == RecvStatus::kOk);  // space freed: consumed now
+}
+
+TEST(try_write_moves_and_lands) {
+  // The reactor-thread write path: non-blocking, and the value is MOVED
+  // (a peer batch is ~500 KB; a copy on the event loop would be the
+  // exact cost the inline path exists to avoid).  The
+  // value-intact-on-failure half of the contract rides on
+  // channel::send_until's no-consume-on-timeout guarantee, which the
+  // channel tests pin down.
+  Store s = Store::open("");
+  Bytes v{9, 9, 9};
+  CHECK(s.try_write(Bytes{1}, &v));
+  auto got = s.read(Bytes{1});
+  CHECK(got.has_value());
+  CHECK(*got == (Bytes{9, 9, 9}));
+
+  Bytes big(512 * 1024, 7);
+  CHECK(s.try_write(Bytes{2}, &big));
+  CHECK(big.empty());  // moved, not copied
+  auto got2 = s.read(Bytes{2});
+  CHECK(got2.has_value());
+  CHECK(got2->size() == 512 * 1024);
+}
+
 int main() { return run_all(); }

@@ -1,0 +1,38 @@
+"""graftlint: repo-native static analysis for the TPU hot path, the
+Python<->C++ wire protocol, and the native tree's sanitizer wiring.
+
+Three checkers, each runnable standalone and together via
+``python -m hotstuff_tpu.analysis`` (exit non-zero on findings):
+
+* :mod:`.hotpath` — AST pass over the JAX device modules flagging
+  host-device sync points, retrace hazards, dtype leaks, and non-donated
+  verify-loop buffers inside jitted code.
+* :mod:`.wirecheck` — cross-checks the sidecar wire constants
+  (``sidecar/protocol.py``) and the shared field-modulus literals against
+  the C++ node sources, so a one-sided edit fails the gate instead of
+  corrupting a QC on the wire.
+* :mod:`.sanitize` — asserts the ASan/UBSan/TSan build wiring
+  (``native/CMakeLists.txt`` presets + ``scripts/native_sanitize.sh``)
+  has not rotted; the actual sanitizer run is the tier-2 slow lane.
+
+Suppression: a finding is silenced by ``# graftlint: disable=<rule>`` on
+the finding's line or the line above (Python sources only); every
+suppression should carry a rationale. See ``analysis/README.md`` for the
+rule catalogue.
+"""
+
+from __future__ import annotations
+
+from .common import Finding  # noqa: F401
+
+
+def run_all(root, checkers=("hotpath", "wire", "sanitize")):
+    """Run the selected checkers over a repo root; returns findings.
+
+    Kept here (delegating to ``__main__``) so callers can use
+    ``hotstuff_tpu.analysis.run_all`` without triggering the runpy
+    double-import warning that a module-level ``from .__main__ import``
+    would cause under ``python -m hotstuff_tpu.analysis``."""
+    from .__main__ import run_all as _run
+
+    return _run(root, checkers)

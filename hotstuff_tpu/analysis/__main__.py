@@ -1,0 +1,65 @@
+"""graftlint CLI: ``python -m hotstuff_tpu.analysis [options]``.
+
+Runs the hot-path lint, the wire/constants cross-checker, and the
+sanitizer-wiring check; prints one line per finding and exits non-zero
+when anything fires.  ``scripts/lint_gate.py`` is the CI entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+CHECKERS = ("hotpath", "wire", "sanitize")
+
+
+def run_all(root: str, checkers=CHECKERS) -> list:
+    from . import hotpath, sanitize, wirecheck
+
+    findings = []
+    if "hotpath" in checkers:
+        findings += hotpath.check(root)
+    if "wire" in checkers:
+        findings += wirecheck.check(root)
+    if "sanitize" in checkers:
+        findings += sanitize.check(root)
+    # checkers may anchor the same missing constant from two rule paths
+    seen, unique = set(), []
+    for f in findings:
+        key = (f.path, f.line, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+def _default_root() -> str:
+    # hotstuff_tpu/analysis/__main__.py -> repo root
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hotstuff_tpu.analysis",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=_default_root(),
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--checker", action="append", choices=CHECKERS,
+                    help="run only this checker (repeatable; default all)")
+    args = ap.parse_args(argv)
+    checkers = tuple(args.checker) if args.checker else CHECKERS
+    findings = run_all(args.root, checkers)
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        print(f.render())
+    if findings:
+        print(f"graftlint: {len(findings)} finding(s) "
+              f"[checkers: {', '.join(checkers)}]", file=sys.stderr)
+        return 1
+    print(f"graftlint: clean [checkers: {', '.join(checkers)}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

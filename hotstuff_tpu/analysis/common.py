@@ -1,0 +1,113 @@
+"""Shared graftlint plumbing: findings, suppressions, constant parsing."""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-, ]+)")
+
+
+def suppressed_rules(source: str) -> dict:
+    """line number (1-based) -> set of rule names silenced on that line.
+
+    A ``# graftlint: disable=rule[,rule2]`` comment silences its own line
+    AND the following line (so a suppression can sit above a long
+    statement)."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def apply_suppressions(findings, sources: dict):
+    """Drop findings silenced by an inline comment in their source file.
+
+    ``sources`` maps finding.path -> file text; findings whose path is
+    unknown pass through unfiltered (C++/CMake findings — those use
+    constants-level gating, not comments)."""
+    cache = {p: suppressed_rules(src) for p, src in sources.items()}
+    kept = []
+    for f in findings:
+        silenced = cache.get(f.path, {}).get(f.line, set())
+        if f.rule in silenced:
+            continue
+        kept.append(f)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# Python constant scraping (AST; no imports, so fixtures and broken trees
+# can still be linted)
+# ---------------------------------------------------------------------------
+
+_BIN_OPS = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.Pow: lambda a, b: a ** b,
+    ast.LShift: lambda a, b: a << b,
+    ast.RShift: lambda a, b: a >> b,
+    ast.Mod: lambda a, b: a % b,
+    ast.FloorDiv: lambda a, b: a // b,
+    ast.BitOr: lambda a, b: a | b,
+    ast.BitAnd: lambda a, b: a & b,
+    ast.BitXor: lambda a, b: a ^ b,
+}
+
+
+def _eval_int(node: ast.AST, env: dict):
+    """Evaluate a constant integer expression; raises ValueError when the
+    expression isn't statically evaluable (calls, attributes, floats)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        raise ValueError(f"unknown name {node.id}")
+    if isinstance(node, ast.BinOp) and type(node.op) in _BIN_OPS:
+        return _BIN_OPS[type(node.op)](_eval_int(node.left, env),
+                                       _eval_int(node.right, env))
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return -_eval_int(node.operand, env)
+    raise ValueError(f"not a static int expression: {ast.dump(node)[:60]}")
+
+
+def module_int_constants(source: str, path: str = "<src>") -> dict:
+    """Top-level ``NAME = <int expr>`` assignments of a module, evaluated
+    in order so later constants may reference earlier ones."""
+    tree = ast.parse(source, filename=path)
+    env: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            name = node.target.id
+        else:
+            continue
+        try:
+            env[name] = _eval_int(node.value, env)
+        except ValueError:
+            continue
+    return env

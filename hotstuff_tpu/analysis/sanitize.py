@@ -1,0 +1,70 @@
+"""graftlint sanitizer-wiring checker.
+
+SURVEY.md §5.2: the reference's memory safety comes from Rust; the C++
+rewrite compensates with sanitizer builds.  That only holds while the
+wiring exists — a refactor that drops the CMake preset or the build
+script silently un-instruments the native tree.  This pass asserts the
+wiring is present and coherent; actually *running* ASan/UBSan is the
+tier-2 slow lane (``scripts/native_sanitize.sh``, driven by the
+slow-marked test in tests/test_analysis.py).
+
+Rule:
+  sanitizer-wiring   native/CMakeLists.txt lacks the GRAFT_SANITIZE
+                     presets, or scripts/native_sanitize.sh is missing /
+                     not executable / doesn't drive the sanitizers
+"""
+
+from __future__ import annotations
+
+import os
+
+from .common import Finding
+
+CMAKELISTS = "native/CMakeLists.txt"
+SCRIPT = "scripts/native_sanitize.sh"
+MODES = ("address", "undefined", "thread")
+
+
+def check(root: str) -> list:
+    findings: list[Finding] = []
+
+    def bad(path, message, line=1):
+        findings.append(Finding(path, line, "sanitizer-wiring", message))
+
+    cmake_path = os.path.join(root, CMAKELISTS)
+    try:
+        with open(cmake_path, encoding="utf-8") as f:
+            cmake = f.read()
+    except OSError:
+        bad(CMAKELISTS, "native/CMakeLists.txt missing")
+        cmake = ""
+    if cmake:
+        if "GRAFT_SANITIZE" not in cmake:
+            bad(CMAKELISTS, "no GRAFT_SANITIZE preset: "
+                "-DGRAFT_SANITIZE=address|undefined|thread must map onto "
+                "the sanitizer build flags")
+        for mode in MODES:
+            if mode not in cmake:
+                bad(CMAKELISTS,
+                    f"sanitizer mode '{mode}' not mentioned in the "
+                    "GRAFT_SANITIZE preset")
+        if "-fsanitize=" not in cmake:
+            bad(CMAKELISTS, "no -fsanitize compile/link options wired")
+
+    script_path = os.path.join(root, SCRIPT)
+    if not os.path.isfile(script_path):
+        bad(SCRIPT, "scripts/native_sanitize.sh missing: the tier-2 "
+            "ASan/UBSan gate has no driver")
+        return findings
+    if not os.access(script_path, os.X_OK):
+        bad(SCRIPT, "scripts/native_sanitize.sh is not executable")
+    with open(script_path, encoding="utf-8") as f:
+        script = f.read()
+    if "-fsanitize=" not in script and "GRAFT_SANITIZE" not in script:
+        bad(SCRIPT, "native_sanitize.sh drives neither -fsanitize flags "
+            "nor the GRAFT_SANITIZE cmake preset")
+    for mode in ("address", "undefined"):
+        if mode not in script:
+            bad(SCRIPT, f"native_sanitize.sh does not support the "
+                f"'{mode}' sanitizer")
+    return findings

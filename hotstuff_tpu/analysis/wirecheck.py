@@ -1,0 +1,230 @@
+"""graftlint wire/constants cross-checker.
+
+The Python sidecar (``sidecar/protocol.py``) and the C++ node
+(``native/src/crypto/sidecar_client.cpp``) speak a hand-rolled binary
+protocol, and the curve arithmetic keeps its field moduli duplicated
+between the device ops, the host reference implementations, and (as
+documentation constants) the C++ crypto layer.  No test exercises both
+sides of every constant — a one-sided edit ships a node that corrupts
+QCs on the wire.  This pass parses both trees (AST for Python, regex for
+the C++ — clang-free by design) and asserts they agree.
+
+Rules:
+  wire-tag-mismatch       sidecar opcode values differ (or are missing)
+                          between protocol.py and sidecar_client.cpp
+  wire-length-mismatch    fixed record sizes differ: digest, Ed25519
+                          pk/sig, BLS pk/sig/sk byte lengths
+  field-modulus-mismatch  the 2^255-19 / BLS12-381 field modulus
+                          literals disagree across ops/field25519.py,
+                          utils/intmath.py, ops/field381.py,
+                          offchain/bls12381.py and crypto.hpp
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from .common import Finding, module_int_constants
+
+# (python constant in protocol.py, C++ constant in sidecar_client.cpp)
+_TAG_PAIRS = (
+    ("OP_VERIFY_BATCH", "kOpVerifyBatch"),
+    ("OP_BLS_VERIFY_AGG", "kOpBlsVerifyAgg"),
+    ("OP_BLS_SIGN", "kOpBlsSign"),
+    ("OP_BLS_VERIFY_VOTES", "kOpBlsVerifyVotes"),
+    ("OP_BLS_VERIFY_MULTI", "kOpBlsVerifyMulti"),
+)
+
+_LEN_PAIRS = (
+    ("BLS_PK_LEN", "kBlsPkLen"),
+    ("BLS_SIG_LEN", "kBlsSigLen"),
+    ("BLS_SK_LEN", "kBlsSkLen"),
+    ("DIGEST_LEN", "kDigestLen"),
+)
+
+PROTOCOL = "hotstuff_tpu/sidecar/protocol.py"
+SIDECAR_CLIENT = "native/src/crypto/sidecar_client.cpp"
+CRYPTO_HPP = "native/src/crypto/crypto.hpp"
+FIELD25519 = "hotstuff_tpu/ops/field25519.py"
+INTMATH = "hotstuff_tpu/utils/intmath.py"
+FIELD381 = "hotstuff_tpu/ops/field381.py"
+BLS12381 = "hotstuff_tpu/offchain/bls12381.py"
+
+
+def _read(root: str, rel: str):
+    path = os.path.join(root, rel)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _line_of(source: str, pattern: str) -> int:
+    m = re.search(pattern, source, re.MULTILINE)
+    return source[:m.start()].count("\n") + 1 if m else 1
+
+
+def cpp_int_constants(source: str) -> dict:
+    """``constexpr <type> kName = <int>;`` declarations (dec or hex)."""
+    out = {}
+    for m in re.finditer(
+            r"constexpr\s+[\w:]+\s+(k\w+)\s*=\s*(0[xX][0-9a-fA-F']+|\d+)",
+            source):
+        out[m.group(1)] = int(m.group(2).replace("'", ""), 0)
+    return out
+
+
+def cpp_hex_string_constants(source: str) -> dict:
+    """``constexpr char kName[] = "hex" "hex"...;`` -> int value."""
+    out = {}
+    for m in re.finditer(
+            r"constexpr\s+char\s+(k\w+)\[\]\s*=\s*(?://[^\n]*)?"
+            r"((?:\s*\"[0-9a-fA-F]*\")+)",
+            source):
+        digits = "".join(re.findall(r'"([0-9a-fA-F]*)"', m.group(2)))
+        if digits:
+            out[m.group(1)] = int(digits, 16)
+    return out
+
+
+def cpp_struct_array_len(source: str, struct: str) -> int | None:
+    """Byte length of ``std::array<uint8_t, N> data`` inside a struct."""
+    m = re.search(r"struct\s+%s\b.*?std::array<uint8_t,\s*(\d+)>\s+data"
+                  % re.escape(struct), source, re.DOTALL)
+    return int(m.group(1)) if m else None
+
+
+def cpp_signature_lens(source: str) -> set:
+    """The wire lengths Signature::deserialize accepts."""
+    m = re.search(r"data\.size\(\)\s*!=\s*(\d+)\s*&&\s*"
+                  r"s\.data\.size\(\)\s*!=\s*(\d+)", source)
+    if not m:
+        return set()
+    return {int(m.group(1)), int(m.group(2))}
+
+
+def check(root: str) -> list:
+    findings: list[Finding] = []
+
+    def miss(path, rule, what):
+        findings.append(Finding(path, 1, rule, f"{what} not found — the "
+                                "cross-check cannot anchor; fix the "
+                                "source or update wirecheck.py"))
+
+    proto_src = _read(root, PROTOCOL)
+    client_src = _read(root, SIDECAR_CLIENT)
+    crypto_src = _read(root, CRYPTO_HPP)
+    if proto_src is None or client_src is None or crypto_src is None:
+        for rel, src in ((PROTOCOL, proto_src), (SIDECAR_CLIENT, client_src),
+                         (CRYPTO_HPP, crypto_src)):
+            if src is None:
+                miss(rel, "wire-tag-mismatch", "source file")
+        return findings
+
+    py = module_int_constants(proto_src, PROTOCOL)
+    cpp = cpp_int_constants(client_src)
+    cpp.update(cpp_int_constants(crypto_src))
+
+    # -- message tags ------------------------------------------------------
+    for py_name, cpp_name in _TAG_PAIRS:
+        if py_name not in py:
+            miss(PROTOCOL, "wire-tag-mismatch", f"constant {py_name}")
+        elif cpp_name not in cpp:
+            miss(SIDECAR_CLIENT, "wire-tag-mismatch", f"constant {cpp_name}")
+        elif py[py_name] != cpp[cpp_name]:
+            findings.append(Finding(
+                SIDECAR_CLIENT, _line_of(client_src, cpp_name),
+                "wire-tag-mismatch",
+                f"{cpp_name}={cpp[cpp_name]} but {PROTOCOL} "
+                f"{py_name}={py[py_name]}: the node and the sidecar "
+                "disagree on a message opcode"))
+
+    # -- fixed byte lengths ------------------------------------------------
+    for py_name, cpp_name in _LEN_PAIRS:
+        if py_name not in py:
+            miss(PROTOCOL, "wire-length-mismatch", f"constant {py_name}")
+        elif cpp_name not in cpp:
+            miss(SIDECAR_CLIENT, "wire-length-mismatch",
+                 f"constant {cpp_name}")
+        elif py[py_name] != cpp[cpp_name]:
+            findings.append(Finding(
+                SIDECAR_CLIENT, _line_of(client_src, cpp_name),
+                "wire-length-mismatch",
+                f"{cpp_name}={cpp[cpp_name]} but {PROTOCOL} "
+                f"{py_name}={py[py_name]}: record framing will desync"))
+
+    digest_len = cpp_struct_array_len(crypto_src, "Digest")
+    pk_len = cpp_struct_array_len(crypto_src, "PublicKey")
+    sig_lens = cpp_signature_lens(crypto_src)
+    checks = (
+        ("DIGEST_LEN", digest_len, "struct Digest byte length"),
+        ("ED_PK_LEN", pk_len, "struct PublicKey byte length"),
+    )
+    for py_name, cpp_val, what in checks:
+        if py_name not in py:
+            miss(PROTOCOL, "wire-length-mismatch", f"constant {py_name}")
+        elif cpp_val is None:
+            miss(CRYPTO_HPP, "wire-length-mismatch", what)
+        elif py[py_name] != cpp_val:
+            findings.append(Finding(
+                CRYPTO_HPP, _line_of(crypto_src, "struct " + (
+                    "Digest" if py_name == "DIGEST_LEN" else "PublicKey")),
+                "wire-length-mismatch",
+                f"{what} is {cpp_val} but {PROTOCOL} "
+                f"{py_name}={py[py_name]}"))
+    for py_name, lens_needed in (("ED_SIG_LEN", sig_lens),
+                                 ("BLS_SIG_LEN", sig_lens)):
+        if py_name not in py:
+            miss(PROTOCOL, "wire-length-mismatch", f"constant {py_name}")
+        elif not lens_needed:
+            miss(CRYPTO_HPP, "wire-length-mismatch",
+                 "Signature::deserialize length check")
+        elif py[py_name] not in lens_needed:
+            findings.append(Finding(
+                CRYPTO_HPP, _line_of(crypto_src, "bad signature length"),
+                "wire-length-mismatch",
+                f"Signature::deserialize accepts {sorted(lens_needed)} "
+                f"but {PROTOCOL} {py_name}={py[py_name]}"))
+
+    # -- field moduli ------------------------------------------------------
+    hexes = cpp_hex_string_constants(crypto_src)
+    moduli = {
+        "P25519": (
+            "kEd25519FieldPrimeHex",
+            [(FIELD25519, "P"), (INTMATH, "P")],
+        ),
+        "Q381": (
+            "kBls381FieldPrimeHex",
+            [(FIELD381, "Q"), (BLS12381, "Q")],
+        ),
+    }
+    for label, (cpp_name, py_sites) in moduli.items():
+        values = {}
+        for rel, const in py_sites:
+            src = _read(root, rel)
+            if src is None:
+                miss(rel, "field-modulus-mismatch", "source file")
+                continue
+            consts = module_int_constants(src, rel)
+            if const not in consts:
+                miss(rel, "field-modulus-mismatch", f"constant {const}")
+                continue
+            values[rel] = (consts[const], _line_of(src, rf"^{const}\s*="))
+        if cpp_name not in hexes:
+            miss(CRYPTO_HPP, "field-modulus-mismatch",
+                 f"constant {cpp_name}")
+        else:
+            values[CRYPTO_HPP] = (hexes[cpp_name],
+                                  _line_of(crypto_src, cpp_name))
+        if len({v for v, _ in values.values()}) > 1:
+            detail = "; ".join(f"{rel} has {hex(v)[:18]}..."
+                               for rel, (v, _) in sorted(values.items()))
+            for rel, (_, line) in sorted(values.items()):
+                findings.append(Finding(
+                    rel, line, "field-modulus-mismatch",
+                    f"{label} field modulus disagrees across sources: "
+                    f"{detail} — verification on one side will accept "
+                    "what the other rejects"))
+    return findings

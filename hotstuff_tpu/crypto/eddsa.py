@@ -190,11 +190,15 @@ def _dispatch_rows(packed: np.ndarray, n: int, pad: bool):
     """(n, 128) prepared rows -> dispatched device launch; returns
     fetch() -> (n,) bool mask.  Single home of the bucket/pad/chunk
     policy shared by the eager and submit paths."""
+    # The launches below DONATE their input buffer; forcing host-side
+    # rows here guarantees each jnp.asarray is a fresh device copy, so a
+    # caller's (possibly device-resident) array is never invalidated.
+    packed = np.asarray(packed)
     if n <= MAX_SUBBATCH:
         m = _bucket(n) if pad else n
         if m != n:
             packed = np.pad(packed, [(0, m - n), (0, 0)])
-        dev = E.verify_packed_jit(jnp.asarray(packed))
+        dev = E.verify_packed_donated(jnp.asarray(packed))
         return lambda: np.asarray(dev)[:n]
     g = -(-n // MAX_SUBBATCH)
     if pad:  # bound the number of compiled scan lengths: next power of two
@@ -203,7 +207,7 @@ def _dispatch_rows(packed: np.ndarray, n: int, pad: bool):
     if m != n:
         packed = np.pad(packed, [(0, m - n), (0, 0)])
     chunked = packed.reshape(g, MAX_SUBBATCH, 128)
-    dev = E.verify_packed_chunked_jit(jnp.asarray(chunked))
+    dev = E.verify_packed_chunked_donated(jnp.asarray(chunked))
     return lambda: np.asarray(dev).reshape(m)[:n]
 
 

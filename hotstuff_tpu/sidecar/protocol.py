@@ -55,6 +55,12 @@ _REPLY_HDR = struct.Struct("<BII")
 
 MAX_FRAME = 64 * 1024 * 1024
 
+# Fixed record sizes shared with the C++ node (crypto/crypto.hpp,
+# crypto/sidecar_client.cpp).  graftlint's wire cross-checker asserts the
+# two sides agree — edit BOTH or the gate fails.
+DIGEST_LEN = 32       # SHA-512/32 digests: the only msg the node sends
+ED_PK_LEN = 32
+ED_SIG_LEN = 64
 BLS_PK_LEN = 96
 BLS_SIG_LEN = 192
 BLS_SK_LEN = 48
@@ -105,7 +111,8 @@ def encode_request(request_id: int, msgs, pks, sigs) -> bytes:
     msg_len = len(msgs[0]) if n else 0
     parts = [_HDR.pack(OP_VERIFY_BATCH, request_id, n, msg_len)]
     for m, p, s in zip(msgs, pks, sigs):
-        assert len(m) == msg_len and len(p) == 32 and len(s) == 64
+        assert len(m) == msg_len and len(p) == ED_PK_LEN \
+            and len(s) == ED_SIG_LEN
         parts.append(m)
         parts.append(p)
         parts.append(s)
@@ -211,7 +218,7 @@ def decode_request(payload: bytes):
             pks.append(payload[base + msg_len:base + msg_len + BLS_PK_LEN])
             sigs.append(payload[base + msg_len + BLS_PK_LEN:base + rec])
         return opcode, BlsMultiRequest(request_id, msgs, pks, sigs)
-    rec = msg_len + 32 + 64
+    rec = msg_len + ED_PK_LEN + ED_SIG_LEN
     off = _HDR.size
     if len(payload) != off + n * rec:
         raise ValueError(
@@ -220,10 +227,10 @@ def decode_request(payload: bytes):
     for _ in range(n):
         msgs.append(payload[off:off + msg_len])
         off += msg_len
-        pks.append(payload[off:off + 32])
-        off += 32
-        sigs.append(payload[off:off + 64])
-        off += 64
+        pks.append(payload[off:off + ED_PK_LEN])
+        off += ED_PK_LEN
+        sigs.append(payload[off:off + ED_SIG_LEN])
+        off += ED_SIG_LEN
     return opcode, VerifyRequest(request_id, msgs, pks, sigs)
 
 

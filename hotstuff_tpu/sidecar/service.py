@@ -308,6 +308,18 @@ class VerifyEngine:
         self._verdicts[record] = ok
 
     def _execute_bls(self, item):
+        """Run one BLS request on the engine thread.
+
+        Reply/caching contract: verdicts are cached ONLY at the explicit
+        sites below that pass ``cacheable=True`` — i.e. verdicts that are
+        a pure function of the request bytes (decode/subgroup failures,
+        completed verifications).  Transient failures (a wedged device, a
+        backend exception) must reply ``None`` and NEVER a cacheable
+        ``[False]``: the verdict cache is shared by every replica, so one
+        poisoned entry would reject a valid certificate fleet-wide.  An
+        exception escaping this method is replied as ``None`` by _run's
+        handler and, by construction, cannot touch the cache.
+        """
         from ..offchain import bls12381 as bls
 
         req = item.request
@@ -326,14 +338,14 @@ class VerifyEngine:
         if cached is not None:
             item.reply_fn([cached])
             return
-        inner_reply, item.reply_fn = item.reply_fn, None
 
-        def reply_and_cache(mask, _key=cache_key, _inner=inner_reply):
-            if _key is not None and mask:
-                self._cache_verdict(_key, bool(mask[0]))
-            _inner(mask)
+        def reply(mask, *, cacheable):
+            # cacheable=True asserts this verdict is a pure function of
+            # the request bytes; nothing else may enter the shared cache.
+            if cacheable and cache_key is not None and mask:
+                self._cache_verdict(cache_key, bool(mask[0]))
+            item.reply_fn(mask)
 
-        item.reply_fn = reply_and_cache
         if isinstance(req, proto.BlsMultiRequest):
             # TC shape: per-vote signatures over DISTINCT digests in one
             # RPC (round-3 verdict: this used to cost N sidecar
@@ -344,11 +356,11 @@ class VerifyEngine:
                 agg = bls.aggregate(
                     [bls.g2_decode_lax(s) for s in req.sigs])
                 if not bls.g2_in_subgroup(agg):
-                    item.reply_fn([False])
+                    reply([False], cacheable=True)
                     return
                 pks = [bls.g1_decode(p) for p in req.pks]
             except ValueError:
-                item.reply_fn([False])
+                reply([False], cacheable=True)
                 return
             if self._use_host or len(pks) not in self._bls_multi_warmed:
                 if not self._use_host:
@@ -360,7 +372,7 @@ class VerifyEngine:
                 from ..ops import bls381 as dbls
 
                 ok = dbls.verify_aggregate_multi(pks, req.msgs, agg)
-            item.reply_fn([bool(ok)])
+            reply([bool(ok)], cacheable=True)
             return
         try:
             if isinstance(req, proto.BlsVotesRequest):
@@ -374,13 +386,13 @@ class VerifyEngine:
                 agg = bls.aggregate(
                     [bls.g2_decode_lax(s) for s in req.sigs])
                 if not bls.g2_in_subgroup(agg):
-                    item.reply_fn([False])
+                    reply([False], cacheable=True)
                     return
             else:
                 agg = bls.g2_decode(req.agg_sig)
             pks = [bls.g1_decode(p) for p in req.pks]
         except ValueError:
-            item.reply_fn([False])
+            reply([False], cacheable=True)
             return
         if self._use_host:
             ok = bls.verify_aggregate_common(pks, req.msg, agg)
@@ -388,7 +400,7 @@ class VerifyEngine:
             from ..ops import bls381 as dbls
 
             ok = dbls.verify_aggregate_common(pks, req.msg, agg)
-        item.reply_fn([bool(ok)])
+        reply([bool(ok)], cacheable=True)
 
     def _verify_submit(self, msgs, pks, sigs):
         """Dispatch one slice; returns fetch() -> (n,) bool mask."""

@@ -40,6 +40,23 @@ struct Digest {
   }
 };
 
+// Field moduli of the curves whose signatures cross the sidecar wire,
+// as big-endian hex.  The C++ node never computes in these fields (all
+// field math lives in OpenSSL or the JAX sidecar); the literals document
+// the crypto contract, and graftlint's wire cross-checker asserts they
+// match the Python sources (ops/field25519.py, utils/intmath.py,
+// ops/field381.py, offchain/bls12381.py) — edit BOTH sides or the gate
+// fails.
+constexpr char kEd25519FieldPrimeHex[] =  // 2^255 - 19
+    "7fffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffed";
+constexpr char kBls381FieldPrimeHex[] =
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf"
+    "6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaaab";
+static_assert(sizeof(kEd25519FieldPrimeHex) == 65,
+              "ed25519 field prime must be 32 bytes of hex");
+static_assert(sizeof(kBls381FieldPrimeHex) == 97,
+              "bls12-381 field prime must be 48 bytes of hex");
+
 // SHA-512 truncated to 32 bytes — the digest function used for every hash in
 // the reference (e.g. consensus/src/messages.rs:80-89).
 Digest sha512_digest(const uint8_t* data, size_t len);

@@ -20,14 +20,17 @@ constexpr uint8_t kOpBlsVerifyMulti = 6;
 constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
+// Every message this client ships is a 32-byte digest (protocol.py
+// DIGEST_LEN; graftlint cross-checks the two).
+constexpr size_t kDigestLen = 32;
 std::unique_ptr<TpuVerifier> g_instance;
 
 void write_header(Writer* w, uint8_t opcode, uint32_t rid, uint32_t count) {
   w->u8(opcode);
   w->u32(rid);
   w->u32(count);
-  w->u8(32);  // msg_len lo (u16 LE): digests are 32 bytes
-  w->u8(0);   // msg_len hi
+  w->u8(kDigestLen & 0xFF);  // msg_len lo (u16 LE)
+  w->u8(kDigestLen >> 8);    // msg_len hi
 }
 }  // namespace
 

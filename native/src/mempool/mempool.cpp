@@ -31,6 +31,11 @@ std::unique_ptr<Mempool> Mempool::spawn(
   mp->closers_.push_back([tx_processor] { tx_processor->close(); });
   mp->closers_.push_back([tx_helper] { tx_helper->close(); });
   mp->closers_.push_back([rx_consensus] { rx_consensus->close(); });
+  // tx_consensus is caller-owned but the peer-receiver's reactor BLOCKS
+  // in send() on it (digest delivery must not drop); closing it here is
+  // what guarantees stop() can always unwedge that send, even if a
+  // caller wired the channel bounded.
+  mp->closers_.push_back([tx_consensus] { tx_consensus->close(); });
 
   mp->threads_.push_back(
       Synchronizer::spawn(name, committee, store, parameters.gc_depth,
@@ -99,9 +104,18 @@ std::unique_ptr<Mempool> Mempool::spawn(
                 // consensus); ~25 us of SHA-512 on the reactor thread.
                 Digest digest = Processor::digest_of(msg);
                 if (store.try_write(digest.to_bytes(), &msg)) {
-                  if (!tx_consensus->try_send(digest)) {
+                  // Once stored, the batch bytes are consumed and the
+                  // sender saw an ACK — the digest MUST reach consensus
+                  // or this node can never propose the batch.  The node
+                  // wires this channel unbounded (node.cpp; digests are
+                  // 32 B), so this send never blocks there; a caller
+                  // that mis-wires a bounded channel gets reactor
+                  // backpressure instead of silent digest loss, and a
+                  // false return means the channel closed at shutdown.
+                  if (!tx_consensus->send(digest)) {
                     LOG_WARN("mempool::mempool")
-                        << "consensus digest queue full; dropping digest";
+                        << "consensus digest channel closed; dropping "
+                           "digest during shutdown";
                   }
                 } else if (!tx_processor->try_send(std::move(msg))) {
                   // Overflow lane: a stalled store worker (WAL compaction

@@ -15,6 +15,7 @@
 
 #include "common/log.hpp"
 #include "network/socket.hpp"
+#include "node/rate_pacer.hpp"
 
 using namespace hotstuff;
 
@@ -87,14 +88,13 @@ int main(int argc, char** argv) {
     return 1;
   }
 
-  // Bursts of rate/kPrecision every 1/kPrecision s; below kPrecision tx/s
-  // (large committees splitting a modest total rate) degrade gracefully to
-  // 1-tx bursts on a stretched interval instead of refusing to run
-  // (client.rs asserts the same floor; the harness divides rate by
-  // committee size, so N=100 at 1k tx/s total must be expressible).
-  const uint64_t burst = std::max<uint64_t>(1, rate / kPrecision);
-  const uint64_t burst_ms =
-      rate >= kPrecision ? kBurstDurationMs : 1000 / rate;
+  // One tick every 1/kPrecision s; the pacer carries the rate/kPrecision
+  // remainder across ticks so the offered load matches --rate exactly at
+  // EVERY rate >= 1 (truncation used to under-deliver [kPrecision,
+  // 2*kPrecision) by up to 2x, and the harness divides the total rate by
+  // committee size, so per-client rates land in that band at scale).
+  // Sub-kPrecision rates emit empty ticks in between 1-tx bursts.
+  RatePacer pacer{rate, kPrecision};
   std::mt19937_64 rng(std::random_device{}());
   uint64_t r = rng();
   uint64_t counter = 0;
@@ -103,11 +103,13 @@ int main(int argc, char** argv) {
   // NOTE: This log entry is used to compute performance.
   LOG_INFO("client") << "Start sending transactions";
 
-  auto interval = std::chrono::milliseconds(burst_ms);
+  auto interval = std::chrono::milliseconds(kBurstDurationMs);
   auto next_tick = std::chrono::steady_clock::now() + interval;
   while (true) {
     std::this_thread::sleep_until(next_tick);
     next_tick += interval;
+    const uint64_t burst = pacer.next_burst();
+    if (burst == 0) continue;  // sub-kPrecision rate: skip this tick
     auto burst_start = std::chrono::steady_clock::now();
     for (uint64_t x = 0; x < burst; x++) {
       uint64_t id;
@@ -128,7 +130,7 @@ int main(int argc, char** argv) {
     }
     auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
         std::chrono::steady_clock::now() - burst_start);
-    if (elapsed.count() > int64_t(burst_ms)) {
+    if (elapsed.count() > int64_t(kBurstDurationMs)) {
       // NOTE: This log entry is used to compute performance.
       LOG_WARN("client") << "Transaction rate too high for this client";
     }

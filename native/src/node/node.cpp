@@ -56,7 +56,12 @@ std::unique_ptr<Node> Node::create(const std::string& committee_file,
 
   SignatureService signature_service(secret.secret);
 
-  auto tx_mempool_to_consensus = make_channel<Digest>();
+  // Effectively unbounded (like the mempool synchronizer's payload-waiter
+  // channel): a digest is 32 bytes, and the mempool's inlined peer-batch
+  // path try_sends here AFTER the batch is stored and ACKed — a bounded
+  // channel would drop the digest under a consensus backlog and the
+  // stored batch could never be proposed by this node (round-5 ADVICE.md).
+  auto tx_mempool_to_consensus = make_channel<Digest>(SIZE_MAX);
   auto tx_consensus_to_mempool =
       make_channel<mempool::ConsensusMempoolMessage>();
 

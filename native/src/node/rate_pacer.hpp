@@ -1,0 +1,29 @@
+// Exact open-loop pacing for the benchmark client.  The old scheme sent
+// floor(rate / precision) transactions per tick, which under-delivers
+// every rate that truncates — worst in [precision, 2*precision), where
+// e.g. --rate 39 at precision 20 sent 20 tx/s, half the run label
+// (round-5 ADVICE.md).  The pacer carries the remainder across ticks so
+// the offered load over any whole second equals `rate` exactly, for
+// every rate >= 1 (sub-precision rates emit empty ticks in between).
+#pragma once
+
+#include <cstdint>
+
+namespace hotstuff {
+
+struct RatePacer {
+  uint64_t rate;       // offered load, tx/s
+  uint64_t precision;  // ticks per second
+  uint64_t acc = 0;    // carried remainder, always < precision
+
+  // Number of transactions to send on this tick.  Summed over any
+  // precision consecutive ticks (one second) this is exactly `rate`.
+  uint64_t next_burst() {
+    acc += rate;
+    uint64_t burst = acc / precision;
+    acc -= burst * precision;
+    return burst;
+  }
+};
+
+}  // namespace hotstuff

@@ -271,4 +271,44 @@ TEST(mempool_pipeline_end_to_end) {
   for (auto& t : threads) t.join();
 }
 
+TEST(peer_batch_digest_survives_consensus_backlog) {
+  // A stored+ACKed peer batch must remain proposable even when consensus
+  // has a deep backlog: the inlined peer-batch path try_sends the digest
+  // AFTER the batch bytes are consumed, so the node wires the digest
+  // channel unbounded (node.cpp).  Replicate that wiring, never drain,
+  // and push well past the default channel capacity — every digest must
+  // survive (a bounded channel silently dropped them, round-5 ADVICE.md).
+  auto committee = mempool_committee(7700);
+  auto myself = keys()[0].name;
+
+  Store store = Store::open("");
+  Parameters params;
+  params.batch_size = 1'000'000;  // nothing seals: only peer batches flow
+  params.max_batch_delay = 60'000;
+  auto rx_consensus = make_channel<ConsensusMempoolMessage>();
+  auto tx_consensus = make_channel<Digest>(SIZE_MAX);  // the node wiring
+  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
+                           tx_consensus);
+
+  auto sock = Socket::connect(*committee.mempool_address(myself));
+  CHECK(sock.has_value());
+  sock->set_recv_timeout(10000);
+  const size_t kBatches = kChannelCapacity + 64;
+  for (size_t i = 0; i < kBatches; i++) {
+    Bytes tx(16, 0);
+    for (int b = 0; b < 8; b++) tx[b] = (i >> (8 * b)) & 0xFF;
+    auto frame = MempoolMessage::make_batch({tx}).serialize();
+    CHECK(sock->write_frame(frame));
+    Bytes ack;  // every peer message is ACKed before processing
+    CHECK(sock->read_frame(&ack));
+  }
+  // All digests arrived (nothing was dropped) and every batch is stored.
+  for (size_t i = 0; i < kBatches; i++) {
+    auto digest = tx_consensus->recv();
+    CHECK(digest.has_value());
+    CHECK(store.read(digest->to_bytes()).has_value());
+  }
+  mp->stop();
+}
+
 int main() { return run_all(); }

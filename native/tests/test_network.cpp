@@ -11,6 +11,7 @@
 #include "network/receiver.hpp"
 #include "network/reliable_sender.hpp"
 #include "network/simple_sender.hpp"
+#include "node/rate_pacer.hpp"
 #include "test_util.hpp"
 
 using namespace hotstuff;
@@ -273,6 +274,50 @@ TEST(reactor_multiplexes_many_connections) {
     CHECK(got.has_value());
   }
   receiver.stop();
+}
+
+TEST(rate_pacer_delivers_exact_rate) {
+  // The load generator's pacing (node/client.cpp): over any whole number
+  // of seconds the sum of bursts must equal rate * seconds EXACTLY —
+  // truncation used to under-deliver [kPrecision, 2*kPrecision) by up to
+  // 2x, misstating the offered load in the run label.
+  constexpr uint64_t kPrecision = 20;
+  for (uint64_t rate : {uint64_t(1), uint64_t(7), uint64_t(19),
+                        uint64_t(20), uint64_t(21), uint64_t(39),
+                        uint64_t(40), uint64_t(1000), uint64_t(12345)}) {
+    RatePacer pacer{rate, kPrecision};
+    uint64_t sent = 0;
+    constexpr uint64_t kSeconds = 10;
+    for (uint64_t tick = 0; tick < kPrecision * kSeconds; tick++) {
+      sent += pacer.next_burst();
+    }
+    CHECK(sent == rate * kSeconds);
+    CHECK(pacer.acc == 0);  // whole seconds leave no remainder
+  }
+}
+
+TEST(rate_pacer_truncation_band) {
+  // The ADVICE.md example: --rate 39 must send 39 tx in 20 ticks (the
+  // old code sent 20), and no single tick may burst more than the exact
+  // rational rate rounds up to.
+  RatePacer pacer{39, 20};
+  uint64_t sent = 0;
+  for (int tick = 0; tick < 20; tick++) {
+    uint64_t burst = pacer.next_burst();
+    CHECK(burst <= 2);
+    sent += burst;
+  }
+  CHECK(sent == 39);
+  // Sub-precision rates average out exactly too: 5 tx/s = one 1-tx burst
+  // every 4th tick.
+  RatePacer slow{5, 20};
+  uint64_t slow_sent = 0;
+  for (int tick = 0; tick < 40; tick++) {
+    uint64_t burst = slow.next_burst();
+    CHECK(burst <= 1);
+    slow_sent += burst;
+  }
+  CHECK(slow_sent == 10);
 }
 
 int main() { return run_all(); }

@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""CI gate: run graftlint (python -m hotstuff_tpu.analysis) from anywhere.
+
+Exit status is the number-of-findings truth: 0 clean, 1 findings, 2 bad
+usage.  Every perf PR runs this before benching — the rules it enforces
+are exactly the silent-degradation class (host syncs, retraces, wire
+drift) that a green unit-test run does not catch.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    from hotstuff_tpu.analysis.__main__ import main
+
+    argv = sys.argv[1:]
+    if not any(a == "--root" or a.startswith("--root=") for a in argv):
+        argv += ["--root", REPO]
+    sys.exit(main(argv))

@@ -1,0 +1,380 @@
+"""graftlint tests: every rule fires on a known-bad fixture and stays
+quiet on a known-good one; the repaired tree lints clean; the sanitizer
+wiring builds and runs (tier-2, slow-marked).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from hotstuff_tpu.analysis import hotpath, sanitize, wirecheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(src: str):
+    return hotpath.check_sources(
+        {"mod.py": textwrap.dedent(src)})
+
+
+def rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# hot-path rules
+# ---------------------------------------------------------------------------
+
+def test_host_sync_in_jit_fires_on_item_and_casts():
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def verify_mask(x):
+            n = int(x.sum())          # host round trip
+            y = x * 2
+            host = np.asarray(y)      # device->host copy
+            return host[:n], y.max().item()
+        """)
+    assert rules(findings) == {"host-sync-in-jit"}
+    assert len(findings) == 3
+
+
+def test_host_sync_quiet_on_host_helpers_and_static_shapes():
+    findings = lint("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def to_limbs(x: int):
+            return np.array([int(x) >> i for i in range(4)],
+                            dtype=np.int32)
+
+        @jax.jit
+        def verify_mask(x, table=()):
+            n = x.shape[0]            # static: .shape launders
+            rows = int(n // 2)        # python int math, not traced
+            return x.reshape(rows, -1).astype(jnp.int32)
+        """)
+    assert findings == []
+
+
+def test_traced_branch_fires_and_static_branch_is_quiet():
+    bad = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.sum() > 0:           # concretization error / retrace
+                return x
+            return -x
+        """)
+    assert rules(bad) == {"traced-branch"}
+    good = lint("""
+        import jax
+
+        def dbl(p, with_t: bool = True):
+            if with_t:                # static python config param
+                return p + p
+            return p
+
+        @jax.jit
+        def f(x):
+            if x.ndim == 2:           # laundered: shape metadata
+                return dbl(x, with_t=False)
+            return dbl(x)
+        """)
+    assert good == []
+
+
+def test_mutable_default_arg_fires_only_on_hot_functions():
+    bad = lint("""
+        import jax
+
+        @jax.jit
+        def f(x, opts={}):
+            return x
+        """)
+    assert rules(bad) == {"mutable-default-arg"}
+    good = lint("""
+        def host_helper(x, opts={}):   # not jit-reachable
+            return x
+        """)
+    assert good == []
+
+
+def test_f64_literal_fires_on_promotion_and_dtype():
+    bad = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = x * 1.5                       # f64 under x64
+            return jnp.zeros(4, dtype=jnp.float64), y
+        """)
+    assert rules(bad) == {"f64-literal"}
+    assert len(bad) == 2
+    good = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        SCALE = 1.5  # host-side constant, folded at trace time
+
+        @jax.jit
+        def f(x):
+            return x.astype(jnp.float32) * jnp.float32(2)
+        """)
+    assert good == []
+
+
+def test_implicit_limb_dtype_fires_on_bare_constant_lists():
+    bad = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            bias = jnp.asarray([237, 255, 127])   # backend-dependent dtype
+            return x + bias
+        """)
+    assert rules(bad) == {"implicit-limb-dtype"}
+    good = lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            bias = jnp.asarray([237, 255, 127], dtype=jnp.int32)
+            return x + bias
+        """)
+    assert good == []
+
+
+def test_nondonated_buffer_fires_on_verify_entry_points():
+    bad = lint("""
+        import jax
+
+        def verify_packed(packed):
+            return packed.sum(-1)
+
+        verify_packed_jit = jax.jit(verify_packed)
+        """)
+    assert rules(bad) == {"nondonated-buffer"}
+    good = lint("""
+        import jax
+
+        def verify_packed(packed):
+            return packed.sum(-1)
+
+        def helper(fn):
+            return jax.jit(fn)        # not a verify_* symbol
+
+        verify_packed_jit = jax.jit(verify_packed, donate_argnums=0)
+        """)
+    assert good == []
+
+
+def test_suppression_comment_silences_a_rule():
+    findings = lint("""
+        import jax
+
+        def verify_packed(packed):
+            return packed.sum(-1)
+
+        # profiling scripts re-time one device-resident input
+        # graftlint: disable=nondonated-buffer
+        verify_packed_jit = jax.jit(verify_packed)
+        """)
+    assert findings == []
+
+
+def test_taint_follows_cross_module_calls():
+    """A hot function calling into a field module taints the callee's
+    params — the rule fires in the callee file."""
+    findings = hotpath.check_sources({
+        "field.py": textwrap.dedent("""
+            def mul(a, b):
+                return int(a) * b       # host sync on a traced value
+            """),
+        "curve.py": textwrap.dedent("""
+            import jax
+            from . import field as F
+
+            @jax.jit
+            def verify_mask(x):
+                return F.mul(x, x)
+            """),
+    })
+    assert [(f.path, f.rule) for f in findings] == \
+        [("field.py", "host-sync-in-jit")]
+
+
+def test_except_handler_bodies_are_linted():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            try:
+                return x * 2
+            except ValueError:
+                return int(x.sum())   # host sync hidden in an error path
+        """)
+    assert rules(findings) == {"host-sync-in-jit"}
+
+
+def test_from_jax_import_numpy_spelling_is_covered():
+    findings = lint("""
+        import jax
+        from jax import numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x + jnp.asarray([237, 255, 127])
+        """)
+    assert rules(findings) == {"implicit-limb-dtype"}
+
+
+def test_scan_and_shard_map_bodies_are_hot():
+    findings = lint("""
+        import jax
+        from jax import shard_map
+
+        def _make_body(cap: int):
+            def _body(a, present):
+                if a.sum() > cap:     # traced branch in a shard body
+                    return a
+                return a * present
+            return _body
+
+        fn = shard_map(_make_body(4), in_specs=None, out_specs=None)
+        checker = jax.jit(fn)
+        """)
+    assert rules(findings) == {"traced-branch"}
+
+
+# ---------------------------------------------------------------------------
+# wire/constants cross-checker (fixture trees under tmp_path)
+# ---------------------------------------------------------------------------
+
+WIRE_FILES = (wirecheck.PROTOCOL, wirecheck.SIDECAR_CLIENT,
+              wirecheck.CRYPTO_HPP, wirecheck.FIELD25519,
+              wirecheck.INTMATH, wirecheck.FIELD381, wirecheck.BLS12381)
+
+
+@pytest.fixture()
+def wire_tree(tmp_path):
+    """Copy of the real tree's cross-checked files: the known-good base
+    every bad fixture mutates — so the tests track the real sources."""
+    for rel in WIRE_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    return tmp_path
+
+
+def _mutate(tree, rel, old, new):
+    path = tree / rel
+    text = path.read_text()
+    assert old in text, f"fixture drift: {old!r} not in {rel}"
+    path.write_text(text.replace(old, new))
+
+
+def test_wire_checker_quiet_on_consistent_tree(wire_tree):
+    assert wirecheck.check(str(wire_tree)) == []
+
+
+def test_wire_tag_mismatch_fires_on_one_sided_opcode_edit(wire_tree):
+    _mutate(wire_tree, wirecheck.SIDECAR_CLIENT,
+            "kOpBlsSign = 4", "kOpBlsSign = 9")
+    findings = wirecheck.check(str(wire_tree))
+    assert rules(findings) == {"wire-tag-mismatch"}
+    assert "kOpBlsSign" in findings[0].message
+
+
+def test_wire_length_mismatch_fires_on_bls_and_digest_drift(wire_tree):
+    _mutate(wire_tree, wirecheck.PROTOCOL,
+            "BLS_SIG_LEN = 192", "BLS_SIG_LEN = 96")
+    _mutate(wire_tree, wirecheck.SIDECAR_CLIENT,
+            "kDigestLen = 32", "kDigestLen = 20")
+    findings = wirecheck.check(str(wire_tree))
+    assert rules(findings) == {"wire-length-mismatch"}
+    assert len(findings) >= 2  # kBlsSigLen drift + digest drift
+
+
+def test_field_modulus_mismatch_fires_on_one_sided_edit(wire_tree):
+    _mutate(wire_tree, wirecheck.FIELD25519,
+            "P = 2**255 - 19", "P = 2**255 - 21")
+    findings = wirecheck.check(str(wire_tree))
+    assert rules(findings) == {"field-modulus-mismatch"}
+    assert any(f.path == wirecheck.FIELD25519 for f in findings)
+
+
+def test_field_modulus_mismatch_fires_on_cpp_hex_edit(wire_tree):
+    _mutate(wire_tree, wirecheck.CRYPTO_HPP,
+            "b9feffffffffaaab", "b9feffffffffaaad")
+    findings = wirecheck.check(str(wire_tree))
+    assert rules(findings) == {"field-modulus-mismatch"}
+
+
+# ---------------------------------------------------------------------------
+# sanitizer wiring
+# ---------------------------------------------------------------------------
+
+def test_sanitizer_wiring_quiet_on_real_tree():
+    assert sanitize.check(REPO) == []
+
+
+def test_sanitizer_wiring_fires_when_preset_or_script_missing(tmp_path):
+    native = tmp_path / "native"
+    native.mkdir()
+    (native / "CMakeLists.txt").write_text(
+        "project(x CXX)\n")  # no GRAFT_SANITIZE, no -fsanitize
+    findings = sanitize.check(str(tmp_path))
+    assert rules(findings) == {"sanitizer-wiring"}
+    assert any("native_sanitize.sh missing" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# the gate itself
+# ---------------------------------------------------------------------------
+
+def test_gate_exits_clean_on_repaired_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hotstuff_tpu.analysis", "--root", REPO],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftlint: clean" in proc.stdout
+
+
+def test_gate_exits_nonzero_on_findings(tmp_path):
+    # An empty tree is missing every anchor: the gate must fail loudly,
+    # not skip silently.
+    proc = subprocess.run(
+        [sys.executable, "-m", "hotstuff_tpu.analysis",
+         "--root", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "finding" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# tier-2: native sanitizer build-and-run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # full native rebuild per sanitizer: minutes
+@pytest.mark.parametrize("mode", ["address", "undefined"])
+def test_native_sanitize_builds_and_runs(mode):
+    script = os.path.join(REPO, "scripts", "native_sanitize.sh")
+    proc = subprocess.run(
+        [script, mode, "serde", "store"], cwd=REPO,
+        capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    assert f"all tests clean under {mode}" in proc.stdout

@@ -228,3 +228,65 @@ def test_sidecar_boot_degrades_to_host_crypto():
     with pytest.raises(BenchError):
         bench._boot_sidecar(host_crypto=True)
     assert kills
+
+
+# ---------------------------------------------------------------------------
+# bench.py headline emit: the live measurement is always the headline and
+# the cache is namespaced by the kernel-source hash (round-5 ADVICE.md
+# high: the old final emit was a monotonic ratchet a regression could
+# never lower).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def bench_mod(tmp_path, monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "CACHE_PATH",
+                        str(tmp_path / "headline_cache.json"))
+    return bench
+
+
+def _emitted_lines(capsys):
+    return [json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()]
+
+
+def test_final_emit_headline_is_live_measurement(bench_mod, capsys):
+    bench_mod.save_cache(100_000.0, 10.0, 10_000.0)  # best on record
+    bench_mod.emit_final(60_000.0, 10_000.0)         # live run regressed
+    (line,) = _emitted_lines(capsys)
+    assert line["value"] == 60_000.0, "headline must be the live reading"
+    assert line["vs_baseline"] == 6.0
+    assert line["best_on_record"] == 100_000.0
+    assert "source" not in line  # not a cached-measurement line
+
+
+def test_final_emit_no_secondary_when_live_is_best(bench_mod, capsys):
+    bench_mod.save_cache(50_000.0, 5.0, 10_000.0)
+    bench_mod.emit_final(60_000.0, 10_000.0)
+    (line,) = _emitted_lines(capsys)
+    assert line["value"] == 60_000.0
+    assert "best_on_record" not in line
+
+
+def test_cache_namespaced_by_kernel_hash(bench_mod):
+    bench_mod.save_cache(100_000.0, 10.0, 10_000.0)
+    assert bench_mod.load_cache()["value"] == 100_000.0
+    # A best recorded by different kernel sources must not answer for
+    # this tree: stamp a foreign kernel hash and reload.
+    with open(bench_mod.CACHE_PATH) as f:
+        cached = json.load(f)
+    cached["kernel"] = "0" * 16
+    with open(bench_mod.CACHE_PATH, "w") as f:
+        json.dump(cached, f)
+    assert bench_mod.load_cache() is None
+    # ... and save_cache starts fresh rather than comparing against it.
+    bench_mod.save_cache(10_000.0, 1.0, 10_000.0)
+    assert bench_mod.load_cache()["value"] == 10_000.0
+
+
+def test_save_cache_keeps_best_for_same_kernel(bench_mod):
+    bench_mod.save_cache(100_000.0, 10.0, 10_000.0)
+    bench_mod.save_cache(60_000.0, 6.0, 10_000.0)  # lower: not stored
+    assert bench_mod.load_cache()["value"] == 100_000.0

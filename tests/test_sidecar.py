@@ -285,3 +285,53 @@ def test_bls_verdict_cache_dedups_pairings(host_server):
         assert client.bls_verify_aggregate(msg2, agg2, pk_enc)
     assert any(k and isinstance(k, tuple) and k[0] == "ba"
                for k in engine._verdicts)
+
+
+def test_bls_transient_failure_replies_none_and_never_caches(host_server):
+    """The verdict cache is shared by every replica, so a TRANSIENT
+    failure (wedged device, backend exception) must reply None and leave
+    the cache untouched — a cached [False] would reject a valid
+    certificate fleet-wide.  Verdicts enter the cache only at the
+    explicit cacheable=True sites in _execute_bls."""
+    from unittest.mock import patch
+
+    from hotstuff_tpu.offchain import bls12381 as bls
+    from hotstuff_tpu.sidecar import service
+
+    engine = host_server.engine
+    keys = [bls.key_gen(bytes([60 + i]) * 32) for i in range(1, 4)]
+    msg = b"transient" * 4
+    pk_enc = [bls.g1_encode(pk) for _, pk in keys]
+    agg = bls.g2_encode(bls.aggregate([bls.sign(sk, msg)
+                                       for sk, _ in keys]))
+    req = proto.BlsAggRequest(9, msg, agg, pk_enc)
+    key = engine.bls_cache_key(req)
+    assert key not in engine._verdicts
+
+    # Engine-thread behavior under a transient backend failure: the
+    # exception escapes _execute_bls (its _run caller replies None).
+    replies = []
+    with patch.object(bls, "verify_aggregate_common",
+                      side_effect=RuntimeError("device wedged")):
+        with pytest.raises(RuntimeError):
+            engine._execute_bls(service._Pending(req, replies.append))
+    assert replies == [], "no cacheable reply may fire on the error path"
+    assert key not in engine._verdicts, "transient failure poisoned cache"
+
+    # A retry without the fault verifies and NOW caches the true verdict.
+    engine._execute_bls(service._Pending(req, replies.append))
+    assert replies == [[True]]
+    assert engine._verdicts[key] is True
+
+
+def test_bls_decode_failure_is_cacheable_false(host_server):
+    """Decode failures are a pure function of the request bytes, so they
+    cache as False (same request -> same rejection, no pairing)."""
+    from hotstuff_tpu.sidecar import service
+
+    engine = host_server.engine
+    req = proto.BlsAggRequest(11, b"m" * 32, b"\x01" * 192, [b"\x02" * 96])
+    replies = []
+    engine._execute_bls(service._Pending(req, replies.append))
+    assert replies == [[False]]
+    assert engine._verdicts[engine.bls_cache_key(req)] is False

@@ -11,11 +11,11 @@ import argparse
 import os
 import sys
 
-CHECKERS = ("hotpath", "wire", "sanitize")
+CHECKERS = ("hotpath", "wire", "sanitize", "padshape")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import hotpath, sanitize, wirecheck
+    from . import hotpath, padshape, sanitize, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -24,6 +24,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += wirecheck.check(root)
     if "sanitize" in checkers:
         findings += sanitize.check(root)
+    if "padshape" in checkers:
+        findings += padshape.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -32,6 +34,34 @@ def run_all(root: str, checkers=CHECKERS) -> list:
             seen.add(key)
             unique.append(f)
     return unique
+
+
+def check_coverage(root: str, must_cover) -> list:
+    """Assert each repo-relative file exists and is scanned by the
+    hot-path checker's target set — the gate for 'this new device module
+    MUST be linted' requirements (scripts/lint_gate.py pins the RLC
+    scalar module this way)."""
+    from . import hotpath
+    from .common import Finding
+
+    findings = []
+    for rel in must_cover:
+        norm = rel.replace(os.sep, "/")
+        if not os.path.isfile(os.path.join(root, rel)):
+            findings.append(Finding(
+                rel, 1, "must-cover",
+                "required module is missing from the tree"))
+            continue
+        covered = any(
+            norm == t or norm.startswith(t.rstrip("/") + "/")
+            for t in hotpath.DEFAULT_TARGETS)
+        if not covered:
+            findings.append(Finding(
+                rel, 1, "must-cover",
+                "file is outside the hotpath scan targets "
+                f"({', '.join(hotpath.DEFAULT_TARGETS)}); add it to "
+                "hotpath.DEFAULT_TARGETS or move it"))
+    return findings
 
 
 def _default_root() -> str:
@@ -48,9 +78,15 @@ def main(argv=None) -> int:
                     help="repo root to lint (default: this checkout)")
     ap.add_argument("--checker", action="append", choices=CHECKERS,
                     help="run only this checker (repeatable; default all)")
+    ap.add_argument("--must-cover", action="append", metavar="RELPATH",
+                    help="fail unless this repo-relative file exists AND "
+                         "lies inside a hotpath scan target (guards "
+                         "against a new device module silently escaping "
+                         "the lint; repeatable)")
     args = ap.parse_args(argv)
     checkers = tuple(args.checker) if args.checker else CHECKERS
     findings = run_all(args.root, checkers)
+    findings += check_coverage(args.root, args.must_cover or ())
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
         print(f.render())
     if findings:

@@ -1,0 +1,221 @@
+"""graftlint padded-bucket checker: every device launch size must be a
+shape the sidecar warmup compiles.
+
+The engine pre-compiles a CLOSED set of batch shapes before it binds its
+socket (sidecar/service._warmup / _warmup_bulk): power-of-two buckets
+from the _MIN_BUCKET floor up to MAX_SUBBATCH, then chunked-scan shapes
+of 2..16 sub-batches.  Any launch whose size is NOT in that set triggers
+a first-time XLA compile on the engine thread mid-traffic — the silent
+30-60 s stall the warmup exists to prevent, and invisible to unit tests
+(CPU compiles are fast enough to pass).  The RLC/MSM path added its own
+launch shapes, which makes the discipline load-bearing in three modules
+instead of one — so it graduates from a code-review convention to a
+mechanical rule.
+
+Rules:
+  padded-bucket   (a) a function that fires a device launch (a
+                  ``*_donated`` production entry or a ``_cached_*``
+                  mesh verifier) without computing its size through a
+                  bucket helper (``next_pow2`` / ``_bucket``);
+                  (b) warmup/bucket constant drift: the service warmup
+                  floor must equal crypto/eddsa._MIN_BUCKET, and
+                  MAX_COALESCED must be a power-of-two multiple of
+                  MAX_SUBBATCH (the exact chunk counts _warmup_bulk
+                  compiles).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .common import (Finding, _eval_int, apply_suppressions,
+                     module_int_constants)
+from .hotpath import _attr_chain
+
+# The modules whose functions launch padded device programs.
+DEFAULT_TARGETS = (
+    "hotstuff_tpu/crypto/eddsa.py",
+    "hotstuff_tpu/parallel/sharded_verify.py",
+)
+
+EDDSA = "hotstuff_tpu/crypto/eddsa.py"
+SERVICE = "hotstuff_tpu/sidecar/service.py"
+
+# Helpers that implement THE bucketing rule (crypto/eddsa.next_pow2 and
+# its module-private wrapper).  A launch-bearing function must route its
+# size through one of these.
+_BUCKET_HELPERS = {"next_pow2", "_bucket"}
+
+# A launch: calling a donated production entry point or a cached mesh
+# verifier.  ``_jit_donated`` itself is the factory, not a launch.
+_LAUNCH_RE = re.compile(r"(^_cached_\w+$)|(^(?!_jit_donated$)\w+_donated$)")
+
+
+def _terminal_name(call: ast.Call) -> str | None:
+    chain = _attr_chain(call.func)
+    if chain:
+        return chain[-1]
+    # _cached_verifier(mesh, n)(*arrays): the launch is the OUTER call;
+    # its func is the inner Call — resolve that inner call's name.
+    if isinstance(call.func, ast.Call):
+        return _terminal_name(call.func)
+    return None
+
+
+def _check_launch_bucketing(path: str, source: str) -> list:
+    findings = []
+    tree = ast.parse(source, filename=path)
+    for fn in tree.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        launches, bucketed = [], False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node)
+            if name is None:
+                continue
+            if name in _BUCKET_HELPERS:
+                bucketed = True
+            elif _LAUNCH_RE.match(name):
+                launches.append((node, name))
+        if launches and not bucketed:
+            for node, name in launches:
+                findings.append(Finding(
+                    path, node.lineno, "padded-bucket",
+                    f"{fn.name}() launches {name} without routing the "
+                    "batch size through next_pow2/_bucket: a non-bucket "
+                    "shape compiles on the engine thread mid-traffic "
+                    "(warmup only covers power-of-two buckets)"))
+    return findings
+
+
+def _line_of(source: str, pattern: str) -> int:
+    m = re.search(pattern, source, re.MULTILINE)
+    return source[:m.start()].count("\n") + 1 if m else 1
+
+
+def _warmup_floor(service_src: str) -> int | None:
+    """The literal start size _warmup hands _warm_shapes."""
+    tree = ast.parse(service_src)
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.FunctionDef) and fn.name == "_warmup":
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id == "_warm_shapes" and \
+                        len(node.args) >= 2 and \
+                        isinstance(node.args[1], ast.Constant) and \
+                        isinstance(node.args[1].value, int):
+                    return node.args[1].value
+    return None
+
+
+def _check_warmup_constants(root: str) -> list:
+    findings = []
+
+    def _read(rel):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    eddsa_src = _read(EDDSA)
+    service_src = _read(SERVICE)
+    if eddsa_src is None or service_src is None:
+        for rel, src in ((EDDSA, eddsa_src), (SERVICE, service_src)):
+            if src is None:
+                findings.append(Finding(
+                    rel, 1, "padded-bucket",
+                    "source file not found — the warmup cross-check "
+                    "cannot anchor; fix the source or update padshape.py"))
+        return findings
+
+    eddsa_consts = module_int_constants(eddsa_src, EDDSA)
+    min_bucket = eddsa_consts.get("_MIN_BUCKET")
+    max_subbatch = eddsa_consts.get("MAX_SUBBATCH")
+    floor = _warmup_floor(service_src)
+    if min_bucket is None or max_subbatch is None:
+        findings.append(Finding(
+            EDDSA, 1, "padded-bucket",
+            "_MIN_BUCKET/MAX_SUBBATCH not found — the warmup cross-check "
+            "cannot anchor"))
+        return findings
+    if floor is None:
+        findings.append(Finding(
+            SERVICE, _line_of(service_src, r"^def _warmup\b"),
+            "padded-bucket",
+            "_warmup's _warm_shapes start literal not found — the "
+            "warmup floor cross-check cannot anchor"))
+    elif floor != min_bucket:
+        findings.append(Finding(
+            SERVICE, _line_of(service_src, r"^def _warmup\b"),
+            "padded-bucket",
+            f"warmup floor {floor} != crypto/eddsa._MIN_BUCKET "
+            f"{min_bucket}: requests bucketed below the warmed floor "
+            "hit a cold shape mid-traffic"))
+
+    # MAX_COALESCED must be a power-of-two multiple of MAX_SUBBATCH:
+    # _warmup_bulk compiles chunk counts 2, 4, ... MAX_COALESCED /
+    # MAX_SUBBATCH, and the chunked dispatch pads its chunk count to a
+    # power of two — any other ratio leaves a launchable shape unwarmed.
+    service_consts = module_int_constants(service_src, SERVICE)
+    max_coalesced = service_consts.get("MAX_COALESCED")
+    if max_coalesced is None:
+        # MAX_COALESCED = 16 * MAX_SUBBATCH references an import the
+        # plain constant scrape cannot see; evaluate it with the eddsa
+        # constants in scope.
+        tree = ast.parse(service_src)
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "MAX_COALESCED":
+                try:
+                    max_coalesced = _eval_int(node.value, dict(eddsa_consts))
+                except ValueError:
+                    pass
+    if max_coalesced is None:
+        findings.append(Finding(
+            SERVICE, 1, "padded-bucket",
+            "MAX_COALESCED not found — the bulk-warmup cross-check "
+            "cannot anchor"))
+    else:
+        ratio, ok = divmod(max_coalesced, max_subbatch)
+        if ok != 0 or ratio < 1 or (ratio & (ratio - 1)) != 0:
+            findings.append(Finding(
+                SERVICE, _line_of(service_src, r"^MAX_COALESCED\s*="),
+                "padded-bucket",
+                f"MAX_COALESCED={max_coalesced} is not a power-of-two "
+                f"multiple of MAX_SUBBATCH={max_subbatch}: the chunked "
+                "dispatch pads chunk counts to powers of two, so a "
+                "coalesced backlog could launch a shape _warmup_bulk "
+                "never compiled"))
+    return findings
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: python source} mapping (unit-test entry point):
+    launch-bucketing only — the warmup constant cross-check needs the
+    real tree (see check)."""
+    findings = []
+    for path, src in sources.items():
+        findings += _check_launch_bucketing(path, src)
+    return sorted(apply_suppressions(findings, sources),
+                  key=lambda f: (f.path, f.line))
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    sources = {}
+    for rel in targets:
+        path = os.path.join(root, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                sources[rel] = f.read()
+        except OSError:
+            continue
+    findings = check_sources(sources)
+    findings += _check_warmup_constants(root)
+    return findings

@@ -14,6 +14,11 @@ Rules:
                           between protocol.py and sidecar_client.cpp
   wire-length-mismatch    fixed record sizes differ: digest, Ed25519
                           pk/sig, BLS pk/sig/sk byte lengths
+  wire-header-mismatch    the header field layout drifted: protocol.py's
+                          ``struct`` format strings (_HDR / _REPLY_HDR)
+                          no longer match the byte sequence
+                          ``write_header`` emits (or the reply-rid
+                          offsets the C++ reader parses)
   field-modulus-mismatch  the 2^255-19 / BLS12-381 field modulus
                           literals disagree across ops/field25519.py,
                           utils/intmath.py, ops/field381.py,
@@ -94,6 +99,81 @@ def cpp_struct_array_len(source: str, struct: str) -> int | None:
     m = re.search(r"struct\s+%s\b.*?std::array<uint8_t,\s*(\d+)>\s+data"
                   % re.escape(struct), source, re.DOTALL)
     return int(m.group(1)) if m else None
+
+
+_STRUCT_WIDTHS = {"B": 1, "b": 1, "H": 2, "h": 2, "I": 4, "i": 4,
+                  "Q": 8, "q": 8, "x": 1}
+
+
+def py_struct_formats(source: str) -> dict:
+    """Top-level ``NAME = struct.Struct("fmt")`` assignments -> {name:
+    (fmt string, line)} (AST; no imports)."""
+    import ast
+
+    out = {}
+    tree = ast.parse(source)
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        call = node.value
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "Struct" \
+                and call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            out[node.targets[0].id] = (call.args[0].value, node.lineno)
+    return out
+
+
+def struct_fmt_fields(fmt: str):
+    """"<BIIH" -> (is_little_endian, [1, 4, 4, 2]).
+
+    Handles repeat counts ("2I" -> two 4-byte fields) and byte-string /
+    pad codes ("16s"/"4x" -> one 16-/4-byte field), so a layout-identical
+    format rewrite never trips the rule; an unknown code yields a None
+    width (flagged by the caller)."""
+    le = fmt[:1] == "<"
+    body = fmt[1:] if fmt[:1] in "<>!=@" else fmt
+    widths = []
+    for m in re.finditer(r"(\d*)(.)", body):
+        count = int(m.group(1)) if m.group(1) else 1
+        ch = m.group(2)
+        if ch.isspace():
+            continue
+        if ch in ("s", "p", "x"):
+            widths.append(count)  # one count-byte field
+        else:
+            widths.extend([_STRUCT_WIDTHS.get(ch)] * count)
+    return le, widths
+
+
+def cpp_write_header_widths(source: str):
+    """Byte widths of the Writer calls inside ``write_header``, in
+    order: [1, 4, 4, 1, 1] for u8/u32/u32/u8/u8.  None when the function
+    body is not found."""
+    m = re.search(r"void\s+write_header\s*\([^)]*\)\s*\{(.*?)\n\}",
+                  source, re.DOTALL)
+    if not m:
+        return None
+    return [int(b) // 8
+            for b in re.findall(r"w->u(8|16|32|64)\(", m.group(1))]
+
+
+def header_layouts_match(py_widths, cpp_widths) -> bool:
+    """Greedy coalescing compare: consecutive C++ writes may add up to
+    one wider Python field (the u16-as-two-u8 idiom in write_header)."""
+    if any(w is None for w in py_widths):
+        return False
+    i = 0
+    for want in py_widths:
+        got = 0
+        while got < want and i < len(cpp_widths):
+            got += cpp_widths[i]
+            i += 1
+        if got != want:
+            return False
+    return i == len(cpp_widths)
 
 
 def cpp_signature_lens(source: str) -> set:
@@ -187,6 +267,69 @@ def check(root: str) -> list:
                 "wire-length-mismatch",
                 f"Signature::deserialize accepts {sorted(lens_needed)} "
                 f"but {PROTOCOL} {py_name}={py[py_name]}"))
+
+    # -- header layouts ----------------------------------------------------
+    fmts = py_struct_formats(proto_src)
+    if "_HDR" not in fmts:
+        miss(PROTOCOL, "wire-header-mismatch", "_HDR struct format")
+    else:
+        fmt, line = fmts["_HDR"]
+        le, widths = struct_fmt_fields(fmt)
+        if not le:
+            findings.append(Finding(
+                PROTOCOL, line, "wire-header-mismatch",
+                f"_HDR format {fmt!r} is not explicit little-endian "
+                "('<'): the C++ Writer emits LE; native byte order "
+                "silently desyncs on a BE host"))
+        cpp_widths = cpp_write_header_widths(client_src)
+        if cpp_widths is None:
+            miss(SIDECAR_CLIENT, "wire-header-mismatch",
+                 "write_header body")
+        elif not header_layouts_match(widths, cpp_widths):
+            findings.append(Finding(
+                SIDECAR_CLIENT, _line_of(client_src,
+                                         r"void\s+write_header"),
+                "wire-header-mismatch",
+                f"write_header emits byte widths {cpp_widths} but "
+                f"{PROTOCOL} _HDR={fmt!r} parses {widths}: every "
+                "request frame desyncs after the header"))
+    if "_REPLY_HDR" not in fmts:
+        miss(PROTOCOL, "wire-header-mismatch", "_REPLY_HDR struct format")
+    else:
+        fmt, line = fmts["_REPLY_HDR"]
+        le, widths = struct_fmt_fields(fmt)
+        if not le:
+            findings.append(Finding(
+                PROTOCOL, line, "wire-header-mismatch",
+                f"_REPLY_HDR format {fmt!r} is not explicit "
+                "little-endian ('<')"))
+        # The C++ reader routes replies by the request id it parses at
+        # raw byte offsets (reader_loop_): opcode then rid.
+        if len(widths) >= 2 and None not in widths[:2] and \
+                widths[1] == 4:
+            off = widths[0]
+            rid_ok = bool(re.search(rf"reply\[{off}\]\)", client_src)) \
+                and all(re.search(
+                    rf"reply\[{off + k}\]\)\s*<<\s*{8 * k}\b",
+                    client_src) for k in (1, 2, 3))
+            m = re.search(r"reply\.size\(\)\s*>=\s*(\d+)", client_src)
+            size_ok = bool(m) and int(m.group(1)) == off + 4
+            if not (rid_ok and size_ok):
+                findings.append(Finding(
+                    SIDECAR_CLIENT,
+                    _line_of(client_src, r"reply\.size\(\)"),
+                    "wire-header-mismatch",
+                    f"reader_loop_ parses the reply request id at a "
+                    f"layout that does not match {PROTOCOL} "
+                    f"_REPLY_HDR={fmt!r} (rid at offset {off}, 4 bytes "
+                    "LE): replies would be routed to the wrong pending "
+                    "request"))
+        else:
+            findings.append(Finding(
+                PROTOCOL, line, "wire-header-mismatch",
+                f"_REPLY_HDR={fmt!r} no longer starts with a 1-byte "
+                "opcode and 4-byte request id; update reader_loop_'s "
+                "raw-offset parse and this check together"))
 
     # -- field moduli ------------------------------------------------------
     hexes = cpp_hex_string_constants(crypto_src)

@@ -24,23 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import ed25519 as E
+from ..utils.intmath import next_pow2  # noqa: F401  (re-export: THE
+# bucketing rule — sharded_verify and the sidecar import it from here)
 
 P = E.P
 L = E.L
 
 _MIN_BUCKET = 8
-
-
-def next_pow2(n: int, lo: int = 1) -> int:
-    """Smallest power-of-two multiple of ``lo`` that is >= n (lo itself a
-    power of two).  THE bucketing rule for compiled batch shapes: the
-    single-device path, the mesh per-shard path, and the sidecar warmup
-    must all agree on it, or a runtime batch can hit a shape warmup never
-    compiled (a mid-traffic XLA compile stall)."""
-    b = lo
-    while b < n:
-        b *= 2
-    return b
 
 
 def _bucket(n: int) -> int:
@@ -220,3 +210,103 @@ def verify_prepared_rows(packed: np.ndarray, n: int, *,
 def verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
     """Single-signature verify routed through the device path."""
     return bool(verify_batch([msg], [pk], [sig])[0])
+
+
+# ---------------------------------------------------------------------------
+# Random-linear-combination batch verification (one MSM per quorum)
+# ---------------------------------------------------------------------------
+
+# Below this the per-signature program is cheaper than the MSM's fixed
+# Horner/comb tail; it is also the bisection floor — sub-batches this
+# small resolve per signature, which is what pinpoints a bad vote.
+RLC_MIN_MSM = 4
+
+_RLC_DOMAIN = b"hotstuff-tpu/rlc-batch-v1"
+
+
+def _rlc_coeffs(rows: np.ndarray, salt: bytes) -> np.ndarray:
+    """(n, 128) prepared rows -> (n, 32) uint8 coefficient rows: 128-bit
+    nonzero z_i in canonical little-endian bytes (high 16 bytes zero).
+
+    Deterministic per call: a SHA-512 counter-mode PRF seeded by the
+    batch CONTENT (all rows), the bisection path (``salt``) and a domain
+    tag.  Soundness needs the z_i to be unpredictable to whoever chose
+    the signatures *before* the batch was formed — hashing every row into
+    the seed gives the standard derandomized batch-verification argument:
+    changing any bit of any signature re-randomizes every coefficient.
+    128-bit coefficients put an adversarial cancellation at ~2^-128, the
+    scheme's security level; anything shorter would make the combined
+    check the weakest link (see ops/ed25519 module notes).
+    """
+    n = rows.shape[0]
+    seed = hashlib.sha512(_RLC_DOMAIN + salt + rows.tobytes()).digest()
+    blocks = -(-n // 4)  # 4 x 16-byte coefficients per SHA-512 block
+    stream = b"".join(
+        hashlib.sha512(seed + i.to_bytes(4, "little")).digest()
+        for i in range(blocks))
+    z = np.zeros((n, 32), np.uint8)
+    z[:, :16] = np.frombuffer(stream, np.uint8)[:16 * n].reshape(n, 16)
+    # An all-zero row (p = 2^-128) would EXCLUDE the signature from the
+    # combined check; force its low byte to 1 (still deterministic).
+    dead = ~z.any(axis=1)
+    z[dead, 0] = 1
+    return z
+
+
+def verify_batch_rlc(msgs, pks, sigs, *, pad: bool = True) -> np.ndarray:
+    """Batch Ed25519 verify via the random-linear-combination check ->
+    (N,) bool mask, bit-identical to :func:`verify_batch`.
+
+    Fast path: ONE device dispatch checks the combined equation
+    [sum z_i S_i]B == sum [z_i]R_i + sum [z_i k_i]A_i over the whole
+    batch (ops/ed25519.verify_rlc_packed).  All-valid batches — the
+    steady state of quorum-certificate verification — pay one MSM
+    instead of 2n scalar ladders.  When the combined check fails, the
+    batch bisects (fresh coefficients per sub-batch) down to
+    RLC_MIN_MSM, below which the per-signature path pinpoints each bad
+    vote — so the returned mask always matches verify_batch exactly,
+    valid or not; an adversary can make us pay the old per-signature
+    price, never accept a bad vote (up to the 2^-128 RLC bound).
+
+    Batches beyond MAX_SUBBATCH fall back to the per-signature chunked
+    path (the MSM's conv group count scales with batch, and quorums that
+    size should shard across the mesh instead —
+    parallel/sharded_verify.verify_rlc_sharded).
+    """
+    n = len(msgs)
+    if n == 0:
+        return np.zeros((0,), bool)
+    prep = prepare_batch(msgs, pks, sigs)
+    mask = np.zeros(n, bool)
+    idx = np.nonzero(prep["host_ok"])[0]
+    _rlc_resolve(prep["packed"], idx, mask, b"", pad)
+    return mask
+
+
+def _rlc_resolve(packed: np.ndarray, indices: np.ndarray,
+                 out: np.ndarray, salt: bytes, pad: bool) -> None:
+    """Resolve ``out[indices]`` for host-canonical rows: combined RLC
+    check first, bisection on failure, per-signature floor."""
+    n = len(indices)
+    if n == 0:
+        return
+    if n < RLC_MIN_MSM or n > MAX_SUBBATCH:
+        rows = np.ascontiguousarray(packed[indices])
+        out[indices] = verify_prepared_rows(rows, n, pad=pad)
+        return
+    rows = np.ascontiguousarray(packed[indices])
+    m = _bucket(n) if pad else n
+    z = np.zeros((m, 32), np.uint8)
+    z[:n] = _rlc_coeffs(rows, salt)
+    if m != n:
+        rows = np.pad(rows, [(0, m - n), (0, 0)])
+    # Fresh host arrays -> fresh device buffers; the launch donates arg 0
+    # (same discipline as _dispatch_rows).
+    ok = bool(np.asarray(E.verify_rlc_packed_donated(
+        jnp.asarray(rows), jnp.asarray(z))))
+    if ok:
+        out[indices] = True
+        return
+    mid = n // 2
+    _rlc_resolve(packed, indices[:mid], out, salt + b"L", pad)
+    _rlc_resolve(packed, indices[mid:], out, salt + b"R", pad)

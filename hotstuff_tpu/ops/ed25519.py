@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import field25519 as F
-from ..utils.intmath import BX, BY, D, L, P, SQRT_M1
+from ..utils.intmath import BX, BY, D, L, P, SQRT_M1, next_pow2
 
 K2D = (2 * D) % P
 
@@ -381,12 +381,12 @@ def _jit_donated(fn):
     out)."""
     jitted = None
 
-    def call(arr):
+    def call(*args):
         nonlocal jitted
         if jitted is None:
             jitted = jax.jit(fn) if jax.default_backend() == "cpu" \
                 else jax.jit(fn, donate_argnums=0)
-        return jitted(arr)
+        return jitted(*args)
 
     return call
 
@@ -571,3 +571,243 @@ def verify_prepared(ay: jnp.ndarray, a_sign: jnp.ndarray,
 # eval_device A/B runs) reuse their device-resident inputs across calls.
 # graftlint: disable=nondonated-buffer
 verify_prepared_jit = jax.jit(verify_prepared)
+
+
+# ---------------------------------------------------------------------------
+# Random-linear-combination batch verification: ONE multi-scalar multiply
+# for the whole quorum
+# ---------------------------------------------------------------------------
+#
+# Per-signature verification solves n independent equations
+# [S_i]B == R_i + [k_i]A_i — two scalar ladders per vote.  Drawing random
+# coefficients z_i and summing z_i * (eq_i) collapses the quorum to ONE
+# equation,
+#
+#     [sum z_i S_i mod L] B  ==  sum [z_i] R_i  +  sum [z_i k_i mod L] A_i,
+#
+# whose right side is a 2n-point multi-scalar multiplication (MSM).  A
+# batch of all-valid votes always satisfies it (the defects sum to exactly
+# zero); an invalid vote escapes only if its defect cancels against the
+# z-weighted sum, probability ~2^-128 for >=128-bit coefficients (see
+# crypto/eddsa.verify_batch_rlc for the PRF and the bisection fallback
+# that pinpoints culprits when the combined check fails).
+#
+# MSM shape (Straus with shared 4-bit windows): per-point 16-entry tables
+# (14 batched adds — the same table build the per-signature ladder does),
+# then for each of the 64 nibble windows select each point's table entry
+# and fold the batch axis with a masked segment-style binary tree of
+# point adds (padding/excluded rows select entry 0 = identity, so no
+# separate mask tensor is needed).  Windows are processed in chunks of
+# _MSM_WINDOW_CHUNK inside one lax.scan — chunking trades conv group
+# count (chunk * 2n per level) against scan depth, keeping groups inside
+# the ~1024-group compile-time envelope at quorum sizes while the scan
+# body still compiles once.  Window sums combine by a 63-step Horner
+# ladder (4 doublings + 1 add per window, batch 1), and the fixed-base
+# [c]B side reuses the zero-doubling comb.  Total point-op work is
+# ~78n + 330 versus ~350n for n per-signature ladders — the arithmetic
+# win the RLC check exists for.
+#
+# Pippenger-style shared buckets (15 buckets per window, scatter by
+# digit) were considered and rejected for this substrate: point adds
+# cannot ride XLA's scatter/segment-sum (the group law is not an
+# elementwise monoid op), so bucket accumulation would need a masked add
+# per (bucket, point) pair — 15x the work of the per-point-table Straus
+# form on a SIMD machine.  The per-point tables cost 2n*16 points of
+# memory (~128 KB at n=512), which is noise next to the conv workspace.
+
+from . import scalar25519 as S  # noqa: E402  (device scalar arithmetic)
+
+_MSM_WINDOW_CHUNK = int(_os.environ.get("HOTSTUFF_TPU_MSM_WINDOW_CHUNK",
+                                        "8"))
+if 64 % _MSM_WINDOW_CHUNK != 0:
+    raise ValueError("HOTSTUFF_TPU_MSM_WINDOW_CHUNK must divide 64")
+
+
+def msm_table(points: jnp.ndarray) -> jnp.ndarray:
+    """(B, 4, 32) ext points -> (B, 16, 4, 32) ext table of 0..15 multiples
+    (entry 0 is the identity: digit-0 selections vanish without a mask)."""
+    cached_p = to_cached(points)
+    entries = [identity_ext(points.shape[:-2]), points]
+    for _ in range(2, 16):
+        entries.append(point_add(entries[-1], cached_p))
+    return jnp.stack(entries, axis=-3)
+
+
+def _tree_sum(pts: jnp.ndarray) -> jnp.ndarray:
+    """(M, ..., 4, 32) ext -> (..., 4, 32): binary tree of point adds over
+    the leading axis (M a power of two; identity entries make padding
+    free).  log2(M) sequential adds at M/2, M/4, ... conv groups — the
+    wide-SIMD segment reduction the MSM rests on."""
+    m = pts.shape[0]
+    while m > 1:
+        m //= 2
+        pts = point_add(pts[:m], to_cached(pts[m:]))
+    return pts[0]
+
+
+def msm_window_sums(points: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    """Per-window sums of a Straus MSM: (64, 4, 32) ext points W_j with
+    sum_i [s_i]P_i = sum_j 16^(63-j) W_j (windows MSB-first).
+
+    Args:
+      points: (B, 4, 32) ext points.  B is padded to a power of two with
+              identity points internally, so any batch size is legal.
+      digits: (B, 64) int32 MSB-first 4-bit windows of the scalars
+              (unpack_nibbles_msb of canonical 32-byte scalars < L).
+
+    This is the shardable half of the MSM: window sums from disjoint
+    point shards simply point-add together (parallel/sharded_verify
+    all-gathers them over ICI and tree-combines before the Horner pass).
+    """
+    b = points.shape[0]
+    b_pad = next_pow2(b)
+    if b_pad != b:
+        points = jnp.concatenate(
+            [points, identity_ext((b_pad - b,))], axis=0)
+        digits = jnp.pad(digits, [(0, b_pad - b), (0, 0)])
+    table = msm_table(points)                        # (B, 16, 4, 32)
+    chunk = _MSM_WINDOW_CHUNK
+    # (64, B) MSB-first -> (64/chunk, chunk, B)
+    dig = jnp.moveaxis(digits, -1, 0).reshape(64 // chunk, chunk, b_pad)
+
+    def chunk_sums(_, dch):
+        tab = jnp.broadcast_to(table[None], (chunk, *table.shape))
+        sel = _digit_select(tab, dch)                # (chunk, B, 4, 32)
+        return None, _tree_sum(jnp.moveaxis(sel, 1, 0))
+
+    _, wsums = jax.lax.scan(chunk_sums, None, dig)   # (64/chunk, chunk,..)
+    return wsums.reshape(64, 4, F.NLIMBS)
+
+
+def msm_horner(wsums: jnp.ndarray) -> jnp.ndarray:
+    """(64, 4, 32) MSB-first window sums -> (4, 32) ext total:
+    63 x (4 doublings + 1 add) at batch 1."""
+    def horner(acc, w):
+        acc = point_dbl(acc, with_t=False)
+        acc = point_dbl(acc, with_t=False)
+        acc = point_dbl(acc, with_t=False)
+        acc = point_dbl(acc)
+        return point_add(acc, to_cached(w)), None
+
+    acc, _ = jax.lax.scan(horner, identity_ext(()), wsums)
+    return acc
+
+
+def msm_straus(points: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    """sum_i [s_i] P_i: (B, 4, 32) ext points + (B, 64) MSB-first nibble
+    digits -> (4, 32) ext sum.  See msm_window_sums for the shape rules."""
+    return msm_horner(msm_window_sums(points, digits))
+
+
+def comb_mul_base(c_digits: jnp.ndarray) -> jnp.ndarray:
+    """[c]B for one scalar given as (32,) int32 base-256 little-endian
+    digits: the fixed-base comb at batch shape () — 32 adds, zero
+    doublings."""
+    comb = jnp.asarray(comb_table())                 # (32, 256, 4, 32)
+
+    def body(acc, xs):
+        comb_j, digit = xs
+        return point_add(acc, jnp.take(comb_j, digit, axis=0)), None
+
+    acc, _ = jax.lax.scan(body, identity_ext(()),
+                          (comb, c_digits.astype(jnp.int32)))
+    return acc
+
+
+def rlc_partials(packed: jnp.ndarray, z: jnp.ndarray):
+    """Shard-local half of the RLC check.
+
+    Args:
+      packed: (B, 128) uint8 rows of A || R || S || k.
+      z:      (B, 32) uint8 canonical coefficient rows; an ALL-ZERO row is
+              excluded (zero scalars select only identity table entries
+              and its decompression result is ignored) — bucket padding
+              and host-rejected votes are plain zero rows.
+    Returns:
+      wsums:   (64, 4, 32) MSB-first MSM window sums of
+               sum [z_i k_i]A_i + [z_i]R_i over this shard's rows.
+      u_sum:   (32,) int32 limb-wise sum of the z_i*S_i mod L terms
+               (fold with scalar25519.reduce_limbsum_mod_l — it commutes
+               with an ICI psum).
+      bad:     () int32 count of included rows whose A or R failed
+               decompression.
+    Window sums from disjoint shards point-add together, which is what
+    lets the MSM buckets shard across the mesh
+    (parallel/sharded_verify.verify_rlc_sharded).
+    """
+    ay, a_sign = split_y_sign(packed[..., 0:32])
+    ry, r_sign = split_y_sign(packed[..., 32:64])
+    s_l = packed[..., 64:96].astype(jnp.int32)
+    k_l = packed[..., 96:128].astype(jnp.int32)
+    z_l = z.astype(jnp.int32)
+
+    present = jnp.any(z_l != 0, axis=-1)
+    # A points first, R points second — matching the digit concat below.
+    pts, ok = decompress(jnp.concatenate([ay, ry], axis=0),
+                         jnp.concatenate([a_sign, r_sign], axis=0))
+    present2 = jnp.concatenate([present, present], axis=0)
+    bad = jnp.sum(~ok & present2).astype(jnp.int32)
+
+    w = S.mul_mod_l(z_l, k_l)          # z_i * k_i mod L  (A_i scalars)
+    u = S.mul_mod_l(z_l, s_l)          # z_i * S_i mod L
+
+    # Torsion-exact CRT lift to the full-group exponent 8L.  E(Fp) is
+    # Z/8 x Z/L: a scalar acts mod L on the prime-order component but
+    # mod 8 on a point's 8-torsion component, and reducing z*k mod L
+    # scrambles the mod-8 residue — a combined check built from the
+    # reduced scalars weighs each row's torsion defect by an
+    # L-reduction artifact an adversary can grind (a mixed-order pubkey
+    # A' + T would slip through whenever the artifact hits 0 mod 8).
+    # Lifting A's scalar to w' ≡ w (mod L), w' ≡ k (mod 8) and R's to
+    # z' ≡ z (mod L), z' ≡ 1 (mod 8) makes every row's torsion defect
+    # enter the sum with the SAME coefficient the per-signature
+    # cofactorless equation uses — so a single defective row passes or
+    # fails the combined check exactly as verify_compact would.
+    # (L ≡ 5 (mod 8), self-inverse; excluded rows keep scalar 0.)
+    present_i = present.astype(jnp.int32)
+    t_w = (5 * ((k_l[..., 0] & 7) - (w[..., 0] & 7))) % 8 * present_i
+    t_z = (5 * (1 - (z_l[..., 0] & 7))) % 8 * present_i
+    w_lift = S.add_small_multiple_of_l(w, t_w)
+    z_lift = S.add_small_multiple_of_l(z_l, t_z)
+
+    digits = unpack_nibbles_msb(jnp.concatenate([w_lift, z_lift], axis=0))
+    wsums = msm_window_sums(pts, digits)
+    return wsums, jnp.sum(u, axis=-2), bad
+
+
+def rlc_finish(wsums: jnp.ndarray, u_limbsum: jnp.ndarray,
+               bad: jnp.ndarray) -> jnp.ndarray:
+    """Combine (possibly mesh-reduced) RLC partials into the () bool
+    verdict: Horner-fold the window sums, comb [c]B from the reduced
+    scalar sum, compare projectively, and veto on any bad point."""
+    c = S.reduce_limbsum_mod_l(u_limbsum)
+    msm = msm_horner(wsums)            # sum [w_i]A_i + [z_i]R_i
+    cb = comb_mul_base(c)              # [c]B
+
+    x1, y1, z1, _ = _unpack(cb)
+    x2, y2, z2, _ = _unpack(msm)
+    cross = F.canonical(F.mul(_pack(x1, x2, y1, y2),
+                              _pack(z2, z1, z2, z1)))
+    eq = jnp.all(cross[..., 0, :] == cross[..., 1, :], axis=-1) & \
+        jnp.all(cross[..., 2, :] == cross[..., 3, :], axis=-1)
+    return (bad == 0) & eq
+
+
+def verify_rlc_packed(packed: jnp.ndarray, z: jnp.ndarray) -> jnp.ndarray:
+    """(B, 128) uint8 rows of A || R || S || k  +  (B, 32) uint8 canonical
+    coefficient rows -> () bool: the whole batch passes the combined
+    random-linear-combination check.  An all-excluded batch returns True
+    (vacuous).  B should be a power-of-two bucket (crypto/eddsa._bucket
+    discipline, the shapes warmup compiles); scalar products z*S and z*k
+    reduce mod L on device (ops/scalar25519), so the caller only ships
+    160 bytes per row.
+    """
+    return rlc_finish(*rlc_partials(packed, z))
+
+
+# Re-timeable variant for profiling scripts (see _jit_donated).
+# graftlint: disable=nondonated-buffer
+verify_rlc_packed_jit = jax.jit(verify_rlc_packed)
+# Production launch shape: each packed buffer is transferred once and
+# consumed once (the z rows are small and not donated).
+verify_rlc_packed_donated = _jit_donated(verify_rlc_packed)

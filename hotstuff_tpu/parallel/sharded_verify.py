@@ -16,11 +16,18 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:  # jax >= 0.6: top-level export, replication checking via check_vma
+    from jax import shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map
+    _SHARD_MAP_KW = {"check_rep": False}
 from jax.sharding import Mesh, PartitionSpec as Pspec
 
-from ..crypto.eddsa import _MIN_BUCKET, MAX_SUBBATCH, next_pow2
+from ..crypto.eddsa import _MIN_BUCKET, MAX_SUBBATCH, _rlc_coeffs, next_pow2
 from ..ops import ed25519 as E
+from ..ops import scalar25519  # noqa: F401  (re-export surface for tests)
 from .mesh import BATCH_AXIS
 
 
@@ -67,15 +74,16 @@ def make_sharded_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
     the caller (verify_batch_sharded does).
     """
     batched = Pspec(BATCH_AXIS)
-    # check_vma=False: the ladder scans carry broadcast constants (identity
-    # point, exponent accumulators) that VMA tracking would flag as unvarying
-    # vs the varying body outputs; replication checking adds nothing here.
+    # Replication checking off (_SHARD_MAP_KW): the ladder scans carry
+    # broadcast constants (identity point, exponent accumulators) that
+    # VMA/rep tracking would flag as unvarying vs the varying body
+    # outputs; the checking adds nothing here.
     fn = shard_map(
         _make_shard_body(max_subbatch),
         mesh=mesh,
         in_specs=(batched,) * 5,
         out_specs=(batched, Pspec()),
-        check_vma=False,
+        **_SHARD_MAP_KW,
     )
     return jax.jit(fn)
 
@@ -122,3 +130,97 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
     if return_bad_total:
         return mask, int(bad_total)
     return mask
+
+
+# ---------------------------------------------------------------------------
+# Sharded random-linear-combination verification: the MSM buckets
+# themselves shard across the mesh
+# ---------------------------------------------------------------------------
+#
+# The RLC check (crypto/eddsa.verify_batch_rlc) splits mesh-natively:
+# window sums of an MSM over disjoint point shards simply point-add
+# together, and the fixed-base scalar sum is a limb-wise integer sum that
+# commutes with an ICI psum.  So each chip runs the shard-local half
+# (ops/ed25519.rlc_partials — decompression, mod-L scalar products,
+# per-point tables, masked tree reduction to 64 window sums), the mesh
+# exchanges 64 points + 32 limbs + 1 counter per chip (an all_gather and
+# two psums — a few KB over ICI, vs. the votes themselves staying
+# sharded), and every chip finishes the tiny replicated tail (Horner,
+# comb, projective compare) to the same () bool verdict.
+
+
+def _rlc_shard_body(packed, z):
+    wsums, u_sum, bad = E.rlc_partials(packed, z)
+    bad_total = jax.lax.psum(bad, BATCH_AXIS)
+    u_total = jax.lax.psum(u_sum, BATCH_AXIS)
+    allw = jax.lax.all_gather(wsums, BATCH_AXIS)   # (n_dev, 64, 4, 32)
+    n_dev = allw.shape[0]
+    n_pad = next_pow2(n_dev)
+    if n_pad != n_dev:
+        allw = jnp.concatenate(
+            [allw, E.identity_ext((n_pad - n_dev, 64))], axis=0)
+    combined = E._tree_sum(allw)                   # (64, 4, 32)
+    return E.rlc_finish(combined, u_total, bad_total)
+
+
+def make_sharded_rlc_verifier(mesh: Mesh):
+    """Returns a jitted fn over ((B, 128) packed rows, (B, 32) coefficient
+    rows), B % n_devices == 0 -> () bool combined-RLC verdict, replicated
+    across the mesh.  Zero-coefficient rows are excluded (padding)."""
+    batched = Pspec(BATCH_AXIS)
+    fn = shard_map(
+        _rlc_shard_body,
+        mesh=mesh,
+        in_specs=(batched, batched),
+        out_specs=Pspec(),
+        **_SHARD_MAP_KW,
+    )
+    return jax.jit(fn)
+
+
+@functools.cache
+def _cached_rlc_verifier(mesh: Mesh):
+    return make_sharded_rlc_verifier(mesh)
+
+
+def verify_rlc_sharded(mesh: Mesh, prep: dict, *,
+                       salt: bytes = b"") -> np.ndarray:
+    """Run a host-prepared batch (crypto/eddsa.prepare_batch) through the
+    mesh-sharded RLC check -> (N,) bool mask, matching verify_batch_sharded.
+
+    Fast path: ONE mesh dispatch for the combined check; when it passes
+    (the steady state — every vote of a sound quorum verifies) the mask
+    is just host_ok.  When it fails, the batch falls back to the
+    per-signature sharded path to pinpoint the bad votes — the old
+    full price, paid only when somebody actually sent a bad vote.
+    Per-shard sizes pad to the same power-of-two buckets as
+    verify_batch_sharded, which bounds the number of DISTINCT compiled
+    shapes; note that no warmup pre-compiles the RLC mesh program yet —
+    wiring these shapes into sidecar/service._warmup is the open
+    ROADMAP item, and until then the first quorum at each bucket size
+    pays its XLA compile.
+    """
+    n = prep["a"].shape[0]
+    host_ok = prep["host_ok"]
+    if n == 0:
+        return np.zeros((0,), bool)
+    n_dev = mesh.devices.size
+    per_shard = -(-n // n_dev)
+    lo = max(1, _MIN_BUCKET // n_dev)
+    m = n_dev * min(next_pow2(per_shard, lo), MAX_SUBBATCH)
+    if per_shard > MAX_SUBBATCH:
+        # Quorums beyond the mesh's one-dispatch envelope keep the
+        # per-signature chunked path (same policy as verify_batch_rlc).
+        return verify_batch_sharded(mesh, prep)
+    packed = np.asarray(prep["packed"])
+    z = np.zeros((m, 32), np.uint8)
+    idx = np.nonzero(host_ok)[0]
+    if len(idx):
+        z[idx] = _rlc_coeffs(np.ascontiguousarray(packed[idx]), salt)
+    if m != n:
+        packed = np.pad(packed, [(0, m - n), (0, 0)])
+    ok = bool(np.asarray(_cached_rlc_verifier(mesh)(
+        jnp.asarray(packed), jnp.asarray(z))))
+    if ok:
+        return host_ok.copy()
+    return verify_batch_sharded(mesh, prep)

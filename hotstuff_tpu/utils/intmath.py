@@ -11,6 +11,19 @@ SQRT_M1 = pow(2, (P - 1) // 4, P)
 BY = (4 * pow(5, P - 2, P)) % P
 
 
+def next_pow2(n: int, lo: int = 1) -> int:
+    """Smallest power-of-two multiple of ``lo`` that is >= n (lo itself a
+    power of two).  THE bucketing rule for compiled batch shapes: the
+    single-device path, the mesh per-shard path, the MSM point padding
+    and the sidecar warmup must all agree on it, or a runtime batch can
+    hit a shape warmup never compiled (a mid-traffic XLA compile
+    stall)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
 def recover_x(y: int, sign: int) -> int | None:
     """RFC 8032 §5.1.3 x-recovery; None when y is not on the curve or the
     encoding is invalid."""

@@ -11,7 +11,7 @@ import textwrap
 
 import pytest
 
-from hotstuff_tpu.analysis import hotpath, sanitize, wirecheck
+from hotstuff_tpu.analysis import hotpath, padshape, sanitize, wirecheck
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -322,6 +322,108 @@ def test_field_modulus_mismatch_fires_on_cpp_hex_edit(wire_tree):
             "b9feffffffffaaab", "b9feffffffffaaad")
     findings = wirecheck.check(str(wire_tree))
     assert rules(findings) == {"field-modulus-mismatch"}
+
+
+def test_wire_header_mismatch_fires_on_request_header_drift(wire_tree):
+    """Widening msg_len to u32 in protocol.py without touching
+    write_header: the exact one-sided edit the rule exists for."""
+    _mutate(wire_tree, wirecheck.PROTOCOL,
+            '_HDR = struct.Struct("<BIIH")',
+            '_HDR = struct.Struct("<BIII")')
+    findings = wirecheck.check(str(wire_tree))
+    assert rules(findings) == {"wire-header-mismatch"}
+    assert any("write_header" in f.message for f in findings)
+
+
+def test_wire_header_mismatch_fires_on_reply_layout_drift(wire_tree):
+    """Shrinking the reply request id breaks the C++ reader's raw-offset
+    rid parse (reply[1..4])."""
+    _mutate(wire_tree, wirecheck.PROTOCOL,
+            '_REPLY_HDR = struct.Struct("<BII")',
+            '_REPLY_HDR = struct.Struct("<BHI")')
+    findings = wirecheck.check(str(wire_tree))
+    assert rules(findings) == {"wire-header-mismatch"}
+
+
+def test_wire_header_mismatch_fires_on_big_endian_format(wire_tree):
+    _mutate(wire_tree, wirecheck.PROTOCOL,
+            '_HDR = struct.Struct("<BIIH")',
+            '_HDR = struct.Struct(">BIIH")')
+    findings = wirecheck.check(str(wire_tree))
+    assert "wire-header-mismatch" in rules(findings)
+    assert any("little-endian" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# padded-bucket (launch-shape discipline)
+# ---------------------------------------------------------------------------
+
+def test_padded_bucket_fires_on_unbucketed_launch():
+    findings = padshape.check_sources({"mod.py": textwrap.dedent("""
+        import numpy as np
+
+        def dispatch(rows):
+            return verify_packed_donated(rows)
+        """)})
+    assert rules(findings) == {"padded-bucket"}
+
+
+def test_padded_bucket_quiet_on_bucketed_launch_and_factories():
+    findings = padshape.check_sources({"mod.py": textwrap.dedent("""
+        def dispatch(rows, n):
+            m = next_pow2(n)
+            rows = pad(rows, m)
+            return verify_packed_donated(rows)
+
+        def cached_launch(mesh, arrays):
+            m = _bucket(len(arrays))
+            return _cached_verifier(mesh)(arrays[:m])
+
+        verify_packed_donated = _jit_donated(verify_packed)
+        """)})
+    assert findings == []
+
+
+def test_padded_bucket_quiet_on_real_tree():
+    assert padshape.check(REPO) == []
+
+
+def test_padded_bucket_fires_on_warmup_floor_drift(tmp_path):
+    for rel in (padshape.EDDSA, padshape.SERVICE):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    _mutate(tmp_path, padshape.SERVICE,
+            "_warm_shapes(engine, 8, warm_max",
+            "_warm_shapes(engine, 16, warm_max")
+    findings = padshape.check(str(tmp_path), targets=())
+    assert rules(findings) == {"padded-bucket"}
+    assert any("_MIN_BUCKET" in f.message for f in findings)
+
+
+def test_padded_bucket_fires_on_non_pow2_coalesce(tmp_path):
+    for rel in (padshape.EDDSA, padshape.SERVICE):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    _mutate(tmp_path, padshape.SERVICE,
+            "MAX_COALESCED = 16 * MAX_SUBBATCH",
+            "MAX_COALESCED = 12 * MAX_SUBBATCH")
+    findings = padshape.check(str(tmp_path), targets=())
+    assert rules(findings) == {"padded-bucket"}
+    assert any("power-of-two" in f.message for f in findings)
+
+
+def test_must_cover_gate():
+    from hotstuff_tpu.analysis.__main__ import check_coverage
+
+    assert check_coverage(REPO, ["hotstuff_tpu/ops/scalar25519.py"]) == []
+    # a file outside the hotpath targets fails the gate
+    out = check_coverage(REPO, ["hotstuff_tpu/crypto/eddsa.py"])
+    assert [f.rule for f in out] == ["must-cover"]
+    # a missing file fails the gate
+    out = check_coverage(REPO, ["hotstuff_tpu/ops/nonexistent.py"])
+    assert [f.rule for f in out] == ["must-cover"]
 
 
 # ---------------------------------------------------------------------------

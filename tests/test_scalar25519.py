@@ -1,0 +1,108 @@
+"""Property tests for the device mod-L scalar arithmetic
+(ops/scalar25519) against python big-int ground truth.
+
+The RLC combined check (ops/ed25519.verify_rlc_packed) is only as sound
+as these reductions: a single wrong limb in z*S mod L silently turns a
+valid quorum into a "failed" combined check (livable — bisection still
+resolves it) or, far worse, could mask a defect.  Every public entry
+point is exercised on full-range random values AND the boundary cases of
+the Montgomery argument bounds.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from hotstuff_tpu.ops import scalar25519 as S  # noqa: E402
+from hotstuff_tpu.utils.intmath import L  # noqa: E402
+
+RNG = np.random.default_rng(20240803)
+
+
+def rand_scalars(n, bits=256, below_l=True):
+    out = []
+    for _ in range(n):
+        v = int.from_bytes(RNG.bytes(bits // 8), "little")
+        out.append(v % L if below_l else v)
+    return out
+
+
+# Boundary values for the [0, L) domain.
+EDGES = [0, 1, 2, L - 1, L - 2, S.DELTA, S.DELTA - 1,
+         (1 << 252) - 1, 1 << 252, (1 << 128) - 1, S.R1, S.R2]
+
+
+def test_mul_mod_l_matches_python_ints():
+    a_int = rand_scalars(100) + EDGES
+    b_int = rand_scalars(100) + list(reversed(EDGES))
+    a = jnp.asarray(S.batch_to_limbs(a_int))
+    b = jnp.asarray(S.batch_to_limbs(b_int))
+    got = S.batch_from_limbs(np.asarray(S.mul_mod_l(a, b)))
+    assert got == [(x * y) % L for x, y in zip(a_int, b_int)]
+
+
+def test_mul_mod_l_edge_cross_product():
+    import itertools
+
+    pairs = list(itertools.product(EDGES, EDGES))
+    a = jnp.asarray(S.batch_to_limbs([p[0] for p in pairs]))
+    b = jnp.asarray(S.batch_to_limbs([p[1] for p in pairs]))
+    got = S.batch_from_limbs(np.asarray(S.mul_mod_l(a, b)))
+    assert got == [(x * y) % L for x, y in pairs]
+
+
+def test_mont_mul_headroom_accepts_full_2_256_operand():
+    """reduce512's high-half path feeds mont_mul an operand up to
+    2^256 - 1 (beyond L); the bound a*b < R*L must still hold exactly."""
+    big = [(1 << 256) - 1, (1 << 256) - 38, 1 << 255]
+    other = [L - 1, S.R2, 1]
+    a = jnp.asarray(np.stack([np.frombuffer(
+        v.to_bytes(32, "little"), np.uint8).astype(np.int32)
+        for v in big]))
+    b = jnp.asarray(S.batch_to_limbs(other))
+    got = S.batch_from_limbs(np.asarray(S.mont_mul(a, b)))
+    rinv = pow(S.R, L - 2, L)
+    assert got == [(x * y * rinv) % L for x, y in zip(big, other)]
+
+
+def test_add_and_sum_mod_l():
+    a_int = rand_scalars(64) + EDGES
+    b_int = rand_scalars(64) + EDGES
+    a = jnp.asarray(S.batch_to_limbs(a_int))
+    b = jnp.asarray(S.batch_to_limbs(b_int))
+    got = S.batch_from_limbs(np.asarray(S.add_mod_l(a, b)))
+    assert got == [(x + y) % L for x, y in zip(a_int, b_int)]
+    got_sum = S.from_limbs(np.asarray(S.sum_mod_l(a, axis=0)))
+    assert got_sum == sum(a_int) % L
+
+
+def test_reduce512_mod_l():
+    vals = [int.from_bytes(RNG.bytes(64), "little") for _ in range(50)]
+    vals += [0, 1, L, L - 1, 2 * L, (1 << 512) - 1, (1 << 256) - 1,
+             1 << 256, (L << 256) + L - 1]
+    arr = np.zeros((len(vals), 64), np.uint8)
+    for i, v in enumerate(vals):
+        arr[i] = np.frombuffer(v.to_bytes(64, "little"), np.uint8)
+    got = S.batch_from_limbs(np.asarray(S.reduce512_mod_l(jnp.asarray(arr))))
+    assert got == [v % L for v in vals]
+
+
+def test_reduce_limbsum_matches_sum(n=1000):
+    """The sharded path psums limb-wise sums across shards before one
+    fold; the fold must be exact at the largest supported term count."""
+    vals = rand_scalars(n)
+    limbs = S.batch_to_limbs(vals).astype(np.int64).sum(axis=0)
+    assert limbs.max() < 2 ** 24  # the documented input bound
+    got = S.from_limbs(np.asarray(
+        S.reduce_limbsum_mod_l(jnp.asarray(limbs, dtype=jnp.int32))))
+    assert got == sum(vals) % L
+
+
+def test_mod_small_reduces_below_l():
+    vals = [0, 1, L - 1, L, L + 1, 8 * L - 1, 15 * L + 7, (1 << 256) - 1]
+    arr = np.stack([np.frombuffer(v.to_bytes(32, "little"),
+                                  np.uint8).astype(np.int32)
+                    for v in vals])
+    got = S.batch_from_limbs(np.asarray(S.mod_small(jnp.asarray(arr))))
+    assert got == [v % L for v in vals]

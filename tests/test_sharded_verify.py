@@ -6,7 +6,8 @@ import jax
 
 from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
 from hotstuff_tpu.parallel.mesh import make_mesh
-from hotstuff_tpu.parallel.sharded_verify import verify_batch_sharded
+from hotstuff_tpu.parallel.sharded_verify import (verify_batch_sharded,
+                                                  verify_rlc_sharded)
 
 
 def test_mesh_has_8_devices():
@@ -71,3 +72,27 @@ def test_sharded_chunked_large_batch():
     assert mask.shape == (n,)
     assert not mask[777] and mask.sum() == n - 1
     assert bad == 1
+
+
+def test_sharded_rlc_matches_per_signature():
+    """The mesh-sharded RLC combined check: one dispatch for a valid
+    (ragged) quorum, per-signature fallback agreement when a vote is
+    corrupted or host-rejected."""
+    rng = np.random.default_rng(31)
+    msgs, pks, sigs = [], [], []
+    for _ in range(13):  # ragged: pads per-shard buckets with zero-z rows
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msg = rng.bytes(32)
+        msgs.append(msg); pks.append(pk); sigs.append(ref.sign(sk, msg))
+    mesh = make_mesh(8)
+    got = verify_rlc_sharded(mesh, eddsa.prepare_batch(msgs, pks, sigs))
+    assert got.shape == (13,) and got.all()
+
+    sigs[5] = sigs[5][:40] + bytes([sigs[5][40] ^ 1]) + sigs[5][41:]
+    pks[9] = b"\xff" * 32  # host-rejected encoding (y >= p)
+    prep = eddsa.prepare_batch(msgs, pks, sigs)
+    got = verify_rlc_sharded(mesh, prep)
+    want = eddsa.verify_batch(msgs, pks, sigs)
+    assert got.tolist() == want.tolist()
+    assert not got[5] and not got[9] and got.sum() == 11

@@ -43,6 +43,23 @@ quorum sizes n in {4, 16, 64, 256}.  Per size:
    "speedup": float}          — or {"skipped": true} if the size budget
 (HOTSTUFF_TPU_RLC_BUDGET seconds, default 300) ran out first.
 
+MSM window-chunk sweep (`"msm_window_chunk"` field): RLC throughput at
+n=256 with ops/ed25519._MSM_WINDOW_CHUNK forced to 4, 8 and 16 via one
+subprocess per value (the constant binds at import; running the sweep
+in subprocesses BEFORE the parent binds the device also gives each
+child the single tunneled chip to itself).  Per chunk:
+  {"chunkC": {"rlc_sigs_per_s": float}}   — or {"skipped"/"error": ...}.
+PR 2 chose the default (8) by conv-group arithmetic; this field gives a
+real v5e run the measurement to settle it (HOTSTUFF_TPU_MSM_SWEEP_BUDGET
+seconds, default 180, bounds the sweep via per-child timeouts).
+
+Scheduler telemetry (`"sched"` field): the verifysched STATS counters of
+a tiny in-process host-mode engine exercise (one latency QC + one bulk
+batch through the real scheduler), round-tripped through the OP_STATS
+wire encoding (protocol.encode_stats_reply -> decode_stats_body) so the
+headline proves the telemetry pipeline end to end.  Schema:
+sidecar/sched/stats.py snapshot().
+
 Degraded mode (`"degraded": true`): the device probe is capped at
 HOTSTUFF_TPU_PROBE_ATTEMPTS tries (default 3) inside a
 HOTSTUFF_TPU_PROBE_WINDOW-second window (default 600); when no device
@@ -236,6 +253,126 @@ def rlc_compare(sizes=(4, 16, 64, 256), repeats: int = 2,
     return out
 
 
+def _make_ref_sigs(n: int, seed: int = 11):
+    """n distinct (msg, pk, sig) triples via the pure-python reference
+    signer — no external dependency (the `cryptography` lib is not
+    guaranteed on this image), so every bench mode can run this."""
+    from hotstuff_tpu.crypto import ref_ed25519 as ref
+
+    rng = np.random.default_rng(seed)
+    msgs, pks, sigs = [], [], []
+    for _ in range(n):
+        sk = rng.bytes(32)
+        msg = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msgs.append(msg)
+        pks.append(pk)
+        sigs.append(ref.sign(sk, msg))
+    return msgs, pks, sigs
+
+
+def msm_chunk_probe(n: int = 256, repeats: int = 2):
+    """Child-process half of the msm_window_chunk sweep: measure RLC
+    throughput at quorum size n under THIS process's
+    ops/ed25519._MSM_WINDOW_CHUNK (bound from the env at import), and
+    print one JSON line.  Run via `python -c "import bench;
+    bench.msm_chunk_probe()"` with HOTSTUFF_TPU_MSM_WINDOW_CHUNK set."""
+    from hotstuff_tpu.crypto import eddsa
+    from hotstuff_tpu.ops import ed25519 as E
+    from hotstuff_tpu.utils.xla_cache import configure_xla_cache
+
+    configure_xla_cache()
+    msgs, pks, sigs = _make_ref_sigs(n)
+    if not eddsa.verify_batch_rlc(msgs, pks, sigs).all():  # warm + correct
+        raise RuntimeError(f"RLC verify failed at n={n}")
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        mask = eddsa.verify_batch_rlc(msgs, pks, sigs)
+        dt = time.perf_counter() - t0
+        if not mask.all():
+            raise RuntimeError(f"RLC verify failed at n={n}")
+        best = max(best, n / dt)
+    print(json.dumps({"chunk": E._MSM_WINDOW_CHUNK,
+                      "rlc_sigs_per_s": round(best, 1)}), flush=True)
+
+
+def msm_chunk_sweep(chunks=(4, 8, 16), n: int = 256,
+                    budget_s: float = 240.0) -> dict:
+    """Parent half: one subprocess per chunk value (the constant binds at
+    ops/ed25519 import, so re-binding needs a fresh interpreter — which
+    also gives each value its own jit cache and a reliable timeout).
+    Chunks that miss the budget report {"skipped": true}; a crashed or
+    hung child reports {"error": ...} — the sweep never takes the
+    headline down with it."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.perf_counter()
+    out = {}
+    for chunk in chunks:
+        left = budget_s - (time.perf_counter() - t0)
+        if left <= 0:
+            out[f"chunk{chunk}"] = {"skipped": True}
+            continue
+        env = dict(os.environ, HOTSTUFF_TPU_MSM_WINDOW_CHUNK=str(chunk))
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 f"import bench; bench.msm_chunk_probe({n})"],
+                cwd=root, env=env, capture_output=True, text=True,
+                timeout=min(left, 180.0), check=True)
+            line = json.loads(proc.stdout.strip().splitlines()[-1])
+            out[f"chunk{chunk}"] = {
+                "rlc_sigs_per_s": line["rlc_sigs_per_s"]}
+        except Exception as e:  # noqa: BLE001 — per-chunk isolation
+            detail = ""
+            if isinstance(e, subprocess.CalledProcessError):
+                detail = (e.stderr or "")[-120:]
+            out[f"chunk{chunk}"] = {"error": f"{e!r:.120}{detail}"}
+    return out
+
+
+def sched_headline_probe() -> dict:
+    """Round-trip the verifysched STATS counters through the wire
+    encoding and return the decoded snapshot for the headline's "sched"
+    field: a host-mode VerifyEngine verifies one latency-class QC and one
+    bulk-class batch through the real scheduler, then the snapshot goes
+    protocol.encode_stats_reply -> decode_reply_raw -> decode_stats_body
+    — the exact bytes a sidecar client would see."""
+    import threading
+
+    from hotstuff_tpu.sidecar import protocol as proto
+    from hotstuff_tpu.sidecar import sched as vsched
+    from hotstuff_tpu.sidecar.service import VerifyEngine
+
+    msgs, pks, sigs = _make_ref_sigs(6, seed=23)
+    engine = VerifyEngine(use_host=True)
+    try:
+        done = []
+        cond = threading.Condition()
+
+        def reply(mask):
+            with cond:
+                done.append(mask)
+                cond.notify()
+
+        engine.submit(proto.VerifyRequest(1, msgs[:4], pks[:4], sigs[:4]),
+                      reply, cls=vsched.LATENCY)
+        engine.submit(proto.VerifyRequest(2, msgs[4:], pks[4:], sigs[4:]),
+                      reply, cls=vsched.BULK)
+        with cond:
+            cond.wait_for(lambda: len(done) == 2, timeout=60.0)
+        frame = proto.encode_stats_reply(7, engine.stats_snapshot())
+        opcode, rid, body = proto.decode_reply_raw(frame[4:])
+        if (opcode, rid) != (proto.OP_STATS, 7):
+            raise RuntimeError("stats reply framing mismatch")
+        return proto.decode_stats_body(body)
+    finally:
+        engine.stop()
+
+
 def run_degraded(reason: str):
     """No usable accelerator: fall back to JAX_PLATFORMS=cpu, measure the
     RLC headline there, and ALWAYS emit one parseable JSON line tagged
@@ -286,11 +423,18 @@ def run_degraded(reason: str):
         value = 0.0
         for stats in rlc.values():
             value = max(value, stats.get("per_sig_sigs_per_s", 0.0))
+        try:
+            sched = sched_headline_probe()
+        except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+            sched = {"error": f"{e!r:.120}"}
+        # The watchdog stays armed until the moment of the real emit: a
+        # stall anywhere above (including the sched probe) must still
+        # produce a parseable line, which is this path's whole contract.
         emitted.set()
         # Report the backend that actually ran (an already-initialized
         # device backend wins over the cpu config flip above).
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
-             note=reason, rlc=rlc)
+             note=reason, rlc=rlc, sched=sched)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -478,6 +622,19 @@ def main():
               f"({remaining:.0f}s left in window)", file=sys.stderr)
         time.sleep(min(retry_sleep, max(0.0, remaining)))
 
+    # MSM window-chunk sweep BEFORE this process binds the device: each
+    # chunk child needs the (single, tunneled) chip to itself, so the
+    # sweep must run while the only device users so far were the probe
+    # subprocesses, which have exited.  Each child carries its own
+    # subprocess timeout, so the stage is bounded by its budget without
+    # a watchdog; failures degrade to per-chunk error entries, never
+    # take the headline down.
+    try:
+        msm = msm_chunk_sweep(budget_s=float(
+            os.environ.get("HOTSTUFF_TPU_MSM_SWEEP_BUDGET", "180")))
+    except Exception as e:  # noqa: BLE001
+        msm = {"error": f"{e!r:.200}"}
+
     def _abort():
         emit_cached_or_fail(
             "watchdog: TPU unresponsive for 900s after a healthy probe")
@@ -525,7 +682,8 @@ def main():
     # ships the line with the rlc field marked aborted.  (budget_s only
     # checks between sizes; a single stalled compile needs the timer.)
     def _rlc_abort():
-        emit_final(tpu, cpu, rlc={"error": "rlc stage watchdog (420s)"})
+        emit_final(tpu, cpu, rlc={"error": "rlc stage watchdog (420s)"},
+                   msm_window_chunk=msm)
         os._exit(0)
 
     rlc_watchdog = threading.Timer(420.0, _rlc_abort)
@@ -537,7 +695,11 @@ def main():
     except Exception as e:  # noqa: BLE001 — headline must not die on rlc
         rlc = {"error": f"{e!r:.200}"}
     rlc_watchdog.cancel()
-    emit_final(tpu, cpu, rlc=rlc)
+    try:
+        sched = sched_headline_probe()
+    except Exception as e:  # noqa: BLE001 — telemetry is best-effort
+        sched = {"error": f"{e!r:.120}"}
+    emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm, sched=sched)
 
 
 if __name__ == "__main__":

@@ -11,11 +11,11 @@ import argparse
 import os
 import sys
 
-CHECKERS = ("hotpath", "wire", "sanitize", "padshape")
+CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import hotpath, padshape, sanitize, wirecheck
+    from . import hotpath, padshape, sanitize, timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -26,6 +26,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += sanitize.check(root)
     if "padshape" in checkers:
         findings += padshape.check(root)
+    if "timing" in checkers:
+        findings += timing.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:

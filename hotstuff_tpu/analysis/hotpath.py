@@ -40,10 +40,24 @@ import os
 from .common import Finding, apply_suppressions
 
 # Paths scanned by default, relative to the repo root.
+#
+# crypto/eddsa.py and offchain/bls12381.py joined the set with the
+# verifysched PR: eddsa is the dispatch layer the engine's hot loop calls
+# straight into (its helpers are one refactor away from being pulled
+# inside a jit closure — the cross-module taint walk keeps that honest),
+# and bls12381 is the host BLS reference the device module's jit bodies
+# call for constants/decoding, where a traced value leaking in would be
+# a silent per-launch host sync.  sidecar/sched is control-plane code
+# for the engine thread itself; scanning it keeps device-touching
+# helpers from accreting there unchecked (lint_gate pins each module
+# with --must-cover).
 DEFAULT_TARGETS = (
     "hotstuff_tpu/ops",
     "hotstuff_tpu/parallel",
     "hotstuff_tpu/sidecar/service.py",
+    "hotstuff_tpu/sidecar/sched",
+    "hotstuff_tpu/crypto/eddsa.py",
+    "hotstuff_tpu/offchain/bls12381.py",
 )
 
 _LAUNDER_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "sharding"}

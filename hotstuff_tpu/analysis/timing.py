@@ -1,0 +1,129 @@
+"""graftlint timing checker: ``block_until_ready`` must not be the
+synchronization inside a timed region of the profiling scripts.
+
+Through the tunneled device, ``block_until_ready()`` has been observed
+returning before the program actually finishes (scripts/PROFILE.md):
+a stage timed as ``t0 = perf_counter(); fn().block_until_ready();
+dt = perf_counter() - t0`` under-reports by up to 1000x, and the bogus
+number then drives real optimization decisions.  The repo convention is
+to force a device->host copy (``np.asarray(out)``) as the fence —
+the data dependency cannot lie.  This rule finds the anti-pattern
+mechanically in the profiling/experiment scripts.
+
+Rule:
+  block-until-ready-in-timing   a ``.block_until_ready()`` call lexically
+                                inside a timed region — between the first
+                                and last ``time.perf_counter()`` /
+                                ``time.monotonic()`` reads of the same
+                                function scope (nested functions and
+                                lambdas are their own scopes, so warmup
+                                fences outside the timer and helpers that
+                                never time anything stay legal)
+
+Scope model is deliberately lexical, not dataflow: a timer read before
+and after a statement is what makes it "timed", and the profiling
+scripts are straight-line enough that this has no false positives on
+the repaired tree (fixtures in tests/test_analysis.py pin both
+directions).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob as _glob
+import os
+
+from .common import Finding, apply_suppressions
+
+# Profiling / experiment scripts, relative to the repo root (globs
+# allowed): the scripts whose printed numbers feed optimization
+# decisions.  bench.py's timed loops synchronize via np.asarray already
+# and its block_until_ready uses are warmup fences; it rides along so a
+# regression there fires too.
+DEFAULT_TARGETS = (
+    "scripts/profile_verify.py",
+    "scripts/exp_*.py",
+    "bench.py",
+)
+
+_TIMER_READS = {"perf_counter", "monotonic", "perf_counter_ns",
+                "monotonic_ns"}
+
+
+def _scopes(tree: ast.Module):
+    """Yield (scope node, direct statements/expressions) with nested
+    function/lambda bodies cut out — each function times (or doesn't)
+    on its own."""
+    nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+    def direct_nodes(root):
+        out = []
+        stack = [iter(ast.iter_child_nodes(root))]
+        while stack:
+            try:
+                node = next(stack[-1])
+            except StopIteration:
+                stack.pop()
+                continue
+            if isinstance(node, nested):
+                continue  # its body is a separate scope
+            out.append(node)
+            stack.append(iter(ast.iter_child_nodes(node)))
+        return out
+
+    yield tree, direct_nodes(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, nested):
+            yield node, direct_nodes(node)
+
+
+def check_source(path: str, source: str) -> list:
+    findings = []
+    tree = ast.parse(source, filename=path)
+    for _scope, nodes in _scopes(tree):
+        timer_lines = []
+        blockers = []
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr in _TIMER_READS:
+                    timer_lines.append(node.lineno)
+                elif func.attr == "block_until_ready":
+                    blockers.append(node)
+            elif isinstance(func, ast.Name) and func.id in _TIMER_READS:
+                timer_lines.append(node.lineno)
+        if len(timer_lines) < 2:
+            continue
+        lo, hi = min(timer_lines), max(timer_lines)
+        for node in blockers:
+            if lo < node.lineno < hi:
+                findings.append(Finding(
+                    path, node.lineno, "block-until-ready-in-timing",
+                    "block_until_ready() inside a timed region: through "
+                    "the tunneled device it can return before the program "
+                    "finishes (PROFILE.md: under-reports by ~1000x); "
+                    "fence with a forced D2H copy — np.asarray(out) — "
+                    "instead"))
+    return findings
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: source} mapping (the unit-test entry point)."""
+    findings = []
+    for path, src in sources.items():
+        findings += check_source(path, src)
+    return sorted(apply_suppressions(findings, sources),
+                  key=lambda f: (f.path, f.line))
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    sources = {}
+    for target in targets:
+        for path in sorted(_glob.glob(os.path.join(root, target))):
+            if not path.endswith(".py"):
+                continue
+            with open(path, encoding="utf-8") as fh:
+                sources[os.path.relpath(path, root)] = fh.read()
+    return check_sources(sources)

@@ -39,6 +39,12 @@ _TAG_PAIRS = (
     ("OP_BLS_SIGN", "kOpBlsSign"),
     ("OP_BLS_VERIFY_VOTES", "kOpBlsVerifyVotes"),
     ("OP_BLS_VERIFY_MULTI", "kOpBlsVerifyMulti"),
+    # protocol v2 (verifysched): class-tagged bulk verifies + telemetry,
+    # and the version constant itself — a bump on one side only means the
+    # other side was not audited for the layout change that caused it.
+    ("OP_VERIFY_BULK", "kOpVerifyBulk"),
+    ("OP_STATS", "kOpStats"),
+    ("PROTOCOL_VERSION", "kProtocolVersion"),
 )
 
 _LEN_PAIRS = (

@@ -273,14 +273,69 @@ def verify_batch_rlc(msgs, pks, sigs, *, pad: bool = True) -> np.ndarray:
     size should shard across the mesh instead —
     parallel/sharded_verify.verify_rlc_sharded).
     """
+    return verify_batch_rlc_submit(msgs, pks, sigs, pad=pad)()
+
+
+def verify_batch_rlc_submit(msgs, pks, sigs, *, pad: bool = True,
+                            on_bisect=None):
+    """Dispatch the combined RLC check WITHOUT fetching its verdict.
+
+    Returns a zero-argument ``fetch`` producing the (N,) bool mask
+    (bit-identical to :func:`verify_batch`), so the sidecar engine can
+    pipeline the next launch behind this one exactly like
+    :func:`verify_batch_submit`.  The all-valid steady state stays fully
+    asynchronous (one dispatched MSM, verdict read at fetch); only a
+    failed combined check falls back to synchronous bisection inside
+    ``fetch`` — the adversarial slow path, which already pays
+    per-signature prices.  ``on_bisect`` (if given) is invoked once when
+    that happens — how the scheduler's telemetry counts ``rlc_bisect``
+    launches without the crypto layer importing it.
+
+    Host-canonicality failures and degenerate sizes (fewer than
+    RLC_MIN_MSM canonical rows, or more than MAX_SUBBATCH) dispatch the
+    per-signature program instead — same contract, same mask.
+    """
     n = len(msgs)
     if n == 0:
-        return np.zeros((0,), bool)
+        return lambda: np.zeros((0,), bool)
     prep = prepare_batch(msgs, pks, sigs)
-    mask = np.zeros(n, bool)
+    packed = prep["packed"]
     idx = np.nonzero(prep["host_ok"])[0]
-    _rlc_resolve(prep["packed"], idx, mask, b"", pad)
-    return mask
+    m = len(idx)
+    if m < RLC_MIN_MSM or m > MAX_SUBBATCH:
+        rows = np.ascontiguousarray(packed[idx])
+        fetch_rows = _dispatch_rows(rows, m, pad) if m else None
+
+        def fetch_degenerate():
+            mask = np.zeros(n, bool)
+            if fetch_rows is not None:
+                mask[idx] = fetch_rows()
+            return mask
+
+        return fetch_degenerate
+    rows = np.ascontiguousarray(packed[idx])
+    bucket = _bucket(m) if pad else m
+    z = np.zeros((bucket, 32), np.uint8)
+    z[:m] = _rlc_coeffs(rows, b"")
+    if bucket != m:
+        rows = np.pad(rows, [(0, bucket - m), (0, 0)])
+    # Fresh host arrays -> fresh device buffers; the launch donates arg 0
+    # (same discipline as _dispatch_rows).
+    dev = E.verify_rlc_packed_donated(jnp.asarray(rows), jnp.asarray(z))
+
+    def fetch():
+        mask = np.zeros(n, bool)
+        if bool(np.asarray(dev)):
+            mask[idx] = True
+            return mask
+        if on_bisect is not None:
+            on_bisect()
+        mid = m // 2
+        _rlc_resolve(packed, idx[:mid], mask, b"L", pad)
+        _rlc_resolve(packed, idx[mid:], mask, b"R", pad)
+        return mask
+
+    return fetch
 
 
 def _rlc_resolve(packed: np.ndarray, indices: np.ndarray,

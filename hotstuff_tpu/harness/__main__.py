@@ -27,6 +27,7 @@ def cmd_local(args):
         "duration": args.duration,
         "tpu_sidecar": use_sidecar,
         "sidecar_host_crypto": args.sidecar_host_crypto,
+        "sidecar_warm_rlc": args.warm_rlc,
         "scheme": args.scheme,
     })
     node_params = NodeParameters.default(
@@ -210,6 +211,11 @@ def main(argv=None):
                         "sidecar never becomes ready)")
     p.add_argument("--tpu-sidecar", action="store_true",
                    help="route QC verification through the TPU sidecar")
+    p.add_argument("--warm-rlc", action="store_true",
+                   help="also pre-compile the sidecar's one-MSM RLC "
+                        "shapes so coalesced batches route through the "
+                        "combined check (adds boot-time compiles, cached "
+                        "across restarts)")
     p.add_argument("--chain", type=int, choices=[2, 3], default=2,
                    help="commit-rule depth: 2-chain (default) or 3-chain")
     p.add_argument("--scheme", choices=["ed25519", "bls"],

@@ -200,6 +200,8 @@ class BenchParameters:
             self.tpu_sidecar = bool(json_input.get("tpu_sidecar", False))
             self.sidecar_host_crypto = bool(
                 json_input.get("sidecar_host_crypto", False))
+            self.sidecar_warm_rlc = bool(
+                json_input.get("sidecar_warm_rlc", False))
             self.scheme = str(json_input.get("scheme", "ed25519"))
         except KeyError as e:
             raise ConfigError(f"Malformed bench parameters: missing key {e}")

@@ -35,6 +35,8 @@ class LocalBench:
         self.tpu_sidecar = getattr(bench_parameters, "tpu_sidecar", False)
         self.sidecar_host_crypto = getattr(
             bench_parameters, "sidecar_host_crypto", False)
+        self.sidecar_warm_rlc = getattr(
+            bench_parameters, "sidecar_warm_rlc", False)
         if self.sidecar_host_crypto:
             self.tpu_sidecar = True  # host-crypto still runs the sidecar
         self.scheme = getattr(bench_parameters, "scheme", "ed25519")
@@ -120,11 +122,16 @@ class LocalBench:
             quorum = 2 * self.nodes // 3 + 1
             warm_bls = f" --warm-bls --warm-bls-multi {quorum}"
         hc = " --host-crypto" if host_crypto else ""
+        # RLC warmup is opt-in (each bucket is another boot-time compile,
+        # though cached across restarts) and meaningless in host mode.
+        warm_rlc = " --warm-rlc" \
+            if getattr(self, "sidecar_warm_rlc", False) and not host_crypto \
+            else ""
         # The degraded reboot appends to the log: the dead device
         # sidecar's output is the evidence needed to diagnose the wedge.
         self._background_run(
             f"python -m hotstuff_tpu.sidecar "
-            f"--port {self.SIDECAR_PORT}{warm_bls}{hc}",
+            f"--port {self.SIDECAR_PORT}{warm_bls}{warm_rlc}{hc}",
             PathMaker.sidecar_log_file(),
             append=self._degraded)
         # The BLS pairing program is a multi-minute first compile on the
@@ -146,6 +153,23 @@ class LocalBench:
                 "measure the device verify path.")
             self._degraded = True
             self._boot_sidecar(host_crypto=True)
+
+    def _fetch_sidecar_stats(self):
+        """Write the sidecar's OP_STATS snapshot next to the logs; best
+        effort — a wedged or already-dead sidecar loses telemetry, never
+        the run."""
+        import json
+
+        from ..sidecar.client import SidecarClient
+
+        try:
+            with SidecarClient(port=self.SIDECAR_PORT,
+                               timeout=10.0) as client:
+                stats = client.stats()
+            with open(PathMaker.sidecar_stats_file(), "w") as f:
+                json.dump(stats, f)
+        except (OSError, ConnectionError, ValueError) as e:
+            Print.warn(f"Could not fetch sidecar scheduler stats: {e}")
 
     def run(self, debug=False):
         assert isinstance(debug, bool)
@@ -226,6 +250,11 @@ class LocalBench:
             Print.info(f"Running benchmark ({self.duration} sec)...")
             sleep(2 * timeout / 1000)
             sleep(self.duration)
+            # Snapshot the scheduler telemetry BEFORE teardown (the
+            # OP_STATS counters die with the sidecar process); the parser
+            # folds the file into the summary's CONFIG notes.
+            if self.tpu_sidecar:
+                self._fetch_sidecar_stats()
             self._kill_nodes()
 
             # Parse logs and return the summary.

@@ -37,9 +37,10 @@ class LogParser:
 
         self.faults = faults
         # Free-form annotations appended to the CONFIG section of the
-        # summary (e.g. the harness marking a degraded host-crypto run).
-        # Extra lines are invisible to the frozen result-grammar parsers,
-        # which match labelled fields only.
+        # summary (e.g. the harness marking a degraded host-crypto run,
+        # or the sidecar's verifysched telemetry).  Extra lines are
+        # invisible to the frozen result-grammar parsers, which match
+        # labelled fields only.
         self.notes = []
         if isinstance(faults, int):
             self.committee_size = len(nodes) + int(faults)
@@ -262,6 +263,43 @@ class LogParser:
             "-----------------------------------------\n"
         )
 
+    def note_sidecar_stats(self, stats: dict):
+        """Fold a verifysched OP_STATS snapshot (sidecar/sched/stats.py
+        schema) into the summary's CONFIG notes — label-free lines, so
+        the frozen result grammar never sees them.  Telemetry is
+        best-effort: a snapshot with hostile value types (a
+        version-skewed sidecar, a writer cut off mid-dump) adds no
+        notes at all rather than raising or leaving a partial block."""
+        if not isinstance(stats, dict) or not stats.get("launches"):
+            return
+        lines = []
+        try:
+            by_class = stats.get("launches_by_class", {})
+            lines.append(
+                f"Sidecar launches: {stats['launches']:,} "
+                f"(latency {by_class.get('latency', 0):,}, "
+                f"bulk {by_class.get('bulk', 0):,})")
+            paths = stats.get("paths", {})
+            if paths:
+                lines.append("Sidecar verify paths: " + ", ".join(
+                    f"{k}={v:,}" for k, v in sorted(paths.items())))
+            waits = stats.get("queue_wait", {})
+            if waits:
+                lines.append("Sidecar queue wait: " + ", ".join(
+                    f"{cls} p50 {w.get('p50_ms', 0)} ms / "
+                    f"p99 {w.get('p99_ms', 0)} ms"
+                    for cls, w in sorted(waits.items()) if w.get("n")))
+            lines.append(
+                f"Sidecar pad fill: {stats.get('bulk_fill_sigs', 0):,} "
+                f"sigs (waste {stats.get('pad_waste_sigs', 0):,})")
+            full = stats.get("queue_full", {})
+            if any(full.values()):
+                lines.append("Sidecar queue-full sheds: " + ", ".join(
+                    f"{k}={v:,}" for k, v in sorted(full.items())))
+        except (TypeError, ValueError, AttributeError):
+            return
+        self.notes.extend(lines)
+
     def print(self, filename):
         assert isinstance(filename, str)
         with open(filename, "a") as f:
@@ -278,4 +316,15 @@ class LogParser:
         for filename in sorted(glob(join(directory, "node-*.log"))):
             with open(filename, "r") as f:
                 nodes.append(f.read())
-        return cls(clients, nodes, faults)
+        parser = cls(clients, nodes, faults)
+        # The harness drops the sidecar's scheduler telemetry here at
+        # teardown (LocalBench._fetch_sidecar_stats); a missing or
+        # malformed file simply means no sidecar ran.
+        try:
+            import json
+
+            with open(join(directory, "sidecar-stats.json")) as f:
+                parser.note_sidecar_stats(json.load(f))
+        except (OSError, ValueError):
+            pass
+        return parser

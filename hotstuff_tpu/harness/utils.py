@@ -64,6 +64,11 @@ class PathMaker:
         return join(PathMaker.logs_path(), "sidecar.log")
 
     @staticmethod
+    def sidecar_stats_file():
+        """verifysched OP_STATS snapshot, fetched at teardown (JSON)."""
+        return join(PathMaker.logs_path(), "sidecar-stats.json")
+
+    @staticmethod
     def results_path():
         return "results"
 

@@ -1,2 +1,2 @@
-from .client import SidecarClient  # noqa: F401
+from .client import SidecarClient, SidecarOverloaded  # noqa: F401
 from .service import VerifyEngine, SidecarServer, serve  # noqa: F401

@@ -9,6 +9,12 @@ import threading
 from . import protocol as proto
 
 
+class SidecarOverloaded(RuntimeError):
+    """The sidecar's class queue was full and it shed this request
+    (explicit empty-body backpressure reply — see protocol.py).  The
+    caller decides: retry after a backoff, or verify on host."""
+
+
 class SidecarClient:
     """Blocking, thread-safe client with request pipelining."""
 
@@ -40,36 +46,68 @@ class SidecarClient:
         self._await(rid)
         return True
 
-    def verify_batch(self, msgs, pks, sigs) -> list:
-        """Returns per-signature validity list of bools."""
+    def verify_batch(self, msgs, pks, sigs, *, bulk: bool = False) -> list:
+        """Returns per-signature validity list of bools.
+
+        ``bulk=True`` tags the request bulk-class on the wire
+        (OP_VERIFY_BULK): it coalesces behind consensus-latency verifies
+        instead of ahead of them.  Mempool batch verification and
+        offchain sweeps should pass it; QC/TC verification must not.
+
+        Raises :class:`SidecarOverloaded` when the sidecar sheds the
+        request (its class queue was full)."""
         if not msgs:
             return []
-        rid = self._send(lambda r: proto.encode_request(r, msgs, pks, sigs))
-        return [bool(b) for b in self._await(rid)]
+        op = proto.OP_VERIFY_BULK if bulk else proto.OP_VERIFY_BATCH
+        rid = self._send(
+            lambda r: proto.encode_request(r, msgs, pks, sigs, opcode=op))
+        body = self._await(rid)
+        if len(body) != len(msgs):
+            raise SidecarOverloaded(
+                f"sidecar shed {'bulk' if bulk else 'latency'}-class "
+                f"verify of {len(msgs)} records (queue full)")
+        return [bool(b) for b in body]
+
+    def stats(self) -> dict:
+        """Scheduler-telemetry snapshot (the OP_STATS round trip)."""
+        rid = self._send(proto.encode_stats_request)
+        return proto.decode_stats_body(bytes(self._await(rid)))
 
     def bls_verify_aggregate(self, msg: bytes, agg_sig: bytes, pks) -> bool:
         """Common-message BLS aggregate verify (pks: 96 B uncompressed G1,
-        agg_sig: 192 B uncompressed G2)."""
+        agg_sig: 192 B uncompressed G2).  Raises SidecarOverloaded on a
+        queue-full shed — an overload must never read as 'forged'."""
         rid = self._send(
             lambda r: proto.encode_bls_agg_request(r, msg, agg_sig, pks))
-        body = self._await(rid)
-        return bool(body and body[0])
+        return self._bls_verdict(self._await(rid))
 
     def bls_verify_multi(self, msgs, pks, sigs) -> bool:
         """Multi-digest BLS verify (the TC shape): n (digest, pk, sig)
-        triples checked as one product of pairings in ONE round-trip."""
+        triples checked as one product of pairings in ONE round-trip.
+        Raises SidecarOverloaded on a queue-full shed."""
         rid = self._send(
             lambda r: proto.encode_bls_multi_request(r, msgs, pks, sigs))
-        body = self._await(rid)
-        return bool(body and body[0])
+        return self._bls_verdict(self._await(rid))
+
+    @staticmethod
+    def _bls_verdict(body) -> bool:
+        # A real BLS verdict is always exactly one 0/1 byte (errors reply
+        # [False], never nothing) — an empty body is the scheduler's
+        # explicit queue-full shed, which must surface as overload, not
+        # as an invalid certificate.
+        if not body:
+            raise SidecarOverloaded(
+                "sidecar shed BLS verify (queue full)")
+        return bool(body[0])
 
     def bls_sign(self, msg: bytes, sk: bytes) -> bytes:
         """BLS sign via the sidecar's host signer -> 192 B G2 signature.
-        Raises on failure (the service replies with an empty body)."""
+        Raises on failure (signing errors and queue-full sheds both
+        reply with an empty body; either way the caller retries)."""
         rid = self._send(lambda r: proto.encode_bls_sign_request(r, msg, sk))
         sig = bytes(self._await(rid))
         if len(sig) != proto.BLS_SIG_LEN:
-            raise RuntimeError("sidecar BLS signing failed")
+            raise RuntimeError("sidecar BLS signing failed or shed")
         return sig
 
     # -- internals ---------------------------------------------------------

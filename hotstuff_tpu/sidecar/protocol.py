@@ -49,6 +49,32 @@ OP_BLS_VERIFY_VOTES = 5
 # prod e(pk_i, H(m_i)) == e(g1, sum sig_i) under a single final
 # exponentiation. Reply: one 0/1 byte.
 OP_BLS_VERIFY_MULTI = 6
+# Protocol v2 (verifysched): request CLASS rides in the opcode, so v1
+# clients keep their correct latency-class behavior without a flag day.
+# OP_VERIFY_BATCH is the latency class (consensus QC/TC verifies, bounds
+# commit latency); OP_VERIFY_BULK is the bulk class (mempool / offchain
+# batch verifies — throughput-bound, yields to latency work).  Same
+# frame layout as OP_VERIFY_BATCH in both directions.
+OP_VERIFY_BULK = 7
+# Scheduler-telemetry snapshot: header-only request (count 0, like
+# PING); the reply body is one UTF-8 JSON object (the engine's
+# stats_snapshot() dict — schema in sidecar/sched/stats.py), framed by
+# encode_reply_raw with count = body length.
+OP_STATS = 8
+
+# Version of this wire protocol, bumped when the opcode set or any frame
+# layout changes (v2: OP_VERIFY_BULK + OP_STATS).  Mirrored by the C++
+# client's kProtocolVersion; graftlint's wire cross-checker pins the
+# pair.  Replies an unknown-opcode ValueError on older peers rather than
+# desyncing, so the constant is documentation + lint anchor, not a
+# handshake.
+PROTOCOL_VERSION = 2
+
+# Backpressure contract (v2): when a class queue is full, the sidecar
+# replies immediately with an EMPTY body (count 0) for a request that
+# carried records — unambiguous, because a real verdict mask always has
+# exactly the request's record count.  Clients shed to host verify (C++)
+# or raise SidecarOverloaded (python) instead of blocking.
 
 _HDR = struct.Struct("<BIIH")  # opcode, request id, count, msg_len
 _REPLY_HDR = struct.Struct("<BII")
@@ -105,11 +131,13 @@ class BlsMultiRequest:
     sigs: list            # n x 192 B uncompressed G2
 
 
-def encode_request(request_id: int, msgs, pks, sigs) -> bytes:
+def encode_request(request_id: int, msgs, pks, sigs,
+                   opcode: int = OP_VERIFY_BATCH) -> bytes:
     n = len(msgs)
     assert len(pks) == n and len(sigs) == n
+    assert opcode in (OP_VERIFY_BATCH, OP_VERIFY_BULK)
     msg_len = len(msgs[0]) if n else 0
-    parts = [_HDR.pack(OP_VERIFY_BATCH, request_id, n, msg_len)]
+    parts = [_HDR.pack(opcode, request_id, n, msg_len)]
     for m, p, s in zip(msgs, pks, sigs):
         assert len(m) == msg_len and len(p) == ED_PK_LEN \
             and len(s) == ED_SIG_LEN
@@ -123,6 +151,34 @@ def encode_request(request_id: int, msgs, pks, sigs) -> bytes:
 def encode_ping(request_id: int = 0) -> bytes:
     payload = _HDR.pack(OP_PING, request_id, 0, 0)
     return struct.pack(">I", len(payload)) + payload
+
+
+def encode_stats_request(request_id: int = 0) -> bytes:
+    """Header-only telemetry request (count 0, like PING)."""
+    payload = _HDR.pack(OP_STATS, request_id, 0, 0)
+    return struct.pack(">I", len(payload)) + payload
+
+
+def encode_stats_reply(request_id: int, snapshot: dict) -> bytes:
+    """Stats snapshot dict -> raw-reply frame (UTF-8 JSON body)."""
+    import json
+
+    body = json.dumps(snapshot, sort_keys=True).encode("utf-8")
+    return encode_reply_raw(OP_STATS, request_id, body)
+
+
+def decode_stats_body(body: bytes) -> dict:
+    """Raw OP_STATS reply body -> snapshot dict (ValueError on garbage,
+    same contract as decode_request)."""
+    import json
+
+    try:
+        out = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ValueError(f"bad stats body: {e}")
+    if not isinstance(out, dict):
+        raise ValueError("stats body is not a JSON object")
+    return out
 
 
 def encode_bls_agg_request(request_id: int, msg: bytes, agg_sig: bytes,
@@ -169,11 +225,11 @@ def decode_request(payload: bytes):
         opcode, request_id, n, msg_len = _HDR.unpack_from(payload, 0)
     except struct.error as e:
         raise ValueError(f"short frame: {e}")
-    if opcode not in (OP_VERIFY_BATCH, OP_PING, OP_BLS_VERIFY_AGG,
-                      OP_BLS_SIGN, OP_BLS_VERIFY_VOTES,
+    if opcode not in (OP_VERIFY_BATCH, OP_VERIFY_BULK, OP_PING, OP_STATS,
+                      OP_BLS_VERIFY_AGG, OP_BLS_SIGN, OP_BLS_VERIFY_VOTES,
                       OP_BLS_VERIFY_MULTI):
         raise ValueError(f"unknown opcode {opcode}")
-    if opcode == OP_PING:
+    if opcode in (OP_PING, OP_STATS):
         return opcode, VerifyRequest(request_id, [], [], [])
     if opcode == OP_BLS_VERIFY_AGG:
         off = _HDR.size

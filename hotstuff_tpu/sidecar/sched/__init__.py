@@ -1,0 +1,20 @@
+"""verifysched: the deadline-aware two-class batching scheduler that owns
+all launch-shape policy for the verify sidecar's device engine.
+
+Modules:
+  classes.py    request classes (latency / bulk), Pending/Launch/queue types
+  shapes.py     warmed-shape registry + verify-path routing (per-sig vs RLC)
+  scheduler.py  admission, strict-priority coalescing, pad-fill, carry-over
+  stats.py      per-launch telemetry behind the OP_STATS wire request
+
+``sidecar/service.VerifyEngine`` consumes launches; policy lives here.
+See scheduler.py for the policy rationale and sidecar/README notes.
+"""
+
+from .classes import BULK, CLASSES, LATENCY, Launch, Pending, \
+    class_of_opcode  # noqa: F401
+from .scheduler import BULK_QUEUE_CAP_SIGS, LATENCY_QUEUE_CAP_SIGS, \
+    Scheduler  # noqa: F401
+from .shapes import PATH_HOST, PATH_MESH, PATH_PER_SIG, PATH_RLC, \
+    RLC_MIN_LAUNCH, ShapeRegistry  # noqa: F401
+from .stats import SchedStats  # noqa: F401

@@ -1,0 +1,135 @@
+"""Request-class taxonomy for the verify scheduler.
+
+Two classes exist on the wire and in the queues:
+
+``LATENCY``
+    QC/TC verifies from the consensus core (``OP_VERIFY_BATCH`` and every
+    BLS verify/sign opcode).  HotStuff's responsiveness argument makes
+    this the number that bounds commit latency: a replica cannot vote,
+    and a leader cannot assemble the next block, until the previous
+    certificate's signatures check out.  A latency request therefore
+    never waits behind more than the launch already in flight.
+
+``BULK``
+    Mempool / offchain batch verifies (``OP_VERIFY_BULK``).  Throughput
+    matters, per-request latency does not; bulk batches coalesce up to
+    the bulk launch cap and yield to any pending latency work.
+
+The mapping opcode -> class lives here (``class_of_opcode``) so the
+connection handler, the scheduler, and the tests agree on one source of
+truth.  Classes ride the wire as distinct opcodes rather than a header
+flag: existing ``OP_VERIFY_BATCH`` clients keep their (correct)
+latency-class behavior without a flag day, and the graftlint wire
+cross-checker pins the opcode pair on both sides of the boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+from time import monotonic
+
+# Class identifiers (also the keys of every per-class stats dict).
+LATENCY = "latency"
+BULK = "bulk"
+
+CLASSES = (LATENCY, BULK)
+
+
+def class_of_opcode(opcode: int) -> str:
+    """Wire opcode -> scheduling class (one source of truth)."""
+    from .. import protocol as proto
+
+    return BULK if opcode == proto.OP_VERIFY_BULK else LATENCY
+
+
+class Pending:
+    """One admitted request: the decoded dataclass, its reply callback,
+    its class, and the admission timestamp (queue-wait telemetry)."""
+
+    __slots__ = ("request", "reply_fn", "cls", "enqueued_at", "is_bls")
+
+    def __init__(self, request, reply_fn, cls: str = LATENCY,
+                 is_bls: bool = False):
+        self.request = request
+        self.reply_fn = reply_fn
+        self.cls = cls
+        self.is_bls = is_bls
+        self.enqueued_at = monotonic()
+
+    def __len__(self):
+        """Signature-record count (BLS requests schedule as one unit)."""
+        if self.is_bls:
+            return 1
+        return len(self.request.msgs)
+
+
+class Launch:
+    """One assembled device launch: ordered items plus bookkeeping the
+    engine thread needs to fan replies back out.
+
+    ``kind`` is ``"verify"`` (a coalesced Ed25519 batch — possibly a
+    latency batch padded out with bulk fill) or ``"bls"`` (a single BLS
+    request, executed alone).  ``fill_count`` counts the trailing items
+    that rode along as pad fill (telemetry only — replies are uniform).
+    """
+
+    __slots__ = ("kind", "items", "cls", "fill_count", "assembled_at")
+
+    def __init__(self, kind: str, items: list, cls: str,
+                 fill_count: int = 0):
+        self.kind = kind
+        self.items = items
+        self.cls = cls
+        self.fill_count = fill_count
+        self.assembled_at = monotonic()
+
+    @property
+    def total_sigs(self) -> int:
+        return sum(len(p) for p in self.items)
+
+
+class ClassQueue:
+    """Bounded FIFO for one class, counted in signature records.
+
+    ``offer`` is called from connection threads and never blocks: a full
+    queue returns False and the caller replies queue-full immediately —
+    the bounded-backpressure contract that keeps a flooded sidecar from
+    wedging every connection thread behind one blocking ``put``.  The
+    engine thread is the only consumer.  A lock (shared with the
+    scheduler, which needs cross-queue atomicity when assembling) guards
+    the deque + the signature count.
+    """
+
+    __slots__ = ("items", "cap_sigs", "sigs", "_lock")
+
+    def __init__(self, cap_sigs: int, lock: threading.Condition):
+        from collections import deque
+
+        self.items: "deque[Pending]" = deque()
+        self.cap_sigs = cap_sigs
+        self.sigs = 0
+        self._lock = lock
+
+    def offer(self, pending: Pending) -> bool:
+        with self._lock:
+            # A request is admitted whole or not at all; a single request
+            # bigger than the whole cap is still admitted when the queue
+            # is empty (it slices inside the engine) so a legal client
+            # can never be starved by its own size.
+            if self.sigs and self.sigs + len(pending) > self.cap_sigs:
+                return False
+            self.items.append(pending)
+            self.sigs += len(pending)
+            self._lock.notify()
+            return True
+
+    def _pop_locked(self) -> Pending:
+        p = self.items.popleft()
+        self.sigs -= len(p)
+        return p
+
+    def __bool__(self):
+        return bool(self.items)
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,184 @@
+"""Deadline-aware two-class batching scheduler for the verify engine.
+
+Replaces the engine's single FIFO coalescing loop with explicit policy,
+the shape continuous-batching servers converged on (Orca's per-class
+admission + iteration-level scheduling, adapted to signature batches):
+
+Strict latency priority.
+    Whenever latency-class work is queued, the next launch is assembled
+    from the latency queue only — a QC verify never waits behind a bulk
+    backlog, only behind the launch already in flight (the engine's
+    pipeline bounds that to PIPELINE_DEPTH launches).
+
+Carry-over within a class.
+    Coalescing never splits a request.  A head request that does not fit
+    the remaining launch budget simply stays queued and is guaranteed to
+    LEAD the next launch of its class (``carries`` telemetry counts how
+    often) — the FIFO position is the fairness token, so an over-budget
+    bulk batch cannot be displaced forever by smaller arrivals.
+
+Bulk pad-fill (carry-over fairness across classes).
+    Launch shapes are padded to power-of-two buckets, so a latency
+    launch of n unique records ships ``bucket(n) - n`` dead slots
+    anyway.  Those slots are filled with whole bulk requests that fit
+    (room is sized off the DEDUPED latency record count — see
+    ``_assemble_locked`` — so fill can never grow the compiled shape) —
+    the latency launch shape, and therefore its time, is unchanged, and
+    bulk traffic keeps draining at least at the pad-waste rate even
+    under 100%% sustained latency load.  Strict priority alone would
+    starve bulk in exactly that regime; a time-slice would trade
+    consensus latency away.  Pad-fill does neither.
+
+Bounded backpressure.
+    Both queues are bounded in signature records; ``offer`` never
+    blocks.  A full queue is an explicit queue-full reply to the client
+    (which falls back to host verify or retries), never a connection
+    thread wedged on an unbounded ``put`` — the engine always sees an
+    honest queue it can reason about.
+
+The scheduler owns queues and policy only; the device, the verify paths
+and the reply fan-out stay in ``sidecar/service.VerifyEngine``.
+"""
+
+from __future__ import annotations
+
+import threading
+from time import monotonic
+
+from ...crypto.eddsa import MAX_SUBBATCH
+from .classes import BULK, LATENCY, ClassQueue, Launch, Pending
+from .shapes import ShapeRegistry
+from .stats import SchedStats
+
+# Admission caps (signature records queued, not requests).  Latency is
+# sized for bursts of full-committee QC verifies; bulk for a few whole
+# coalesced launches — beyond that, shedding to the client beats hiding
+# an ever-growing backlog inside the sidecar.
+LATENCY_QUEUE_CAP_SIGS = 64 * 1024
+BULK_QUEUE_CAP_SIGS = 128 * 1024
+
+
+class Scheduler:
+    def __init__(self, shapes: ShapeRegistry | None = None,
+                 stats: SchedStats | None = None,
+                 latency_cap_sigs: int = LATENCY_QUEUE_CAP_SIGS,
+                 bulk_cap_sigs: int = BULK_QUEUE_CAP_SIGS):
+        self.shapes = shapes if shapes is not None else ShapeRegistry()
+        self.stats = stats if stats is not None else SchedStats()
+        self._cond = threading.Condition()
+        self._queues = {
+            LATENCY: ClassQueue(latency_cap_sigs, self._cond),
+            BULK: ClassQueue(bulk_cap_sigs, self._cond),
+        }
+
+    # -- admission (connection threads) -------------------------------------
+
+    def offer(self, request, reply_fn, cls: str = LATENCY,
+              is_bls: bool = False) -> bool:
+        """Admit one request; False means queue-full (the caller must
+        reply explicitly — nothing was retained)."""
+        pending = Pending(request, reply_fn, cls, is_bls=is_bls)
+        if self._queues[cls].offer(pending):
+            self.stats.note_admitted(cls)
+            return True
+        self.stats.note_queue_full(cls)
+        return False
+
+    def wake(self):
+        """Unblock a next_launch() waiter (shutdown path)."""
+        with self._cond:
+            self._cond.notify_all()
+
+    def queued_sigs(self, cls: str) -> int:
+        return self._queues[cls].sigs
+
+    # -- assembly (engine thread) -------------------------------------------
+
+    def next_launch(self, block: bool = True,
+                    timeout: float | None = None) -> Launch | None:
+        """Assemble the next launch, or None when (a) non-blocking and
+        idle, or (b) the timeout expired."""
+        deadline = None if timeout is None else monotonic() + timeout
+        with self._cond:
+            while True:
+                launch = self._assemble_locked()
+                if launch is not None or not block:
+                    return launch
+                wait = None if deadline is None \
+                    else max(0.0, deadline - monotonic())
+                if wait == 0.0 or not self._cond.wait(timeout=wait):
+                    if deadline is not None and monotonic() >= deadline:
+                        return None
+
+    def _assemble_locked(self) -> Launch | None:
+        lat, blk = self._queues[LATENCY], self._queues[BULK]
+        if lat:
+            if lat.items[0].is_bls:
+                launch = Launch("bls", [lat._pop_locked()], LATENCY)
+                # BLS runs one request per launch (nothing coalesces);
+                # capacity 1 keeps pad-waste at zero while the launch
+                # count and the latency queue-wait reservoir — where a
+                # seconds-long pairing backlog shows up — stay honest.
+                self.stats.note_launch(launch, 1, monotonic())
+                return launch
+            items, total = self._coalesce_locked(lat)
+            # Fill room comes from the DEDUPED record count, not the raw
+            # total: the engine dedups (msg, pk, sig) records before
+            # dispatch and launches bucket(unique), so under the headline
+            # shared-sidecar load (N replicas submitting the SAME QC,
+            # total >> unique) sizing fill off the raw total would grow
+            # the compiled shape past the latency batch's own bucket —
+            # the exact latency cost pad-fill promises not to incur.
+            # Each fill request is counted at its full record count
+            # (worst case: all its records are new), so unique-after-fill
+            # can never exceed the latency batch's bucket.  The dedup is
+            # computed only when fill is actually on the table (bulk
+            # queued, batch within one sub-batch) — it hashes every
+            # record while holding the admission lock, so the common
+            # pure-consensus case must not pay it per launch.
+            fill = []
+            if blk.items and total <= MAX_SUBBATCH:
+                uniq = len({rec for p in items
+                            for rec in zip(p.request.msgs, p.request.pks,
+                                           p.request.sigs)})
+                capacity = self.shapes.bucket_capacity(uniq)
+                fill = self._fill_locked(blk, capacity - uniq)
+            else:
+                capacity = self.shapes.bucket_capacity(total)
+            launch = Launch("verify", items + fill, LATENCY,
+                            fill_count=len(fill))
+            self.stats.note_launch(launch, capacity, monotonic())
+            return launch
+        if blk:
+            items, total = self._coalesce_locked(blk)
+            launch = Launch("verify", items, BULK)
+            self.stats.note_launch(
+                launch, self.shapes.bucket_capacity(total), monotonic())
+            return launch
+        return None
+
+    def _coalesce_locked(self, q: ClassQueue):
+        """Pop a FIFO run of same-class Ed25519 requests up to the launch
+        cap.  The head always ships (an oversized single request slices
+        inside the engine dispatch); a later head that would overflow the
+        budget stays queued and leads the next launch (carry-over)."""
+        cap = self.shapes.launch_cap
+        items = [q._pop_locked()]
+        total = len(items[0])
+        while q.items and not q.items[0].is_bls:
+            nxt_len = len(q.items[0])
+            if total + nxt_len > cap:
+                self.stats.note_carry(items[0].cls)
+                break
+            items.append(q._pop_locked())
+            total += nxt_len
+        return items, total
+
+    def _fill_locked(self, blk: ClassQueue, room: int):
+        """Whole bulk requests that fit the latency launch's pad slots."""
+        fill = []
+        while room > 0 and blk.items and len(blk.items[0]) <= room:
+            p = blk._pop_locked()
+            fill.append(p)
+            room -= len(p)
+        return fill

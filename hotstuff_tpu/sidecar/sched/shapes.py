@@ -1,0 +1,119 @@
+"""Warmed-shape registry: which compiled launch shapes exist, and which
+verify path a batch of size n should take.
+
+The engine may only launch shapes whose XLA programs were compiled
+before the socket bound (sidecar/service._warmup*): a first-time compile
+on the engine thread is a silent 30-60 s stall mid-traffic.  This
+registry is the single record of what was warmed:
+
+  * ``buckets``   — padded power-of-two batch shapes (8 .. MAX_SUBBATCH)
+                    for the per-signature ladder program;
+  * ``chunks``    — chunked-scan lengths g (2 .. 16) for bulk backlogs
+                    (g * MAX_SUBBATCH signatures in ONE dispatch);
+  * ``rlc_buckets`` — padded shapes of the one-MSM RLC program
+                    (ops/ed25519.verify_rlc_packed), compiled by
+                    ``--warm-rlc``.
+
+``route`` turns (batch size, warmed state) into the launch path — the
+policy that finally wires crypto/eddsa.verify_batch_rlc into the
+engine's coalesced launch path (the top ROADMAP item): batches of
+``RLC_MIN_LAUNCH`` or more signatures whose bucket is RLC-warmed pay one
+Straus MSM instead of 2n scalar ladders, and the bisection fallback
+inside the RLC path keeps the verdict mask bit-identical to the
+per-signature program whenever the combined check fails.
+
+Bucketing arithmetic is delegated to ``crypto/eddsa`` (``next_pow2`` /
+``_bucket``) — THE padding rule the graftlint padshape checker pins —
+so the registry can never disagree with the dispatch layer about which
+shape a size lands on.
+"""
+
+from __future__ import annotations
+
+from ...crypto.eddsa import MAX_SUBBATCH, _bucket, next_pow2
+
+# Engine-path RLC floor: below this the combined check's fixed
+# Horner/comb tail outweighs the saved ladders (crypto/eddsa.RLC_MIN_MSM
+# is the *bisection* floor, a different constant: bisection wants to go
+# as low as profitable, the engine wants to start where the MSM wins).
+RLC_MIN_LAUNCH = 16
+
+# Verify paths route() can answer (also the stats path-counter keys).
+PATH_PER_SIG = "per_sig"
+PATH_RLC = "rlc"
+PATH_HOST = "host"
+PATH_MESH = "mesh"
+
+
+class ShapeRegistry:
+    """Tracks warmed shapes; owned by the engine, read by the scheduler.
+
+    Mutations happen on the warmup path (before the server socket binds)
+    or from tests; reads happen on the engine thread.  No lock: the sets
+    are only ever grown, and a stale read can at worst route one batch
+    down the always-safe per-signature path.
+    """
+
+    def __init__(self, use_host: bool = False, mesh: bool = False):
+        self.use_host = use_host
+        self.mesh = mesh
+        self.buckets: set[int] = set()
+        self.chunks: set[int] = set()
+        self.rlc_buckets: set[int] = set()
+        # Per-launch cap in signatures; raised to the bulk cap only after
+        # the chunked-scan shapes are warmed (enable_bulk).
+        self.launch_cap = MAX_SUBBATCH
+
+    # -- warmup bookkeeping -------------------------------------------------
+
+    def mark_bucket(self, n: int):
+        self.buckets.add(_bucket(n))
+
+    def mark_chunks(self, g: int):
+        self.chunks.add(g)
+
+    def mark_rlc(self, n: int):
+        self.rlc_buckets.add(_bucket(n))
+
+    def enable_bulk(self, max_coalesced: int):
+        """Raise the per-launch cap; call only after the chunked-scan
+        shapes up to max_coalesced / MAX_SUBBATCH are compiled."""
+        self.launch_cap = max_coalesced
+
+    # -- shape queries ------------------------------------------------------
+
+    def bucket_capacity(self, n: int) -> int:
+        """Padded device capacity of an n-signature launch: the bucket
+        (or chunk-scan) shape the dispatch layer will actually compile —
+        the free room pad-fill may use without growing the launch.
+
+        Host mode has NO padding (the host path verifies exactly n
+        records, one ref.verify each), and the mesh path buckets
+        per-shard (a fill record can bump every shard's padded shape) —
+        in both, "pad slots" would be real extra latency work, so the
+        capacity is the batch itself and fill never happens."""
+        if self.use_host or self.mesh:
+            return n
+        if n <= MAX_SUBBATCH:
+            return _bucket(n)
+        g = next_pow2(-(-n // MAX_SUBBATCH))
+        return g * MAX_SUBBATCH
+
+    def route(self, n: int) -> str:
+        """Verify path for a coalesced batch of n unique records."""
+        if self.use_host:
+            return PATH_HOST
+        if self.mesh:
+            return PATH_MESH
+        if RLC_MIN_LAUNCH <= n <= MAX_SUBBATCH and \
+                _bucket(n) in self.rlc_buckets:
+            return PATH_RLC
+        return PATH_PER_SIG
+
+    def snapshot(self) -> dict:
+        return {
+            "launch_cap": self.launch_cap,
+            "buckets": sorted(self.buckets),
+            "chunks": sorted(self.chunks),
+            "rlc_buckets": sorted(self.rlc_buckets),
+        }

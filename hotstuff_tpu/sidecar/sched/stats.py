@@ -1,0 +1,120 @@
+"""Per-launch telemetry for the verify scheduler.
+
+Counters answer the questions the drain-loop engine could not: how big
+are launches actually (coalesce histogram), how much padded capacity is
+wasted (pad_waste vs bulk fill), which verify path ran (per_sig / rlc /
+rlc_bisect / host / mesh), how long requests sat queued per class
+(p50/p99), and how often backpressure fired.
+
+Exposed over the wire as the ``OP_STATS`` reply (one JSON object — the
+snapshot() dict verbatim), which the harness fetches at teardown into
+the LogParser summary and bench.py folds into the headline line.
+
+Writers: the engine thread (launch/path/wait counters) and connection
+threads (queue_full rejections, admissions).  One lock guards it all —
+every operation is a few integer bumps, invisible next to a device
+launch.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of an ascending list (empty -> 0)."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class SchedStats:
+    # Bounded queue-wait reservoirs per class: enough resolution for a
+    # p99 over a bench window, bounded so a week-long sidecar cannot
+    # grow without limit (newest samples win — the interesting tail).
+    WAIT_SAMPLES_CAP = 4096
+
+    def __init__(self):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self.launches = 0
+        self.launches_by_class: dict[str, int] = {}
+        # coalesce-size histogram: padded-bucket capacity -> launches
+        self.coalesce_hist: dict[int, int] = {}
+        self.sigs_launched = 0
+        self.pad_waste_sigs = 0          # padded slots left empty
+        self.bulk_fill_sigs = 0          # padded slots used by bulk fill
+        self.paths: dict[str, int] = {}  # per_sig / rlc / rlc_bisect / ...
+        self.admitted: dict[str, int] = {}
+        self.queue_full: dict[str, int] = {}
+        self.carries: dict[str, int] = {}
+        self._waits = {c: deque(maxlen=self.WAIT_SAMPLES_CAP)
+                       for c in ("latency", "bulk")}
+
+    # -- recording ----------------------------------------------------------
+
+    def note_admitted(self, cls: str):
+        with self._lock:
+            self.admitted[cls] = self.admitted.get(cls, 0) + 1
+
+    def note_queue_full(self, cls: str):
+        with self._lock:
+            self.queue_full[cls] = self.queue_full.get(cls, 0) + 1
+
+    def note_carry(self, cls: str):
+        with self._lock:
+            self.carries[cls] = self.carries.get(cls, 0) + 1
+
+    def note_launch(self, launch, capacity: int, now: float):
+        """One assembled launch: size/pad/fill accounting + queue waits.
+        ``capacity`` is the padded device shape the batch rides in."""
+        with self._lock:
+            self.launches += 1
+            self.launches_by_class[launch.cls] = \
+                self.launches_by_class.get(launch.cls, 0) + 1
+            total = launch.total_sigs
+            self.sigs_launched += total
+            self.coalesce_hist[capacity] = \
+                self.coalesce_hist.get(capacity, 0) + 1
+            self.pad_waste_sigs += max(0, capacity - total)
+            fill = launch.items[len(launch.items) - launch.fill_count:]
+            self.bulk_fill_sigs += sum(len(p) for p in fill)
+            for p in launch.items:
+                waits = self._waits.get(p.cls)
+                if waits is not None:
+                    waits.append(now - p.enqueued_at)
+
+    def note_path(self, path: str):
+        with self._lock:
+            self.paths[path] = self.paths.get(path, 0) + 1
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe dict: the OP_STATS reply body, byte-for-byte."""
+        with self._lock:
+            waits = {}
+            for cls, samples in self._waits.items():
+                vals = sorted(samples)
+                waits[cls] = {
+                    "n": len(vals),
+                    "p50_ms": round(_percentile(vals, 0.50) * 1e3, 3),
+                    "p99_ms": round(_percentile(vals, 0.99) * 1e3, 3),
+                }
+            return {
+                "launches": self.launches,
+                "launches_by_class": dict(self.launches_by_class),
+                "coalesce_hist": {str(k): v for k, v in
+                                  sorted(self.coalesce_hist.items())},
+                "sigs_launched": self.sigs_launched,
+                "pad_waste_sigs": self.pad_waste_sigs,
+                "bulk_fill_sigs": self.bulk_fill_sigs,
+                "paths": dict(self.paths),
+                "admitted": dict(self.admitted),
+                "queue_full": dict(self.queue_full),
+                "carries": dict(self.carries),
+                "queue_wait": waits,
+            }

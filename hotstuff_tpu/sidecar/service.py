@@ -1,12 +1,23 @@
 """TPU verify sidecar: a long-lived JAX process owning the accelerator.
 
 Architecture mirrors the reference's ``SignatureService`` actor
-(crypto/src/lib.rs:226-254) scaled to a process boundary: connection threads
-feed a bounded request queue; a single device thread drains it, coalesces
-pending requests into one padded device batch (so concurrent QC
-verifications from the consensus core and the vote aggregator share a single
-ladder launch), and fans replies back out.  Request/response framing in
-``protocol.py``.
+(crypto/src/lib.rs:226-254) scaled to a process boundary: connection
+threads admit requests into the two-class verifysched scheduler
+(``sidecar/sched/``); a single device thread asks the scheduler for
+launches, dispatches them down the routed verify path (per-signature
+ladders, or the one-MSM RLC program for warmed batch shapes), and fans
+replies back out.  Request/response framing in ``protocol.py``.
+
+Scheduling policy (details + rationale in sched/scheduler.py):
+  * ``latency`` class (consensus QC/TC verifies, all BLS ops) has strict
+    priority — it waits behind at most the launches already in flight;
+  * ``bulk`` class (OP_VERIFY_BULK mempool/offchain batches) coalesces
+    up to the bulk cap, rides the pad slots of latency launches so it
+    drains even under sustained latency load, and carries over whole
+    requests that miss a launch budget;
+  * both queues are bounded — a full queue is an explicit queue-full
+    reply (empty mask), never a blocked connection thread;
+  * every launch is counted (OP_STATS returns the telemetry snapshot).
 
 Run:  python -m hotstuff_tpu.sidecar --port 7100 [--mesh N]
 """
@@ -24,6 +35,7 @@ from time import monotonic
 import numpy as np
 
 from . import protocol as proto
+from . import sched as vsched
 
 log = logging.getLogger("sidecar")
 
@@ -41,26 +53,26 @@ from ..crypto.eddsa import MAX_SUBBATCH  # per-program sub-batch cap
 MAX_COALESCED = 16 * MAX_SUBBATCH
 
 
-class _Pending:
-    __slots__ = ("request", "reply_fn")
-
-    def __init__(self, request, reply_fn):
-        self.request = request
-        self.reply_fn = reply_fn
+# Back-compat alias: direct engine tests (and older embedders) wrap a
+# (request, reply_fn) pair this way; scheduling metadata defaults to the
+# latency class.
+_Pending = vsched.Pending
 
 
 class VerifyEngine:
-    """Owns the device; single consumer thread coalescing request batches."""
+    """Owns the device; single consumer thread draining scheduler launches."""
 
     def __init__(self, mesh_devices: int | None = None, use_host: bool = False):
-        self._queue: "queue.Queue[_Pending]" = queue.Queue(maxsize=1024)
-        self._carry: _Pending | None = None  # over-budget request held over
+        # All launch-shape policy lives in the scheduler subsystem: the
+        # shape registry records what the warmup compiled (until
+        # enable_bulk, launches cap at MAX_SUBBATCH; _warmup covers every
+        # padded bucket up to that cap, so warmed deployments never hit a
+        # first-time compile on this thread), and the two-class queues
+        # decide what each launch contains.
+        self._shapes = vsched.ShapeRegistry(
+            use_host=use_host, mesh=bool(mesh_devices and mesh_devices > 1))
+        self._sched = vsched.Scheduler(shapes=self._shapes)
         self._use_host = use_host
-        # Until the chunked-scan program shapes are warmed (enable_bulk),
-        # launches cap at MAX_SUBBATCH; _warmup covers every padded bucket
-        # up to that cap, so warmed deployments never hit a first-time
-        # compile on this thread.
-        self._launch_cap = MAX_SUBBATCH
         # Device multi-digest pairing programs compile one shape per vote
         # count (minutes each); only counts warmed via _warmup_bls_multi
         # may launch on device — others verify on host so a surprise TC
@@ -78,8 +90,20 @@ class VerifyEngine:
         self._stopped = threading.Event()
         self._thread.start()
 
-    def submit(self, request, reply_fn):
-        self._queue.put(_Pending(request, reply_fn))
+    def submit(self, request, reply_fn, cls: str = vsched.LATENCY,
+               is_bls: bool = False) -> bool:
+        """Admit one request into its class queue.  Returns False on
+        queue-full — nothing was retained and the CALLER must reply
+        (the handler sends the explicit empty-mask backpressure reply);
+        never blocks the calling connection thread."""
+        return self._sched.offer(request, reply_fn, cls=cls, is_bls=is_bls)
+
+    def stats_snapshot(self) -> dict:
+        """The OP_STATS reply body: scheduler telemetry + warmed shapes."""
+        snap = self._sched.stats.snapshot()
+        snap["shapes"] = self._shapes.snapshot()
+        snap["verdict_cache_entries"] = len(self._verdicts)
+        return snap
 
     def cached_verdicts(self, request):
         """[bool] if EVERY (msg, pk, sig) record of this Ed25519 verify
@@ -141,11 +165,11 @@ class VerifyEngine:
     def enable_bulk(self):
         """Raise the per-launch cap to MAX_COALESCED; call only after the
         chunked-scan shapes have been compiled (see _warmup_bulk)."""
-        self._launch_cap = MAX_COALESCED
+        self._shapes.enable_bulk(MAX_COALESCED)
 
     def stop(self):
         self._stopped.set()
-        self._queue.put(None)  # wake consumer
+        self._sched.wake()  # wake consumer
 
     # -- consumer ----------------------------------------------------------
 
@@ -161,27 +185,25 @@ class VerifyEngine:
 
         inflight = collections.deque()  # (batch, fetch_fn)
         while not self._stopped.is_set():
-            if self._carry is not None:
-                item, self._carry = self._carry, None
-            elif inflight:
-                # Work is pending on the device: don't block on the queue;
-                # drain the oldest launch if nothing new is waiting.
-                try:
-                    item = self._queue.get_nowait()
-                except queue.Empty:
+            if inflight:
+                # Work is pending on the device: don't block on the
+                # scheduler; drain the oldest launch if nothing is queued.
+                launch = self._sched.next_launch(block=False)
+                if launch is None:
                     self._drain_one(inflight)
                     continue
             else:
-                item = self._queue.get()
-            if item is None:
-                continue
+                # Bounded wait so a stop() that races the wait's entry is
+                # still observed promptly (same poll discipline as
+                # serve_forever).
+                launch = self._sched.next_launch(timeout=0.25)
+                if launch is None:
+                    continue
             # BLS requests run individually (a QC aggregate is one check;
             # there is nothing to coalesce) on the same device thread,
             # after all in-flight Ed25519 launches drain.
-            if isinstance(item.request, (proto.BlsAggRequest,
-                                         proto.BlsSignRequest,
-                                         proto.BlsVotesRequest,
-                                         proto.BlsMultiRequest)):
+            if launch.kind == "bls":
+                (item,) = launch.items
                 while inflight:
                     self._drain_one(inflight)
                 try:
@@ -190,21 +212,7 @@ class VerifyEngine:
                     log.exception("BLS request failed")
                     item.reply_fn(None)
                 continue
-            batch = [item]
-            total = len(item.request.msgs)
-            # coalesce whatever else is already waiting, up to the launch cap
-            while total < self._launch_cap:
-                try:
-                    nxt = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if nxt is None:
-                    continue
-                if total + len(nxt.request.msgs) > self._launch_cap:
-                    self._carry = nxt  # runs first in the next launch
-                    break
-                batch.append(nxt)
-                total += len(nxt.request.msgs)
+            batch = launch.items
             try:
                 inflight.append((batch, self._submit(batch)))
             except Exception:
@@ -266,19 +274,36 @@ class VerifyEngine:
         m_msgs = [r[0] for r in uniq_records]
         m_pks = [r[1] for r in uniq_records]
         m_sigs = [r[2] for r in uniq_records]
-        # The host path verifies per sub-batch; the device paths (single
-        # chip via eddsa.verify_batch_submit, mesh via
-        # verify_batch_sharded — both chunk internally) run up to a whole
-        # launch-cap window as one dispatch, so the per-dispatch tunnel
-        # cost is paid once.  A single request larger than the cap (the
-        # coalescer only bounds *additional* requests) is still sliced
-        # here so no request can force an unwarmed compile shape or an
-        # unbounded device allocation.
-        step = MAX_SUBBATCH if self._use_host else self._launch_cap
-        fetchers = [self._verify_submit(m_msgs[i:i + step],
-                                        m_pks[i:i + step],
-                                        m_sigs[i:i + step])
-                    for i in range(0, len(m_msgs), step)]
+        # Route via the warmed-shape registry: batches of RLC_MIN_LAUNCH+
+        # unique records whose padded bucket the RLC warmup compiled pay
+        # ONE Straus MSM (crypto/eddsa.verify_batch_rlc_submit) instead
+        # of per-signature ladders; its bisection fallback keeps the
+        # verdict mask bit-identical when the combined check fails.
+        stats = self._sched.stats
+        path = self._shapes.route(len(uniq_records))
+        if uniq_records:
+            stats.note_path(path)
+        if path == vsched.PATH_RLC:
+            from ..crypto import eddsa
+
+            fetchers = [eddsa.verify_batch_rlc_submit(
+                m_msgs, m_pks, m_sigs,
+                on_bisect=lambda: stats.note_path("rlc_bisect"))]
+        else:
+            # The host path verifies per sub-batch; the device paths
+            # (single chip via eddsa.verify_batch_submit, mesh via
+            # verify_batch_sharded — both chunk internally) run up to a
+            # whole launch-cap window as one dispatch, so the
+            # per-dispatch tunnel cost is paid once.  A single request
+            # larger than the cap (the coalescer only bounds *additional*
+            # requests) is still sliced here so no request can force an
+            # unwarmed compile shape or an unbounded device allocation.
+            step = MAX_SUBBATCH if self._use_host \
+                else self._shapes.launch_cap
+            fetchers = [self._verify_submit(m_msgs[i:i + step],
+                                            m_pks[i:i + step],
+                                            m_sigs[i:i + step])
+                        for i in range(0, len(m_msgs), step)]
 
         def fetch():
             fresh = []
@@ -466,6 +491,13 @@ class _Handler(socketserver.BaseRequestHandler):
                     outbox.put(proto.encode_reply(
                         proto.OP_PING, req.request_id, []))
                     continue
+                if opcode == proto.OP_STATS:
+                    # Telemetry snapshot, answered on the connection
+                    # thread: reading counters must never queue behind
+                    # the device work being diagnosed.
+                    outbox.put(proto.encode_stats_reply(
+                        req.request_id, engine.stats_snapshot()))
+                    continue
 
                 # Cache fast path: a fully-cached Ed25519 verify request is
                 # answered on THIS connection thread — no engine queue
@@ -475,21 +507,24 @@ class _Handler(socketserver.BaseRequestHandler):
                 # hops per cached answer is what saturates the host, not
                 # the device.  Dict reads under the GIL are safe against
                 # the engine thread's insert/evict writes.
-                if opcode == proto.OP_VERIFY_BATCH:
+                is_bls = False
+                if opcode in (proto.OP_VERIFY_BATCH, proto.OP_VERIFY_BULK):
                     verdicts = engine.cached_verdicts(req)
                     if verdicts is not None:
                         outbox.put(proto.encode_reply(
-                            proto.OP_VERIFY_BATCH, req.request_id,
-                            verdicts))
+                            opcode, req.request_id, verdicts))
                         continue
                 elif opcode in (proto.OP_BLS_VERIFY_AGG,
                                 proto.OP_BLS_VERIFY_VOTES,
                                 proto.OP_BLS_VERIFY_MULTI):
+                    is_bls = True
                     verdicts = engine.cached_bls_verdict(req)
                     if verdicts is not None:
                         outbox.put(proto.encode_reply(
                             opcode, req.request_id, verdicts))
                         continue
+                elif opcode == proto.OP_BLS_SIGN:
+                    is_bls = True
 
                 def reply(result, _rid=req.request_id, _op=opcode):
                     if _op == proto.OP_BLS_SIGN:
@@ -504,7 +539,21 @@ class _Handler(socketserver.BaseRequestHandler):
                     except queue.Full:
                         pass  # connection is wedged; drop, reader will reap
 
-                engine.submit(req, reply)
+                # Admission is bounded: a full class queue is answered
+                # HERE with an explicit empty-body reply (count 0 where
+                # records were sent — unambiguous, since a real verdict
+                # mask always matches the request count).  Clients shed
+                # to host verify / retry; no connection thread ever
+                # blocks on a saturated engine.
+                if not engine.submit(req, reply,
+                                     cls=vsched.class_of_opcode(opcode),
+                                     is_bls=is_bls):
+                    if opcode == proto.OP_BLS_SIGN:
+                        outbox.put(proto.encode_reply_raw(
+                            opcode, req.request_id, b""))
+                    else:
+                        outbox.put(proto.encode_reply(
+                            opcode, req.request_id, []))
         finally:
             outbox.put(None)
 
@@ -522,7 +571,8 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
           mesh_devices: int | None = None, use_host: bool = False,
           ready_event: threading.Event | None = None,
           warm_max: int = MAX_SUBBATCH, warm_bls: bool = False,
-          warm_bls_multi: int = 0, warm_bulk: bool = False):
+          warm_bls_multi: int = 0, warm_bulk: bool = False,
+          warm_rlc: bool = False):
     engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host)
     # Warm the jit cache BEFORE binding: until the socket exists, node
     # crypto gets ECONNREFUSED and falls back to host verify instead of
@@ -543,6 +593,11 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
             # so every launchable mesh batch maps onto a shape warmed here.
             _warmup_bulk(engine)
             engine.enable_bulk()
+        if warm_rlc and not (mesh_devices and mesh_devices > 1):
+            # Single-chip only: the mesh path routes through
+            # verify_rlc_sharded (its own warmup story), and the shape
+            # registry never routes RLC in mesh/host mode.
+            _warmup_rlc(engine, warm_max)
     server = SidecarServer((host, port), engine)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
     if ready_event is not None:
@@ -602,7 +657,8 @@ def _warmup_bls_multi(engine, n_votes: int):
 
 def _warm_shapes(engine, start: int, stop: int, label: str):
     """Compile padded batch shapes start, 2*start, ... stop through the
-    engine's own verify path so the exact jitted callables are cached."""
+    engine's own verify path so the exact jitted callables are cached,
+    and record each shape in the scheduler's warmed-shape registry."""
     from ..crypto import ref_ed25519 as ref
 
     sk = bytes(range(32))
@@ -615,6 +671,10 @@ def _warm_shapes(engine, start: int, stop: int, label: str):
         mask = engine._verify([msg] * n, [pk] * n, [sig] * n)
         if not all(mask):
             log.error("%s verify returned false at N=%d", label, n)
+        if n <= MAX_SUBBATCH:
+            engine._shapes.mark_bucket(n)
+        else:
+            engine._shapes.mark_chunks(n // MAX_SUBBATCH)
         log.info("%s N=%d done in %.1fs", label, n, monotonic() - t0)
         n *= 2
 
@@ -636,6 +696,37 @@ def _warmup(engine, warm_max: int = MAX_SUBBATCH):
     engine's own verify path so the exact jitted callable is cached.
     """
     _warm_shapes(engine, 8, warm_max, "warmup")
+
+
+def _warmup_rlc(engine, warm_max: int = MAX_SUBBATCH):
+    """Compile the one-MSM RLC program at every padded bucket the engine
+    may route to it (RLC_MIN_LAUNCH .. warm_max), and register the shapes
+    so the scheduler's router starts choosing the RLC path.
+
+    Runs all-valid batches in INCREASING size through the real
+    verify_batch_rlc entry, so the bisection fallback's smaller-bucket
+    programs are always already compiled when a larger bucket first
+    bisects mid-traffic (the per-signature floor shapes come from
+    _warmup, which serve() always runs first).  Starts at the bucket
+    floor (8), BELOW the routing threshold: bisection halves sub-batches
+    down to RLC_MIN_MSM regardless of what the router admits, so the
+    small RLC shapes must exist even though no whole batch routes to
+    them."""
+    from ..crypto import eddsa, ref_ed25519 as ref
+
+    sk = bytes(range(32))
+    _, pk = ref.generate_keypair(sk)
+    msg = b"\x01" * 32
+    sig = ref.sign(sk, msg)
+    n = 8  # == crypto/eddsa._MIN_BUCKET, the smallest padded shape
+    while n <= min(warm_max, MAX_SUBBATCH):
+        t0 = monotonic()
+        mask = eddsa.verify_batch_rlc([msg] * n, [pk] * n, [sig] * n)
+        if not all(mask):
+            log.error("RLC warmup verify returned false at N=%d", n)
+        engine._shapes.mark_rlc(n)
+        log.info("RLC warmup N=%d done in %.1fs", n, monotonic() - t0)
+        n *= 2
 
 
 def main(argv=None):
@@ -661,6 +752,11 @@ def main(argv=None):
                     help="also pre-compile the chunked-scan bulk shapes and "
                          "raise the per-launch cap to %d sigs (bulk/offchain "
                          "workloads)" % MAX_COALESCED)
+    ap.add_argument("--warm-rlc", action="store_true",
+                    help="also pre-compile the one-MSM RLC batch-verify "
+                         "shapes so coalesced batches of %d+ signatures "
+                         "route through the combined check"
+                         % vsched.RLC_MIN_LAUNCH)
     ap.add_argument("-v", "--verbose", action="count", default=0)
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -670,7 +766,7 @@ def main(argv=None):
     serve(args.host, args.port, mesh_devices=args.mesh or None,
           use_host=args.host_crypto, warm_max=args.warm,
           warm_bls=args.warm_bls, warm_bls_multi=args.warm_bls_multi,
-          warm_bulk=args.warm_bulk)
+          warm_bulk=args.warm_bulk, warm_rlc=args.warm_rlc)
 
 
 if __name__ == "__main__":

@@ -257,7 +257,8 @@ bool Signature::verify_batch(
 }
 
 bool Signature::verify_batch_multi(
-    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    bool bulk) {
   // BLS TCs carry per-vote BLS signatures over distinct digests: ONE
   // multi-digest sidecar round-trip, verified device-side as a single
   // product of pairings (TC verify parity: consensus/src/messages.rs:
@@ -271,7 +272,7 @@ bool Signature::verify_batch_multi(
   }
   TpuVerifier* tpu = TpuVerifier::instance();
   if (tpu && tpu->connected()) {
-    auto mask = tpu->verify_batch_multi(items);
+    auto mask = tpu->verify_batch_multi(items, bulk);
     if (mask) {
       for (bool ok : *mask) {
         if (!ok) return false;

@@ -165,9 +165,13 @@ struct Signature {
   // Batch verification where every vote signed its own digest (a TC's
   // timeout votes). The reference verifies these one-by-one
   // (messages.rs:307-313); here they share a single device launch when the
-  // TpuVerifier is installed.
+  // TpuVerifier is installed.  `bulk` tags the sidecar scheduling class
+  // (protocol v2): consensus certificate verification keeps the default
+  // latency class; only throughput-bound batch workloads (the offchain
+  // sweep, mempool-style verification) pass true.
   static bool verify_batch_multi(
-      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      bool bulk = false);
 
   // True when a device verifier is installed, connected, and has spare
   // in-flight budget — i.e. verify_batch_multi_async will actually

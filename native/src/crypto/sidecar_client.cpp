@@ -17,6 +17,12 @@ constexpr uint8_t kOpBlsVerifyAgg = 3;  // NOLINT (wire constant, unused here)
 constexpr uint8_t kOpBlsSign = 4;
 constexpr uint8_t kOpBlsVerifyVotes = 5;
 constexpr uint8_t kOpBlsVerifyMulti = 6;
+// Protocol v2 (verifysched): bulk-class verifies ride a distinct opcode;
+// kOpVerifyBatch stays the latency class (consensus QC/TC verifies), so
+// the scheduler can launch them ahead of any bulk backlog.
+constexpr uint8_t kOpVerifyBulk = 7;
+constexpr uint8_t kOpStats = 8;  // NOLINT (wire constant, unused here)
+constexpr uint8_t kProtocolVersion = 2;  // NOLINT (lint anchor; no handshake)
 constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
@@ -225,14 +231,18 @@ void TpuVerifier::submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
 
 void TpuVerifier::verify_batch_multi_async(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-    MaskCallback cb) {
+    MaskCallback cb, bool bulk) {
+  // Class tag rides the opcode: consensus QC/TC verifies stay latency
+  // class (the sidecar launches them ahead of any bulk backlog); bulk
+  // callers (offchain sweeps, mempool-style batches) must say so.
+  const uint8_t opcode = bulk ? kOpVerifyBulk : kOpVerifyBatch;
   Writer w;
   uint32_t rid;
   {
     std::lock_guard<std::mutex> lk(inner_->m);
     rid = inner_->next_id++;
   }
-  write_header(&w, kOpVerifyBatch, rid, static_cast<uint32_t>(items.size()));
+  write_header(&w, opcode, rid, static_cast<uint32_t>(items.size()));
   for (const auto& [digest, pk, sig] : items) {
     if (sig.data.size() != 64) {  // not an Ed25519 sig
       cb(std::nullopt);
@@ -243,19 +253,28 @@ void TpuVerifier::verify_batch_multi_async(
     w.out.insert(w.out.end(), sig.data.begin(), sig.data.end());
   }
   size_t n_items = items.size();
-  submit_(kOpVerifyBatch, w.out, rid, kRecvTimeoutMs,
-          [cb = std::move(cb), rid, n_items](std::optional<Bytes> reply) {
+  submit_(opcode, w.out, rid, kRecvTimeoutMs,
+          [cb = std::move(cb), rid, n_items,
+           opcode](std::optional<Bytes> reply) {
             if (!reply) {
               cb(std::nullopt);
               return;
             }
             try {
               Reader r(*reply);
-              uint8_t opcode = r.u8();
+              uint8_t got_op = r.u8();
               uint32_t got_rid = r.u32();
               uint32_t n = r.u32();
-              if (opcode != kOpVerifyBatch || got_rid != rid ||
-                  n != n_items) {
+              if (got_op == opcode && got_rid == rid && n == 0 &&
+                  n_items != 0) {
+                // Explicit backpressure: the sidecar shed this request
+                // (class queue full).  nullopt -> caller's host fallback.
+                LOG_DEBUG("crypto::sidecar") << "sidecar queue full; "
+                                                "falling back to host";
+                cb(std::nullopt);
+                return;
+              }
+              if (got_op != opcode || got_rid != rid || n != n_items) {
                 LOG_WARN("crypto::sidecar") << "protocol mismatch from sidecar";
                 cb(std::nullopt);
                 return;
@@ -270,12 +289,15 @@ void TpuVerifier::verify_batch_multi_async(
 }
 
 std::optional<std::vector<bool>> TpuVerifier::verify_batch_multi(
-    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    bool bulk) {
   Oneshot<std::optional<std::vector<bool>>> done;
   verify_batch_multi_async(
-      items, [done](std::optional<std::vector<bool>> mask) {
+      items,
+      [done](std::optional<std::vector<bool>> mask) {
         done.set(std::move(mask));
-      });
+      },
+      bulk);
   return done.wait();  // bounded: every submitted callback fires by deadline
 }
 

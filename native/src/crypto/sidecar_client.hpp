@@ -51,9 +51,16 @@ class TpuVerifier {
   // One coalesced launch, one digest PER record (QC votes share a digest;
   // TC votes sign distinct (round, high_qc_round) digests — the wire
   // format carries a message per record either way). Returns nullopt on
-  // transport failure (caller falls back to host verify).
+  // transport failure OR an explicit queue-full shed by the sidecar's
+  // scheduler (caller falls back to host verify either way).
+  //
+  // `bulk` tags the request's scheduling class on the wire (protocol v2):
+  // false = latency class (consensus QC/TC verification — launched ahead
+  // of any bulk backlog), true = bulk class (mempool/offchain batches —
+  // coalesced behind latency work).  Consensus paths must NOT pass true.
   std::optional<std::vector<bool>> verify_batch_multi(
-      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      bool bulk = false);
 
   // Asynchronous form: the callback is invoked EXACTLY once — with the
   // validity mask on a reply, or nullopt on transport failure/timeout —
@@ -63,7 +70,7 @@ class TpuVerifier {
       std::function<void(std::optional<std::vector<bool>>)>;
   void verify_batch_multi_async(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-      MaskCallback cb);
+      MaskCallback cb, bool bulk = false);
 
   // scheme=bls operations (pairing lives only in the sidecar; signing is
   // its host G2 scalar mult). These use a longer deadline than Ed25519

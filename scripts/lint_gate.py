@@ -21,7 +21,15 @@ if __name__ == "__main__":
         argv += ["--root", REPO]
     if not any(a == "--must-cover" or a.startswith("--must-cover=")
                for a in argv):
-        # The RLC scalar module is device hot-path code: the gate fails
-        # if it ever moves out of the scanned target set.
-        argv += ["--must-cover", "hotstuff_tpu/ops/scalar25519.py"]
+        # The RLC scalar module is device hot-path code, and every
+        # verifysched module is engine-thread control plane: the gate
+        # fails if any of them ever moves out of the scanned target set
+        # (or is deleted without this pin being updated consciously).
+        for pin in ("hotstuff_tpu/ops/scalar25519.py",
+                    "hotstuff_tpu/sidecar/sched/__init__.py",
+                    "hotstuff_tpu/sidecar/sched/classes.py",
+                    "hotstuff_tpu/sidecar/sched/scheduler.py",
+                    "hotstuff_tpu/sidecar/sched/shapes.py",
+                    "hotstuff_tpu/sidecar/sched/stats.py"):
+            argv += ["--must-cover", pin]
     sys.exit(main(argv))

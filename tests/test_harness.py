@@ -72,6 +72,56 @@ def test_parser_mines_golden_logs():
     assert "Consensus latency" in out
 
 
+def test_parser_folds_sidecar_stats_into_notes():
+    """The verifysched OP_STATS snapshot renders as CONFIG notes — and
+    the labelled RESULTS grammar the aggregator parses is untouched."""
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_sidecar_stats({
+        "launches": 42,
+        "launches_by_class": {"latency": 40, "bulk": 2},
+        "paths": {"rlc": 30, "per_sig": 10, "rlc_bisect": 2},
+        "queue_wait": {"latency": {"n": 40, "p50_ms": 0.4, "p99_ms": 2.1},
+                       "bulk": {"n": 2, "p50_ms": 9.0, "p99_ms": 9.5}},
+        "bulk_fill_sigs": 128,
+        "pad_waste_sigs": 300,
+        "queue_full": {"bulk": 3},
+    })
+    out = parser.result()
+    assert "Sidecar launches: 42 (latency 40, bulk 2)" in out
+    assert "rlc=30" in out and "rlc_bisect=2" in out
+    assert "latency p50 0.4 ms / p99 2.1 ms" in out
+    assert "Sidecar pad fill: 128 sigs (waste 300)" in out
+    assert "Sidecar queue-full sheds: bulk=3" in out
+    # labelled grammar intact
+    assert "End-to-end TPS" in out and "Consensus latency" in out
+    # an idle / absent snapshot adds nothing
+    quiet = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    quiet.note_sidecar_stats({})
+    quiet.note_sidecar_stats({"launches": 0})
+    assert quiet.notes == []
+    # hostile value types (version-skewed sidecar, truncated writer):
+    # no exception, no partial note block
+    quiet.note_sidecar_stats({"launches": 1, "paths": {"rlc": None}})
+    quiet.note_sidecar_stats({"launches": "what", "queue_wait": 3})
+    assert quiet.notes == []
+
+
+def test_parser_process_reads_sidecar_stats_file(tmp_path):
+    import json
+
+    (tmp_path / "client-0.log").write_text(GOLDEN_CLIENT)
+    (tmp_path / "node-0.log").write_text(GOLDEN_NODE)
+    (tmp_path / "sidecar-stats.json").write_text(json.dumps({
+        "launches": 7, "launches_by_class": {"latency": 7},
+        "bulk_fill_sigs": 0, "pad_waste_sigs": 11}))
+    parser = LogParser.process(str(tmp_path), faults=0)
+    assert any("Sidecar launches: 7" in n for n in parser.notes)
+    # garbage file: telemetry is best-effort, parsing must survive
+    (tmp_path / "sidecar-stats.json").write_text("{not json")
+    parser = LogParser.process(str(tmp_path), faults=0)
+    assert parser.notes == []
+
+
 def test_parser_rejects_client_error():
     # The two fatal shapes the C++ client can emit.
     bad = GOLDEN_CLIENT + \

@@ -174,6 +174,9 @@ def test_protocol_decode_survives_hostile_bytes():
     good_frames = [
         proto.encode_request(1, [b"m" * 32] * 3, [b"p" * 32] * 3,
                              [b"s" * 64] * 3),
+        proto.encode_request(7, [b"m" * 32] * 3, [b"p" * 32] * 3,
+                             [b"s" * 64] * 3,
+                             opcode=proto.OP_VERIFY_BULK),
         proto.encode_bls_agg_request(3, b"d" * 32, b"g" * 192,
                                      [b"k" * 96] * 2),
         proto.encode_bls_sign_request(4, b"d" * 32, b"x" * 48),
@@ -193,9 +196,19 @@ def test_protocol_decode_survives_hostile_bytes():
         with pytest.raises(ValueError):
             proto.decode_request(payload + b"\x00" * 5)
 
-    # PING carries no records; trailing bytes are explicitly tolerated
+    # PING/STATS carry no records; trailing bytes are explicitly tolerated
     opcode, req = proto.decode_request(proto.encode_ping(2)[4:] + b"\x00")
     assert opcode == proto.OP_PING
+    opcode, req = proto.decode_request(
+        proto.encode_stats_request(8)[4:] + b"\x00")
+    assert opcode == proto.OP_STATS
+
+    # hostile stats bodies reject instead of crashing the client
+    with pytest.raises(ValueError):
+        proto.decode_stats_body(b"\xff\xfe not json")
+    with pytest.raises(ValueError):
+        proto.decode_stats_body(b"[1, 2, 3]")
+    assert proto.decode_stats_body(b"{\"launches\": 3}") == {"launches": 3}
 
     # random garbage: ValueError or (rarely) a well-formed parse, nothing else
     for size in (0, 1, 4, 10, 11, 64, 333):
@@ -207,8 +220,9 @@ def test_protocol_decode_survives_hostile_bytes():
     # hostile record counts far beyond the actual frame size must reject
     # BEFORE any allocation sized by the count (uses the real header
     # struct so this tracks wire-format changes)
-    for op in (proto.OP_VERIFY_BATCH, proto.OP_BLS_VERIFY_AGG,
-               proto.OP_BLS_VERIFY_VOTES, proto.OP_BLS_VERIFY_MULTI):
+    for op in (proto.OP_VERIFY_BATCH, proto.OP_VERIFY_BULK,
+               proto.OP_BLS_VERIFY_AGG, proto.OP_BLS_VERIFY_VOTES,
+               proto.OP_BLS_VERIFY_MULTI):
         hostile = proto._HDR.pack(op, 7, 0xFFFFFF, 32) + b"\x01" * 64
         with pytest.raises(ValueError):
             proto.decode_request(hostile)

@@ -1,0 +1,371 @@
+"""verifysched scheduler invariants: strict latency priority under a
+mixed-class soak, bounded backpressure (queue-full replies), carry-over
+fairness and bulk pad-fill, and the RLC-vs-per-signature verdict-mask
+equivalence asserted through the FULL engine path (not the crypto
+layer).
+"""
+
+import itertools
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
+from hotstuff_tpu.sidecar import protocol as proto
+from hotstuff_tpu.sidecar import sched as vsched
+from hotstuff_tpu.sidecar import service
+from hotstuff_tpu.sidecar.client import SidecarClient, SidecarOverloaded
+from hotstuff_tpu.sidecar.service import SidecarServer, VerifyEngine
+
+
+def _req(n, tag):
+    """A fake verify request of n records with distinct msg bytes (the
+    engine dedups identical (msg, pk, sig) records, so scheduling tests
+    must not reuse them)."""
+    msgs = [b"%16d|%16d" % (tag, i) for i in range(n)]
+    return SimpleNamespace(request_id=tag, msgs=msgs,
+                           pks=[b"p" * 32] * n, sigs=[b"s" * 64] * n)
+
+
+def _sigs(n, tamper=(), seed=7):
+    rng = np.random.default_rng(seed)
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msg = rng.bytes(32)
+        sig = ref.sign(sk, msg)
+        if i in tamper:
+            sig = sig[:1] + bytes([sig[1] ^ 0xFF]) + sig[2:]
+        msgs.append(msg)
+        pks.append(pk)
+        sigs.append(sig)
+    return msgs, pks, sigs
+
+
+# ---------------------------------------------------------------------------
+# scheduler-level policy (deterministic, single-threaded driving)
+# ---------------------------------------------------------------------------
+
+def test_latency_strict_priority_and_bulk_only_behind():
+    s = vsched.Scheduler()
+    order = []
+    for i in range(3):
+        assert s.offer(_req(600, 100 + i), order.append, cls=vsched.BULK)
+    assert s.offer(_req(100, 1), order.append, cls=vsched.LATENCY)
+    first = s.next_launch(block=False)
+    assert first.cls == vsched.LATENCY
+    assert [p.request.request_id for p in first.items] == [1]
+    # bucket(100) = 128; no 600-sig bulk request fits the 28 pad slots
+    assert first.fill_count == 0
+    for want in (100, 101, 102):
+        launch = s.next_launch(block=False)
+        assert launch.cls == vsched.BULK
+        assert [p.request.request_id for p in launch.items] == [want]
+    assert s.next_launch(block=False) is None
+
+
+def test_carry_over_keeps_fifo_and_leads_next_launch():
+    s = vsched.Scheduler()
+    assert s.shapes.launch_cap == eddsa.MAX_SUBBATCH
+    s.offer(_req(700, 1), lambda m: None, cls=vsched.BULK)
+    s.offer(_req(700, 2), lambda m: None, cls=vsched.BULK)
+    s.offer(_req(100, 3), lambda m: None, cls=vsched.BULK)
+    first = s.next_launch(block=False)
+    # 700 + 700 > 1024: request 2 is carried over, and request 3 must
+    # NOT jump the queue into the first launch (FIFO is the fairness
+    # token).
+    assert [p.request.request_id for p in first.items] == [1]
+    second = s.next_launch(block=False)
+    assert [p.request.request_id for p in second.items] == [2, 3]
+    assert s.stats.snapshot()["carries"] == {"bulk": 1}
+
+
+def test_oversized_single_request_still_ships():
+    s = vsched.Scheduler()
+    s.offer(_req(3000, 9), lambda m: None, cls=vsched.BULK)
+    launch = s.next_launch(block=False)
+    # Bigger than the launch cap: admitted whole (the engine dispatch
+    # slices it into warmed shapes); the coalescer only bounds additions.
+    assert launch.total_sigs == 3000
+
+
+def test_bulk_pad_fill_drains_under_sustained_latency_load():
+    s = vsched.Scheduler()
+    done_bulk = []
+    for i in range(10):
+        assert s.offer(_req(2, 200 + i), done_bulk.append,
+                       cls=vsched.BULK)
+    launches = []
+    # Sustained latency load: the latency queue is never empty when the
+    # engine asks for work, so no bulk-only launch can ever be
+    # assembled — pad-fill is the only drain.
+    for i in range(12):
+        s.offer(_req(4, i), lambda m: None, cls=vsched.LATENCY)
+        launch = s.next_launch(block=False)
+        assert launch.cls == vsched.LATENCY
+        launches.append(launch)
+        if s.queued_sigs(vsched.BULK) == 0:
+            break
+    assert s.queued_sigs(vsched.BULK) == 0, \
+        "bulk starved under sustained latency load"
+    # bucket(4) = 8 leaves 4 pad slots -> two 2-sig bulk requests ride
+    # each latency launch for free.
+    filled = [l for l in launches if l.fill_count]
+    assert filled and all(l.total_sigs <= 8 for l in launches)
+    snap = s.stats.snapshot()
+    assert snap["bulk_fill_sigs"] == 20
+    assert snap["launches_by_class"].get("bulk", 0) == 0
+
+
+def test_pad_fill_room_uses_deduped_records():
+    """N replicas submitting the SAME QC coalesce into one launch whose
+    device shape is bucket(unique records) — fill room must be sized off
+    that, or fill would grow the compiled shape and charge latency for
+    bulk's ride (the raw total here is 10 -> bucket 16 -> room 6, which
+    would push the unique count past bucket 8)."""
+    s = vsched.Scheduler()
+    s.offer(_req(5, 1), lambda m: None, cls=vsched.LATENCY)
+    s.offer(_req(5, 1), lambda m: None, cls=vsched.LATENCY)  # same records
+    for i in range(3):
+        s.offer(_req(3, 300 + i), lambda m: None, cls=vsched.BULK)
+    launch = s.next_launch(block=False)
+    assert launch.cls == vsched.LATENCY
+    # unique = 5 -> bucket 8 -> room 3: exactly one 3-sig bulk fill fits,
+    # and unique-after-fill (8) still rides the latency batch's bucket.
+    assert launch.fill_count == 1
+    assert launch.total_sigs == 13  # 10 raw latency + 3 fill
+    uniq = {rec for p in launch.items
+            for rec in zip(p.request.msgs, p.request.pks, p.request.sigs)}
+    assert len(uniq) <= 8
+
+
+def test_queue_full_offer_rejects_and_counts():
+    s = vsched.Scheduler(bulk_cap_sigs=8)
+    assert s.offer(_req(8, 1), lambda m: None, cls=vsched.BULK)
+    assert not s.offer(_req(4, 2), lambda m: None, cls=vsched.BULK)
+    # the other class is unaffected by bulk saturation
+    assert s.offer(_req(4, 3), lambda m: None, cls=vsched.LATENCY)
+    snap = s.stats.snapshot()
+    assert snap["queue_full"] == {"bulk": 1}
+    assert snap["admitted"] == {"bulk": 1, "latency": 1}
+
+
+# ---------------------------------------------------------------------------
+# mixed-priority soak through the full engine
+# ---------------------------------------------------------------------------
+
+def test_mixed_priority_soak_through_engine():
+    """Every latency-class request is launched before any bulk batch
+    assembled after it.  The engine's verify is stubbed (scheduling is
+    under test, not curve math) and slowed slightly so a real backlog
+    forms while requests stream in."""
+    engine = VerifyEngine(use_host=True)
+    admit_idx = {}
+    seq = itertools.count()
+    launches = []
+
+    def fake_verify_submit(msgs, pks, sigs):
+        time.sleep(0.02)  # dispatch cost: lets the queues build up
+        res = np.ones(len(msgs), bool)
+        return lambda: res
+
+    orig_submit = engine._submit
+
+    def spying_submit(batch):
+        launches.append([(p.cls, admit_idx[p.request.request_id])
+                         for p in batch])
+        return orig_submit(batch)
+
+    engine._verify_submit = fake_verify_submit
+    engine._submit = spying_submit
+    try:
+        replies = []
+        cond = threading.Condition()
+
+        def reply(mask):
+            with cond:
+                replies.append(mask)
+                cond.notify()
+
+        total = 0
+        rid = itertools.count(1)
+        for wave in range(6):
+            for _ in range(3):
+                r = _req(8, next(rid))
+                admit_idx[r.request_id] = next(seq)
+                assert engine.submit(r, reply, cls=vsched.BULK)
+                total += 1
+            for _ in range(2):
+                r = _req(3, next(rid))
+                admit_idx[r.request_id] = next(seq)
+                assert engine.submit(r, reply, cls=vsched.LATENCY)
+                total += 1
+        with cond:
+            assert cond.wait_for(lambda: len(replies) == total,
+                                 timeout=60.0)
+        # Reconstruct the invariant from the observed launch order:
+        # for every latency item, no bulk-ONLY launch consisting purely
+        # of later-admitted items may have launched before it.
+        for i, launch in enumerate(launches):
+            lat_admits = [a for cls, a in launch if cls == vsched.LATENCY]
+            if not lat_admits:
+                continue
+            for j in range(i):
+                earlier = launches[j]
+                if any(cls == vsched.LATENCY for cls, _ in earlier):
+                    continue
+                assert min(a for _, a in earlier) < min(lat_admits), \
+                    (j, earlier, i, launch)
+        snap = engine.stats_snapshot()
+        assert snap["launches"] == len(launches)
+        assert snap["launches_by_class"].get("latency", 0) >= 1
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# wire-level backpressure
+# ---------------------------------------------------------------------------
+
+def test_queue_full_backpressure_reply_over_the_wire():
+    """A saturated bulk queue is an immediate empty-mask reply that the
+    client surfaces as SidecarOverloaded — never a blocked connection."""
+    engine = VerifyEngine(use_host=True)
+
+    def slow_verify_submit(msgs, pks, sigs):
+        time.sleep(0.8)  # hold the engine thread so the queue stays full
+        res = np.ones(len(msgs), bool)
+        return lambda: res
+
+    engine._verify_submit = slow_verify_submit
+    engine._sched._queues[vsched.BULK].cap_sigs = 8
+    srv = SidecarServer(("127.0.0.1", 0), engine)
+    t = threading.Thread(target=srv.serve_forever,
+                         kwargs=dict(poll_interval=0.1), daemon=True)
+    t.start()
+    port = srv.server_address[1]
+    try:
+        msgs, pks, sigs = _sigs(4, seed=31)
+        results = {}
+
+        def bg_verify(name, records, bulk):
+            with SidecarClient(port=port, timeout=30.0) as c:
+                m, p, s = records
+                results[name] = c.verify_batch(m, p, s, bulk=bulk)
+
+        # Plug the engine (latency launch dispatches, then sleeps)...
+        plug = threading.Thread(
+            target=bg_verify, args=("plug", _sigs(2, seed=32), False))
+        plug.start()
+        time.sleep(0.3)
+        # ...fill the bulk queue to its 8-sig cap...
+        filler = threading.Thread(
+            target=bg_verify, args=("filler", _sigs(8, seed=33), True))
+        filler.start()
+        time.sleep(0.2)
+        # ...and the next bulk request must shed, not block.
+        with SidecarClient(port=port, timeout=30.0) as c:
+            t0 = time.monotonic()
+            with pytest.raises(SidecarOverloaded):
+                c.verify_batch(msgs, pks, sigs, bulk=True)
+            assert time.monotonic() - t0 < 5.0, \
+                "queue-full reply must be immediate, not engine-paced"
+        plug.join(timeout=30)
+        filler.join(timeout=30)
+        assert len(results["plug"]) == 2 and len(results["filler"]) == 8
+        assert engine.stats_snapshot()["queue_full"].get("bulk", 0) >= 1
+    finally:
+        srv.shutdown()
+        engine.stop()
+        srv.server_close()
+
+
+def test_stats_roundtrip_over_the_wire():
+    engine = VerifyEngine(use_host=True)
+    srv = SidecarServer(("127.0.0.1", 0), engine)
+    t = threading.Thread(target=srv.serve_forever,
+                         kwargs=dict(poll_interval=0.1), daemon=True)
+    t.start()
+    try:
+        with SidecarClient(port=srv.server_address[1]) as c:
+            msgs, pks, sigs = _sigs(5, tamper={2}, seed=41)
+            assert c.verify_batch(msgs, pks, sigs) == \
+                [i != 2 for i in range(5)]
+            assert c.verify_batch(*_sigs(3, seed=42), bulk=True) == \
+                [True] * 3
+            snap = c.stats()
+        assert snap["launches"] >= 2
+        assert snap["launches_by_class"].get("latency", 0) >= 1
+        assert set(snap["launches_by_class"]) <= {"latency", "bulk"}
+        assert snap["paths"].get("host", 0) >= 2
+        assert snap["queue_wait"]["latency"]["n"] >= 1
+        assert snap["shapes"]["launch_cap"] == eddsa.MAX_SUBBATCH
+    finally:
+        srv.shutdown()
+        engine.stop()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# RLC routing through the full engine path
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rlc_engine():
+    """Device-path engine (CPU backend) with per-signature and RLC
+    shapes warmed up to 32 — the real warmup entry points, so the
+    registry state matches what `--warm-rlc` produces."""
+    engine = VerifyEngine()
+    service._warmup(engine, warm_max=32)
+    service._warmup_rlc(engine, warm_max=32)
+    yield engine
+    engine.stop()
+
+
+def _engine_mask(engine, msgs, pks, sigs):
+    done = []
+    cond = threading.Condition()
+
+    def reply(mask):
+        with cond:
+            done.append(mask)
+            cond.notify()
+
+    assert engine.submit(proto.VerifyRequest(1, msgs, pks, sigs), reply)
+    with cond:
+        assert cond.wait_for(lambda: done, timeout=120.0)
+    return done[0]
+
+
+def test_engine_routes_rlc_and_masks_match_per_sig(rlc_engine):
+    """Batches of n >= 16 valid-shape signatures route through
+    verify_batch_rlc with verdict masks bit-identical to verify_batch —
+    asserted through the engine (submit -> scheduler -> routed launch ->
+    reply), across all-valid AND tampered batches (bisection path)."""
+    engine = rlc_engine
+    assert engine._shapes.route(16) == vsched.PATH_RLC
+    assert engine._shapes.route(15) == vsched.PATH_PER_SIG
+    before = engine.stats_snapshot()["paths"].get("rlc", 0)
+    cases = [(16, set(), 50), (20, {3, 17}, 51), (31, {0}, 52)]
+    for n, tamper, seed in cases:
+        msgs, pks, sigs = _sigs(n, tamper=tamper, seed=seed)
+        got = _engine_mask(engine, msgs, pks, sigs)
+        want = eddsa.verify_batch(msgs, pks, sigs)
+        assert got == [bool(b) for b in want], (n, tamper)
+        assert got == [i not in tamper for i in range(n)]
+    snap = engine.stats_snapshot()
+    assert snap["paths"].get("rlc", 0) - before == len(cases)
+    assert snap["paths"].get("rlc_bisect", 0) >= 2  # the tampered cases
+
+
+def test_engine_small_batches_stay_per_sig(rlc_engine):
+    engine = rlc_engine
+    before = engine.stats_snapshot()["paths"].get("per_sig", 0)
+    msgs, pks, sigs = _sigs(10, tamper={4}, seed=60)
+    got = _engine_mask(engine, msgs, pks, sigs)
+    assert got == [i != 4 for i in range(10)]
+    assert engine.stats_snapshot()["paths"].get("per_sig", 0) == before + 1

@@ -60,6 +60,15 @@ wire encoding (protocol.encode_stats_reply -> decode_stats_body) so the
 headline proves the telemetry pipeline end to end.  Schema:
 sidecar/sched/stats.py snapshot().
 
+graftchaos (`"chaos"` field): the fault timeline + per-event recovery
+latencies of a fault plan (--fault-plan PATH|SPEC, or the
+HOTSTUFF_TPU_FAULT_PLAN env, else a miniature default) run through the
+real plan parser, PlanRunner, the logs/chaos-events.json round trip,
+and hotstuff_tpu/chaos/recovery.summarize_recovery — the exact pipeline
+a live `harness local --fault-plan` run reports through its summary.
+Keys: plan_events, executed, recovered, injected_ok, max_recovery_ms,
+events[] (each with t/target/action/wall/recovery_ms).
+
 Degraded mode (`"degraded": true`): the device probe is capped at
 HOTSTUFF_TPU_PROBE_ATTEMPTS tries (default 3) inside a
 HOTSTUFF_TPU_PROBE_WINDOW-second window (default 600); when no device
@@ -373,6 +382,67 @@ def sched_headline_probe() -> dict:
         engine.stop()
 
 
+# --fault-plan pass-through (set by main(); run_degraded reads it so the
+# degraded line carries the same chaos field as a healthy one).
+_FAULT_PLAN = None
+
+# Miniature default plan for the headline probe: one of every fault
+# class, timed inside a tenth of a (virtual) second.
+_DEFAULT_CHAOS_SPEC = ("0.01 sidecar kill; 0.04 sidecar restart; "
+                       "0.02 node:1 pause; 0.05 node:1 resume; "
+                       "0.06 sidecar degrade shed=1")
+
+
+def chaos_headline_probe(plan_spec=None) -> dict:
+    """The headline's ``chaos`` field: prove the graftchaos pipeline end
+    to end without booting a committee.  The fault plan (the passed
+    ``--fault-plan``, or a miniature default) runs through the REAL
+    parser and PlanRunner against a recording injector on a virtual
+    clock (instant, regardless of the plan's timescale); the executed
+    events round-trip through the JSON contract the harness writes to
+    logs/chaos-events.json; and recovery latencies come from the same
+    ``summarize_recovery`` the LogParser folds into a live run summary —
+    commits are synthesized 250 ms after each event, so a healthy
+    pipeline reports ``recovered: true`` with per-event latencies."""
+    import json as _json
+
+    from hotstuff_tpu.chaos import PlanRunner, parse_plan, \
+        summarize_recovery
+
+    plan = parse_plan(plan_spec if plan_spec else _DEFAULT_CHAOS_SPEC)
+
+    class _NullInjector:
+        def apply(self, event):
+            pass  # the probe measures the pipeline, not real processes
+
+    base_wall = 1_700_000_000.0
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(dt):
+        now[0] += dt
+
+    runner = PlanRunner(plan, _NullInjector(), clock=clock, sleep=sleep,
+                        wall=lambda: base_wall + now[0])
+    runner.start(t0=0.0)
+    runner.join(timeout=60.0)
+    # The on-disk/wire contract: what the harness persists is what the
+    # parser reads back.
+    events = _json.loads(_json.dumps(runner.events()))
+    commits = [e["wall"] + 0.25 for e in events]
+    summary = summarize_recovery(events, commits)
+    return {
+        "plan_events": len(plan.events),
+        "executed": len(events),
+        "recovered": summary["recovered"],
+        "injected_ok": summary["injected_ok"],
+        "max_recovery_ms": summary["max_recovery_ms"],
+        "events": summary["events"],
+    }
+
+
 def run_degraded(reason: str):
     """No usable accelerator: fall back to JAX_PLATFORMS=cpu, measure the
     RLC headline there, and ALWAYS emit one parseable JSON line tagged
@@ -427,6 +497,10 @@ def run_degraded(reason: str):
             sched = sched_headline_probe()
         except Exception as e:  # noqa: BLE001 — telemetry is best-effort
             sched = {"error": f"{e!r:.120}"}
+        try:
+            chaos = chaos_headline_probe(_FAULT_PLAN)
+        except Exception as e:  # noqa: BLE001 — chaos probe is best-effort
+            chaos = {"error": f"{e!r:.120}"}
         # The watchdog stays armed until the moment of the real emit: a
         # stall anywhere above (including the sched probe) must still
         # produce a parseable line, which is this path's whole contract.
@@ -434,7 +508,7 @@ def run_degraded(reason: str):
         # Report the backend that actually ran (an already-initialized
         # device backend wins over the cpu config flip above).
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
-             note=reason, rlc=rlc, sched=sched)
+             note=reason, rlc=rlc, sched=sched, chaos=chaos)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -566,7 +640,20 @@ def tpu_throughput(msgs, pks, sigs, on_trial=None) -> float:
     return best
 
 
-def main():
+def main(argv=None):
+    # --fault-plan rides through to the chaos headline probe (a path to a
+    # JSON plan or an inline DSL spec; the HOTSTUFF_TPU_FAULT_PLAN env is
+    # the no-argv channel).  parse_known_args: the driver may pass flags
+    # this bench does not own.
+    import argparse
+
+    global _FAULT_PLAN
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--fault-plan", default=None)
+    known, _ = ap.parse_known_args(argv)
+    _FAULT_PLAN = known.fault_plan \
+        or os.environ.get("HOTSTUFF_TPU_FAULT_PLAN") or None
+
     # Watchdog: the tunneled TPU can wedge indefinitely (observed: a plain
     # 8x8 matmul never returning).  A hung bench is worse than a failed
     # one — the driver's round-end run must always terminate.
@@ -699,7 +786,12 @@ def main():
         sched = sched_headline_probe()
     except Exception as e:  # noqa: BLE001 — telemetry is best-effort
         sched = {"error": f"{e!r:.120}"}
-    emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm, sched=sched)
+    try:
+        chaos = chaos_headline_probe(_FAULT_PLAN)
+    except Exception as e:  # noqa: BLE001 — chaos probe is best-effort
+        chaos = {"error": f"{e!r:.120}"}
+    emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm, sched=sched,
+               chaos=chaos)
 
 
 if __name__ == "__main__":

@@ -8,14 +8,15 @@ when anything fires.  ``scripts/lint_gate.py`` is the CI entry point.
 from __future__ import annotations
 
 import argparse
+import fnmatch
 import os
 import sys
 
-CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing")
+CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import hotpath, padshape, sanitize, timing, wirecheck
+    from . import hotpath, padshape, sanitize, sockets, timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -28,6 +29,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += padshape.check(root)
     if "timing" in checkers:
         findings += timing.check(root)
+    if "sockets" in checkers:
+        findings += sockets.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -39,30 +42,58 @@ def run_all(root: str, checkers=CHECKERS) -> list:
 
 
 def check_coverage(root: str, must_cover) -> list:
-    """Assert each repo-relative file exists and is scanned by the
-    hot-path checker's target set — the gate for 'this new device module
-    MUST be linted' requirements (scripts/lint_gate.py pins the RLC
-    scalar module this way)."""
-    from . import hotpath
+    """Assert each repo-relative file exists and is scanned — the gate
+    for 'this new module MUST be linted' requirements.
+
+    A pin may be checker-qualified (``hotpath:path``, ``sockets:path``,
+    ``timing:path``, ``padshape:path``) to demand coverage by THAT
+    checker's target set: a device module pinned to hotpath stays
+    covered-by-hotpath even though the sockets checker happens to scan
+    the same directory (a union would let the hot-path scan silently
+    lose a file another checker's prefix still matches).  A bare path
+    accepts any checker.  scripts/lint_gate.py pins the RLC scalar
+    module and the verifysched modules to hotpath, and the graftchaos
+    modules to sockets."""
+    from . import hotpath, padshape, sockets, timing
     from .common import Finding
 
+    target_sets = {
+        "hotpath": tuple(hotpath.DEFAULT_TARGETS),
+        "sockets": tuple(sockets.DEFAULT_TARGETS),
+        "timing": tuple(timing.DEFAULT_TARGETS),
+        "padshape": tuple(padshape.DEFAULT_TARGETS),
+    }
     findings = []
-    for rel in must_cover:
+    for pin in must_cover:
+        checker, _, rel = pin.rpartition(":")
+        if checker and checker not in target_sets:
+            findings.append(Finding(
+                rel or pin, 1, "must-cover",
+                f"unknown checker {checker!r} in --must-cover pin "
+                f"(have {', '.join(sorted(target_sets))})"))
+            continue
+        scan_targets = target_sets[checker] if checker else tuple(
+            t for ts in target_sets.values() for t in ts)
         norm = rel.replace(os.sep, "/")
         if not os.path.isfile(os.path.join(root, rel)):
             findings.append(Finding(
                 rel, 1, "must-cover",
                 "required module is missing from the tree"))
             continue
+        # Targets are files, directories, or globs (timing's
+        # "scripts/exp_*.py"); a pin matches any of the three shapes.
         covered = any(
             norm == t or norm.startswith(t.rstrip("/") + "/")
-            for t in hotpath.DEFAULT_TARGETS)
+            or fnmatch.fnmatch(norm, t)
+            for t in scan_targets)
         if not covered:
+            where = f"the {checker} scan targets" if checker \
+                else "every lint scan target"
             findings.append(Finding(
                 rel, 1, "must-cover",
-                "file is outside the hotpath scan targets "
-                f"({', '.join(hotpath.DEFAULT_TARGETS)}); add it to "
-                "hotpath.DEFAULT_TARGETS or move it"))
+                f"file is outside {where} "
+                f"({', '.join(scan_targets)}); add it to the checker's "
+                "DEFAULT_TARGETS or move it"))
     return findings
 
 
@@ -80,11 +111,13 @@ def main(argv=None) -> int:
                     help="repo root to lint (default: this checkout)")
     ap.add_argument("--checker", action="append", choices=CHECKERS,
                     help="run only this checker (repeatable; default all)")
-    ap.add_argument("--must-cover", action="append", metavar="RELPATH",
+    ap.add_argument("--must-cover", action="append",
+                    metavar="[CHECKER:]RELPATH",
                     help="fail unless this repo-relative file exists AND "
-                         "lies inside a hotpath scan target (guards "
-                         "against a new device module silently escaping "
-                         "the lint; repeatable)")
+                         "lies inside a lint scan target — of the named "
+                         "checker (hotpath/sockets) when qualified, of "
+                         "any checker when bare (guards against a module "
+                         "silently escaping its lint; repeatable)")
     args = ap.parse_args(argv)
     checkers = tuple(args.checker) if args.checker else CHECKERS
     findings = run_all(args.root, checkers)

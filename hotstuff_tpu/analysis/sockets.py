@@ -1,0 +1,168 @@
+"""graftlint socket checker: every socket operation on the harness and
+sidecar boundary must be explicitly bounded.
+
+The graftchaos postmortem class this rule exists for: a dead sidecar
+used to cost every verify a fresh connect wait, and a wedged peer could
+park a harness thread on a bare ``recv`` forever — failures that only
+show up mid-run, when the fault plan (or real life) kills a process.
+The repo convention is that *every* ``connect``/``recv``/``accept`` in
+the control plane carries an explicit bound: a ``timeout=`` argument on
+``socket.create_connection``, or a ``settimeout(...)`` configured on the
+same socket in the same lexical scope.
+
+Rule:
+  unbounded-socket-op   a socket ``connect``/``accept``/``recv``/
+                        ``recv_into`` call (or ``create_connection``
+                        without a timeout argument) with no visible
+                        bound in its scope
+
+Receiver detection is deliberately name-based (identifiers containing
+``sock``/``socket``/``conn``), not dataflow: the boundary modules use
+conventional socket names, bare parameters carry no assignment history,
+and a rename that dodges the rule is exactly the kind of edit a reviewer
+should see.  The one deliberately unbounded op in the tree — the
+server-side frame read idling between requests in
+``sidecar/protocol._read_exact`` — carries the inline suppression with
+its rationale, per the suppression policy in analysis/README.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .common import Finding, apply_suppressions
+
+# Modules on the process/socket boundary: the sidecar (service, client,
+# protocol), the harness (local/remote orchestration), and the graftchaos
+# fault layer that reaches into both.
+DEFAULT_TARGETS = (
+    "hotstuff_tpu/sidecar",
+    "hotstuff_tpu/harness",
+    "hotstuff_tpu/chaos",
+)
+
+_SOCKET_NAME_RE = re.compile(r"sock|socket|conn", re.IGNORECASE)
+_SOCKET_OPS = {"connect", "accept", "recv", "recv_into", "recvfrom"}
+
+
+def _last_ident(node: ast.AST):
+    """Rightmost identifier of a receiver expression (``self._sock`` ->
+    ``_sock``; ``sock`` -> ``sock``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _scopes(tree: ast.Module):
+    """(scope, direct nodes) pairs with nested function/lambda bodies cut
+    out — a timeout configured in one function does not bound another."""
+    nested = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+    def direct_nodes(root):
+        out = []
+        stack = [iter(ast.iter_child_nodes(root))]
+        while stack:
+            try:
+                node = next(stack[-1])
+            except StopIteration:
+                stack.pop()
+                continue
+            if isinstance(node, nested):
+                continue
+            out.append(node)
+            stack.append(iter(ast.iter_child_nodes(node)))
+        return out
+
+    yield tree, direct_nodes(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, nested):
+            yield node, direct_nodes(node)
+
+
+def _has_timeout_arg(call: ast.Call) -> bool:
+    """True when a create_connection call carries a non-None timeout
+    (2nd positional, or the ``timeout=`` keyword — a plain ``timeout=x``
+    variable counts: the bound is the caller's explicit choice)."""
+    if len(call.args) >= 2:
+        a = call.args[1]
+        return not (isinstance(a, ast.Constant) and a.value is None)
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    return False
+
+
+def check_source(path: str, source: str) -> list:
+    findings = []
+    tree = ast.parse(source, filename=path)
+    for _scope, nodes in _scopes(tree):
+        bounded = set()   # receiver idents with a settimeout in scope
+        suspects = []     # (node, op, receiver ident)
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr == "settimeout":
+                ident = _last_ident(func.value)
+                if ident:
+                    bounded.add(ident)
+            elif func.attr == "create_connection":
+                if not _has_timeout_arg(node):
+                    findings.append(Finding(
+                        path, node.lineno, "unbounded-socket-op",
+                        "socket.create_connection without a timeout "
+                        "argument: a dead peer parks this thread for the "
+                        "kernel's connect timeout (minutes); pass "
+                        "timeout= explicitly"))
+            elif func.attr in _SOCKET_OPS:
+                ident = _last_ident(func.value)
+                if ident and _SOCKET_NAME_RE.search(ident):
+                    suspects.append((node, func.attr, ident))
+        for node, op, ident in suspects:
+            if ident in bounded:
+                continue
+            findings.append(Finding(
+                path, node.lineno, "unbounded-socket-op",
+                f"socket .{op}() on {ident!r} with no settimeout() in "
+                "this scope: a wedged or chaos-killed peer blocks this "
+                "thread indefinitely; bound the socket (settimeout / "
+                "create_connection timeout) or carry a justified "
+                "suppression"))
+    return findings
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: source} mapping (the unit-test entry point)."""
+    findings = []
+    for path, src in sources.items():
+        findings += check_source(path, src)
+    return sorted(apply_suppressions(findings, sources),
+                  key=lambda f: (f.path, f.line))
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    sources = {}
+    for target in targets:
+        base = os.path.join(root, target)
+        if os.path.isfile(base):
+            paths = [base]
+        elif os.path.isdir(base):
+            paths = []
+            for dirpath, _dirnames, filenames in os.walk(base):
+                paths += [os.path.join(dirpath, f)
+                          for f in sorted(filenames)]
+        else:
+            continue
+        for path in paths:
+            if not path.endswith(".py"):
+                continue
+            with open(path, encoding="utf-8") as fh:
+                sources[os.path.relpath(path, root)] = fh.read()
+    return check_sources(sources)

@@ -44,6 +44,8 @@ _TAG_PAIRS = (
     # other side was not audited for the layout change that caused it.
     ("OP_VERIFY_BULK", "kOpVerifyBulk"),
     ("OP_STATS", "kOpStats"),
+    # protocol v3 (graftchaos): the sidecar fault-injection hook.
+    ("OP_CHAOS", "kOpChaos"),
     ("PROTOCOL_VERSION", "kProtocolVersion"),
 )
 

@@ -1,0 +1,27 @@
+"""graftchaos: scripted fault injection for the local testbed.
+
+The paper's claim — device-accelerated QC verification inside a live
+HotStuff deployment — only matters if consensus stays live when the
+accelerator path misbehaves.  The reference benchmarks model crash
+faults as replicas that were never booted (benchmark/local.py:75-76);
+Twins-style BFT testing (Bano et al.) shows that *scripted, mid-run*
+fault schedules are what actually shake out recovery bugs.  This
+package is the declarative half of that testing story:
+
+  plan.py      fault-plan model + parser (JSON file, dict list, or a
+               one-line DSL: ``"5 sidecar kill; 10 sidecar restart"``)
+  runner.py    executes a plan against a running bench on its own
+               thread, recording wall-clock timestamps per event
+  recovery.py  per-fault recovery latency from the executed events and
+               the committee's commit timeline (shared by the harness
+               LogParser and bench.py's ``chaos`` headline field)
+
+The harness side (process murder, SIGSTOP partitions, sidecar chaos
+RPCs) lives in ``hotstuff_tpu/harness/faults.py``; the sidecar's
+in-process fault hook (``OP_CHAOS``) in ``sidecar/service.py``.
+"""
+
+from .plan import ACTIONS, FaultEvent, FaultPlan, PlanError, node_index, \
+    parse_plan  # noqa: F401
+from .recovery import summarize_recovery  # noqa: F401
+from .runner import PlanRunner  # noqa: F401

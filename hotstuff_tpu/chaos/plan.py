@@ -1,0 +1,238 @@
+"""Fault-plan model: a validated, time-ordered list of fault events.
+
+A plan is declarative — *what* happens *when* — and carries no process
+knowledge; the runner hands each due event to an injector (the harness's
+``LocalFaultInjector``, or a stub in tests/bench probes).
+
+Targets
+    ``sidecar``      the verify sidecar process
+    ``node:<i>``     replica i of the local committee (boot order index)
+
+Actions (per target)
+    node:     ``kill`` (SIGKILL), ``restart`` (reboot on the same store),
+              ``pause`` / ``resume`` (SIGSTOP/SIGCONT — a cheap
+              network-partition proxy: the process holds its sockets but
+              answers nothing, exactly what a partitioned peer looks
+              like to the committee)
+    sidecar:  ``kill``, ``restart``, and ``degrade`` — the protocol v3
+              ``OP_CHAOS`` hook (bounded reply delay, connection drops,
+              forced queue-full sheds) for testing client-side handling
+              without process murder.  ``degrade`` params ride in the
+              event's ``params`` dict (see sidecar/service.ChaosState).
+
+Validation is a per-target state machine over the time-ordered events:
+``restart`` must follow ``kill``, ``resume`` must follow ``pause``, and
+``degrade`` needs a live sidecar — a plan that cannot physically execute
+fails at parse time, not five seconds into a thirty-second bench.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+ACTIONS = ("kill", "restart", "pause", "resume", "degrade")
+SIDECAR = "sidecar"
+
+_NODE_RE = re.compile(r"^node:(\d+)$")
+
+
+def node_index(target: str):
+    """``"node:<i>"`` -> i, else None (the one place the target grammar
+    is parsed; the injector and plan validation both route through it)."""
+    m = _NODE_RE.match(target)
+    return int(m.group(1)) if m else None
+
+# Actions each target kind accepts (sidecar pause would stop the shared
+# verify engine for EVERY replica at once — use degrade for that class
+# of fault instead, it is observable and bounded).
+_NODE_ACTIONS = {"kill", "restart", "pause", "resume"}
+_SIDECAR_ACTIONS = {"kill", "restart", "degrade"}
+
+# degrade params the sidecar's ChaosState accepts (mirrored there; the
+# plan validates early so a typo fails at parse time).
+DEGRADE_KEYS = ("delay_ms", "shed", "drop", "clear")
+
+
+class PlanError(ValueError):
+    """Malformed or physically unexecutable fault plan."""
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    t: float                    # seconds from the start of the run window
+    target: str                 # "sidecar" or "node:<i>"
+    action: str
+    params: dict = field(default_factory=dict)
+
+    def label(self) -> str:
+        return f"t={self.t:g}s {self.action} {self.target}"
+
+    def to_json(self) -> dict:
+        out = {"t": self.t, "target": self.target, "action": self.action}
+        if self.params:
+            out["params"] = dict(self.params)
+        return out
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    events: tuple
+
+    def to_json(self) -> list:
+        return [e.to_json() for e in self.events]
+
+    def node_indices(self) -> set:
+        out = set()
+        for e in self.events:
+            i = node_index(e.target)
+            if i is not None:
+                out.add(i)
+        return out
+
+    def max_time(self) -> float:
+        return max((e.t for e in self.events), default=0.0)
+
+
+def _event_from_dict(obj: dict) -> FaultEvent:
+    unknown = set(obj) - {"t", "target", "action", "params"}
+    if unknown:
+        raise PlanError(f"unknown event key(s) {sorted(unknown)}")
+    try:
+        t = float(obj["t"])
+        target = str(obj["target"])
+        action = str(obj["action"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise PlanError(f"event needs numeric 't', 'target', 'action': {e}")
+    params = obj.get("params", {})
+    if not isinstance(params, dict):
+        raise PlanError(f"{action} {target}: params must be an object")
+    return FaultEvent(t, target, action, dict(params))
+
+
+def _event_from_text(entry: str) -> FaultEvent:
+    """``"<t> <target> <action> [k=v ...]"`` -> event (the inline DSL)."""
+    toks = entry.split()
+    if len(toks) < 3:
+        raise PlanError(
+            f"bad plan entry {entry!r}: want '<t> <target> <action>'")
+    t_raw = toks[0][:-1] if toks[0].endswith("s") else toks[0]
+    try:
+        t = float(t_raw)
+    except ValueError:
+        raise PlanError(f"bad event time {toks[0]!r} in {entry!r}")
+    params = {}
+    for tok in toks[3:]:
+        if "=" not in tok:
+            raise PlanError(f"bad param {tok!r} in {entry!r} (want k=v)")
+        k, v = tok.split("=", 1)
+        try:
+            params[k] = int(v)
+        except ValueError:
+            params[k] = v
+    return FaultEvent(t, toks[1], toks[2], params)
+
+
+def _validate(events) -> FaultPlan:
+    # Per-target liveness state machine over the time-ordered sequence.
+    state: dict[str, str] = {}
+    ordered = sorted(events, key=lambda e: e.t)
+    for e in ordered:
+        if not (e.t >= 0.0 and e.t == e.t and e.t != float("inf")):
+            raise PlanError(f"{e.label()}: event time must be finite >= 0")
+        if e.action not in ACTIONS:
+            raise PlanError(f"{e.label()}: unknown action (have "
+                            f"{', '.join(ACTIONS)})")
+        if e.target == SIDECAR:
+            allowed = _SIDECAR_ACTIONS
+        elif _NODE_RE.match(e.target):
+            allowed = _NODE_ACTIONS
+        else:
+            raise PlanError(f"{e.label()}: target must be 'sidecar' or "
+                            "'node:<i>'")
+        if e.action not in allowed:
+            raise PlanError(f"{e.label()}: {e.target} does not support "
+                            f"{e.action} (allowed: {', '.join(sorted(allowed))})")
+        if e.params and e.action != "degrade":
+            raise PlanError(f"{e.label()}: only degrade takes params")
+        if e.action == "degrade":
+            bad = set(e.params) - set(DEGRADE_KEYS)
+            if bad:
+                raise PlanError(f"{e.label()}: unknown degrade param(s) "
+                                f"{sorted(bad)} (have "
+                                f"{', '.join(DEGRADE_KEYS)})")
+            # Mirror ChaosState.configure's value rules so a typo'd value
+            # fails here, not as a mid-run injection failure that costs
+            # the whole bench window.
+            for key in ("delay_ms", "shed", "drop"):
+                v = e.params.get(key)
+                if v is not None and (not isinstance(v, int)
+                                      or isinstance(v, bool) or v < 0):
+                    raise PlanError(
+                        f"{e.label()}: degrade {key} must be an int >= 0 "
+                        f"(got {v!r})")
+        cur = state.get(e.target, "up")
+        if e.action == "kill" and cur == "down":
+            raise PlanError(f"{e.label()}: target is already down")
+        if e.action == "restart" and cur != "down":
+            raise PlanError(f"{e.label()}: restart must follow a kill")
+        if e.action == "pause" and cur != "up":
+            raise PlanError(f"{e.label()}: pause needs a live target")
+        if e.action == "resume" and cur != "paused":
+            raise PlanError(f"{e.label()}: resume must follow a pause")
+        if e.action == "degrade" and cur != "up":
+            raise PlanError(f"{e.label()}: degrade needs a live sidecar")
+        state[e.target] = {"kill": "down", "restart": "up",
+                           "pause": "paused", "resume": "up",
+                           "degrade": "up"}[e.action]
+    return FaultPlan(tuple(ordered))
+
+
+def parse_plan(spec) -> FaultPlan:
+    """Parse + validate a fault plan from any accepted shape:
+
+    * a ``FaultPlan`` (returned as-is),
+    * a list of event dicts (or of DSL strings),
+    * a path to a JSON file (a list, or ``{"events": [...]}``),
+    * an inline DSL string: ``";"``/newline-separated
+      ``"<t> <target> <action> [k=v ...]"`` entries, e.g.
+      ``"5 sidecar kill; 10 sidecar restart; 12 node:1 pause; 15 node:1 resume"``.
+
+    Raises :class:`PlanError` on anything malformed or unexecutable.
+    """
+    if isinstance(spec, FaultPlan):
+        return spec
+    if isinstance(spec, str):
+        if os.path.isfile(spec):
+            try:
+                with open(spec, encoding="utf-8") as f:
+                    obj = json.load(f)
+            except (OSError, ValueError) as e:
+                raise PlanError(f"cannot read fault plan {spec!r}: {e}")
+            if isinstance(obj, dict):
+                obj = obj.get("events")
+            if not isinstance(obj, list):
+                raise PlanError(f"{spec!r}: want a JSON list of events "
+                                "(or {'events': [...]})")
+            spec = obj
+        else:
+            spec = [entry for entry in
+                    re.split(r"[;\n]", spec) if entry.strip()]
+            if not spec:
+                raise PlanError("empty fault plan")
+    if not isinstance(spec, (list, tuple)):
+        raise PlanError(f"unsupported fault-plan spec type "
+                        f"{type(spec).__name__}")
+    events = []
+    for entry in spec:
+        if isinstance(entry, FaultEvent):
+            events.append(entry)
+        elif isinstance(entry, dict):
+            events.append(_event_from_dict(entry))
+        elif isinstance(entry, str):
+            events.append(_event_from_text(entry.strip()))
+        else:
+            raise PlanError(f"bad plan entry {entry!r}")
+    return _validate(events)

@@ -29,6 +29,7 @@ def cmd_local(args):
         "sidecar_host_crypto": args.sidecar_host_crypto,
         "sidecar_warm_rlc": args.warm_rlc,
         "scheme": args.scheme,
+        "fault_plan": args.fault_plan,
     })
     node_params = NodeParameters.default(
         tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
@@ -221,6 +222,13 @@ def main(argv=None):
     p.add_argument("--scheme", choices=["ed25519", "bls"],
                    default="ed25519",
                    help="signature scheme (bls implies --tpu-sidecar)")
+    p.add_argument("--fault-plan", default=None, metavar="PATH|SPEC",
+                   help="graftchaos fault plan to execute against the "
+                        "running bench: a JSON file, or an inline spec "
+                        "like '5 sidecar kill; 10 sidecar restart; "
+                        "12 node:1 pause; 15 node:1 resume' (times are "
+                        "seconds into the run window; the summary "
+                        "reports per-fault recovery latency)")
     p.add_argument("--debug", action="store_true")
     p.add_argument("--output", help="append summary to this result file")
     p.set_defaults(func=cmd_local)

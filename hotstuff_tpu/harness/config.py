@@ -203,6 +203,9 @@ class BenchParameters:
             self.sidecar_warm_rlc = bool(
                 json_input.get("sidecar_warm_rlc", False))
             self.scheme = str(json_input.get("scheme", "ed25519"))
+            # graftchaos: a fault-plan spec (path / inline DSL string /
+            # event list); parsed + validated by LocalBench.
+            self.fault_plan = json_input.get("fault_plan")
         except KeyError as e:
             raise ConfigError(f"Malformed bench parameters: missing key {e}")
         except ValueError:

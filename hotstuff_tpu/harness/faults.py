@@ -1,0 +1,134 @@
+"""Harness fault injector: turns graftchaos plan events into process
+signals and sidecar RPCs against a running LocalBench.
+
+Separation of concerns: ``hotstuff_tpu/chaos`` owns *what happens when*
+(plan model, runner thread, recovery math); this module owns *how* —
+which pid gets which signal, how a replica reboots on the same store,
+and how the sidecar's OP_CHAOS hook is reached.  The injector is handed
+the LocalBench instance itself, which tracks per-node boot commands and
+live processes exactly for this purpose.
+
+Design notes:
+  * kill is SIGKILL on the whole process group — no clean shutdown, the
+    crash-fault model (the restart path must recover from persisted
+    state, never from a flushed goodbye).
+  * pause/resume is SIGSTOP/SIGCONT on the group: the process keeps its
+    sockets but answers nothing — the cheapest faithful proxy for a
+    network partition of one replica.  ``cleanup()`` SIGCONTs anything
+    still paused so teardown's SIGTERM is actually deliverable.
+  * restart re-runs the exact boot command with the log in append mode:
+    same keys, same store, same ports — and the pre-fault log survives
+    for the parser.
+  * sidecar degrade opens a short-lived SidecarClient and posts the
+    event's params to the OP_CHAOS hook; a sidecar running without
+    ``--chaos`` refuses (reported as an injection failure, because the
+    plan demanded a fault the deployment cannot express).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+
+from ..chaos.plan import SIDECAR, FaultEvent, node_index
+
+
+class InjectionError(RuntimeError):
+    pass
+
+
+class LocalFaultInjector:
+    def __init__(self, bench):
+        self._bench = bench
+        self._paused: set[int] = set()
+
+    def apply(self, event: FaultEvent):
+        if event.target == SIDECAR:
+            fn = getattr(self, f"_sidecar_{event.action}")
+            fn(**event.params)
+            return
+        i = node_index(event.target)
+        if i is None:
+            raise InjectionError(f"unknown target {event.target!r}")
+        getattr(self, f"_node_{event.action}")(i)
+
+    def cleanup(self):
+        """SIGCONT any group still paused (teardown's SIGTERM queues
+        behind a SIGSTOP forever otherwise)."""
+        for i in sorted(self._paused):
+            try:
+                self._signal_node(i, signal.SIGCONT)
+            except InjectionError:
+                pass
+        self._paused.clear()
+
+    # -- nodes --------------------------------------------------------------
+
+    def _proc(self, i: int):
+        proc = self._bench._node_procs.get(i)
+        if proc is None:
+            raise InjectionError(f"node {i} was never booted "
+                                 "(crash-faulted or out of range)")
+        return proc
+
+    def _signal_node(self, i: int, sig):
+        proc = self._proc(i)
+        try:
+            os.killpg(os.getpgid(proc.pid), sig)
+        except (ProcessLookupError, PermissionError) as e:
+            raise InjectionError(f"node {i} signal {sig!r} failed: {e}")
+
+    def _node_kill(self, i: int):
+        self._signal_node(i, signal.SIGKILL)
+        self._paused.discard(i)
+        try:
+            self._proc(i).wait(timeout=10)
+        except Exception as e:  # noqa: BLE001
+            raise InjectionError(f"node {i} did not die on SIGKILL: {e}")
+
+    def _node_restart(self, i: int):
+        cmd, log = self._bench._node_cmds[i]
+        self._bench._node_procs[i] = self._bench._background_run(
+            cmd, log, append=True)
+
+    def _node_pause(self, i: int):
+        self._signal_node(i, signal.SIGSTOP)
+        self._paused.add(i)
+
+    def _node_resume(self, i: int):
+        self._signal_node(i, signal.SIGCONT)
+        self._paused.discard(i)
+
+    # -- sidecar ------------------------------------------------------------
+
+    def _sidecar_kill(self):
+        proc = self._bench._sidecar_proc
+        if proc is None:
+            raise InjectionError("no sidecar process to kill")
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            proc.wait(timeout=10)
+        except (ProcessLookupError, PermissionError) as e:
+            raise InjectionError(f"sidecar SIGKILL failed: {e}")
+
+    def _sidecar_restart(self):
+        cmd, log = self._bench._sidecar_cmd
+        self._bench._sidecar_proc = self._bench._background_run(
+            cmd, log, append=True)
+        # No readiness wait here: the node-side circuit breaker re-attaches
+        # on its next probe once the socket binds, and blocking the runner
+        # thread would delay every later plan event by a warmup.
+
+    def _sidecar_degrade(self, **params):
+        from ..sidecar.client import SidecarClient
+
+        try:
+            with SidecarClient(port=self._bench.SIDECAR_PORT,
+                               timeout=10.0) as client:
+                applied = client.chaos(**params)
+        except (OSError, ConnectionError) as e:
+            raise InjectionError(f"sidecar chaos RPC failed: {e}")
+        if not applied:
+            raise InjectionError(
+                "sidecar is running without --chaos; the plan's degrade "
+                "event cannot be expressed")

@@ -48,6 +48,23 @@ class LocalBench:
             scheme=self.scheme if self.scheme != "ed25519" else None)
         self._procs = []
         self._degraded = False
+        # graftchaos: per-node boot info + the sidecar boot command are
+        # tracked so the fault injector can SIGKILL/SIGSTOP groups and
+        # reboot on the same store/log (harness/faults.py).
+        self._node_procs = {}
+        self._node_cmds = {}
+        self._sidecar_proc = None
+        self._sidecar_cmd = None
+        fp = getattr(bench_parameters, "fault_plan", None)
+        if fp:
+            from ..chaos import PlanError, parse_plan
+
+            try:
+                self.fault_plan = parse_plan(fp)
+            except PlanError as e:
+                raise BenchError("Invalid fault plan", e)
+        else:
+            self.fault_plan = None
 
     def _background_run(self, command, log_file, append=False):
         name = command.split()[0]
@@ -58,6 +75,7 @@ class LocalBench:
         proc = subprocess.Popen(
             ["/bin/sh", "-c", cmd], preexec_fn=os.setsid)
         self._procs.append((name, proc))
+        return proc
 
     def _wait_sidecar_ready(self, deadline_s=300):
         """Block until the sidecar answers a PING (it binds post-warmup, so
@@ -84,10 +102,17 @@ class LocalBench:
     def _kill_nodes(self):
         for _, proc in self._procs:
             try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+                pgid = os.getpgid(proc.pid)
+                os.killpg(pgid, signal.SIGTERM)
+                # A chaos-paused (SIGSTOPped) group only sees the SIGTERM
+                # once continued; always chase with SIGCONT so teardown
+                # can never leave a stopped orphan holding the ports.
+                os.killpg(pgid, signal.SIGCONT)
             except (ProcessLookupError, PermissionError):
                 pass
         self._procs = []
+        self._node_procs = {}
+        self._sidecar_proc = None
         # Stale-state discipline (benchmark/local.py:31-37): also sweep by
         # pattern for processes from previous runs this harness no longer
         # tracks — including the sidecar, which a wedged device can leave
@@ -127,13 +152,20 @@ class LocalBench:
         warm_rlc = " --warm-rlc" \
             if getattr(self, "sidecar_warm_rlc", False) and not host_crypto \
             else ""
+        # The chaos hook binds only when a fault plan can reach it; the
+        # committee/rate parameters size the scheduler's admission caps
+        # (sidecar/sched/scheduler.size_queue_caps) instead of the static
+        # defaults.
+        chaos = " --chaos" if getattr(self, "fault_plan", None) else ""
+        cmd = (f"python -m hotstuff_tpu.sidecar "
+               f"--port {self.SIDECAR_PORT}"
+               f" --committee {self.nodes} --client-rate {self.rate}"
+               f"{warm_bls}{warm_rlc}{hc}{chaos}")
         # The degraded reboot appends to the log: the dead device
         # sidecar's output is the evidence needed to diagnose the wedge.
-        self._background_run(
-            f"python -m hotstuff_tpu.sidecar "
-            f"--port {self.SIDECAR_PORT}{warm_bls}{warm_rlc}{hc}",
-            PathMaker.sidecar_log_file(),
-            append=self._degraded)
+        self._sidecar_cmd = (cmd, PathMaker.sidecar_log_file())
+        self._sidecar_proc = self._background_run(
+            cmd, PathMaker.sidecar_log_file(), append=self._degraded)
         # The BLS pairing program is a multi-minute first compile on the
         # device (cached across restarts via the XLA compilation cache);
         # host-crypto warmup compiles nothing.
@@ -171,9 +203,90 @@ class LocalBench:
         except (OSError, ConnectionError, ValueError) as e:
             Print.warn(f"Could not fetch sidecar scheduler stats: {e}")
 
+    def _check_fault_plan(self):
+        """Reject an unexecutable plan BEFORE anything boots: every input
+        (duration, committee, faults, sidecar mode, timeout) is known at
+        construction time, and a plan targeting a replica that will never
+        exist must not cost a multi-minute compile+warmup first."""
+        if self.fault_plan is None or not self.fault_plan.events:
+            return
+        alive = self.nodes - self.faults
+        # Window headroom: the strict recovery assertion (logs.py) needs
+        # commits AFTER every event, and recovery from a kill legitimately
+        # costs view changes plus the node-side breaker's failure window —
+        # an event too close to teardown would either silently never fire
+        # (runner.stop() skips it) or fail a healthy run.  Reject the plan
+        # up front instead.
+        grace = 2 * self.node_parameters.timeout_delay / 1000 + 3
+        if self.fault_plan.max_time() > self.duration - grace:
+            raise BenchError(
+                f"fault plan's last event (t={self.fault_plan.max_time():g}s) "
+                f"leaves less than {grace:g}s of run-window headroom "
+                f"(duration {self.duration}s) for recovery to be "
+                "observable; extend --duration or move the event earlier")
+        bad = [i for i in self.fault_plan.node_indices() if i >= alive]
+        if bad:
+            raise BenchError(
+                f"fault plan targets node(s) {bad} but only {alive} "
+                "replicas will be booted (crash faults are never booted)")
+        if any(e.target == "sidecar" for e in self.fault_plan.events) \
+                and not self.tpu_sidecar:
+            raise BenchError(
+                "fault plan targets the sidecar but this run boots none "
+                "(pass --tpu-sidecar / --sidecar-host-crypto)")
+
+    def _start_fault_plan(self, alive: int):
+        """Launch the graftchaos runner for this run window (None when no
+        plan).  Event times are offsets from the moment clients start
+        being paced — the same origin the plan author reasons in."""
+        if self.fault_plan is None or not self.fault_plan.events:
+            return None
+        # Validation already happened at the top of run() — before the
+        # bench paid compile/warmup — off the same construction-time
+        # inputs this method sees.
+        assert alive == self.nodes - self.faults
+        from ..chaos import PlanRunner
+        from .faults import LocalFaultInjector
+
+        Print.info(f"Executing fault plan "
+                   f"({len(self.fault_plan.events)} event(s))...")
+        self._injector = LocalFaultInjector(self)
+        runner = PlanRunner(self.fault_plan, self._injector)
+        runner.start()
+        return runner
+
+    def _finish_fault_plan(self, runner):
+        """Stop the runner, un-pause stragglers, and persist the executed
+        events next to the logs for the parser's recovery summary.  A
+        plan event the window closed on (a stalled injection pushing a
+        later event past stop()) is a FAILED chaos run: the acceptance
+        criterion is recovery after EVERY event, not every event that
+        happened to fire."""
+        if runner is None:
+            return
+        import json
+
+        runner.stop()
+        runner.join(timeout=30)
+        self._injector.cleanup()
+        events = runner.events()
+        with open(PathMaker.chaos_events_file(), "w") as f:
+            json.dump(events, f)
+        if len(events) < len(self.fault_plan.events):
+            raise BenchError(
+                f"fault plan executed only {len(events)} of "
+                f"{len(self.fault_plan.events)} event(s) before the run "
+                "window closed (an earlier injection stalled?); the "
+                "scripted scenario did not happen as written")
+
     def run(self, debug=False):
         assert isinstance(debug, bool)
         Print.heading("Starting local benchmark")
+
+        # An unexecutable fault plan must fail HERE, before the bench
+        # pays compile + keygen + sidecar warmup for a run that cannot
+        # deliver its scripted scenario.
+        self._check_fault_plan()
 
         # Kill any previous testbed and cleanup.
         self._kill_nodes()
@@ -238,7 +351,9 @@ class LocalBench:
                     PathMaker.db_path(i),
                     PathMaker.parameters_file(),
                     debug=debug)
-                self._background_run(cmd, PathMaker.node_log_file(i))
+                self._node_cmds[i] = (cmd, PathMaker.node_log_file(i))
+                self._node_procs[i] = self._background_run(
+                    cmd, PathMaker.node_log_file(i))
 
             for i, address in enumerate(addresses):
                 cmd = CommandMaker.run_client(
@@ -249,7 +364,9 @@ class LocalBench:
             # Wait for all transactions to be processed.
             Print.info(f"Running benchmark ({self.duration} sec)...")
             sleep(2 * timeout / 1000)
+            runner = self._start_fault_plan(alive)
             sleep(self.duration)
+            self._finish_fault_plan(runner)
             # Snapshot the scheduler telemetry BEFORE teardown (the
             # OP_STATS counters die with the sidecar process); the parser
             # folds the file into the summary's CONFIG notes.

@@ -69,6 +69,13 @@ class PathMaker:
         return join(PathMaker.logs_path(), "sidecar-stats.json")
 
     @staticmethod
+    def chaos_events_file():
+        """graftchaos executed-event record (JSON list, PlanRunner.events
+        shape); written after the run window, read back by LogParser for
+        the per-fault recovery-latency summary."""
+        return join(PathMaker.logs_path(), "chaos-events.json")
+
+    @staticmethod
     def results_path():
         return "results"
 

@@ -73,6 +73,16 @@ class SidecarClient:
         rid = self._send(proto.encode_stats_request)
         return proto.decode_stats_body(bytes(self._await(rid)))
 
+    def chaos(self, **spec) -> bool:
+        """Configure the sidecar's fault-injection hook (OP_CHAOS):
+        ``delay_ms=``, ``shed=``, ``drop=``, ``clear=True`` — see
+        service.ChaosState.  Returns True when applied, False when the
+        sidecar runs without ``--chaos`` (refusal, not an error: the
+        graftchaos injector turns it into a reported plan failure)."""
+        rid = self._send(lambda r: proto.encode_chaos_request(r, spec))
+        body = self._await(rid)
+        return bool(body) and bool(body[0])
+
     def bls_verify_aggregate(self, msg: bytes, agg_sig: bytes, pks) -> bool:
         """Common-message BLS aggregate verify (pks: 96 B uncompressed G1,
         agg_sig: 192 B uncompressed G2).  Raises SidecarOverloaded on a

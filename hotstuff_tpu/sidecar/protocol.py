@@ -61,14 +61,22 @@ OP_VERIFY_BULK = 7
 # stats_snapshot() dict — schema in sidecar/sched/stats.py), framed by
 # encode_reply_raw with count = body length.
 OP_STATS = 8
+# Protocol v3 (graftchaos): configure the sidecar's fault-injection hook.
+# The request body is one UTF-8 JSON object (count = body length, msg_len
+# 0; spec schema in sidecar/service.ChaosState: bounded reply delay,
+# forced connection drops, forced queue-full sheds, clear).  Reply is a
+# one-byte mask: [1] applied, [0] refused (server runs without --chaos).
+# Only honored behind the explicit --chaos flag — a production sidecar
+# cannot be degraded over the wire.
+OP_CHAOS = 9
 
 # Version of this wire protocol, bumped when the opcode set or any frame
-# layout changes (v2: OP_VERIFY_BULK + OP_STATS).  Mirrored by the C++
-# client's kProtocolVersion; graftlint's wire cross-checker pins the
-# pair.  Replies an unknown-opcode ValueError on older peers rather than
-# desyncing, so the constant is documentation + lint anchor, not a
-# handshake.
-PROTOCOL_VERSION = 2
+# layout changes (v2: OP_VERIFY_BULK + OP_STATS; v3: OP_CHAOS).  Mirrored
+# by the C++ client's kProtocolVersion; graftlint's wire cross-checker
+# pins the pair.  Replies an unknown-opcode ValueError on older peers
+# rather than desyncing, so the constant is documentation + lint anchor,
+# not a handshake.
+PROTOCOL_VERSION = 3
 
 # Backpressure contract (v2): when a class queue is full, the sidecar
 # replies immediately with an EMPTY body (count 0) for a request that
@@ -131,6 +139,12 @@ class BlsMultiRequest:
     sigs: list            # n x 192 B uncompressed G2
 
 
+@dataclass
+class ChaosRequest:
+    request_id: int
+    spec: dict            # fault knobs (service.ChaosState.configure)
+
+
 def encode_request(request_id: int, msgs, pks, sigs,
                    opcode: int = OP_VERIFY_BATCH) -> bytes:
     n = len(msgs)
@@ -181,6 +195,16 @@ def decode_stats_body(body: bytes) -> dict:
     return out
 
 
+def encode_chaos_request(request_id: int, spec: dict) -> bytes:
+    """Chaos-hook configuration -> request frame (UTF-8 JSON body riding
+    the count field as its byte length, like the OP_STATS reply)."""
+    import json
+
+    body = json.dumps(spec, sort_keys=True).encode("utf-8")
+    payload = _HDR.pack(OP_CHAOS, request_id, len(body), 0) + body
+    return struct.pack(">I", len(payload)) + payload
+
+
 def encode_bls_agg_request(request_id: int, msg: bytes, agg_sig: bytes,
                            pks) -> bytes:
     assert len(agg_sig) == BLS_SIG_LEN
@@ -227,10 +251,23 @@ def decode_request(payload: bytes):
         raise ValueError(f"short frame: {e}")
     if opcode not in (OP_VERIFY_BATCH, OP_VERIFY_BULK, OP_PING, OP_STATS,
                       OP_BLS_VERIFY_AGG, OP_BLS_SIGN, OP_BLS_VERIFY_VOTES,
-                      OP_BLS_VERIFY_MULTI):
+                      OP_BLS_VERIFY_MULTI, OP_CHAOS):
         raise ValueError(f"unknown opcode {opcode}")
     if opcode in (OP_PING, OP_STATS):
         return opcode, VerifyRequest(request_id, [], [], [])
+    if opcode == OP_CHAOS:
+        import json
+
+        body = payload[_HDR.size:]
+        if len(body) != n:
+            raise ValueError("bad chaos frame")
+        try:
+            spec = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise ValueError(f"bad chaos body: {e}")
+        if not isinstance(spec, dict):
+            raise ValueError("chaos body is not a JSON object")
+        return opcode, ChaosRequest(request_id, spec)
     if opcode == OP_BLS_VERIFY_AGG:
         off = _HDR.size
         msg = payload[off:off + msg_len]
@@ -326,6 +363,11 @@ def read_frame(sock) -> bytes:
 def _read_exact(sock, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
+        # The bound lives on the socket, not here: every CLIENT sets a
+        # connect/recv timeout (SidecarClient), while the server-side
+        # reader idles between requests by design — its bound is peer
+        # close.  The one shared recv in the tree, hence the suppression.
+        # graftlint: disable=unbounded-socket-op
         chunk = sock.recv(n - len(buf))
         if not chunk:
             raise ConnectionError("socket closed mid-frame")

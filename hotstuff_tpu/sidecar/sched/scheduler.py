@@ -42,6 +42,7 @@ and the reply fan-out stay in ``sidecar/service.VerifyEngine``.
 
 from __future__ import annotations
 
+import os
 import threading
 from time import monotonic
 
@@ -53,9 +54,72 @@ from .stats import SchedStats
 # Admission caps (signature records queued, not requests).  Latency is
 # sized for bursts of full-committee QC verifies; bulk for a few whole
 # coalesced launches — beyond that, shedding to the client beats hiding
-# an ever-growing backlog inside the sidecar.
-LATENCY_QUEUE_CAP_SIGS = 64 * 1024
-BULK_QUEUE_CAP_SIGS = 128 * 1024
+# an ever-growing backlog inside the sidecar.  These are the STATIC
+# defaults; deployments that know their committee size / client rate get
+# caps sized from those parameters instead (size_queue_caps below), and
+# the HOTSTUFF_TPU_{LATENCY,BULK}_QUEUE_CAP_SIGS env vars override both.
+_DEFAULT_LATENCY_CAP_SIGS = 64 * 1024
+_DEFAULT_BULK_CAP_SIGS = 128 * 1024
+
+# Per-replica async verify pipeline depth the latency sizing assumes —
+# the C++ node's MAXIMUM adaptive in-flight budget (TpuVerifier::
+# kInflightBudgetMax; the budget only ever shrinks below this).
+_INFLIGHT_PER_REPLICA = 64
+
+
+def _env_cap(name: str):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def _clamp(v: int, lo: int, hi: int) -> int:
+    return max(lo, min(hi, v))
+
+
+def size_queue_caps(committee: int | None = None,
+                    client_rate: int | None = None):
+    """``(latency_cap_sigs, bulk_cap_sigs)`` for a deployment.
+
+    Latency demand scales with the committee: on the shared local
+    testbed every replica verifies every certificate, so a worst-case
+    burst is ``committee`` replicas x ``_INFLIGHT_PER_REPLICA`` pipelined
+    requests x ``quorum`` signatures each.  Bulk demand scales with the
+    client transaction rate: the cap admits ~2 s of arrivals, past which
+    shedding to the client's host path beats an ever-older backlog
+    (their verdicts would miss the batch's consensus round anyway).
+    Both are clamped to [default/4, 16x default] so a typo'd parameter
+    cannot starve or balloon the sidecar, and the explicit env
+    overrides (HOTSTUFF_TPU_LATENCY_QUEUE_CAP_SIGS /
+    HOTSTUFF_TPU_BULK_QUEUE_CAP_SIGS) win over everything."""
+    lat = _env_cap("HOTSTUFF_TPU_LATENCY_QUEUE_CAP_SIGS")
+    if lat is None:
+        if committee and committee > 1:
+            quorum = 2 * committee // 3 + 1
+            lat = _clamp(committee * quorum * _INFLIGHT_PER_REPLICA,
+                         _DEFAULT_LATENCY_CAP_SIGS // 4,
+                         16 * _DEFAULT_LATENCY_CAP_SIGS)
+        else:
+            lat = _DEFAULT_LATENCY_CAP_SIGS
+    blk = _env_cap("HOTSTUFF_TPU_BULK_QUEUE_CAP_SIGS")
+    if blk is None:
+        if client_rate and client_rate > 0:
+            blk = _clamp(2 * client_rate,
+                         _DEFAULT_BULK_CAP_SIGS // 4,
+                         16 * _DEFAULT_BULK_CAP_SIGS)
+        else:
+            blk = _DEFAULT_BULK_CAP_SIGS
+    return lat, blk
+
+
+# Back-compat module constants (env-aware at import): the parameterless
+# Scheduler() and older embedders read these.
+LATENCY_QUEUE_CAP_SIGS, BULK_QUEUE_CAP_SIGS = size_queue_caps()
 
 
 class Scheduler:
@@ -91,6 +155,10 @@ class Scheduler:
 
     def queued_sigs(self, cls: str) -> int:
         return self._queues[cls].sigs
+
+    def queue_caps(self) -> dict:
+        """Admission caps per class (OP_STATS telemetry)."""
+        return {cls: q.cap_sigs for cls, q in self._queues.items()}
 
     # -- assembly (engine thread) -------------------------------------------
 

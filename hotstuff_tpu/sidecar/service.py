@@ -59,19 +59,110 @@ MAX_COALESCED = 16 * MAX_SUBBATCH
 _Pending = vsched.Pending
 
 
+class ChaosState:
+    """Protocol v3 fault-injection hook (OP_CHAOS, behind ``--chaos``).
+
+    Lets the graftchaos harness exercise the *client-side* failure
+    handling — C++ host fallback, python SidecarOverloaded, reconnect —
+    without process murder, by making a healthy sidecar misbehave in
+    three bounded, scripted ways:
+
+      ``delay_ms``  every verify reply is delayed this long (capped at
+                    MAX_DELAY_MS; 0 clears) — a slow/contended device
+      ``drop``      the next N verify requests close their connection
+                    instead of answering — a crashing sidecar, minus the
+                    crash
+      ``shed``      the next N verify requests get the explicit
+                    queue-full backpressure reply — a saturated engine,
+                    without needing to actually saturate it
+      ``clear``     reset everything
+
+    Chaos only touches verify/sign opcodes: PING stays honest so
+    readiness probes (and the harness's own boot wait) keep working, and
+    OP_STATS/OP_CHAOS stay reachable so a degraded sidecar can still be
+    observed and un-degraded.  Delayed replies are rescheduled onto a
+    timer — the connection's reader thread never sleeps, so a PING
+    pipelined behind a delayed verify still answers immediately.
+    """
+
+    # Deliberately BELOW the C++ client's Ed25519 reply deadline
+    # (TpuVerifier::kRecvTimeoutMs = 1000): a capped delay must model a
+    # SLOW sidecar the client still waits out, never an expired request
+    # — past the deadline the fault is indistinguishable from an outage,
+    # which ``kill`` already scripts (and which would cascade into the
+    # wedged-connection teardown + circuit breaker instead of the
+    # scripted slow-reply behavior).
+    MAX_DELAY_MS = 750
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.delay_ms = 0
+        self.shed_left = 0
+        self.drop_left = 0
+
+    def configure(self, spec: dict) -> dict:
+        """Apply one OP_CHAOS spec; raises ValueError on unknown keys or
+        non-integer values (the connection closes, same contract as any
+        malformed frame)."""
+        unknown = set(spec) - {"delay_ms", "shed", "drop", "clear"}
+        if unknown:
+            raise ValueError(f"unknown chaos key(s) {sorted(unknown)}")
+        vals = {}
+        for key in ("delay_ms", "shed", "drop"):
+            if key in spec:
+                v = spec[key]
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    raise ValueError(f"chaos {key} must be an int >= 0")
+                vals[key] = v
+        with self._lock:
+            if spec.get("clear"):
+                self.delay_ms = self.shed_left = self.drop_left = 0
+            if "delay_ms" in vals:
+                self.delay_ms = min(vals["delay_ms"], self.MAX_DELAY_MS)
+            if "shed" in vals:
+                self.shed_left = vals["shed"]
+            if "drop" in vals:
+                self.drop_left = vals["drop"]
+            applied = {"delay_ms": self.delay_ms, "shed": self.shed_left,
+                       "drop": self.drop_left}
+        log.warning("chaos hook configured: %s", applied)
+        return applied
+
+    def verify_action(self):
+        """Consume the chaos decision for one verify/sign request ->
+        (drop: bool, shed: bool, delay_s: float)."""
+        with self._lock:
+            if self.drop_left > 0:
+                self.drop_left -= 1
+                return True, False, 0.0
+            shed = self.shed_left > 0
+            if shed:
+                self.shed_left -= 1
+            return False, shed, self.delay_ms / 1e3
+
+
 class VerifyEngine:
     """Owns the device; single consumer thread draining scheduler launches."""
 
-    def __init__(self, mesh_devices: int | None = None, use_host: bool = False):
+    def __init__(self, mesh_devices: int | None = None, use_host: bool = False,
+                 committee: int | None = None,
+                 client_rate: int | None = None):
         # All launch-shape policy lives in the scheduler subsystem: the
         # shape registry records what the warmup compiled (until
         # enable_bulk, launches cap at MAX_SUBBATCH; _warmup covers every
         # padded bucket up to that cap, so warmed deployments never hit a
         # first-time compile on this thread), and the two-class queues
-        # decide what each launch contains.
+        # decide what each launch contains.  Admission caps are sized
+        # from the deployment (committee size drives latency-class
+        # demand, client rate drives bulk) with env overrides winning —
+        # see sched/scheduler.size_queue_caps.
         self._shapes = vsched.ShapeRegistry(
             use_host=use_host, mesh=bool(mesh_devices and mesh_devices > 1))
-        self._sched = vsched.Scheduler(shapes=self._shapes)
+        lat_cap, bulk_cap = vsched.size_queue_caps(
+            committee=committee, client_rate=client_rate)
+        self._sched = vsched.Scheduler(shapes=self._shapes,
+                                       latency_cap_sigs=lat_cap,
+                                       bulk_cap_sigs=bulk_cap)
         self._use_host = use_host
         # Device multi-digest pairing programs compile one shape per vote
         # count (minutes each); only counts warmed via _warmup_bls_multi
@@ -102,6 +193,7 @@ class VerifyEngine:
         """The OP_STATS reply body: scheduler telemetry + warmed shapes."""
         snap = self._sched.stats.snapshot()
         snap["shapes"] = self._shapes.snapshot()
+        snap["queue_caps"] = self._sched.queue_caps()
         snap["verdict_cache_entries"] = len(self._verdicts)
         return snap
 
@@ -498,6 +590,54 @@ class _Handler(socketserver.BaseRequestHandler):
                     outbox.put(proto.encode_stats_reply(
                         req.request_id, engine.stats_snapshot()))
                     continue
+                chaos: ChaosState | None = \
+                    getattr(self.server, "chaos", None)
+                if opcode == proto.OP_CHAOS:
+                    # [0] = refused (no --chaos): a production sidecar is
+                    # not degradable over the wire, and the caller can
+                    # tell refusal from success.
+                    if chaos is None:
+                        outbox.put(proto.encode_reply(
+                            opcode, req.request_id, [0]))
+                        continue
+                    chaos.configure(req.spec)  # ValueError closes conn
+                    outbox.put(proto.encode_reply(
+                        opcode, req.request_id, [1]))
+                    continue
+                delay_s = 0.0
+                if chaos is not None:
+                    # Scripted misbehavior for verify/sign traffic only
+                    # (PING/STATS/CHAOS above stay honest).  Decided
+                    # BEFORE the verdict-cache fast path so a scripted
+                    # shed/drop cannot be masked by a cache hit.
+                    drop, shed, delay_s = chaos.verify_action()
+                    if drop:
+                        log.warning("chaos: dropping connection")
+                        return
+                    if shed:
+                        log.warning("chaos: forcing queue-full shed")
+                        outbox.put(proto.encode_reply(
+                            opcode, req.request_id, []))
+                        continue
+
+                def send(frame, _delay=delay_s):
+                    # Delayed replies reschedule onto a timer so THIS
+                    # reader thread keeps draining frames (a pipelined
+                    # PING behind a delayed verify answers on time).
+                    # put_nowait everywhere: a wedged connection drops
+                    # its reply and the reader reaps it, never a blocked
+                    # thread (the established outbox policy).
+                    def enqueue():
+                        try:
+                            outbox.put_nowait(frame)
+                        except queue.Full:
+                            pass
+                    if _delay:
+                        t = threading.Timer(_delay, enqueue)
+                        t.daemon = True
+                        t.start()
+                    else:
+                        enqueue()
 
                 # Cache fast path: a fully-cached Ed25519 verify request is
                 # answered on THIS connection thread — no engine queue
@@ -511,7 +651,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 if opcode in (proto.OP_VERIFY_BATCH, proto.OP_VERIFY_BULK):
                     verdicts = engine.cached_verdicts(req)
                     if verdicts is not None:
-                        outbox.put(proto.encode_reply(
+                        send(proto.encode_reply(
                             opcode, req.request_id, verdicts))
                         continue
                 elif opcode in (proto.OP_BLS_VERIFY_AGG,
@@ -520,13 +660,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     is_bls = True
                     verdicts = engine.cached_bls_verdict(req)
                     if verdicts is not None:
-                        outbox.put(proto.encode_reply(
+                        send(proto.encode_reply(
                             opcode, req.request_id, verdicts))
                         continue
                 elif opcode == proto.OP_BLS_SIGN:
                     is_bls = True
 
-                def reply(result, _rid=req.request_id, _op=opcode):
+                def reply(result, _rid=req.request_id, _op=opcode,
+                          _send=send):
                     if _op == proto.OP_BLS_SIGN:
                         frame = proto.encode_reply_raw(
                             _op, _rid, result if result else b"")
@@ -534,10 +675,7 @@ class _Handler(socketserver.BaseRequestHandler):
                         frame = proto.encode_reply(
                             _op, _rid, result if result is not None
                             else [False])
-                    try:
-                        outbox.put_nowait(frame)
-                    except queue.Full:
-                        pass  # connection is wedged; drop, reader will reap
+                    _send(frame)
 
                 # Admission is bounded: a full class queue is answered
                 # HERE with an explicit empty-body reply (count 0 where
@@ -562,9 +700,11 @@ class SidecarServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, addr, engine: VerifyEngine):
+    def __init__(self, addr, engine: VerifyEngine,
+                 chaos: ChaosState | None = None):
         super().__init__(addr, _Handler)
         self.engine = engine
+        self.chaos = chaos
 
 
 def serve(host: str = "127.0.0.1", port: int = 7100,
@@ -572,8 +712,10 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
           ready_event: threading.Event | None = None,
           warm_max: int = MAX_SUBBATCH, warm_bls: bool = False,
           warm_bls_multi: int = 0, warm_bulk: bool = False,
-          warm_rlc: bool = False):
-    engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host)
+          warm_rlc: bool = False, chaos: bool = False,
+          committee: int | None = None, client_rate: int | None = None):
+    engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host,
+                          committee=committee, client_rate=client_rate)
     # Warm the jit cache BEFORE binding: until the socket exists, node
     # crypto gets ECONNREFUSED and falls back to host verify instead of
     # connecting into a server whose device thread is still compiling.
@@ -598,7 +740,12 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
             # verify_rlc_sharded (its own warmup story), and the shape
             # registry never routes RLC in mesh/host mode.
             _warmup_rlc(engine, warm_max)
-    server = SidecarServer((host, port), engine)
+    chaos_state = None
+    if chaos:
+        chaos_state = ChaosState()
+        log.warning("chaos hook ENABLED (--chaos): OP_CHAOS requests can "
+                    "degrade this sidecar")
+    server = SidecarServer((host, port), engine, chaos=chaos_state)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
     if ready_event is not None:
         ready_event.set()
@@ -757,6 +904,17 @@ def main(argv=None):
                          "shapes so coalesced batches of %d+ signatures "
                          "route through the combined check"
                          % vsched.RLC_MIN_LAUNCH)
+    ap.add_argument("--chaos", action="store_true",
+                    help="enable the OP_CHAOS fault-injection hook "
+                         "(bounded reply delay, forced connection drops, "
+                         "forced queue-full sheds) — graftchaos testbeds "
+                         "only, never production")
+    ap.add_argument("--committee", type=int, default=0, metavar="N",
+                    help="committee size served; sizes the latency-class "
+                         "admission cap (0 = static default)")
+    ap.add_argument("--client-rate", type=int, default=0, metavar="TPS",
+                    help="aggregate client tx rate; sizes the bulk-class "
+                         "admission cap (0 = static default)")
     ap.add_argument("-v", "--verbose", action="count", default=0)
     args = ap.parse_args(argv)
     logging.basicConfig(
@@ -766,7 +924,9 @@ def main(argv=None):
     serve(args.host, args.port, mesh_devices=args.mesh or None,
           use_host=args.host_crypto, warm_max=args.warm,
           warm_bls=args.warm_bls, warm_bls_multi=args.warm_bls_multi,
-          warm_bulk=args.warm_bulk, warm_rlc=args.warm_rlc)
+          warm_bulk=args.warm_bulk, warm_rlc=args.warm_rlc,
+          chaos=args.chaos, committee=args.committee or None,
+          client_rate=args.client_rate or None)
 
 
 if __name__ == "__main__":

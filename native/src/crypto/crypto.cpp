@@ -291,8 +291,14 @@ bool Signature::async_available() {
   TpuVerifier* tpu = TpuVerifier::instance();
   if (!tpu) return false;
   // Bound the pipeline depth: past this, backpressure to the synchronous
-  // path beats queueing more work behind a busy engine.
-  if (tpu->inflight() >= 64) return false;
+  // path beats queueing more work behind a busy engine.  The bound is
+  // adaptive — the client shrinks it when the sidecar's OP_STATS report
+  // a rising latency-class queue-wait p99 (TpuVerifier::adapt_budget) —
+  // so congestion sheds pipelining pressure before the engine has to
+  // shed requests.
+  if (tpu->inflight() >= static_cast<size_t>(tpu->inflight_budget())) {
+    return false;
+  }
   if (current_scheme() == Scheme::kBls && !BlsContext::instance()) {
     return false;
   }

@@ -2,9 +2,11 @@
 
 #include <poll.h>
 
+#include <algorithm>
 #include <thread>
 
 #include "common/channel.hpp"
+#include "common/json.hpp"
 #include "common/log.hpp"
 #include "common/serde.hpp"
 #include "crypto/crypto.hpp"
@@ -21,8 +23,13 @@ constexpr uint8_t kOpBlsVerifyMulti = 6;
 // kOpVerifyBatch stays the latency class (consensus QC/TC verifies), so
 // the scheduler can launch them ahead of any bulk backlog.
 constexpr uint8_t kOpVerifyBulk = 7;
-constexpr uint8_t kOpStats = 8;  // NOLINT (wire constant, unused here)
-constexpr uint8_t kProtocolVersion = 2;  // NOLINT (lint anchor; no handshake)
+// Telemetry snapshot: the reader polls this to adapt the async in-flight
+// budget off the sidecar's latency queue-wait p99.
+constexpr uint8_t kOpStats = 8;
+// Protocol v3 (graftchaos): sidecar fault-injection hook. The node never
+// sends it (the chaos harness does, via the python client).
+constexpr uint8_t kOpChaos = 9;  // NOLINT (wire constant, unused here)
+constexpr uint8_t kProtocolVersion = 3;  // NOLINT (lint anchor; no handshake)
 constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
@@ -41,12 +48,15 @@ void write_header(Writer* w, uint8_t opcode, uint32_t rid, uint32_t count) {
 }  // namespace
 
 TpuVerifier::TpuVerifier(const Address& addr)
-    : addr_(addr), inner_(std::make_shared<Inner>()) {}
+    : addr_(addr), inner_(std::make_shared<Inner>()) {
+  inner_->addr = addr;
+}
 
 TpuVerifier::~TpuVerifier() {
   std::vector<FrameCallback> cbs;
   {
     std::lock_guard<std::mutex> lk(inner_->m);
+    inner_->closing = true;  // probes exit; no new probe may start
     inner_->gen++;  // stale readers exit without touching the socket
     for (auto& [rid, p] : inner_->pending) cbs.push_back(std::move(p.cb));
     inner_->pending.clear();
@@ -54,6 +64,7 @@ TpuVerifier::~TpuVerifier() {
     // by ~Inner once the last reader drops its shared_ptr.
     inner_->sock.shutdown();
   }
+  inner_->cv.notify_all();  // wakes a probe sleeping out its backoff
   for (auto& cb : cbs) cb(std::nullopt);
 }
 
@@ -73,18 +84,57 @@ size_t TpuVerifier::inflight() const {
   return inner_->pending.size();
 }
 
+TpuVerifier::BreakerState TpuVerifier::breaker_state() const {
+  std::lock_guard<std::mutex> lk(inner_->m);
+  return inner_->breaker;
+}
+
+int TpuVerifier::inflight_budget() const {
+  std::lock_guard<std::mutex> lk(inner_->m);
+  return inner_->inflight_budget;
+}
+
+int TpuVerifier::adapt_budget(int current, double p99_ms) {
+  // AIMD: a congested engine (queue-wait p99 past the shrink threshold)
+  // halves the pipeline fast — every queued request is already paying
+  // that wait, so piling more on only lengthens it — while a quiet one
+  // creeps back up additively.  The hysteresis band between the two
+  // thresholds keeps the budget from oscillating on a borderline load.
+  if (p99_ms > kQueueWaitShrinkMs) {
+    return std::max(kInflightBudgetMin, current / 2);
+  }
+  if (p99_ms < kQueueWaitGrowMs) {
+    return std::min(kInflightBudgetMax, current + 8);
+  }
+  return current;
+}
+
+void TpuVerifier::set_backoff_for_test(int base_ms, int max_ms) {
+  std::lock_guard<std::mutex> lk(inner_->m);
+  inner_->backoff_base_ms = base_ms;
+  inner_->backoff_ms = base_ms;
+  inner_->backoff_max_ms = max_ms;
+  inner_->backoff_until = {};
+}
+
 bool TpuVerifier::ensure_connected_locked_() {
   Inner& in = *inner_;
   if (in.sock.valid()) return true;
+  if (in.breaker != BreakerState::kClosed) {
+    // Open (or probing): the host path answers immediately; reconnection
+    // is the probe thread's job, never a verify's.
+    start_probe_locked_(inner_);
+    return false;
+  }
   if (std::chrono::steady_clock::now() < in.backoff_until) return false;
   auto s = Socket::connect(addr_, kConnectTimeoutMs);
   if (!s) {
-    in.backoff_until = std::chrono::steady_clock::now() +
-                       std::chrono::milliseconds(kBackoffMs);
-    if (!in.ever_connected) return false;
-    LOG_WARN("crypto::sidecar") << "lost connection to verify sidecar "
-                                << addr_.str();
-    in.ever_connected = false;
+    if (in.ever_connected) {
+      LOG_WARN("crypto::sidecar") << "lost connection to verify sidecar "
+                                  << addr_.str();
+      in.ever_connected = false;
+    }
+    note_failure_locked_(inner_, "connect failed");
     return false;
   }
   in.sock = std::move(*s);
@@ -93,6 +143,8 @@ bool TpuVerifier::ensure_connected_locked_() {
   in.sock.set_recv_timeout(kRecvTimeoutMs);
   in.gen++;
   in.last_rx = std::chrono::steady_clock::now();
+  in.consecutive_failures = 0;
+  in.backoff_ms = in.backoff_base_ms;
   if (!in.ever_connected) {
     LOG_INFO("crypto::sidecar") << "connected to verify sidecar "
                                 << addr_.str();
@@ -100,6 +152,76 @@ bool TpuVerifier::ensure_connected_locked_() {
   in.ever_connected = true;
   std::thread(reader_loop_, inner_, in.gen, in.sock.fd()).detach();
   return true;
+}
+
+void TpuVerifier::note_failure_locked_(const std::shared_ptr<Inner>& inner,
+                                       const char* why) {
+  Inner& in = *inner;
+  in.backoff_until = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(in.backoff_ms);
+  in.consecutive_failures++;
+  if (in.breaker == BreakerState::kClosed &&
+      in.consecutive_failures >= kBreakerThreshold) {
+    in.breaker = BreakerState::kOpen;
+    LOG_WARN("crypto::sidecar")
+        << "circuit breaker OPEN after " << in.consecutive_failures
+        << " consecutive transport failures (" << why
+        << "): verifying on host, probing " << in.addr.str() << " every "
+        << in.backoff_ms << "+ ms";
+    start_probe_locked_(inner);
+  }
+}
+
+void TpuVerifier::start_probe_locked_(const std::shared_ptr<Inner>& inner) {
+  if (inner->probe_running || inner->closing ||
+      inner->breaker == BreakerState::kClosed) {
+    return;
+  }
+  inner->probe_running = true;
+  std::thread(probe_loop_, inner).detach();
+}
+
+// Half-open reconnect loop: sleep out the current backoff, try one
+// connect, double the backoff on failure (capped).  Owns breaker state
+// transitions while the breaker is open; exits as soon as it re-attaches,
+// the client is destroyed, or something else closed the breaker.
+void TpuVerifier::probe_loop_(std::shared_ptr<Inner> inner) {
+  std::unique_lock<std::mutex> lk(inner->m);
+  while (!inner->closing && inner->breaker != BreakerState::kClosed) {
+    inner->breaker = BreakerState::kOpen;
+    inner->cv.wait_for(lk, std::chrono::milliseconds(inner->backoff_ms),
+                       [&] { return inner->closing; });
+    if (inner->closing) break;
+    inner->breaker = BreakerState::kHalfOpen;
+    Address addr = inner->addr;
+    lk.unlock();
+    auto s = Socket::connect(addr, kConnectTimeoutMs);
+    lk.lock();
+    if (inner->closing) break;
+    if (s) {
+      inner->sock = std::move(*s);
+      inner->sock.set_recv_timeout(kRecvTimeoutMs);
+      inner->gen++;
+      inner->last_rx = std::chrono::steady_clock::now();
+      inner->breaker = BreakerState::kClosed;
+      inner->consecutive_failures = 0;
+      inner->backoff_ms = inner->backoff_base_ms;
+      inner->backoff_until = {};
+      inner->ever_connected = true;
+      LOG_INFO("crypto::sidecar")
+          << "circuit breaker CLOSED: re-attached to verify sidecar "
+          << addr.str();
+      std::thread(reader_loop_, inner, inner->gen, inner->sock.fd())
+          .detach();
+      break;
+    }
+    inner->backoff_ms =
+        std::min(inner->backoff_ms * 2, inner->backoff_max_ms);
+    LOG_DEBUG("crypto::sidecar")
+        << "breaker probe failed; next probe in " << inner->backoff_ms
+        << " ms";
+  }
+  inner->probe_running = false;
 }
 
 // Fails every pending request and closes the socket. The reader of `gen`
@@ -119,8 +241,7 @@ void TpuVerifier::fail_all_(const std::shared_ptr<Inner>& inner,
     for (auto& [rid, p] : inner->pending) cbs.push_back(std::move(p.cb));
     inner->pending.clear();
     inner->sock.close();
-    inner->backoff_until = std::chrono::steady_clock::now() +
-                           std::chrono::milliseconds(kBackoffMs);
+    note_failure_locked_(inner, why);
   }
   for (auto& cb : cbs) cb(std::nullopt);
 }
@@ -170,6 +291,10 @@ void TpuVerifier::reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
         return;
       }
     }
+    // Telemetry heartbeat rides the same pipelined connection: at most
+    // one OP_STATS request per kStatsIntervalMs, whose reply adapts the
+    // async in-flight budget off the engine's queue-wait p99.
+    maybe_poll_stats_(inner, gen);
     if (rc == 0) continue;
     Bytes reply;
     // Safe without the lock: this reader is the only thread reading, and
@@ -200,6 +325,76 @@ void TpuVerifier::reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
     } else {
       LOG_DEBUG("crypto::sidecar") << "dropping late/unknown sidecar reply";
     }
+  }
+}
+
+void TpuVerifier::maybe_poll_stats_(const std::shared_ptr<Inner>& inner,
+                                    uint64_t gen) {
+  std::lock_guard<std::mutex> lk(inner->m);
+  if (inner->gen != gen || !inner->sock.valid()) return;
+  auto now = std::chrono::steady_clock::now();
+  if (now - inner->last_stats_tx <
+      std::chrono::milliseconds(kStatsIntervalMs)) {
+    return;
+  }
+  inner->last_stats_tx = now;
+  uint32_t rid = inner->next_id++;
+  Writer w;
+  write_header(&w, kOpStats, rid, 0);
+  PendingReq req;
+  req.opcode = kOpStats;
+  req.deadline = now + std::chrono::milliseconds(kRecvTimeoutMs);
+  std::weak_ptr<Inner> weak = inner;
+  req.cb = [weak, rid](std::optional<Bytes> reply) {
+    handle_stats_reply_(weak, rid, std::move(reply));
+  };
+  inner->pending.emplace(rid, std::move(req));
+  if (!inner->sock.write_frame(w.out)) inner->sock.shutdown();
+}
+
+void TpuVerifier::handle_stats_reply_(const std::weak_ptr<Inner>& weak,
+                                      uint32_t rid,
+                                      std::optional<Bytes> reply) {
+  if (!reply) return;  // transport failure: budget stays as it was
+  double p99 = -1.0;
+  try {
+    Reader r(*reply);
+    uint8_t op = r.u8();
+    uint32_t got_rid = r.u32();
+    uint32_t n = r.u32();
+    if (op != kOpStats || got_rid != rid) return;
+    std::string body;
+    body.reserve(n);
+    for (uint32_t i = 0; i < n; i++) body.push_back(char(r.u8()));
+    Json snap = Json::parse(body);
+    const Json* waits = snap.find("queue_wait");
+    if (!waits || !waits->is_object()) return;
+    const Json* lat = waits->find("latency");
+    if (!lat || !lat->is_object()) return;
+    const Json* p99j = lat->find("p99_ms");
+    const Json* count = lat->find("n");
+    // No samples yet means no evidence of congestion either way.
+    if (!p99j || !count || count->as_u64() == 0) return;
+    p99 = p99j->as_number();
+  } catch (const SerdeError&) {
+    return;
+  } catch (const JsonError&) {
+    return;
+  }
+  auto inner = weak.lock();
+  if (!inner) return;
+  int before;
+  int after;
+  {
+    std::lock_guard<std::mutex> lk(inner->m);
+    before = inner->inflight_budget;
+    inner->inflight_budget = adapt_budget(before, p99);
+    after = inner->inflight_budget;
+  }
+  if (after != before) {
+    LOG_INFO("crypto::sidecar")
+        << "async in-flight budget " << before << " -> " << after
+        << " (sidecar latency queue-wait p99 " << p99 << " ms)";
   }
 }
 

@@ -14,6 +14,7 @@
 #pragma once
 
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <functional>
 #include <memory>
@@ -47,6 +48,31 @@ class TpuVerifier {
   bool connected();
   // Number of requests currently awaiting a sidecar reply.
   size_t inflight() const;
+
+  // Degradation ladder (graftchaos): after kBreakerThreshold consecutive
+  // transport failures the breaker OPENs — every verify goes straight to
+  // the host path with zero connect cost while a background probe thread
+  // re-dials the sidecar on an exponential backoff (half-open).  A probe
+  // that connects CLOSEs the breaker and re-attaches the reader.  State
+  // transitions are logged ("circuit breaker OPEN/CLOSED"), which the
+  // harness LogParser folds into the run summary.
+  enum class BreakerState { kClosed, kOpen, kHalfOpen };
+  BreakerState breaker_state() const;
+
+  // Adaptive async pipeline bound: the reader polls the sidecar's
+  // OP_STATS latency-class queue-wait p99 every kStatsIntervalMs and
+  // AIMD-adapts how many requests may be pending at once (replacing the
+  // old fixed 64 in Signature::async_available) — a congested engine
+  // sheds pipelining pressure before its queue-full backpressure has to.
+  int inflight_budget() const;
+  // The pure adaptation step (multiplicative decrease past
+  // kQueueWaitShrinkMs, additive increase below kQueueWaitGrowMs,
+  // hysteresis between): factored out for unit tests.
+  static int adapt_budget(int current, double p99_ms);
+
+  // Test hook: shrink the breaker timings so unit tests can watch a full
+  // open -> probe -> re-attach cycle without multi-second sleeps.
+  void set_backoff_for_test(int base_ms, int max_ms);
 
   // One coalesced launch, one digest PER record (QC votes share a digest;
   // TC votes sign distinct (round, high_qc_round) digests — the wire
@@ -99,8 +125,23 @@ class TpuVerifier {
   static constexpr int kRecvTimeoutMs = 1000;
   static constexpr int kBlsRecvTimeoutMs = 60'000;
   // After a transport failure, skip the sidecar entirely for this long so a
-  // dead device costs one timeout, not one per QC.
+  // dead device costs one timeout, not one per QC.  Once the breaker is
+  // open this is also the INITIAL probe interval, doubled per failed
+  // probe up to kBackoffMaxMs — steady-state cost of a dead sidecar is
+  // one background connect attempt per backoff, zero per verify.
   static constexpr int kBackoffMs = 2000;
+  static constexpr int kBackoffMaxMs = 30'000;
+  // Consecutive transport failures (failed connects, lost/wedged
+  // connections) before the breaker opens.  One flaky reply should not
+  // abandon the device path; three in a row is an outage.
+  static constexpr int kBreakerThreshold = 3;
+  // OP_STATS polling cadence and the adaptive in-flight budget's bounds
+  // + thresholds (queue-wait p99, ms).
+  static constexpr int kStatsIntervalMs = 1000;
+  static constexpr int kInflightBudgetMax = 64;
+  static constexpr int kInflightBudgetMin = 8;
+  static constexpr double kQueueWaitShrinkMs = 50.0;
+  static constexpr double kQueueWaitGrowMs = 10.0;
 
  private:
   // Reply callback: full reply frame bytes, or nullopt on failure.
@@ -112,23 +153,48 @@ class TpuVerifier {
     FrameCallback cb;
   };
 
-  // Connection state shared with (detached) reader threads, so a reader
-  // draining a dead socket can never touch a destroyed client.
+  // Connection state shared with (detached) reader/probe threads, so a
+  // thread draining a dead socket can never touch a destroyed client.
   struct Inner {
     mutable std::mutex m;
     Socket sock;
+    Address addr;      // dial target (probe thread re-dials off Inner)
     uint64_t gen = 0;  // bumped per socket lifetime; stale readers exit
     std::unordered_map<uint32_t, PendingReq> pending;
     uint32_t next_id = 0;
     bool ever_connected = false;
     std::chrono::steady_clock::time_point backoff_until{};
     std::chrono::steady_clock::time_point last_rx{};
+    // Circuit breaker + probe state (constants on TpuVerifier).
+    BreakerState breaker = BreakerState::kClosed;
+    int consecutive_failures = 0;
+    int backoff_ms = kBackoffMs;       // current probe interval
+    int backoff_base_ms = kBackoffMs;  // reset target (test hook)
+    int backoff_max_ms = kBackoffMaxMs;
+    bool probe_running = false;
+    bool closing = false;  // destructor: probes must exit
+    std::condition_variable cv;  // wakes a sleeping probe on shutdown
+    // Adaptive async budget (OP_STATS-driven).
+    int inflight_budget = kInflightBudgetMax;
+    std::chrono::steady_clock::time_point last_stats_tx{};
   };
 
   static void reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
                            int fd);
   static void fail_all_(const std::shared_ptr<Inner>& inner, uint64_t gen,
                         const char* why);
+  // Count one transport failure; opens the breaker (and starts the probe
+  // thread) at the threshold.  Lock held by the caller.
+  static void note_failure_locked_(const std::shared_ptr<Inner>& inner,
+                                   const char* why);
+  static void start_probe_locked_(const std::shared_ptr<Inner>& inner);
+  static void probe_loop_(std::shared_ptr<Inner> inner);
+  // Send an OP_STATS request at most once per kStatsIntervalMs (called
+  // from the reader loop; the reply adapts inflight_budget).
+  static void maybe_poll_stats_(const std::shared_ptr<Inner>& inner,
+                                uint64_t gen);
+  static void handle_stats_reply_(const std::weak_ptr<Inner>& weak,
+                                  uint32_t rid, std::optional<Bytes> reply);
   bool ensure_connected_locked_();
   // Registers cb and writes the frame; on any failure invokes cb(nullopt)
   // before returning. Thread-safe; never blocks on the sidecar's reply.

@@ -1,6 +1,11 @@
 // Crypto layer tests (crypto/src/tests/crypto_tests.rs:31-132 analogue):
 // key round-trips, valid/invalid single + batch verification,
-// SignatureService, and RFC 8032 test vector cross-check.
+// SignatureService, RFC 8032 test vector cross-check, and the sidecar
+// client's circuit breaker / adaptive in-flight budget.
+#include <chrono>
+#include <thread>
+
+#include "crypto/sidecar_client.hpp"
 #include "test_util.hpp"
 
 using namespace hotstuff;
@@ -150,6 +155,99 @@ TEST(verify_batch_multi_distinct_digests) {
   bad.data[5] ^= 1;
   CHECK(!Signature::verify_batch_multi({{d1, kp1.name, s1},
                                         {d2, kp2.name, bad}}));
+}
+
+TEST(sidecar_inflight_budget_adapts_aimd) {
+  // Multiplicative decrease past the shrink threshold, bounded below.
+  CHECK(TpuVerifier::adapt_budget(64, 100.0) == 32);
+  CHECK(TpuVerifier::adapt_budget(9, 100.0) == 8);
+  CHECK(TpuVerifier::adapt_budget(8, 10000.0) == 8);
+  // Additive increase below the grow threshold, bounded above.
+  CHECK(TpuVerifier::adapt_budget(32, 5.0) == 40);
+  CHECK(TpuVerifier::adapt_budget(64, 0.0) == 64);
+  // Hysteresis band: no change.
+  CHECK(TpuVerifier::adapt_budget(32, 25.0) == 32);
+}
+
+TEST(sidecar_circuit_breaker_opens_then_reattaches) {
+  // Reserve a port with nothing listening by binding and releasing it.
+  uint16_t port;
+  {
+    auto l = Listener::bind({"127.0.0.1", 0});
+    CHECK(l.has_value());
+    port = l->port();
+  }
+  auto v = std::make_unique<TpuVerifier>(Address{"127.0.0.1", port});
+  v->set_backoff_for_test(50, 200);
+  CHECK(v->breaker_state() == TpuVerifier::BreakerState::kClosed);
+  CHECK(v->inflight_budget() == TpuVerifier::kInflightBudgetMax);
+
+  auto kp = keys()[0];
+  Digest d = sha512_digest(Bytes{5});
+  Signature sig = Signature::sign(d, kp.secret);
+  std::vector<std::tuple<Digest, PublicKey, Signature>> items{
+      {d, kp.name, sig}};
+
+  // Each failed connect is one consecutive transport failure; the short
+  // backoff gate between attempts must elapse or later calls return
+  // without dialing (and without counting).
+  for (int i = 0; i < TpuVerifier::kBreakerThreshold; i++) {
+    CHECK(!v->verify_batch_multi(items).has_value());
+    std::this_thread::sleep_for(std::chrono::milliseconds(60));
+  }
+  CHECK(v->breaker_state() != TpuVerifier::BreakerState::kClosed);
+
+  // Open breaker: verifies fail over to the caller instantly — no
+  // connect timeout is paid on the verify path.
+  auto t0 = std::chrono::steady_clock::now();
+  CHECK(!v->verify_batch_multi(items).has_value());
+  auto dt = std::chrono::duration_cast<std::chrono::milliseconds>(
+      std::chrono::steady_clock::now() - t0);
+  CHECK(dt.count() < TpuVerifier::kConnectTimeoutMs);
+
+  // ... and the crypto-layer entry point still answers via host verify.
+  CHECK(Signature::verify_batch_multi(items));
+
+  // Boot a minimal sidecar stand-in on the reserved port: the probe
+  // must re-attach within a few (capped 200 ms) backoff periods.
+  auto l2 = Listener::bind({"127.0.0.1", port});
+  CHECK(l2.has_value());
+  std::thread server([&l2] {
+    auto sock = l2->accept();
+    if (!sock) return;
+    Bytes frame;
+    while (sock->read_frame(&frame)) {
+      Reader r(frame);
+      uint8_t op = r.u8();
+      uint32_t rid = r.u32();
+      uint32_t count = r.u32();
+      Writer w;
+      w.u8(op);
+      w.u32(rid);
+      if (op == 8) {  // OP_STATS: reply an empty JSON object
+        w.u32(2);
+        w.out.push_back('{');
+        w.out.push_back('}');
+      } else {
+        w.u32(count);
+        for (uint32_t i = 0; i < count; i++) w.u8(1);
+      }
+      if (!sock->write_frame(w.out)) return;
+    }
+  });
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(5);
+  while (v->breaker_state() != TpuVerifier::BreakerState::kClosed &&
+         std::chrono::steady_clock::now() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  CHECK(v->breaker_state() == TpuVerifier::BreakerState::kClosed);
+  auto mask = v->verify_batch_multi(items);
+  CHECK(mask.has_value());
+  CHECK(mask->size() == 1 && (*mask)[0]);
+
+  v.reset();  // closes the socket -> server's read_frame sees EOF
+  l2->shutdown();
+  server.join();
 }
 
 int main() { return run_all(); }

@@ -21,15 +21,22 @@ if __name__ == "__main__":
         argv += ["--root", REPO]
     if not any(a == "--must-cover" or a.startswith("--must-cover=")
                for a in argv):
-        # The RLC scalar module is device hot-path code, and every
-        # verifysched module is engine-thread control plane: the gate
-        # fails if any of them ever moves out of the scanned target set
-        # (or is deleted without this pin being updated consciously).
-        for pin in ("hotstuff_tpu/ops/scalar25519.py",
-                    "hotstuff_tpu/sidecar/sched/__init__.py",
-                    "hotstuff_tpu/sidecar/sched/classes.py",
-                    "hotstuff_tpu/sidecar/sched/scheduler.py",
-                    "hotstuff_tpu/sidecar/sched/shapes.py",
-                    "hotstuff_tpu/sidecar/sched/stats.py"):
+        # Checker-qualified pins: the RLC scalar module and every
+        # verifysched module must stay inside the HOTPATH scan (the
+        # sockets checker also walking sidecar/ must not satisfy them),
+        # and the graftchaos modules inside the SOCKETS scan.  The gate
+        # fails if any of them ever moves out of its checker's target
+        # set (or is deleted without this pin being updated consciously).
+        for pin in ("hotpath:hotstuff_tpu/ops/scalar25519.py",
+                    "hotpath:hotstuff_tpu/sidecar/sched/__init__.py",
+                    "hotpath:hotstuff_tpu/sidecar/sched/classes.py",
+                    "hotpath:hotstuff_tpu/sidecar/sched/scheduler.py",
+                    "hotpath:hotstuff_tpu/sidecar/sched/shapes.py",
+                    "hotpath:hotstuff_tpu/sidecar/sched/stats.py",
+                    "sockets:hotstuff_tpu/chaos/__init__.py",
+                    "sockets:hotstuff_tpu/chaos/plan.py",
+                    "sockets:hotstuff_tpu/chaos/runner.py",
+                    "sockets:hotstuff_tpu/chaos/recovery.py",
+                    "sockets:hotstuff_tpu/harness/faults.py"):
             argv += ["--must-cover", pin]
     sys.exit(main(argv))

@@ -11,8 +11,8 @@ import textwrap
 
 import pytest
 
-from hotstuff_tpu.analysis import (hotpath, padshape, sanitize, timing,
-                                   wirecheck)
+from hotstuff_tpu.analysis import (hotpath, padshape, sanitize, sockets,
+                                   timing, wirecheck)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -421,16 +421,38 @@ def test_must_cover_gate():
     # the lint_gate pins: the RLC scalar module, the verifysched package
     # (directory target), and the newly-covered crypto/BLS modules
     assert check_coverage(REPO, [
-        "hotstuff_tpu/ops/scalar25519.py",
-        "hotstuff_tpu/crypto/eddsa.py",
-        "hotstuff_tpu/offchain/bls12381.py",
-        "hotstuff_tpu/sidecar/sched/scheduler.py",
-        "hotstuff_tpu/sidecar/sched/shapes.py",
-        "hotstuff_tpu/sidecar/sched/stats.py",
-        "hotstuff_tpu/sidecar/sched/classes.py",
+        "hotpath:hotstuff_tpu/ops/scalar25519.py",
+        "hotpath:hotstuff_tpu/crypto/eddsa.py",
+        "hotpath:hotstuff_tpu/offchain/bls12381.py",
+        "hotpath:hotstuff_tpu/sidecar/sched/scheduler.py",
+        "hotpath:hotstuff_tpu/sidecar/sched/shapes.py",
+        "hotpath:hotstuff_tpu/sidecar/sched/stats.py",
+        "hotpath:hotstuff_tpu/sidecar/sched/classes.py",
+        # graftchaos pins (the sockets checker's targets)
+        "sockets:hotstuff_tpu/chaos/plan.py",
+        "sockets:hotstuff_tpu/chaos/runner.py",
+        "sockets:hotstuff_tpu/chaos/recovery.py",
+        "sockets:hotstuff_tpu/harness/faults.py",
+        # bare pins accept any checker — including timing (exact file
+        # and glob targets) and padshape
+        "hotstuff_tpu/sidecar/protocol.py",
+        "bench.py",
+        "scripts/exp_xfer_streams.py",
+        "timing:bench.py",
     ]) == []
-    # a file outside the hotpath targets fails the gate
-    out = check_coverage(REPO, ["hotstuff_tpu/harness/logs.py"])
+    # Checker qualification is load-bearing: the sockets checker scans
+    # sidecar/ too, but a hotpath-qualified pin on a file only sockets
+    # covers must FAIL (a union would let the hot-path lint silently
+    # lose a file another checker's prefix still matches).
+    out = check_coverage(REPO, ["hotpath:hotstuff_tpu/sidecar/client.py"])
+    assert [f.rule for f in out] == ["must-cover"]
+    assert "hotpath scan targets" in out[0].message
+    # an unknown checker name fails loudly, never passes silently
+    out = check_coverage(REPO, ["typo:hotstuff_tpu/sidecar/client.py"])
+    assert [f.rule for f in out] == ["must-cover"]
+    assert "unknown checker" in out[0].message
+    # a file outside every checker's targets fails the gate
+    out = check_coverage(REPO, ["hotstuff_tpu/utils/intmath.py"])
     assert [f.rule for f in out] == ["must-cover"]
     # a missing file fails the gate
     out = check_coverage(REPO, ["hotstuff_tpu/ops/nonexistent.py"])
@@ -570,3 +592,89 @@ def test_native_sanitize_builds_and_runs(mode):
         capture_output=True, text=True, timeout=1800)
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
     assert f"all tests clean under {mode}" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# sockets rule (unbounded-socket-op over sidecar/, harness/, chaos/)
+# ---------------------------------------------------------------------------
+
+def slint(src: str):
+    return sockets.check_sources({"net.py": textwrap.dedent(src)})
+
+
+def test_unbounded_socket_op_fires_on_bare_ops():
+    findings = slint("""
+        import socket
+        def dial():
+            c = socket.create_connection(("127.0.0.1", 7100))
+            return c
+        def pump(sock):
+            return sock.recv(4)
+        def serve(listen_sock):
+            conn, _ = listen_sock.accept()
+            return conn
+    """)
+    assert [(f.rule, f.line) for f in findings] == [
+        ("unbounded-socket-op", 4),
+        ("unbounded-socket-op", 7),
+        ("unbounded-socket-op", 9),
+    ]
+    assert "create_connection" in findings[0].message
+    assert ".recv()" in findings[1].message
+
+
+def test_unbounded_socket_op_quiet_on_bounded_ops():
+    findings = slint("""
+        import socket
+        def dial(timeout):
+            a = socket.create_connection(("h", 1), timeout=timeout)
+            b = socket.create_connection(("h", 1), 5.0)
+            return a, b
+        def pump(sock):
+            sock.settimeout(2.0)
+            return sock.recv(4)
+        def serve(listen_sock):
+            listen_sock.settimeout(1.0)
+            conn, _ = listen_sock.accept()
+            return conn
+        def not_a_socket(db):
+            return db.connect()
+    """)
+    assert findings == []
+
+
+def test_unbounded_socket_op_scopes_do_not_leak():
+    # A settimeout in one function does not bound another function's
+    # socket of the same name.
+    findings = slint("""
+        def a(sock):
+            sock.settimeout(1.0)
+            return sock.recv(4)
+        def b(sock):
+            return sock.recv(4)
+    """)
+    assert [(f.rule, f.line) for f in findings] == [
+        ("unbounded-socket-op", 6)]
+
+
+def test_unbounded_socket_op_timeout_none_still_fires():
+    findings = slint("""
+        import socket
+        def dial():
+            return socket.create_connection(("h", 1), timeout=None)
+    """)
+    assert [f.rule for f in findings] == ["unbounded-socket-op"]
+
+
+def test_unbounded_socket_op_suppression():
+    findings = slint("""
+        def pump(sock):
+            # callers bound the socket; server readers idle by design
+            # graftlint: disable=unbounded-socket-op
+            return sock.recv(4)
+    """)
+    assert findings == []
+
+
+def test_sockets_rule_quiet_on_real_tree():
+    assert sockets.check(REPO) == []

@@ -251,6 +251,9 @@ def test_sidecar_boot_degrades_to_host_crypto():
     bench = LocalBench.__new__(LocalBench)
     bench.scheme = "ed25519"
     bench._degraded = False
+    bench.nodes = 4
+    bench.rate = 1000
+    bench.fault_plan = None
     booted, waits, kills = [], [], []
     bench._background_run = \
         lambda cmd, log, append=False: booted.append(cmd)

@@ -1,21 +1,28 @@
 """Crash-restart integration: a replica killed mid-run (SIGKILL) restarts on
 its own store, restores its persisted voting state ("Restored consensus
 state" from native/src/consensus/core.cpp), resyncs via the pull-based sync
-path, and the committee keeps committing with it back.
+path, and the committee keeps committing with it back.  The sidecar case
+(graftchaos): the verify sidecar SIGKILLed mid-run keeps consensus
+committing via host fallback behind an OPEN circuit breaker, and every
+node re-attaches within a backoff probe of its restart.
 
 Capability beyond the reference: its benchmarks only model crash faults by
 never booting nodes (benchmark/local.py:77); restarted replicas are possible
 but untested there, and their volatile round state is lost
-(core.rs:112 TODO).  Host-verify mode: no sidecar or accelerator involved.
+(core.rs:112 TODO).  Replica test runs host-verify (no sidecar); the
+sidecar test boots a --host-crypto sidecar (no accelerator either way).
 """
 
 import os
+import signal
+import sys
 import time
 
 import pytest
 
 from conftest import (
-    CLIENT_BIN, NODE_BIN, count_in_log, make_committee, wait_commits,
+    CLIENT_BIN, NODE_BIN, count_in_log, free_port, make_committee,
+    wait_commits, wait_sidecar_ping,
 )
 
 pytestmark = pytest.mark.skipif(
@@ -79,3 +86,77 @@ def test_killed_node_restarts_with_state_and_rejoins(testbed):
                                  deadline_s=30)
     assert all(a > b for a, b in zip(healthy_after, healthy_before)), (
         f"healthy replicas stalled: {healthy_before} -> {healthy_after}")
+
+
+def _count_all(paths, needle):
+    return sum(count_in_log(p, needle) for p in paths)
+
+
+def test_sidecar_sigkill_midrun_host_fallback_and_reattach(testbed):
+    """graftchaos acceptance: SIGKILL the verify sidecar mid-run — the
+    committee keeps committing via the C++ host-verify fallback (circuit
+    breaker OPEN: no per-verify connect penalty) — then restart it on the
+    same port and watch every node's breaker re-attach within a backoff
+    probe, with commits continuing throughout."""
+    tmp_path, spawn = testbed
+    port = free_port()
+    _, committee, _ = make_committee(tmp_path, NODES, TIMEOUT_DELAY_MS,
+                                     sidecar_port=port)
+
+    def start_sidecar(log_name):
+        return spawn([sys.executable, "-m", "hotstuff_tpu.sidecar",
+                      "--port", str(port), "--host-crypto"], log_name)
+
+    sidecar = start_sidecar("sidecar.log")
+    assert wait_sidecar_ping(port, deadline_s=60), "sidecar never ready"
+
+    node_logs = [tmp_path / f"node-{i}.log" for i in range(NODES)]
+    for i in range(NODES):
+        spawn([NODE_BIN, "run", "--keys", f".node-{i}.json",
+               "--committee", ".committee.json", "--store", f".db-{i}",
+               "--parameters", ".parameters.json", "-v"],
+              f"node-{i}.log")
+    for i, addr in enumerate(committee.front_addresses()):
+        spawn([CLIENT_BIN, addr, "--size", "64", "--rate", "250",
+               "--timeout", str(TIMEOUT_DELAY_MS),
+               "--nodes", *committee.front_addresses()],
+              f"client-{i}.log")
+
+    # Phase 1: healthy committee commits THROUGH the sidecar.
+    counts = wait_commits(node_logs, minimum=3, deadline_s=60)
+    assert all(c >= 3 for c in counts), f"no commits pre-fault: {counts}"
+    connects_before = _count_all(node_logs, "connected to verify sidecar")
+    assert connects_before >= NODES
+
+    # Phase 2: SIGKILL the sidecar. Consensus must keep committing on
+    # the host path, and every node's breaker must OPEN (three
+    # consecutive transport failures at ~2 s backoff each).
+    sidecar.send_signal(signal.SIGKILL)
+    sidecar.wait()
+    before = [count_in_log(p, "Committed B") for p in node_logs]
+    after = wait_commits(node_logs, minimum=max(before) + 3, deadline_s=60)
+    assert all(a > b for a, b in zip(after, before)), (
+        f"consensus stalled without the sidecar: {before} -> {after}")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if _count_all(node_logs, "circuit breaker OPEN") >= NODES:
+            break
+        time.sleep(0.5)
+    assert _count_all(node_logs, "circuit breaker OPEN") >= NODES, (
+        "breakers never opened on the dead sidecar")
+
+    # Phase 3: restart the sidecar on the same port; every breaker
+    # re-attaches on a probe and commits continue.
+    start_sidecar("sidecar-restart.log")
+    assert wait_sidecar_ping(port, deadline_s=60), "restart never ready"
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        if _count_all(node_logs, "circuit breaker CLOSED") >= NODES:
+            break
+        time.sleep(0.5)
+    assert _count_all(node_logs, "circuit breaker CLOSED") >= NODES, (
+        "breakers never re-attached after the sidecar restart")
+    before = [count_in_log(p, "Committed B") for p in node_logs]
+    after = wait_commits(node_logs, minimum=max(before) + 3, deadline_s=60)
+    assert all(a > b for a, b in zip(after, before)), (
+        f"consensus stalled after re-attach: {before} -> {after}")

@@ -349,3 +349,131 @@ def test_bls_decode_failure_is_cacheable_false(host_server):
     engine._execute_bls(service._Pending(req, replies.append))
     assert replies == [[False]]
     assert engine._verdicts[engine.bls_cache_key(req)] is False
+
+
+# ---------------------------------------------------------------------------
+# graftchaos: the protocol v3 OP_CHAOS hook (service.ChaosState)
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_chaos_roundtrip_and_hostile_bytes():
+    frame = proto.encode_chaos_request(5, {"delay_ms": 100, "shed": 2})
+    opcode, req = proto.decode_request(frame[4:])
+    assert opcode == proto.OP_CHAOS
+    assert req.request_id == 5
+    assert req.spec == {"delay_ms": 100, "shed": 2}
+    # body length must match the count field; garbage JSON raises
+    import struct
+
+    bad = proto._HDR.pack(proto.OP_CHAOS, 1, 4, 0) + b"{}"
+    with pytest.raises(ValueError):
+        proto.decode_request(bad)
+    bad = proto._HDR.pack(proto.OP_CHAOS, 1, 5, 0) + b"{nope"
+    with pytest.raises(ValueError):
+        proto.decode_request(bad)
+    bad = proto._HDR.pack(proto.OP_CHAOS, 1, 2, 0) + b"[]"
+    with pytest.raises(ValueError):
+        proto.decode_request(bad)
+    assert struct.unpack(">I", frame[:4])[0] == len(frame) - 4
+
+
+@pytest.fixture(scope="module")
+def chaos_server():
+    """Host-crypto server with the chaos hook armed (--chaos)."""
+    from hotstuff_tpu.sidecar.service import ChaosState
+
+    engine = VerifyEngine(use_host=True)
+    srv = SidecarServer(("127.0.0.1", 0), engine, chaos=ChaosState())
+    t = threading.Thread(target=srv.serve_forever,
+                         kwargs=dict(poll_interval=0.1), daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    engine.stop()
+    srv.server_close()
+
+
+def test_chaos_refused_without_flag(host_server):
+    port = host_server.server_address[1]
+    with SidecarClient(port=port) as client:
+        assert client.chaos(shed=1) is False
+        # ... and nothing was configured: verifies run normally
+        msgs, pks, sigs = _sigs(3)
+        assert client.verify_batch(msgs, pks, sigs) == [True] * 3
+
+
+def test_chaos_forced_shed_then_recovers(chaos_server):
+    from hotstuff_tpu.sidecar.client import SidecarOverloaded
+
+    port = chaos_server.server_address[1]
+    with SidecarClient(port=port) as client:
+        assert client.chaos(shed=2) is True
+        msgs, pks, sigs = _sigs(4)
+        for _ in range(2):
+            with pytest.raises(SidecarOverloaded):
+                client.verify_batch(msgs, pks, sigs)
+        # budget consumed: the next verify is honest again
+        assert client.verify_batch(msgs, pks, sigs) == [True] * 4
+
+
+def test_chaos_bounded_delay_applies_and_clears(chaos_server):
+    import threading
+    import time
+
+    port = chaos_server.server_address[1]
+    with SidecarClient(port=port) as client:
+        msgs, pks, sigs = _sigs(2)
+        client.verify_batch(msgs, pks, sigs)  # warm: engine, not chaos
+        assert client.chaos(delay_ms=300) is True
+        t0 = time.monotonic()
+        assert client.verify_batch(msgs, pks, sigs) == [True] * 2
+        assert time.monotonic() - t0 >= 0.3
+        # PING is exempt EVEN when pipelined behind a delayed verify on
+        # the same connection: delays reschedule onto a timer, the
+        # reader thread keeps draining (readiness probes stay honest).
+        done = {}
+
+        def delayed_verify():
+            done["mask"] = client.verify_batch(msgs, pks, sigs)
+
+        t = threading.Thread(target=delayed_verify)
+        t.start()
+        time.sleep(0.05)  # verify request is in flight, reply delayed
+        t0 = time.monotonic()
+        assert client.ping()
+        assert time.monotonic() - t0 < 0.25
+        t.join(timeout=10)
+        assert done["mask"] == [True] * 2
+        assert client.chaos(clear=True) is True
+        t0 = time.monotonic()
+        assert client.verify_batch(msgs, pks, sigs) == [True] * 2
+        assert time.monotonic() - t0 < 0.25
+
+
+def test_chaos_delay_capped_at_maximum(chaos_server):
+    from hotstuff_tpu.sidecar.service import ChaosState
+
+    state = chaos_server.chaos
+    state.configure({"delay_ms": 10 ** 9})
+    assert state.delay_ms == ChaosState.MAX_DELAY_MS
+    state.configure({"clear": True})
+    assert state.delay_ms == 0
+    with pytest.raises(ValueError):
+        state.configure({"explode": 1})
+    with pytest.raises(ValueError):
+        state.configure({"shed": -1})
+    with pytest.raises(ValueError):
+        state.configure({"shed": True})
+
+
+def test_chaos_connection_drop(chaos_server):
+    port = chaos_server.server_address[1]
+    with SidecarClient(port=port) as control:
+        assert control.chaos(drop=1) is True
+        msgs, pks, sigs = _sigs(2)
+        # The victim connection dies on its next verify...
+        with SidecarClient(port=port) as victim:
+            with pytest.raises((ConnectionError, OSError)):
+                victim.verify_batch(msgs, pks, sigs)
+        # ...and the server is healthy for the connection after it.
+        assert control.verify_batch(msgs, pks, sigs) == [True] * 2

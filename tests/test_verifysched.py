@@ -369,3 +369,51 @@ def test_engine_small_batches_stay_per_sig(rlc_engine):
     got = _engine_mask(engine, msgs, pks, sigs)
     assert got == [i != 4 for i in range(10)]
     assert engine.stats_snapshot()["paths"].get("per_sig", 0) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# parameter-sized admission caps (ROADMAP follow-up: committee/rate sizing
+# replaces the static constants; env overrides win over everything)
+# ---------------------------------------------------------------------------
+
+
+def test_queue_caps_sized_from_committee_and_rate(monkeypatch):
+    monkeypatch.delenv("HOTSTUFF_TPU_LATENCY_QUEUE_CAP_SIGS",
+                       raising=False)
+    monkeypatch.delenv("HOTSTUFF_TPU_BULK_QUEUE_CAP_SIGS", raising=False)
+    # No parameters: the static defaults.
+    assert vsched.size_queue_caps() == (64 * 1024, 128 * 1024)
+    # Committee sizing: n * quorum * per-replica pipeline depth (64),
+    # clamped to [default/4, 16x default].
+    lat, blk = vsched.size_queue_caps(committee=20, client_rate=100_000)
+    assert lat == 20 * (2 * 20 // 3 + 1) * 64
+    assert blk == 2 * 100_000
+    # Clamps: a 4-node committee floors, a silly rate ceilings.
+    lat, _ = vsched.size_queue_caps(committee=4)
+    assert lat == 64 * 1024 // 4
+    _, blk = vsched.size_queue_caps(client_rate=10 ** 9)
+    assert blk == 16 * 128 * 1024
+
+
+def test_queue_caps_env_override_wins(monkeypatch):
+    monkeypatch.setenv("HOTSTUFF_TPU_LATENCY_QUEUE_CAP_SIGS", "777")
+    monkeypatch.setenv("HOTSTUFF_TPU_BULK_QUEUE_CAP_SIGS", "888")
+    assert vsched.size_queue_caps(committee=100, client_rate=10 ** 6) \
+        == (777, 888)
+    # Malformed / non-positive env values fall back cleanly.
+    monkeypatch.setenv("HOTSTUFF_TPU_LATENCY_QUEUE_CAP_SIGS", "soon")
+    monkeypatch.setenv("HOTSTUFF_TPU_BULK_QUEUE_CAP_SIGS", "-2")
+    assert vsched.size_queue_caps() == (64 * 1024, 128 * 1024)
+
+
+def test_engine_applies_sized_caps_and_reports_them(monkeypatch):
+    monkeypatch.delenv("HOTSTUFF_TPU_LATENCY_QUEUE_CAP_SIGS",
+                       raising=False)
+    monkeypatch.delenv("HOTSTUFF_TPU_BULK_QUEUE_CAP_SIGS", raising=False)
+    engine = VerifyEngine(use_host=True, committee=20, client_rate=50_000)
+    try:
+        caps = engine.stats_snapshot()["queue_caps"]
+        assert caps["latency"] == 20 * (2 * 20 // 3 + 1) * 64
+        assert caps["bulk"] == 100_000
+    finally:
+        engine.stop()

@@ -16,12 +16,27 @@ Rules:
   padded-bucket   (a) a function that fires a device launch (a
                   ``*_donated`` production entry or a ``_cached_*``
                   mesh verifier) without computing its size through a
-                  bucket helper (``next_pow2`` / ``_bucket``);
+                  bucket helper (``next_pow2`` / ``_bucket``, or the
+                  shard-aligned helpers on the mesh path);
                   (b) warmup/bucket constant drift: the service warmup
                   floor must equal crypto/eddsa._MIN_BUCKET, and
                   MAX_COALESCED must be a power-of-two multiple of
                   MAX_SUBBATCH (the exact chunk counts _warmup_bulk
                   compiles).
+  shard-misaligned-launch
+                  On the MESH path (parallel/sharded_verify.py and the
+                  scheduler's shape registry), launch-size arithmetic
+                  must route through THE shard-alignment helpers
+                  (parallel/shard_shapes.shard_bucket /
+                  shard_aligned_rows): a function that fires a mesh
+                  launch (``_cached_*``) or hand-rolls per-device size
+                  math (multiply/divide by an ``n_dev``/``n_devices``
+                  operand) without calling a shard helper can produce a
+                  per-shard row count warmup never compiled (3000 sigs
+                  on 8 devices -> 375-row shards) — a cold XLA compile
+                  on the engine thread mid-traffic.  next_pow2 alone is
+                  NOT sufficient there: the power-of-two discipline must
+                  be applied per shard, which only the helpers encode.
 """
 
 from __future__ import annotations
@@ -38,15 +53,35 @@ from .hotpath import _attr_chain
 DEFAULT_TARGETS = (
     "hotstuff_tpu/crypto/eddsa.py",
     "hotstuff_tpu/parallel/sharded_verify.py",
+    "hotstuff_tpu/sidecar/sched/shapes.py",
+)
+
+# The MESH-path modules: launch sizing there must go through the
+# shard-alignment helpers, not just any bucket helper.  The helper
+# module itself (parallel/shard_shapes.py) is the definition site and
+# deliberately NOT a target.
+MESH_TARGETS = (
+    "hotstuff_tpu/parallel/sharded_verify.py",
+    "hotstuff_tpu/sidecar/sched/shapes.py",
 )
 
 EDDSA = "hotstuff_tpu/crypto/eddsa.py"
 SERVICE = "hotstuff_tpu/sidecar/service.py"
 
-# Helpers that implement THE bucketing rule (crypto/eddsa.next_pow2 and
-# its module-private wrapper).  A launch-bearing function must route its
+# Helpers that implement THE bucketing rules: crypto/eddsa.next_pow2 and
+# its module-private wrapper, plus the mesh shard-alignment pair
+# (parallel/shard_shapes).  A launch-bearing function must route its
 # size through one of these.
-_BUCKET_HELPERS = {"next_pow2", "_bucket"}
+_SHARD_HELPERS = {"shard_bucket", "shard_aligned_rows"}
+_BUCKET_HELPERS = {"next_pow2", "_bucket"} | _SHARD_HELPERS
+
+# An n_devices-ish operand: arithmetic against one of these names is the
+# signature of hand-rolled per-device size math.
+_NDEV_RE = re.compile(r"^n_dev(ices)?$")
+
+# Mult/div/mod against a device count is size math; Add/Sub is padding
+# arithmetic on already-derived sizes and stays legal.
+_SIZE_MATH_OPS = (ast.Mult, ast.Div, ast.FloorDiv, ast.Mod)
 
 # A launch: calling a donated production entry point or a cached mesh
 # verifier.  ``_jit_donated`` itself is the factory, not a launch.
@@ -64,6 +99,19 @@ def _terminal_name(call: ast.Call) -> str | None:
     return None
 
 
+def _is_launch(call: ast.Call, name: str) -> bool:
+    """A device launch: a ``*_donated`` production entry called with
+    arrays, or a cached mesh verifier in its two-level
+    ``_cached_x(mesh)(arrays)`` form.  A DIRECT ``_cached_*`` call is the
+    factory handing back the jit (the donated wrappers share the plain
+    cache on CPU) — referencing it launches nothing."""
+    if not _LAUNCH_RE.match(name):
+        return False
+    if name.startswith("_cached_"):
+        return isinstance(call.func, ast.Call)
+    return True
+
+
 def _check_launch_bucketing(path: str, source: str) -> list:
     findings = []
     tree = ast.parse(source, filename=path)
@@ -79,7 +127,7 @@ def _check_launch_bucketing(path: str, source: str) -> list:
                 continue
             if name in _BUCKET_HELPERS:
                 bucketed = True
-            elif _LAUNCH_RE.match(name):
+            elif _is_launch(node, name):
                 launches.append((node, name))
         if launches and not bucketed:
             for node, name in launches:
@@ -89,6 +137,68 @@ def _check_launch_bucketing(path: str, source: str) -> list:
                     "batch size through next_pow2/_bucket: a non-bucket "
                     "shape compiles on the engine thread mid-traffic "
                     "(warmup only covers power-of-two buckets)"))
+    return findings
+
+
+def _operand_name(node: ast.AST) -> str | None:
+    """Terminal identifier of a Name/Attribute operand (self.n_devices ->
+    n_devices)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _outer_functions(tree: ast.Module):
+    """Module-level functions and class methods — the per-function scope
+    both rules reason in (nested closures belong to their enclosing
+    function: a dispatch() closure launching a mesh program is aligned by
+    the pack function that built its buffers)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub
+
+
+def _check_shard_alignment(path: str, source: str) -> list:
+    """The shard-misaligned-launch rule over one mesh-path module: any
+    function that (a) fires a ``_cached_*`` mesh launch or (b) does size
+    math (mul/div/mod) against an ``n_dev``/``n_devices`` operand must
+    call a shard-alignment helper."""
+    findings = []
+    tree = ast.parse(source, filename=path)
+    for fn in _outer_functions(tree):
+        shard_helper_called = False
+        evidence = []  # (node, what)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node)
+                if name in _SHARD_HELPERS:
+                    shard_helper_called = True
+                elif name is not None and name.startswith("_cached_") \
+                        and _is_launch(node, name):
+                    evidence.append((node, f"mesh launch {name}"))
+            elif isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, _SIZE_MATH_OPS):
+                for side in (node.left, node.right):
+                    opname = _operand_name(side)
+                    if opname and _NDEV_RE.match(opname):
+                        evidence.append(
+                            (node, f"size math against {opname}"))
+                        break
+        if evidence and not shard_helper_called:
+            for node, what in evidence:
+                findings.append(Finding(
+                    path, node.lineno, "shard-misaligned-launch",
+                    f"{fn.name}() has {what} without routing through "
+                    "shard_bucket/shard_aligned_rows: a hand-rolled "
+                    "per-device size can land on a per-shard shape "
+                    "warmup never compiled (a cold XLA compile on the "
+                    "engine thread mid-traffic)"))
     return findings
 
 
@@ -198,11 +308,13 @@ def _check_warmup_constants(root: str) -> list:
 
 def check_sources(sources: dict) -> list:
     """Lint a {path: python source} mapping (unit-test entry point):
-    launch-bucketing only — the warmup constant cross-check needs the
-    real tree (see check)."""
+    launch-bucketing + (for mesh-path modules) shard alignment — the
+    warmup constant cross-check needs the real tree (see check)."""
     findings = []
     for path, src in sources.items():
         findings += _check_launch_bucketing(path, src)
+        if path in MESH_TARGETS:
+            findings += _check_shard_alignment(path, src)
     return sorted(apply_suppressions(findings, sources),
                   key=lambda f: (f.path, f.line))
 

@@ -137,6 +137,19 @@ def prepare_batch(msgs, pks, sigs):
                 k=packed[:, 96:128], packed=packed, host_ok=host_ok)
 
 
+def split_packed_rows(packed: np.ndarray, host_ok=None) -> dict:
+    """(n, 128) already-prepared rows -> the prepare_batch dict shape,
+    without re-deriving anything.  The RLC bisection paths slice prepared
+    rows by index and re-enter the batch verifiers with them; rows
+    selected through a host_ok mask are canonical by construction, so the
+    default mask is all-True."""
+    n = packed.shape[0]
+    if host_ok is None:
+        host_ok = np.ones((n,), bool)
+    return dict(a=packed[:, 0:32], r=packed[:, 32:64], s=packed[:, 64:96],
+                k=packed[:, 96:128], packed=packed, host_ok=host_ok)
+
+
 # Per-program sub-batch cap. A/B-measured best end-to-end shape on v5e
 # (scripts/eval_device.py): larger batches run as sub-batches of this size
 # scanned inside ONE dispatch (ops/ed25519.verify_packed_chunked), which
@@ -167,19 +180,43 @@ def verify_batch_submit(msgs, pks, sigs, *, pad: bool = True):
     serializes every launch behind the previous launch's result fetch,
     halving the sidecar engine's verify throughput.
     """
+    return verify_batch_pack(msgs, pks, sigs, pad=pad)()
+
+
+def verify_batch_pack(msgs, pks, sigs, *, pad: bool = True):
+    """Pack stage of a batch verify: ALL host-side work — byte decode,
+    canonicality checks, SHA-512 challenges, bucket padding and the
+    h2d transfer — happens here, on the caller's thread.  The returned
+    ``dispatch()`` fires the donated device program (cheap — the input
+    already lives on device) and returns ``fetch() -> (N,) bool mask``.
+
+    This is the three-stage split the sidecar engine's double-buffered
+    pipeline needs: its pack thread stages launch N+1 (this function)
+    while launch N executes, and the engine thread only ever pays the
+    dispatch + fetch cost.  ``verify_batch_submit`` is the two-stage
+    wrapper (pack + dispatch in one call) for callers without a pack
+    thread.
+    """
     n = len(msgs)
     if n == 0:
-        return lambda: np.zeros((0,), bool)
+        return lambda: (lambda: np.zeros((0,), bool))
     prep = prepare_batch(msgs, pks, sigs)
     host_ok = prep["host_ok"]
-    fetch_rows = _dispatch_rows(prep["packed"], n, pad)
-    return lambda: fetch_rows() & host_ok
+    dispatch_rows = _pack_rows(prep["packed"], n, pad)
+
+    def dispatch():
+        fetch_rows = dispatch_rows()
+        return lambda: fetch_rows() & host_ok
+
+    return dispatch
 
 
-def _dispatch_rows(packed: np.ndarray, n: int, pad: bool):
-    """(n, 128) prepared rows -> dispatched device launch; returns
-    fetch() -> (n,) bool mask.  Single home of the bucket/pad/chunk
-    policy shared by the eager and submit paths."""
+def _pack_rows(packed: np.ndarray, n: int, pad: bool):
+    """(n, 128) prepared rows -> staged device input; returns
+    dispatch() -> fetch() -> (n,) bool mask.  Single home of the
+    bucket/pad/chunk policy shared by the eager, submit and pack paths.
+    The h2d transfer happens HERE (pack stage); the donated program
+    launch happens inside dispatch()."""
     # The launches below DONATE their input buffer; forcing host-side
     # rows here guarantees each jnp.asarray is a fresh device copy, so a
     # caller's (possibly device-resident) array is never invalidated.
@@ -188,17 +225,32 @@ def _dispatch_rows(packed: np.ndarray, n: int, pad: bool):
         m = _bucket(n) if pad else n
         if m != n:
             packed = np.pad(packed, [(0, m - n), (0, 0)])
-        dev = E.verify_packed_donated(jnp.asarray(packed))
-        return lambda: np.asarray(dev)[:n]
+        dev_in = jnp.asarray(packed)
+
+        def dispatch():
+            dev = E.verify_packed_donated(dev_in)
+            return lambda: np.asarray(dev)[:n]
+
+        return dispatch
     g = -(-n // MAX_SUBBATCH)
     if pad:  # bound the number of compiled scan lengths: next power of two
         g = next_pow2(g)
     m = g * MAX_SUBBATCH
     if m != n:
         packed = np.pad(packed, [(0, m - n), (0, 0)])
-    chunked = packed.reshape(g, MAX_SUBBATCH, 128)
-    dev = E.verify_packed_chunked_donated(jnp.asarray(chunked))
-    return lambda: np.asarray(dev).reshape(m)[:n]
+    dev_in = jnp.asarray(packed.reshape(g, MAX_SUBBATCH, 128))
+
+    def dispatch():
+        dev = E.verify_packed_chunked_donated(dev_in)
+        return lambda: np.asarray(dev).reshape(m)[:n]
+
+    return dispatch
+
+
+def _dispatch_rows(packed: np.ndarray, n: int, pad: bool):
+    """Two-stage form of :func:`_pack_rows` (pack + dispatch in one
+    call); returns fetch() -> (n,) bool mask."""
+    return _pack_rows(packed, n, pad)()
 
 
 def verify_prepared_rows(packed: np.ndarray, n: int, *,
@@ -295,24 +347,40 @@ def verify_batch_rlc_submit(msgs, pks, sigs, *, pad: bool = True,
     RLC_MIN_MSM canonical rows, or more than MAX_SUBBATCH) dispatch the
     per-signature program instead — same contract, same mask.
     """
+    return verify_batch_rlc_pack(msgs, pks, sigs, pad=pad,
+                                 on_bisect=on_bisect)()
+
+
+def verify_batch_rlc_pack(msgs, pks, sigs, *, pad: bool = True,
+                          on_bisect=None):
+    """Pack stage of the combined RLC check: host preparation, the
+    coefficient PRF, bucket padding and the h2d transfers happen here;
+    the returned ``dispatch()`` fires the donated one-MSM program and
+    returns the ``fetch`` described on :func:`verify_batch_rlc_submit`
+    (which is this function's two-stage wrapper)."""
     n = len(msgs)
     if n == 0:
-        return lambda: np.zeros((0,), bool)
+        return lambda: (lambda: np.zeros((0,), bool))
     prep = prepare_batch(msgs, pks, sigs)
     packed = prep["packed"]
     idx = np.nonzero(prep["host_ok"])[0]
     m = len(idx)
     if m < RLC_MIN_MSM or m > MAX_SUBBATCH:
         rows = np.ascontiguousarray(packed[idx])
-        fetch_rows = _dispatch_rows(rows, m, pad) if m else None
+        dispatch_rows = _pack_rows(rows, m, pad) if m else None
 
-        def fetch_degenerate():
-            mask = np.zeros(n, bool)
-            if fetch_rows is not None:
-                mask[idx] = fetch_rows()
-            return mask
+        def dispatch_degenerate():
+            fetch_rows = dispatch_rows() if dispatch_rows else None
 
-        return fetch_degenerate
+            def fetch_degenerate():
+                mask = np.zeros(n, bool)
+                if fetch_rows is not None:
+                    mask[idx] = fetch_rows()
+                return mask
+
+            return fetch_degenerate
+
+        return dispatch_degenerate
     rows = np.ascontiguousarray(packed[idx])
     bucket = _bucket(m) if pad else m
     z = np.zeros((bucket, 32), np.uint8)
@@ -320,22 +388,27 @@ def verify_batch_rlc_submit(msgs, pks, sigs, *, pad: bool = True,
     if bucket != m:
         rows = np.pad(rows, [(0, bucket - m), (0, 0)])
     # Fresh host arrays -> fresh device buffers; the launch donates arg 0
-    # (same discipline as _dispatch_rows).
-    dev = E.verify_rlc_packed_donated(jnp.asarray(rows), jnp.asarray(z))
+    # (same discipline as _pack_rows).
+    dev_rows, dev_z = jnp.asarray(rows), jnp.asarray(z)
 
-    def fetch():
-        mask = np.zeros(n, bool)
-        if bool(np.asarray(dev)):
-            mask[idx] = True
+    def dispatch():
+        dev = E.verify_rlc_packed_donated(dev_rows, dev_z)
+
+        def fetch():
+            mask = np.zeros(n, bool)
+            if bool(np.asarray(dev)):
+                mask[idx] = True
+                return mask
+            if on_bisect is not None:
+                on_bisect()
+            mid = m // 2
+            _rlc_resolve(packed, idx[:mid], mask, b"L", pad)
+            _rlc_resolve(packed, idx[mid:], mask, b"R", pad)
             return mask
-        if on_bisect is not None:
-            on_bisect()
-        mid = m // 2
-        _rlc_resolve(packed, idx[:mid], mask, b"L", pad)
-        _rlc_resolve(packed, idx[mid:], mask, b"R", pad)
-        return mask
 
-    return fetch
+        return fetch
+
+    return dispatch
 
 
 def _rlc_resolve(packed: np.ndarray, indices: np.ndarray,

@@ -28,6 +28,7 @@ def cmd_local(args):
         "tpu_sidecar": use_sidecar,
         "sidecar_host_crypto": args.sidecar_host_crypto,
         "sidecar_warm_rlc": args.warm_rlc,
+        "sidecar_mesh": args.sidecar_mesh,
         "scheme": args.scheme,
         "fault_plan": args.fault_plan,
     })
@@ -212,6 +213,11 @@ def main(argv=None):
                         "sidecar never becomes ready)")
     p.add_argument("--tpu-sidecar", action="store_true",
                    help="route QC verification through the TPU sidecar")
+    p.add_argument("--sidecar-mesh", type=int, default=0, metavar="N",
+                   help="run the sidecar with --mesh N --warm-rlc-sharded "
+                        "(shard verify launches over an N-device mesh and "
+                        "route coalesced batches through the sharded "
+                        "one-MSM path; 0 = single device)")
     p.add_argument("--warm-rlc", action="store_true",
                    help="also pre-compile the sidecar's one-MSM RLC "
                         "shapes so coalesced batches route through the "

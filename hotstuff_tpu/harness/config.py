@@ -202,6 +202,7 @@ class BenchParameters:
                 json_input.get("sidecar_host_crypto", False))
             self.sidecar_warm_rlc = bool(
                 json_input.get("sidecar_warm_rlc", False))
+            self.sidecar_mesh = int(json_input.get("sidecar_mesh", 0))
             self.scheme = str(json_input.get("scheme", "ed25519"))
             # graftchaos: a fault-plan spec (path / inline DSL string /
             # event list); parsed + validated by LocalBench.

@@ -37,6 +37,8 @@ class LocalBench:
             bench_parameters, "sidecar_host_crypto", False)
         self.sidecar_warm_rlc = getattr(
             bench_parameters, "sidecar_warm_rlc", False)
+        self.sidecar_mesh = int(getattr(
+            bench_parameters, "sidecar_mesh", 0) or 0)
         if self.sidecar_host_crypto:
             self.tpu_sidecar = True  # host-crypto still runs the sidecar
         self.scheme = getattr(bench_parameters, "scheme", "ed25519")
@@ -152,6 +154,13 @@ class LocalBench:
         warm_rlc = " --warm-rlc" \
             if getattr(self, "sidecar_warm_rlc", False) and not host_crypto \
             else ""
+        # Mesh mode: shard verify launches over an N-device mesh, with
+        # the sharded one-MSM warmup so coalesced QC batches route
+        # through the rlc_sharded engine path from the first block.
+        mesh = ""
+        if int(getattr(self, "sidecar_mesh", 0) or 0) > 1 \
+                and not host_crypto:
+            mesh = f" --mesh {self.sidecar_mesh} --warm-rlc-sharded"
         # The chaos hook binds only when a fault plan can reach it; the
         # committee/rate parameters size the scheduler's admission caps
         # (sidecar/sched/scheduler.size_queue_caps) instead of the static
@@ -160,7 +169,7 @@ class LocalBench:
         cmd = (f"python -m hotstuff_tpu.sidecar "
                f"--port {self.SIDECAR_PORT}"
                f" --committee {self.nodes} --client-rate {self.rate}"
-               f"{warm_bls}{warm_rlc}{hc}{chaos}")
+               f"{warm_bls}{warm_rlc}{mesh}{hc}{chaos}")
         # The degraded reboot appends to the log: the dead device
         # sidecar's output is the evidence needed to diagnose the wedge.
         self._sidecar_cmd = (cmd, PathMaker.sidecar_log_file())

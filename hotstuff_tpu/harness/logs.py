@@ -334,6 +334,23 @@ class LogParser:
             lines.append(
                 f"Sidecar pad fill: {stats.get('bulk_fill_sigs', 0):,} "
                 f"sigs (waste {stats.get('pad_waste_sigs', 0):,})")
+            mesh = stats.get("mesh", {})
+            if mesh.get("sharded_launches"):
+                hist = ", ".join(
+                    f"{k}x{v:,}" for k, v in
+                    sorted(mesh.get("shard_buckets", {}).items(),
+                           key=lambda kv: int(kv[0])))
+                lines.append(
+                    f"Sidecar mesh launches: "
+                    f"{mesh['sharded_launches']:,}"
+                    + (f" (per-shard buckets {hist})" if hist else ""))
+            pipe = stats.get("pipeline", {})
+            if pipe.get("pack_ms"):
+                lines.append(
+                    f"Sidecar pack overlap: "
+                    f"{pipe.get('overlap_ratio', 0.0):.0%} of "
+                    f"{pipe['pack_ms']:g} ms packing hidden behind "
+                    "device execution")
             full = stats.get("queue_full", {})
             if any(full.values()):
                 lines.append("Sidecar queue-full sheds: " + ", ".join(

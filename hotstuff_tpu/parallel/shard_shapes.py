@@ -1,0 +1,51 @@
+"""THE shard-alignment rule: how a global batch size maps onto per-shard
+device shapes on an n-device mesh.
+
+Every mesh launch pads its batch so each shard gets the SAME power-of-two
+row count (a per-shard "bucket"), because the sidecar warmup compiles
+exactly those per-shard shapes: any other per-shard size (3000 votes on
+8 devices -> 375-row shards) is a first-time XLA compile on the engine
+thread mid-traffic — the silent 30-60 s stall warmup exists to prevent.
+This module is the single home of that arithmetic; the mesh verifiers
+(parallel/sharded_verify), the scheduler's shape registry
+(sidecar/sched/shapes) and the warmup (sidecar/service) all route
+through it, and the graftlint ``shard-misaligned-launch`` rule pins the
+discipline mechanically (hotstuff_tpu/analysis/padshape.py).
+
+Pure integer arithmetic — importable without touching a JAX backend.
+"""
+
+from __future__ import annotations
+
+from ..crypto.eddsa import _MIN_BUCKET, MAX_SUBBATCH, next_pow2
+
+
+def shard_bucket(n: int, n_devices: int,
+                 max_subbatch: int = MAX_SUBBATCH) -> int:
+    """Per-shard padded row count for a global batch of ``n`` records.
+
+    Power-of-two bucket of ceil(n / n_devices), floored at the smallest
+    per-shard shape the warmup compiles (_MIN_BUCKET / n_devices rows —
+    warmed GLOBAL sizes start at _MIN_BUCKET, so a lone tiny request on a
+    small mesh still lands on a warmed shape) and capped at
+    ``max_subbatch``; beyond the cap the shard runs as a chunked scan of
+    whole ``max_subbatch`` sub-chunks, so the bucket grows in
+    power-of-two multiples of ``max_subbatch`` instead.
+    """
+    if n_devices < 1:
+        raise ValueError(f"need a positive device count, got {n_devices}")
+    per_shard = -(-max(n, 1) // n_devices)
+    if per_shard <= max_subbatch:
+        lo = max(1, _MIN_BUCKET // n_devices)
+        return min(next_pow2(per_shard, lo), max_subbatch)
+    g = next_pow2(-(-per_shard // max_subbatch))
+    return g * max_subbatch
+
+
+def shard_aligned_rows(n: int, n_devices: int,
+                       max_subbatch: int = MAX_SUBBATCH) -> int:
+    """Global padded row count of an ``n``-record mesh launch: the
+    per-shard bucket times the device count — by construction divisible
+    by ``n_devices``, and the capacity pad-fill may use without growing
+    any shard's compiled shape."""
+    return n_devices * shard_bucket(n, n_devices, max_subbatch)

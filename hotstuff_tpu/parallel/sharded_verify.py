@@ -25,10 +25,13 @@ except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
     _SHARD_MAP_KW = {"check_rep": False}
 from jax.sharding import Mesh, PartitionSpec as Pspec
 
-from ..crypto.eddsa import _MIN_BUCKET, MAX_SUBBATCH, _rlc_coeffs, next_pow2
+from ..crypto.eddsa import MAX_SUBBATCH, RLC_MIN_MSM, _rlc_coeffs, next_pow2
 from ..ops import ed25519 as E
 from ..ops import scalar25519  # noqa: F401  (re-export surface for tests)
 from .mesh import BATCH_AXIS
+from .shard_shapes import shard_aligned_rows, shard_bucket  # noqa: F401
+# (shard_bucket re-exported: the scheduler's shape registry and tests
+# read per-shard buckets from the same module that launches them)
 
 
 def _make_shard_body(max_subbatch: int):
@@ -63,7 +66,8 @@ def _make_shard_body(max_subbatch: int):
     return _shard_body
 
 
-def make_sharded_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
+def make_sharded_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH,
+                          donate: bool = False):
     """Returns jitted fn over compact byte arrays + present mask (global
     batch B, B % n_devices == 0; shards larger than max_subbatch must
     divide into max_subbatch chunks) -> ((B,) bool mask, () int32 invalid
@@ -72,6 +76,11 @@ def make_sharded_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
     Note: ``bad_total`` counts votes with present=1 whose signature fails on
     device; host-side encoding rejections must be folded into ``present`` by
     the caller (verify_batch_sharded does).
+
+    ``donate=True`` donates every input buffer (the engine's production
+    launch shape: each per-shard buffer is transferred once at pack time
+    and consumed once at dispatch); unsupported on the CPU test backend,
+    where the caller gets the plain jit instead (see _cached_*_donated).
     """
     batched = Pspec(BATCH_AXIS)
     # Replication checking off (_SHARD_MAP_KW): the ladder scans carry
@@ -85,6 +94,8 @@ def make_sharded_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
         out_specs=(batched, Pspec()),
         **_SHARD_MAP_KW,
     )
+    if donate:
+        return jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4))
     return jax.jit(fn)
 
 
@@ -93,30 +104,29 @@ def _cached_verifier(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
     return make_sharded_verifier(mesh, max_subbatch)
 
 
-def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
-                         max_subbatch: int = MAX_SUBBATCH):
-    """Run a host-prepared batch (see crypto/eddsa.prepare_batch) across the
-    mesh.  Pads the batch to a multiple of the mesh size (and, beyond
-    max_subbatch per shard, to whole per-shard chunks); padding and
-    host-rejected votes are excluded from the device-side verdict count."""
+@functools.cache
+def _cached_verifier_donated(mesh: Mesh, max_subbatch: int = MAX_SUBBATCH):
+    # Donation is unimplemented on CPU (a warning per launch, nothing
+    # else) — share the plain jit there so the test suite compiles each
+    # mesh shape once, not twice.
+    if jax.default_backend() == "cpu":
+        return _cached_verifier(mesh, max_subbatch)
+    return make_sharded_verifier(mesh, max_subbatch, donate=True)
+
+
+def _shard_put(mesh: Mesh, arr: np.ndarray):
+    """Host array -> committed device array sharded over the batch axis.
+    This is the pack-stage h2d transfer: it runs on the engine's pack
+    thread, overlapping the device compute of the launch in flight."""
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(arr, NamedSharding(mesh, Pspec(BATCH_AXIS)))
+
+
+def _pack_sharded_arrays(mesh: Mesh, prep: dict, m: int):
+    """Pad the five per-record arrays to the shard-aligned row count and
+    ship them to the mesh (pack-stage work: byte padding + h2d)."""
     n = prep["a"].shape[0]
-    n_dev = mesh.devices.size
-    # Bucket the per-shard size to a power of two (mirroring
-    # crypto/eddsa.verify_prepared_rows): the sidecar pre-compiles exactly
-    # the power-of-two shapes, so any other per-shard size (e.g. 3000 sigs
-    # on 8 devices -> 375-row shards) would hit a first-time XLA compile on
-    # the engine thread mid-traffic — the stall warmup exists to prevent.
-    per_shard = -(-n // n_dev)
-    if per_shard <= max_subbatch:
-        # Floor at the smallest per-shard shape warmup compiles: warmed
-        # global sizes start at _MIN_BUCKET, i.e. _MIN_BUCKET/n_dev rows
-        # per shard (tiny lone requests on small meshes would otherwise
-        # still hit a cold shape).
-        lo = max(1, _MIN_BUCKET // n_dev)
-        m = n_dev * min(next_pow2(per_shard, lo), max_subbatch)
-    else:
-        g = next_pow2(-(-per_shard // max_subbatch))
-        m = n_dev * max_subbatch * g
     arrays = dict(prep)
     arrays["present"] = prep["host_ok"].astype(np.int32)
     out = []
@@ -124,7 +134,54 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
         a = arrays[key]
         if m != n:
             a = np.pad(a, [(0, m - n)] + [(0, 0)] * (a.ndim - 1))
-        out.append(jnp.asarray(a))
+        out.append(_shard_put(mesh, a))
+    return out
+
+
+def verify_batch_sharded_pack(mesh: Mesh, prep: dict, *,
+                              max_subbatch: int = MAX_SUBBATCH):
+    """Pack stage of a sharded per-signature verify launch.
+
+    Host work (shard-aligned padding + the h2d transfer of every
+    per-shard buffer) happens HERE, on the caller's thread; the returned
+    ``dispatch()`` fires the donated mesh program and returns
+    ``fetch() -> (N,) bool mask`` — the three-stage split the sidecar
+    engine's double-buffered pipeline rides (pack launch N+1 while
+    launch N executes).  The per-shard row count comes from THE
+    shard-alignment rule (parallel/shard_shapes): the padded bucket
+    always divides evenly across the mesh, so every launch lands on a
+    shape the warmup compiled.
+    """
+    n = prep["a"].shape[0]
+    n_dev = mesh.devices.size
+    m = shard_aligned_rows(n, n_dev, max_subbatch)
+    dev = _pack_sharded_arrays(mesh, prep, m)
+
+    def dispatch():
+        mask_dev, _bad = _cached_verifier_donated(
+            mesh, max_subbatch)(*dev)
+
+        def fetch():
+            return np.asarray(mask_dev)[:n]
+
+        return fetch
+
+    return dispatch
+
+
+def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
+                         max_subbatch: int = MAX_SUBBATCH):
+    """Run a host-prepared batch (see crypto/eddsa.prepare_batch) across the
+    mesh.  Pads the batch so every shard gets the same power-of-two row
+    count (shard_shapes.shard_aligned_rows — the sidecar pre-compiles
+    exactly those shapes, so any other per-shard size, e.g. 3000 sigs on
+    8 devices -> 375-row shards, would hit a first-time XLA compile on
+    the engine thread mid-traffic); padding and host-rejected votes are
+    excluded from the device-side verdict count."""
+    n = prep["a"].shape[0]
+    n_dev = mesh.devices.size
+    m = shard_aligned_rows(n, n_dev, max_subbatch)
+    out = _pack_sharded_arrays(mesh, prep, m)
     mask, bad_total = _cached_verifier(mesh, max_subbatch)(*out)
     mask = np.asarray(mask)[:n]
     if return_bad_total:
@@ -163,10 +220,12 @@ def _rlc_shard_body(packed, z):
     return E.rlc_finish(combined, u_total, bad_total)
 
 
-def make_sharded_rlc_verifier(mesh: Mesh):
+def make_sharded_rlc_verifier(mesh: Mesh, donate: bool = False):
     """Returns a jitted fn over ((B, 128) packed rows, (B, 32) coefficient
     rows), B % n_devices == 0 -> () bool combined-RLC verdict, replicated
-    across the mesh.  Zero-coefficient rows are excluded (padding)."""
+    across the mesh.  Zero-coefficient rows are excluded (padding).
+    ``donate=True`` donates both input buffers (production launches
+    transfer each once and consume each once)."""
     batched = Pspec(BATCH_AXIS)
     fn = shard_map(
         _rlc_shard_body,
@@ -175,6 +234,8 @@ def make_sharded_rlc_verifier(mesh: Mesh):
         out_specs=Pspec(),
         **_SHARD_MAP_KW,
     )
+    if donate:
+        return jax.jit(fn, donate_argnums=(0, 1))
     return jax.jit(fn)
 
 
@@ -183,44 +244,130 @@ def _cached_rlc_verifier(mesh: Mesh):
     return make_sharded_rlc_verifier(mesh)
 
 
+@functools.cache
+def _cached_rlc_verifier_donated(mesh: Mesh):
+    # Same CPU-backend sharing as _cached_verifier_donated: one compile
+    # per mesh shape on the test backend, donation on real devices.
+    if jax.default_backend() == "cpu":
+        return _cached_rlc_verifier(mesh)
+    return make_sharded_rlc_verifier(mesh, donate=True)
+
+
+def _pack_rlc_rows(mesh: Mesh, packed: np.ndarray, idx: np.ndarray,
+                   n: int, m: int, salt: bytes):
+    """Coefficient rows + padding to the shard-aligned row count ``m``
+    (callers derive it via shard_aligned_rows) + h2d for one sharded RLC
+    launch over ``packed[:n]`` with host-canonical rows ``idx``."""
+    z = np.zeros((m, 32), np.uint8)
+    if len(idx):
+        z[idx] = _rlc_coeffs(np.ascontiguousarray(packed[idx]), salt)
+    if m != n:
+        packed = np.pad(packed, [(0, m - n), (0, 0)])
+    return _shard_put(mesh, packed), _shard_put(mesh, z)
+
+
+def verify_rlc_sharded_pack(mesh: Mesh, prep: dict, *, salt: bytes = b"",
+                            on_bisect=None):
+    """Pack stage of a sharded one-MSM RLC verify launch; returns
+    ``dispatch() -> fetch() -> (N,) bool mask``, bit-identical to
+    :func:`verify_batch_sharded` (and therefore to
+    crypto/eddsa.verify_batch).
+
+    Pack (this thread): coefficient PRF, shard-aligned padding
+    (shard_shapes.shard_aligned_rows — every shard gets a warmed
+    power-of-two bucket), h2d of the packed rows + coefficient rows.
+    Dispatch (engine thread): ONE donated mesh program computing the
+    combined verdict.  Fetch: when the combined check passes (the steady
+    state) the mask is just host_ok; on failure the batch BISECTS with
+    fresh per-sub-batch coefficients down to the RLC_MIN_MSM floor,
+    below which the per-signature sharded path pinpoints each bad vote —
+    ``on_bisect`` (if given) fires once so the scheduler's telemetry
+    counts the slow path.  Degenerate batches (fewer than RLC_MIN_MSM
+    canonical rows, or per-shard sizes beyond the one-dispatch envelope)
+    dispatch the per-signature sharded program instead — same contract,
+    same mask.
+    """
+    n = prep["a"].shape[0]
+    host_ok = prep["host_ok"]
+    if n == 0:
+        return lambda: (lambda: np.zeros((0,), bool))
+    n_dev = mesh.devices.size
+    idx = np.nonzero(host_ok)[0]
+    if len(idx) < RLC_MIN_MSM or shard_bucket(n, n_dev) > MAX_SUBBATCH:
+        # Too few canonical rows for the MSM to win, or a quorum beyond
+        # the mesh's one-dispatch RLC envelope (same policy as
+        # verify_batch_rlc): per-signature sharded, identical mask.
+        return verify_batch_sharded_pack(mesh, prep)
+    packed = np.asarray(prep["packed"])
+    dev_rows, dev_z = _pack_rlc_rows(
+        mesh, packed, idx, n, shard_aligned_rows(n, n_dev), salt)
+
+    def dispatch():
+        ok_dev = _cached_rlc_verifier_donated(mesh)(dev_rows, dev_z)
+
+        def fetch():
+            if bool(np.asarray(ok_dev)):
+                return host_ok.copy()
+            if on_bisect is not None:
+                on_bisect()
+            mask = np.zeros((n,), bool)
+            mid = len(idx) // 2
+            _rlc_sharded_resolve(mesh, packed, idx[:mid], mask,
+                                 salt + b"L")
+            _rlc_sharded_resolve(mesh, packed, idx[mid:], mask,
+                                 salt + b"R")
+            return mask
+
+        return fetch
+
+    return dispatch
+
+
+def _rlc_sharded_resolve(mesh: Mesh, packed: np.ndarray,
+                         indices: np.ndarray, out: np.ndarray,
+                         salt: bytes) -> None:
+    """Resolve ``out[indices]`` for host-canonical rows across the mesh:
+    combined sharded RLC check first, bisection with fresh coefficients
+    on failure, per-signature sharded floor below RLC_MIN_MSM.  Every
+    sub-batch re-pads through the shard-alignment rule, so bisection can
+    only ever land on warmed per-shard buckets (smaller than the batch
+    that failed)."""
+    n = len(indices)
+    if n == 0:
+        return
+    rows = np.ascontiguousarray(packed[indices])
+    if n < RLC_MIN_MSM:
+        from ..crypto.eddsa import split_packed_rows
+
+        # Through the pack entry, NOT the eager wrapper: the warmup only
+        # compiles the donated programs on a real device backend, and a
+        # mid-traffic bisection must never pay a cold compile.
+        prep = split_packed_rows(rows)
+        out[indices] = verify_batch_sharded_pack(mesh, prep)()()
+        return
+    m = shard_aligned_rows(n, mesh.devices.size)
+    dev_rows, dev_z = _pack_rlc_rows(mesh, rows, np.arange(n), n, m, salt)
+    # Same donated program the warmup compiled (the buffers above are
+    # fresh device arrays consumed exactly once — donation-safe).
+    ok = bool(np.asarray(_cached_rlc_verifier_donated(mesh)(
+        dev_rows, dev_z)))
+    if ok:
+        out[indices] = True
+        return
+    mid = n // 2
+    _rlc_sharded_resolve(mesh, packed, indices[:mid], out, salt + b"L")
+    _rlc_sharded_resolve(mesh, packed, indices[mid:], out, salt + b"R")
+
+
 def verify_rlc_sharded(mesh: Mesh, prep: dict, *,
                        salt: bytes = b"") -> np.ndarray:
     """Run a host-prepared batch (crypto/eddsa.prepare_batch) through the
     mesh-sharded RLC check -> (N,) bool mask, matching verify_batch_sharded.
 
-    Fast path: ONE mesh dispatch for the combined check; when it passes
-    (the steady state — every vote of a sound quorum verifies) the mask
-    is just host_ok.  When it fails, the batch falls back to the
-    per-signature sharded path to pinpoint the bad votes — the old
-    full price, paid only when somebody actually sent a bad vote.
-    Per-shard sizes pad to the same power-of-two buckets as
-    verify_batch_sharded, which bounds the number of DISTINCT compiled
-    shapes; note that no warmup pre-compiles the RLC mesh program yet —
-    wiring these shapes into sidecar/service._warmup is the open
-    ROADMAP item, and until then the first quorum at each bucket size
-    pays its XLA compile.
+    Eager wrapper over :func:`verify_rlc_sharded_pack` (pack, dispatch
+    and fetch in one call) — the sidecar engine uses the staged form;
+    the ``--warm-rlc-sharded`` warmup (sidecar/service) pre-compiles
+    every per-shard bucket this can launch, and the scheduler's shape
+    registry only routes batches onto buckets that warmup marked.
     """
-    n = prep["a"].shape[0]
-    host_ok = prep["host_ok"]
-    if n == 0:
-        return np.zeros((0,), bool)
-    n_dev = mesh.devices.size
-    per_shard = -(-n // n_dev)
-    lo = max(1, _MIN_BUCKET // n_dev)
-    m = n_dev * min(next_pow2(per_shard, lo), MAX_SUBBATCH)
-    if per_shard > MAX_SUBBATCH:
-        # Quorums beyond the mesh's one-dispatch envelope keep the
-        # per-signature chunked path (same policy as verify_batch_rlc).
-        return verify_batch_sharded(mesh, prep)
-    packed = np.asarray(prep["packed"])
-    z = np.zeros((m, 32), np.uint8)
-    idx = np.nonzero(host_ok)[0]
-    if len(idx):
-        z[idx] = _rlc_coeffs(np.ascontiguousarray(packed[idx]), salt)
-    if m != n:
-        packed = np.pad(packed, [(0, m - n), (0, 0)])
-    ok = bool(np.asarray(_cached_rlc_verifier(mesh)(
-        jnp.asarray(packed), jnp.asarray(z))))
-    if ok:
-        return host_ok.copy()
-    return verify_batch_sharded(mesh, prep)
+    return verify_rlc_sharded_pack(mesh, prep, salt=salt)()()

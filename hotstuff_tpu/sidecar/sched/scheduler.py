@@ -197,6 +197,13 @@ class Scheduler:
             # total >> unique) sizing fill off the raw total would grow
             # the compiled shape past the latency batch's own bucket —
             # the exact latency cost pad-fill promises not to incur.
+            # On a mesh, bucket_capacity is the SHARD-ALIGNED row count
+            # (per-shard power-of-two bucket x device count, via
+            # parallel/shard_shapes): launches always divide evenly
+            # across the devices — no 375-row shards, no cold XLA
+            # compiles mid-run — and fill room is computed against that
+            # same shard-aligned capacity, so mesh pad slots drain bulk
+            # exactly like single-chip ones.
             # Each fill request is counted at its full record count
             # (worst case: all its records are new), so unique-after-fill
             # can never exceed the latency batch's bucket.  The dedup is

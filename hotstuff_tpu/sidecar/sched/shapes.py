@@ -12,25 +12,34 @@ registry is the single record of what was warmed:
                     (g * MAX_SUBBATCH signatures in ONE dispatch);
   * ``rlc_buckets`` — padded shapes of the one-MSM RLC program
                     (ops/ed25519.verify_rlc_packed), compiled by
-                    ``--warm-rlc``.
+                    ``--warm-rlc``;
+  * ``shard_buckets`` / ``rlc_shard_buckets`` — PER-SHARD padded row
+                    counts of the mesh programs (verify_batch_sharded /
+                    verify_rlc_sharded), compiled by the mesh warmup and
+                    ``--warm-rlc-sharded``.
 
 ``route`` turns (batch size, warmed state) into the launch path — the
-policy that finally wires crypto/eddsa.verify_batch_rlc into the
-engine's coalesced launch path (the top ROADMAP item): batches of
-``RLC_MIN_LAUNCH`` or more signatures whose bucket is RLC-warmed pay one
-Straus MSM instead of 2n scalar ladders, and the bisection fallback
-inside the RLC path keeps the verdict mask bit-identical to the
-per-signature program whenever the combined check fails.
+policy that wires the one-MSM verifiers into the engine's coalesced
+launch path: batches of ``RLC_MIN_LAUNCH`` or more signatures whose
+bucket (per-shard bucket, on a mesh) is RLC-warmed pay one Straus MSM
+instead of 2n scalar ladders, and the bisection fallback inside the RLC
+paths keeps the verdict mask bit-identical to the per-signature program
+whenever the combined check fails.  Mesh deployments route between
+``rlc_sharded`` and ``ladder_sharded`` the same way single-chip ones
+route between ``rlc`` and ``per_sig``.
 
-Bucketing arithmetic is delegated to ``crypto/eddsa`` (``next_pow2`` /
-``_bucket``) — THE padding rule the graftlint padshape checker pins —
-so the registry can never disagree with the dispatch layer about which
-shape a size lands on.
+Bucketing arithmetic is delegated: single-chip sizes to ``crypto/eddsa``
+(``next_pow2`` / ``_bucket``) and mesh sizes to
+``parallel/shard_shapes`` (``shard_bucket`` / ``shard_aligned_rows``) —
+THE padding rules the graftlint padshape checker pins — so the registry
+can never disagree with the dispatch layer about which shape a size
+lands on.
 """
 
 from __future__ import annotations
 
 from ...crypto.eddsa import MAX_SUBBATCH, _bucket, next_pow2
+from ...parallel.shard_shapes import shard_aligned_rows, shard_bucket
 
 # Engine-path RLC floor: below this the combined check's fixed
 # Horner/comb tail outweighs the saved ladders (crypto/eddsa.RLC_MIN_MSM
@@ -42,6 +51,10 @@ RLC_MIN_LAUNCH = 16
 PATH_PER_SIG = "per_sig"
 PATH_RLC = "rlc"
 PATH_HOST = "host"
+PATH_RLC_SHARDED = "rlc_sharded"
+PATH_LADDER_SHARDED = "ladder_sharded"
+# Legacy mesh route: a registry flagged mesh without a device count
+# cannot compute per-shard buckets, so it keeps the old catch-all.
 PATH_MESH = "mesh"
 
 
@@ -54,12 +67,18 @@ class ShapeRegistry:
     down the always-safe per-signature path.
     """
 
-    def __init__(self, use_host: bool = False, mesh: bool = False):
+    def __init__(self, use_host: bool = False, mesh: bool = False,
+                 n_devices: int = 0):
         self.use_host = use_host
-        self.mesh = mesh
+        self.n_devices = int(n_devices or 0)
+        self.mesh = bool(mesh) or self.n_devices > 1
         self.buckets: set[int] = set()
         self.chunks: set[int] = set()
         self.rlc_buckets: set[int] = set()
+        # Per-SHARD padded row counts the mesh programs were compiled at
+        # (the mesh analogue of buckets / rlc_buckets).
+        self.shard_buckets: set[int] = set()
+        self.rlc_shard_buckets: set[int] = set()
         # Per-launch cap in signatures; raised to the bulk cap only after
         # the chunked-scan shapes are warmed (enable_bulk).
         self.launch_cap = MAX_SUBBATCH
@@ -68,12 +87,21 @@ class ShapeRegistry:
 
     def mark_bucket(self, n: int):
         self.buckets.add(_bucket(n))
+        if self.n_devices > 1:
+            # A mesh warmup compiles per-shard shapes, not global ones.
+            self.shard_buckets.add(shard_bucket(n, self.n_devices))
 
     def mark_chunks(self, g: int):
         self.chunks.add(g)
 
     def mark_rlc(self, n: int):
         self.rlc_buckets.add(_bucket(n))
+
+    def mark_rlc_sharded(self, n: int):
+        """Record that the sharded one-MSM program was compiled for the
+        per-shard bucket an n-record launch lands on."""
+        if self.n_devices > 1:
+            self.rlc_shard_buckets.add(shard_bucket(n, self.n_devices))
 
     def enable_bulk(self, max_coalesced: int):
         """Raise the per-launch cap; call only after the chunked-scan
@@ -82,18 +110,32 @@ class ShapeRegistry:
 
     # -- shape queries ------------------------------------------------------
 
+    def shard_bucket_of(self, n: int) -> int | None:
+        """Per-shard padded row count an n-record mesh launch lands on
+        (None when this registry has no mesh size)."""
+        if self.n_devices > 1:
+            return shard_bucket(n, self.n_devices)
+        return None
+
     def bucket_capacity(self, n: int) -> int:
         """Padded device capacity of an n-signature launch: the bucket
-        (or chunk-scan) shape the dispatch layer will actually compile —
-        the free room pad-fill may use without growing the launch.
+        (or chunk-scan, or shard-aligned mesh) shape the dispatch layer
+        will actually compile — the free room pad-fill may use without
+        growing the launch.
 
         Host mode has NO padding (the host path verifies exactly n
-        records, one ref.verify each), and the mesh path buckets
-        per-shard (a fill record can bump every shard's padded shape) —
-        in both, "pad slots" would be real extra latency work, so the
-        capacity is the batch itself and fill never happens."""
-        if self.use_host or self.mesh:
+        records, one ref.verify each), so there the capacity is the
+        batch itself and fill never happens.  Mesh launches pad to the
+        shard-aligned row count (per-shard power-of-two bucket x device
+        count — parallel/shard_shapes), so their pad-fill room is real
+        free capacity too: filling up to it never grows any shard's
+        compiled shape."""
+        if self.use_host:
             return n
+        if self.n_devices > 1:
+            return shard_aligned_rows(n, self.n_devices)
+        if self.mesh:
+            return n  # legacy mesh-without-count: no sizing knowledge
         if n <= MAX_SUBBATCH:
             return _bucket(n)
         g = next_pow2(-(-n // MAX_SUBBATCH))
@@ -103,6 +145,12 @@ class ShapeRegistry:
         """Verify path for a coalesced batch of n unique records."""
         if self.use_host:
             return PATH_HOST
+        if self.n_devices > 1:
+            per = shard_bucket(n, self.n_devices)
+            if n >= RLC_MIN_LAUNCH and per <= MAX_SUBBATCH and \
+                    per in self.rlc_shard_buckets:
+                return PATH_RLC_SHARDED
+            return PATH_LADDER_SHARDED
         if self.mesh:
             return PATH_MESH
         if RLC_MIN_LAUNCH <= n <= MAX_SUBBATCH and \
@@ -116,4 +164,7 @@ class ShapeRegistry:
             "buckets": sorted(self.buckets),
             "chunks": sorted(self.chunks),
             "rlc_buckets": sorted(self.rlc_buckets),
+            "n_devices": self.n_devices,
+            "shard_buckets": sorted(self.shard_buckets),
+            "rlc_shard_buckets": sorted(self.rlc_shard_buckets),
         }

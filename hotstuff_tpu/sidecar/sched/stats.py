@@ -3,8 +3,11 @@
 Counters answer the questions the drain-loop engine could not: how big
 are launches actually (coalesce histogram), how much padded capacity is
 wasted (pad_waste vs bulk fill), which verify path ran (per_sig / rlc /
-rlc_bisect / host / mesh), how long requests sat queued per class
-(p50/p99), and how often backpressure fired.
+rlc_bisect / host / rlc_sharded / ladder_sharded), how long requests sat
+queued per class (p50/p99), how often backpressure fired, how mesh
+launches distribute over per-shard buckets, and how much of the host
+pack work the double-buffered dispatch pipeline actually hid behind
+device execution (the ``pipeline`` overlap ratio).
 
 Exposed over the wire as the ``OP_STATS`` reply (one JSON object — the
 snapshot() dict verbatim), which the harness fetches at teardown into
@@ -51,6 +54,18 @@ class SchedStats:
         self.admitted: dict[str, int] = {}
         self.queue_full: dict[str, int] = {}
         self.carries: dict[str, int] = {}
+        # Mesh routing: launches that went to the device mesh, and the
+        # per-SHARD padded bucket each landed on (the warmed-shape
+        # discipline made visible: every key here must be a bucket the
+        # warmup marked, or a cold compile happened mid-traffic).
+        self.mesh_launches = 0
+        self.shard_bucket_hist: dict[int, int] = {}
+        # Double-buffered dispatch pipeline: total host pack time, and
+        # the share of it that ran while a launch was already executing
+        # on the device (hidden == free; the overlap ratio is the
+        # pipeline doing its job).
+        self.pack_s = 0.0
+        self.pack_hidden_s = 0.0
         self._waits = {c: deque(maxlen=self.WAIT_SAMPLES_CAP)
                        for c in ("latency", "bulk")}
 
@@ -91,6 +106,27 @@ class SchedStats:
         with self._lock:
             self.paths[path] = self.paths.get(path, 0) + 1
 
+    def note_mesh_launch(self, per_shard_bucket: int | None):
+        """One launch dispatched onto the mesh, keyed by the per-shard
+        padded bucket it landed on (None — a registry without a mesh
+        size — is counted but not bucketed)."""
+        with self._lock:
+            self.mesh_launches += 1
+            if per_shard_bucket is not None:
+                self.shard_bucket_hist[per_shard_bucket] = \
+                    self.shard_bucket_hist.get(per_shard_bucket, 0) + 1
+
+    def note_pack(self, duration_s: float, hidden: bool):
+        """One host-side pack stage: ``hidden`` says a launch was
+        executing on the device when the pack began, i.e. the pipeline
+        overlapped this pack with device compute (the approximation is
+        conservative per-launch and exact in the steady state, where
+        pack N+1 runs entirely under launch N)."""
+        with self._lock:
+            self.pack_s += duration_s
+            if hidden:
+                self.pack_hidden_s += duration_s
+
     # -- reporting ----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -117,4 +153,17 @@ class SchedStats:
                 "queue_full": dict(self.queue_full),
                 "carries": dict(self.carries),
                 "queue_wait": waits,
+                "mesh": {
+                    "sharded_launches": self.mesh_launches,
+                    "shard_buckets": {
+                        str(k): v for k, v in
+                        sorted(self.shard_bucket_hist.items())},
+                },
+                "pipeline": {
+                    "pack_ms": round(self.pack_s * 1e3, 3),
+                    "pack_hidden_ms": round(self.pack_hidden_s * 1e3, 3),
+                    "overlap_ratio": round(
+                        self.pack_hidden_s / self.pack_s, 3)
+                    if self.pack_s else 0.0,
+                },
             }

@@ -152,12 +152,14 @@ class VerifyEngine:
         # enable_bulk, launches cap at MAX_SUBBATCH; _warmup covers every
         # padded bucket up to that cap, so warmed deployments never hit a
         # first-time compile on this thread), and the two-class queues
-        # decide what each launch contains.  Admission caps are sized
-        # from the deployment (committee size drives latency-class
-        # demand, client rate drives bulk) with env overrides winning —
-        # see sched/scheduler.size_queue_caps.
+        # decide what each launch contains.  The registry knows the mesh
+        # size, so launch capacities and routes are shard-aligned on
+        # multi-chip deployments.  Admission caps are sized from the
+        # deployment (committee size drives latency-class demand, client
+        # rate drives bulk) with env overrides winning — see
+        # sched/scheduler.size_queue_caps.
         self._shapes = vsched.ShapeRegistry(
-            use_host=use_host, mesh=bool(mesh_devices and mesh_devices > 1))
+            use_host=use_host, n_devices=mesh_devices or 0)
         lat_cap, bulk_cap = vsched.size_queue_caps(
             committee=committee, client_rate=client_rate)
         self._sched = vsched.Scheduler(shapes=self._shapes,
@@ -176,6 +178,18 @@ class VerifyEngine:
             from ..parallel.mesh import make_mesh
 
             self._mesh = make_mesh(mesh_devices)
+        # Double-buffered dispatch: ONE pack worker stages the host side
+        # of launch N+1 (byte decode, prepare_batch, h2d transfer) while
+        # launch N executes on the device — the engine thread only ever
+        # pays dispatch + fetch.  A single worker keeps pack order equal
+        # to scheduler assembly order (the strict-priority guarantee
+        # rides on it), and the single staged slot + the in-flight cap
+        # bound how much work leaves the bounded class queues.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pack_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="verify-pack")
+        self._inflight_n = 0  # launches executing on device (telemetry)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="verify-engine")
         self._stopped = threading.Event()
@@ -269,58 +283,93 @@ class VerifyEngine:
     # The tunneled device charges a fixed ~15-20 ms per dispatch that
     # OVERLAPS device execution of the previous launch — but only if the
     # engine dispatches launch i+1 before fetching launch i's mask.  Depth
-    # 2 covers dispatch ~= execute; deeper only adds reply latency.
+    # 2 covers dispatch ~= execute; deeper only adds reply latency.  On
+    # top of the dispatch depth sits ONE pack slot (the pack worker in
+    # __init__): while up to two launches execute, the host side of the
+    # next launch — byte decode, prepare_batch, h2d — is already staging,
+    # so in the steady state the device never waits for host packing.
     PIPELINE_DEPTH = 2
 
     def _run(self):
         import collections
+        from concurrent import futures as cfut
 
+        packing = collections.deque()   # (batch, Future[dispatch_fn])
         inflight = collections.deque()  # (batch, fetch_fn)
         while not self._stopped.is_set():
-            if inflight:
-                # Work is pending on the device: don't block on the
-                # scheduler; drain the oldest launch if nothing is queued.
-                launch = self._sched.next_launch(block=False)
-                if launch is None:
-                    self._drain_one(inflight)
-                    continue
-            else:
-                # Bounded wait so a stop() that races the wait's entry is
-                # still observed promptly (same poll discipline as
-                # serve_forever).
-                launch = self._sched.next_launch(timeout=0.25)
-                if launch is None:
-                    continue
-            # BLS requests run individually (a QC aggregate is one check;
-            # there is nothing to coalesce) on the same device thread,
-            # after all in-flight Ed25519 launches drain.
-            if launch.kind == "bls":
-                (item,) = launch.items
-                while inflight:
-                    self._drain_one(inflight)
-                try:
-                    self._execute_bls(item)
-                except Exception:
-                    log.exception("BLS request failed")
-                    item.reply_fn(None)
+            # 1) A FINISHED pack moves onto the device whenever there is
+            #    dispatch room.  Unfinished packs are waited out in step
+            #    3's bounded slices, never blocked on here — stop() must
+            #    stay observable even mid-pack.
+            if packing and len(inflight) < self.PIPELINE_DEPTH and \
+                    packing[0][1].done():
+                self._dispatch_one(packing, inflight)
                 continue
-            batch = launch.items
-            try:
-                inflight.append((batch, self._submit(batch)))
-            except Exception:
-                log.exception("verify batch dispatch failed")
-                for p in batch:
-                    p.reply_fn([False] * len(p.request.msgs))
-            while len(inflight) >= self.PIPELINE_DEPTH:
+            # 2) A free pack slot admits the next scheduler launch.
+            if not packing:
+                idle = not inflight
+                # Bounded wait when idle so a stop() that races the
+                # wait's entry is still observed promptly (same poll
+                # discipline as serve_forever).
+                launch = self._sched.next_launch(timeout=0.25) if idle \
+                    else self._sched.next_launch(block=False)
+                if launch is not None:
+                    # BLS requests run individually (a QC aggregate is
+                    # one check; there is nothing to coalesce) on the
+                    # same device thread, after the whole Ed25519
+                    # pipeline drains.
+                    if launch.kind == "bls":
+                        (item,) = launch.items
+                        while inflight:
+                            self._drain_one(inflight)
+                        try:
+                            self._execute_bls(item)
+                        except Exception:
+                            log.exception("BLS request failed")
+                            item.reply_fn(None)
+                        continue
+                    batch = launch.items
+                    packing.append(
+                        (batch, self._pack_pool.submit(self._pack, batch)))
+                    continue
+                if idle:
+                    continue
+            # 3) Pipeline full or queue empty: make progress on the
+            #    oldest work — fetch the oldest launch (its execution
+            #    overlapped the pack that is still staging), or wait out
+            #    the pack in bounded slices so stop() stays observable.
+            if inflight:
                 self._drain_one(inflight)
+            elif packing:
+                try:
+                    packing[0][1].exception(timeout=0.25)
+                except cfut.TimeoutError:
+                    pass
         # Shutdown: every accepted request still gets its reply (clients
         # would otherwise block until their recv deadline and report a
         # spurious transport failure).
+        while packing:
+            self._dispatch_one(packing, inflight)
         while inflight:
             self._drain_one(inflight)
+        self._pack_pool.shutdown(wait=False)
+
+    def _dispatch_one(self, packing, inflight):
+        """Move the oldest staged pack onto the device (engine thread)."""
+        batch, fut = packing.popleft()
+        try:
+            fetch = fut.result()()  # wait for pack, then device dispatch
+        except Exception:
+            log.exception("verify batch pack/dispatch failed")
+            for p in batch:
+                p.reply_fn([False] * len(p.request.msgs))
+            return
+        inflight.append((batch, fetch))
+        self._inflight_n = len(inflight)
 
     def _drain_one(self, inflight):
         batch, fetch = inflight.popleft()
+        self._inflight_n = len(inflight)
         try:
             mask = fetch()
         except Exception:
@@ -335,9 +384,18 @@ class VerifyEngine:
             off += n
 
     def _submit(self, batch):
-        """Dispatch one coalesced batch; returns fetch() -> concatenated
-        mask.  The host path computes eagerly; the device paths dispatch
-        asynchronously so the next launch can overlap this one.
+        """Two-stage form of the launch path (pack + dispatch in one
+        call) for embedders without a pack thread; returns fetch() ->
+        concatenated mask."""
+        return self._pack(batch)()
+
+    def _pack(self, batch):
+        """Host-side pack stage of one coalesced batch (runs on the pack
+        worker): byte concat, verdict-cache lookups, in-batch dedup,
+        route selection, host preparation and the h2d transfers.  Returns
+        ``dispatch() -> fetch()`` — dispatch fires the (donated) device
+        program from the engine thread; the host path computes eagerly
+        here instead.
 
         Verdict cache: signature validity is a pure function of the
         (msg, pk, sig) bytes, so records already verified are answered
@@ -345,7 +403,11 @@ class VerifyEngine:
         shared sidecar (the local testbed runs up to 100 replicas against
         ONE sidecar process) every replica verifies the same QC — the
         cache turns N identical quorum verifications per block into one
-        device launch plus N-1 lookups."""
+        device launch plus N-1 lookups.  (Cache reads here happen off the
+        engine thread, same dict-read-under-GIL safety as the connection
+        threads' fast path; the engine thread stays the only writer.)"""
+        t0 = monotonic()
+        hidden = self._inflight_n > 0  # device busy while we pack
         msgs, pks, sigs = [], [], []
         for p in batch:
             msgs += p.request.msgs
@@ -367,49 +429,99 @@ class VerifyEngine:
         m_pks = [r[1] for r in uniq_records]
         m_sigs = [r[2] for r in uniq_records]
         # Route via the warmed-shape registry: batches of RLC_MIN_LAUNCH+
-        # unique records whose padded bucket the RLC warmup compiled pay
-        # ONE Straus MSM (crypto/eddsa.verify_batch_rlc_submit) instead
-        # of per-signature ladders; its bisection fallback keeps the
-        # verdict mask bit-identical when the combined check fails.
+        # unique records whose padded (per-shard, on a mesh) bucket the
+        # RLC warmup compiled pay ONE Straus MSM — single-chip via
+        # crypto/eddsa.verify_batch_rlc_pack, mesh via
+        # parallel/sharded_verify.verify_rlc_sharded_pack — instead of
+        # per-signature ladders; the bisection fallbacks keep the verdict
+        # mask bit-identical when the combined check fails.
         stats = self._sched.stats
         path = self._shapes.route(len(uniq_records))
         if uniq_records:
             stats.note_path(path)
-        if path == vsched.PATH_RLC:
+
+        def on_bisect():
+            stats.note_path("rlc_bisect")
+
+        if not uniq_records:
+            dispatchers = []
+        elif path == vsched.PATH_RLC:
             from ..crypto import eddsa
 
-            fetchers = [eddsa.verify_batch_rlc_submit(
-                m_msgs, m_pks, m_sigs,
-                on_bisect=lambda: stats.note_path("rlc_bisect"))]
+            dispatchers = [eddsa.verify_batch_rlc_pack(
+                m_msgs, m_pks, m_sigs, on_bisect=on_bisect)]
+        elif path in (vsched.PATH_RLC_SHARDED, vsched.PATH_LADDER_SHARDED,
+                      vsched.PATH_MESH):
+            dispatchers = self._pack_sharded(path, m_msgs, m_pks, m_sigs,
+                                             on_bisect)
+        elif path == vsched.PATH_HOST:
+            # Host verification is pure host work — it runs right here on
+            # the pack worker (per sub-batch, the pre-scheduler slicing
+            # discipline), overlapping whatever the device is doing.
+            fetchers = [self._verify_submit(m_msgs[i:i + MAX_SUBBATCH],
+                                            m_pks[i:i + MAX_SUBBATCH],
+                                            m_sigs[i:i + MAX_SUBBATCH])
+                        for i in range(0, len(m_msgs), MAX_SUBBATCH)]
+            dispatchers = [(lambda f=f: f) for f in fetchers]
         else:
-            # The host path verifies per sub-batch; the device paths
-            # (single chip via eddsa.verify_batch_submit, mesh via
-            # verify_batch_sharded — both chunk internally) run up to a
-            # whole launch-cap window as one dispatch, so the
-            # per-dispatch tunnel cost is paid once.  A single request
-            # larger than the cap (the coalescer only bounds *additional*
-            # requests) is still sliced here so no request can force an
-            # unwarmed compile shape or an unbounded device allocation.
-            step = MAX_SUBBATCH if self._use_host \
-                else self._shapes.launch_cap
-            fetchers = [self._verify_submit(m_msgs[i:i + step],
-                                            m_pks[i:i + step],
-                                            m_sigs[i:i + step])
-                        for i in range(0, len(m_msgs), step)]
+            # Single-chip per-signature ladders: up to a whole launch-cap
+            # window per dispatch, so the per-dispatch tunnel cost is
+            # paid once.  A single request larger than the cap (the
+            # coalescer only bounds *additional* requests) is still
+            # sliced here so no request can force an unwarmed compile
+            # shape or an unbounded device allocation.
+            from ..crypto import eddsa
 
-        def fetch():
-            fresh = []
-            for f in fetchers:
-                fresh.extend(f())
-            mask = list(cached)
-            for record, ok in zip(uniq_records, fresh):
-                ok = bool(ok)
-                self._cache_verdict(record, ok)
-                for i in uniq[record]:
-                    mask[i] = ok
-            return mask
+            step = self._shapes.launch_cap
+            dispatchers = [eddsa.verify_batch_pack(m_msgs[i:i + step],
+                                                   m_pks[i:i + step],
+                                                   m_sigs[i:i + step])
+                           for i in range(0, len(m_msgs), step)]
+        stats.note_pack(monotonic() - t0, hidden)
 
-        return fetch
+        def dispatch():
+            fetchers = [d() for d in dispatchers]
+
+            def fetch():
+                fresh = []
+                for f in fetchers:
+                    fresh.extend(f())
+                mask = list(cached)
+                for record, ok in zip(uniq_records, fresh):
+                    ok = bool(ok)
+                    self._cache_verdict(record, ok)
+                    for i in uniq[record]:
+                        mask[i] = ok
+                return mask
+
+            return fetch
+
+        return dispatch
+
+    def _pack_sharded(self, path, msgs, pks, sigs, on_bisect):
+        """Pack-stage dispatchers for the mesh routes: RLC launches go
+        whole (one MSM across the mesh); ladder launches slice at the
+        launch cap like the single-chip path.  Every launch's per-shard
+        bucket lands in the OP_STATS histogram — the warmed-shape
+        discipline made observable."""
+        from ..crypto.eddsa import prepare_batch
+        from ..parallel import sharded_verify as shv
+
+        stats = self._sched.stats
+        if path == vsched.PATH_RLC_SHARDED:
+            stats.note_mesh_launch(self._shapes.shard_bucket_of(len(msgs)))
+            return [shv.verify_rlc_sharded_pack(
+                self._mesh, prepare_batch(msgs, pks, sigs),
+                on_bisect=on_bisect)]
+        step = self._shapes.launch_cap
+        out = []
+        for i in range(0, len(msgs), step):
+            sl = slice(i, i + step)
+            n = len(msgs[sl])
+            stats.note_mesh_launch(self._shapes.shard_bucket_of(n))
+            out.append(shv.verify_batch_sharded_pack(
+                self._mesh, prepare_batch(msgs[sl], pks[sl], sigs[sl])))
+        return out
 
     # Verdict-cache capacity: ~224 B/record key; 64k entries ~ 15 MB.
     VERDICT_CACHE_CAP = 64 * 1024
@@ -530,12 +642,14 @@ class VerifyEngine:
                             for m, p, s in zip(msgs, pks, sigs)])
             return lambda: res
         if self._mesh is not None:
+            # The staged production entry (dispatched immediately): the
+            # warmup path runs through here, so the exact donated mesh
+            # program the engine launches is what gets compiled.
             from ..crypto.eddsa import prepare_batch
-            from ..parallel.sharded_verify import verify_batch_sharded
+            from ..parallel.sharded_verify import verify_batch_sharded_pack
 
-            res = verify_batch_sharded(self._mesh, prepare_batch(
-                msgs, pks, sigs))
-            return lambda: res
+            return verify_batch_sharded_pack(self._mesh, prepare_batch(
+                msgs, pks, sigs))()
         from ..crypto import eddsa
 
         return eddsa.verify_batch_submit(msgs, pks, sigs)
@@ -712,7 +826,8 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
           ready_event: threading.Event | None = None,
           warm_max: int = MAX_SUBBATCH, warm_bls: bool = False,
           warm_bls_multi: int = 0, warm_bulk: bool = False,
-          warm_rlc: bool = False, chaos: bool = False,
+          warm_rlc: bool = False, warm_rlc_sharded: bool = False,
+          chaos: bool = False,
           committee: int | None = None, client_rate: int | None = None):
     engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host,
                           committee=committee, client_rate=client_rate)
@@ -737,9 +852,16 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
             engine.enable_bulk()
         if warm_rlc and not (mesh_devices and mesh_devices > 1):
             # Single-chip only: the mesh path routes through
-            # verify_rlc_sharded (its own warmup story), and the shape
-            # registry never routes RLC in mesh/host mode.
+            # verify_rlc_sharded, whose warmup is --warm-rlc-sharded
+            # below (per-SHARD buckets, not global ones).
             _warmup_rlc(engine, warm_max)
+        if warm_rlc_sharded and mesh_devices and mesh_devices > 1:
+            # Mesh one-MSM warmup: compiles verify_rlc_sharded AND
+            # verify_batch_sharded at every per-shard bucket up to the
+            # cap, so the scheduler routes coalesced launches of
+            # RLC_MIN_LAUNCH+ unique records down the sharded MSM path
+            # with its bisection fallback already compiled.
+            _warmup_rlc_sharded(engine, warm_max)
     chaos_state = None
     if chaos:
         chaos_state = ChaosState()
@@ -845,6 +967,55 @@ def _warmup(engine, warm_max: int = MAX_SUBBATCH):
     _warm_shapes(engine, 8, warm_max, "warmup")
 
 
+def _warmup_rlc_sharded(engine, warm_max: int = MAX_SUBBATCH):
+    """Compile the MESH verify programs at every per-shard bucket the
+    engine may launch, and register the shapes so the scheduler's router
+    starts choosing the ``rlc_sharded`` path.
+
+    Walks GLOBAL sizes n = n_dev * per_shard for every power-of-two
+    per-shard bucket from the floor (parallel/shard_shapes.shard_bucket
+    of the smallest batch) up to the launch cap, running each through
+    the REAL staged entries — verify_rlc_sharded_pack AND
+    verify_batch_sharded_pack — so both the one-MSM program and its
+    per-signature bisection/fallback program are compiled for every
+    bucket before the socket binds.  Bisection halves land on smaller
+    buckets, which this loop has always already compiled (increasing
+    order).
+    """
+    from ..crypto import eddsa, ref_ed25519 as ref
+    from ..parallel import sharded_verify as shv
+
+    n_dev = engine._shapes.n_devices
+    if n_dev < 2 or engine._mesh is None:
+        log.warning("--warm-rlc-sharded ignored: no device mesh")
+        return
+    sk = bytes(range(32))
+    _, pk = ref.generate_keypair(sk)
+    msg = b"\x02" * 32
+    sig = ref.sign(sk, msg)
+    per = shv.shard_bucket(1, n_dev)          # the smallest bucket
+    cap = min(warm_max, MAX_SUBBATCH)         # largest routed launch
+    top = shv.shard_bucket(cap, n_dev)        # its per-shard bucket
+    while per <= top:
+        n = n_dev * per
+        t0 = monotonic()
+        # One prep serves both programs: neither pack entry mutates the
+        # host dict (padding copies before device_put).
+        prep = eddsa.prepare_batch([msg] * n, [pk] * n, [sig] * n)
+        mask = shv.verify_batch_sharded_pack(engine._mesh, prep)()()
+        if not all(mask):
+            log.error("sharded warmup verify returned false at N=%d", n)
+        mask = shv.verify_rlc_sharded_pack(engine._mesh, prep)()()
+        if not all(mask):
+            log.error("RLC sharded warmup verify returned false at N=%d",
+                      n)
+        engine._shapes.mark_bucket(n)
+        engine._shapes.mark_rlc_sharded(n)
+        log.info("RLC sharded warmup N=%d (per-shard bucket %d) done "
+                 "in %.1fs", n, per, monotonic() - t0)
+        per *= 2
+
+
 def _warmup_rlc(engine, warm_max: int = MAX_SUBBATCH):
     """Compile the one-MSM RLC program at every padded bucket the engine
     may route to it (RLC_MIN_LAUNCH .. warm_max), and register the shapes
@@ -904,6 +1075,13 @@ def main(argv=None):
                          "shapes so coalesced batches of %d+ signatures "
                          "route through the combined check"
                          % vsched.RLC_MIN_LAUNCH)
+    ap.add_argument("--warm-rlc-sharded", action="store_true",
+                    help="with --mesh N: pre-compile the mesh-sharded "
+                         "one-MSM RLC programs (and their per-signature "
+                         "fallback) at every per-shard bucket, so "
+                         "coalesced batches of %d+ signatures route "
+                         "through the sharded combined check"
+                         % vsched.RLC_MIN_LAUNCH)
     ap.add_argument("--chaos", action="store_true",
                     help="enable the OP_CHAOS fault-injection hook "
                          "(bounded reply delay, forced connection drops, "
@@ -925,6 +1103,7 @@ def main(argv=None):
           use_host=args.host_crypto, warm_max=args.warm,
           warm_bls=args.warm_bls, warm_bls_multi=args.warm_bls_multi,
           warm_bulk=args.warm_bulk, warm_rlc=args.warm_rlc,
+          warm_rlc_sharded=args.warm_rlc_sharded,
           chaos=args.chaos, committee=args.committee or None,
           client_rate=args.client_rate or None)
 

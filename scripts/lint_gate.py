@@ -28,11 +28,14 @@ if __name__ == "__main__":
         # fails if any of them ever moves out of its checker's target
         # set (or is deleted without this pin being updated consciously).
         for pin in ("hotpath:hotstuff_tpu/ops/scalar25519.py",
+                    "hotpath:hotstuff_tpu/parallel/shard_shapes.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/__init__.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/classes.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/scheduler.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/shapes.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/stats.py",
+                    "padshape:hotstuff_tpu/parallel/sharded_verify.py",
+                    "padshape:hotstuff_tpu/sidecar/sched/shapes.py",
                     "sockets:hotstuff_tpu/chaos/__init__.py",
                     "sockets:hotstuff_tpu/chaos/plan.py",
                     "sockets:hotstuff_tpu/chaos/runner.py",

@@ -389,6 +389,74 @@ def test_padded_bucket_quiet_on_real_tree():
     assert padshape.check(REPO) == []
 
 
+# ---------------------------------------------------------------------------
+# shard-misaligned-launch (mesh launch-size discipline)
+# ---------------------------------------------------------------------------
+
+MESH_MOD = padshape.MESH_TARGETS[0]
+
+
+def test_shard_misaligned_fires_on_handrolled_device_math():
+    findings = padshape.check_sources({MESH_MOD: textwrap.dedent("""
+        import numpy as np
+
+        def verify_over_mesh(mesh, prep, n_dev):
+            n = prep.shape[0]
+            m = n_dev * next_pow2(-(-n // n_dev))
+            rows = np.pad(prep, m - n)
+            return _cached_verifier(mesh)(rows)
+        """)})
+    assert rules(findings) == {"shard-misaligned-launch"}
+    assert any("size math against n_dev" in f.message for f in findings)
+
+
+def test_shard_misaligned_fires_on_unaligned_mesh_launch():
+    # A mesh launch with NO size math at all still needs the helper —
+    # whoever shaped the buffers must have aligned them.
+    findings = padshape.check_sources({MESH_MOD: textwrap.dedent("""
+        def launch(mesh, rows, z, n):
+            m = next_pow2(n)
+            return _cached_rlc_verifier(mesh)(rows[:m], z[:m])
+        """)})
+    assert rules(findings) == {"shard-misaligned-launch"}
+    assert any("mesh launch _cached_rlc_verifier" in f.message
+               for f in findings)
+
+
+def test_shard_misaligned_quiet_on_helper_routed_launch():
+    findings = padshape.check_sources({MESH_MOD: textwrap.dedent("""
+        import numpy as np
+
+        def verify_over_mesh(mesh, prep):
+            n = prep.shape[0]
+            m = shard_aligned_rows(n, mesh.devices.size)
+            rows = np.pad(prep, m - n)
+            return _cached_verifier(mesh)(rows)
+
+        def registry_capacity(self, n):
+            return shard_aligned_rows(n, self.n_devices)
+        """)})
+    assert findings == []
+
+
+def test_shard_misaligned_quiet_on_factories_and_non_mesh_modules():
+    # The donated-cache factory REFERENCES _cached_verifier without
+    # launching it; a non-mesh module may do n_dev math freely (the rule
+    # is scoped to the mesh-path targets).
+    factory = textwrap.dedent("""
+        def _cached_verifier_donated(mesh, max_subbatch):
+            if backend() == "cpu":
+                return _cached_verifier(mesh, max_subbatch)
+            return make_sharded_verifier(mesh, max_subbatch, donate=True)
+        """)
+    assert padshape.check_sources({MESH_MOD: factory}) == []
+    elsewhere = textwrap.dedent("""
+        def partition(n, n_dev):
+            return n // n_dev
+        """)
+    assert padshape.check_sources({"mod.py": elsewhere}) == []
+
+
 def test_padded_bucket_fires_on_warmup_floor_drift(tmp_path):
     for rel in (padshape.EDDSA, padshape.SERVICE):
         dst = tmp_path / rel

@@ -368,6 +368,30 @@ def test_local_bench_boot_flags_carry_chaos_and_sizing():
     assert "--chaos" in cmd
 
 
+def test_local_bench_boot_flags_carry_mesh():
+    """--sidecar-mesh N boots the sidecar with --mesh N and the sharded
+    one-MSM warmup; a host-crypto degrade drops both (no device, no
+    mesh)."""
+    from hotstuff_tpu.harness.config import BenchParameters
+    from hotstuff_tpu.harness.local import LocalBench
+
+    def boot_cmd(host_crypto):
+        params = {"faults": 0, "nodes": 4, "rate": 1000, "tx_size": 512,
+                  "duration": 10, "tpu_sidecar": True, "sidecar_mesh": 8}
+        bench = LocalBench(BenchParameters(params))
+        booted = []
+        bench._background_run = \
+            lambda cmd, log, append=False: booted.append(cmd)
+        bench._wait_sidecar_ready = lambda deadline_s: None
+        bench._boot_sidecar(host_crypto=host_crypto)
+        return booted[0]
+
+    cmd = boot_cmd(host_crypto=False)
+    assert "--mesh 8 --warm-rlc-sharded" in cmd
+    cmd = boot_cmd(host_crypto=True)
+    assert "--mesh" not in cmd and "--warm-rlc-sharded" not in cmd
+
+
 def test_bench_chaos_headline_probe_round_trips():
     import bench
 
@@ -378,6 +402,118 @@ def test_bench_chaos_headline_probe_round_trips():
     out = bench.chaos_headline_probe("1 node:0 kill; 2 node:0 restart")
     assert out["plan_events"] == 2 and out["recovered"]
     assert [e["action"] for e in out["events"]] == ["kill", "restart"]
+
+
+# ---------------------------------------------------------------------------
+# bench device probe: the retry loop must respect the OUTER budget (the
+# BENCH_r05.json regression — rc=124, nine retries, no JSON at all)
+# ---------------------------------------------------------------------------
+
+
+class _VirtualClock:
+    """Deterministic clock for the probe loop: a fake always-failing
+    probe advances it by its timeout (a wedge eats the full wait);
+    sleeps advance it too.  No real time passes."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+    def wedged_run(self, cmd, timeout=None, **kwargs):
+        import subprocess
+
+        self.t += timeout
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+
+def test_probe_device_caps_window_against_bench_deadline(monkeypatch):
+    import bench
+
+    clock = _VirtualClock()
+    monkeypatch.setattr(bench, "_BENCH_T0", 0.0)
+    monkeypatch.setenv("HOTSTUFF_TPU_BENCH_DEADLINE", "200")
+    # The probe's own window (600 s) exceeds the outer budget: without
+    # the cap, retries would outlive the driver's timeout and the
+    # degraded JSON line would never print.
+    ok, reason = bench.probe_device(
+        window=600.0, max_attempts=99, run=clock.wedged_run,
+        sleep=clock.sleep, now=clock.now)
+    assert not ok
+    # The loop gave up with at least the emit slack left in the budget.
+    assert clock.t <= 200.0 - bench._DEADLINE_SLACK
+    assert "outer budget 200s" in reason
+
+
+def test_probe_device_exhausted_budget_probes_once_briefly(monkeypatch):
+    import bench
+
+    clock = _VirtualClock()
+    clock.t = 500.0  # already past the whole budget
+    monkeypatch.setattr(bench, "_BENCH_T0", 0.0)
+    monkeypatch.setenv("HOTSTUFF_TPU_BENCH_DEADLINE", "200")
+    calls = []
+
+    def run(cmd, timeout=None, **kwargs):
+        import subprocess
+
+        calls.append(timeout)
+        clock.t += timeout
+        raise subprocess.TimeoutExpired(cmd, timeout)
+
+    ok, _ = bench.probe_device(window=600.0, max_attempts=99, run=run,
+                               sleep=clock.sleep, now=clock.now)
+    assert not ok
+    assert calls == [5.0]  # one floor-timeout attempt, nothing more
+
+
+def test_probe_device_attempt_cap_and_success(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "_BENCH_T0", 0.0)
+    monkeypatch.delenv("HOTSTUFF_TPU_BENCH_DEADLINE", raising=False)
+    clock = _VirtualClock()
+    ok, reason = bench.probe_device(
+        window=600.0, max_attempts=3, run=clock.wedged_run,
+        sleep=clock.sleep, now=clock.now)
+    assert not ok and "3x (cap 3" in reason
+
+    healthy = _VirtualClock()
+    ok, reason = bench.probe_device(
+        window=600.0, max_attempts=3,
+        run=lambda *a, **k: None, sleep=healthy.sleep, now=healthy.now)
+    assert ok and reason == ""
+
+
+def test_probe_device_deterministic_errors_bail_fast(monkeypatch):
+    import bench
+
+    monkeypatch.setattr(bench, "_BENCH_T0", 0.0)
+    monkeypatch.delenv("HOTSTUFF_TPU_BENCH_DEADLINE", raising=False)
+    clock = _VirtualClock()
+
+    def broken_run(cmd, timeout=None, **kwargs):
+        import subprocess
+
+        clock.t += 1.0
+        raise subprocess.CalledProcessError(1, cmd,
+                                            stderr=b"ImportError: nope")
+
+    ok, reason = bench.probe_device(
+        window=600.0, max_attempts=99, run=broken_run,
+        sleep=clock.sleep, now=clock.now)
+    assert not ok and "not a wedge" in reason and "ImportError" in reason
+    assert clock.t < 60.0  # quick retries, no 30 s wedge waits
+
+
+def test_mesh_rlc_headline_skips_on_zero_budget():
+    import bench
+
+    assert bench.mesh_rlc_headline(budget_s=0.0) == {"skipped": True}
 
 
 def test_local_fault_injector_signals_real_process_groups(tmp_path):

@@ -15,6 +15,23 @@ def test_entry_jits():
     assert list(mask) == [True, True, True, True, False, True, True, True]
 
 
+def test_dryrun_lane_diagnostics_classify_disagreements():
+    """The dryrun's per-lane check must say WHICH lanes disagreed and
+    why — false-reject vs escaped-invalid — and pass silently when the
+    mask matches the injected fault pattern exactly."""
+    import __graft_entry__ as ge
+
+    expect = np.array([True, True, False, True])
+    ge._check_lanes("test", expect.copy(), expect)  # exact match: quiet
+    with pytest.raises(AssertionError) as exc:
+        ge._check_lanes("test", np.array([True, False, False, True]),
+                        expect)
+    assert "lane 1: false-reject" in str(exc.value)
+    with pytest.raises(AssertionError) as exc:
+        ge._check_lanes("test", np.array([True, True, True, True]), expect)
+    assert "lane 2: escaped-invalid" in str(exc.value)
+
+
 @pytest.mark.slow  # ~84 s; the driver runs dryrun_multichip itself every round
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge

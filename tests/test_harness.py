@@ -79,18 +79,26 @@ def test_parser_folds_sidecar_stats_into_notes():
     parser.note_sidecar_stats({
         "launches": 42,
         "launches_by_class": {"latency": 40, "bulk": 2},
-        "paths": {"rlc": 30, "per_sig": 10, "rlc_bisect": 2},
+        "paths": {"rlc_sharded": 30, "ladder_sharded": 10,
+                  "rlc_bisect": 2},
         "queue_wait": {"latency": {"n": 40, "p50_ms": 0.4, "p99_ms": 2.1},
                        "bulk": {"n": 2, "p50_ms": 9.0, "p99_ms": 9.5}},
         "bulk_fill_sigs": 128,
         "pad_waste_sigs": 300,
         "queue_full": {"bulk": 3},
+        "mesh": {"sharded_launches": 40,
+                 "shard_buckets": {"2": 30, "4": 10}},
+        "pipeline": {"pack_ms": 120.5, "pack_hidden_ms": 90.4,
+                     "overlap_ratio": 0.75},
     })
     out = parser.result()
     assert "Sidecar launches: 42 (latency 40, bulk 2)" in out
-    assert "rlc=30" in out and "rlc_bisect=2" in out
+    assert "rlc_sharded=30" in out and "rlc_bisect=2" in out
     assert "latency p50 0.4 ms / p99 2.1 ms" in out
     assert "Sidecar pad fill: 128 sigs (waste 300)" in out
+    assert "Sidecar mesh launches: 40 (per-shard buckets 2x30, 4x10)" \
+        in out
+    assert "Sidecar pack overlap: 75% of 120.5 ms packing hidden" in out
     assert "Sidecar queue-full sheds: bulk=3" in out
     # labelled grammar intact
     assert "End-to-end TPS" in out and "Consensus latency" in out

@@ -96,3 +96,68 @@ def test_sharded_rlc_matches_per_signature():
     want = eddsa.verify_batch(msgs, pks, sigs)
     assert got.tolist() == want.tolist()
     assert not got[5] and not got[9] and got.sum() == 11
+
+
+def test_shard_shapes_alignment_rule():
+    """THE shard-alignment arithmetic: per-shard power-of-two buckets
+    with the warmup floor, whole-chunk growth beyond the sub-batch cap,
+    and global rows always divisible by the device count."""
+    from hotstuff_tpu.parallel.shard_shapes import (shard_aligned_rows,
+                                                    shard_bucket)
+
+    assert shard_bucket(16, 8) == 2
+    assert shard_bucket(17, 8) == 4          # ceil(17/8)=3 -> pow2 4
+    assert shard_bucket(1, 8) == 1           # floor: _MIN_BUCKET/8
+    assert shard_bucket(1, 2) == 4           # floor: _MIN_BUCKET/2
+    assert shard_bucket(3000, 8) == 512      # NOT 375
+    # Beyond the per-shard cap: whole max_subbatch chunks, pow2 count.
+    assert shard_bucket(8 * 3000, 8, max_subbatch=1024) == 4 * 1024
+    assert shard_bucket(100, 8, max_subbatch=4) == 16  # ceil=13 -> g=4
+    for n in (1, 7, 16, 100, 3000, 50_000):
+        for n_dev in (2, 4, 8):
+            rows = shard_aligned_rows(n, n_dev)
+            assert rows % n_dev == 0 and rows >= n
+            assert rows == n_dev * shard_bucket(n, n_dev)
+    import pytest
+
+    with pytest.raises(ValueError):
+        shard_bucket(8, 0)
+
+
+def test_sharded_pack_stages_match_eager():
+    """The pack -> dispatch -> fetch split (the engine's double-buffered
+    launch shape) returns the same masks as the eager entry points, for
+    both the ladder and the RLC mesh programs."""
+    from hotstuff_tpu.parallel.sharded_verify import (
+        verify_batch_sharded_pack, verify_rlc_sharded_pack)
+
+    rng = np.random.default_rng(47)
+    msgs, pks, sigs = [], [], []
+    for i in range(21):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msg = rng.bytes(32)
+        sig = ref.sign(sk, msg)
+        if i in (2, 19):
+            sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
+        msgs.append(msg); pks.append(pk); sigs.append(sig)
+    mesh = make_mesh(8)
+    want = eddsa.verify_batch(msgs, pks, sigs)
+
+    dispatch = verify_batch_sharded_pack(
+        mesh, eddsa.prepare_batch(msgs, pks, sigs))
+    assert dispatch()().tolist() == want.tolist()
+
+    bisected = []
+    dispatch = verify_rlc_sharded_pack(
+        mesh, eddsa.prepare_batch(msgs, pks, sigs),
+        on_bisect=lambda: bisected.append(1))
+    assert dispatch()().tolist() == want.tolist()
+    assert bisected == [1]  # tampered rows forced the bisection path
+
+    # All-valid: the combined check passes in one dispatch, no bisection.
+    ok_prep = eddsa.prepare_batch(msgs[3:19], pks[3:19], sigs[3:19])
+    bisected.clear()
+    assert verify_rlc_sharded_pack(
+        mesh, ok_prep, on_bisect=lambda: bisected.append(1))()().all()
+    assert bisected == []

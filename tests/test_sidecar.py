@@ -235,19 +235,18 @@ def test_engine_mesh_mode_buckets_to_warmed_shapes(monkeypatch):
     warmed shapes may reach the device program)."""
     from hotstuff_tpu.parallel import sharded_verify as sv
 
+    # Spy on the pack-stage h2d seam (_shard_put): every mesh launch
+    # ships its padded per-record arrays through it, so the row counts
+    # it sees ARE the launched shapes.  (The verifier factories are
+    # functools.cached across the test session and can't be spied.)
     launched = []
-    real = sv._cached_verifier
+    real_put = sv._shard_put
 
-    def spying(mesh, max_subbatch=sv.MAX_SUBBATCH):
-        fn = real(mesh, max_subbatch)
+    def spying(mesh, arr):
+        launched.append(arr.shape[0])
+        return real_put(mesh, arr)
 
-        def wrapper(*arrays):
-            launched.append(arrays[0].shape[0])
-            return fn(*arrays)
-
-        return wrapper
-
-    monkeypatch.setattr(sv, "_cached_verifier", spying)
+    monkeypatch.setattr(sv, "_shard_put", spying)
     engine = VerifyEngine(mesh_devices=8)
     try:
         # n=3 -> per-shard 1 (floored at _MIN_BUCKET/8) -> m=8;
@@ -258,7 +257,9 @@ def test_engine_mesh_mode_buckets_to_warmed_shapes(monkeypatch):
             msgs, pks, sigs = _sigs(n, tamper=tamper)
             got = engine._verify(msgs, pks, sigs)
             assert list(got) == [i not in tamper for i in range(n)]
-            assert launched == [want_m], (n, launched)
+            # One ladder launch = the five packed arrays, all at the
+            # shard-aligned row count.
+            assert launched == [want_m] * 5, (n, launched)
     finally:
         engine.stop()
 

@@ -173,15 +173,18 @@ def test_mixed_priority_soak_through_engine():
         res = np.ones(len(msgs), bool)
         return lambda: res
 
-    orig_submit = engine._submit
+    orig_pack = engine._pack
 
-    def spying_submit(batch):
+    def spying_pack(batch):
+        # _pack is the launch-admission surface of the double-buffered
+        # engine (the single pack worker preserves scheduler assembly
+        # order, so this records the true launch order).
         launches.append([(p.cls, admit_idx[p.request.request_id])
                          for p in batch])
-        return orig_submit(batch)
+        return orig_pack(batch)
 
     engine._verify_submit = fake_verify_submit
-    engine._submit = spying_submit
+    engine._pack = spying_pack
     try:
         replies = []
         cond = threading.Condition()
@@ -369,6 +372,118 @@ def test_engine_small_batches_stay_per_sig(rlc_engine):
     got = _engine_mask(engine, msgs, pks, sigs)
     assert got == [i != 4 for i in range(10)]
     assert engine.stats_snapshot()["paths"].get("per_sig", 0) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Mesh routing through the full engine path (8-device forced-host CPU
+# mesh from conftest): sharded-RLC route selection, shard-aligned launch
+# shapes, and mask bit-identity vs verify_batch incl. forced bisection.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_engine():
+    """Mesh engine with the per-signature AND sharded one-MSM warmups
+    run through the real entry points (what `--mesh 8 --warm-rlc-sharded`
+    produces), capped at 32 records to bound compile time."""
+    engine = VerifyEngine(mesh_devices=8)
+    service._warmup(engine, warm_max=32)
+    service._warmup_rlc_sharded(engine, warm_max=32)
+    yield engine
+    engine.stop()
+
+
+def test_mesh_route_selection(mesh_engine):
+    shapes = mesh_engine._shapes
+    # Warmed + >= RLC_MIN_LAUNCH -> the sharded one-MSM path; below the
+    # floor the ladder path, even though its per-shard bucket is warmed.
+    assert shapes.route(16) == vsched.PATH_RLC_SHARDED
+    assert shapes.route(32) == vsched.PATH_RLC_SHARDED
+    assert shapes.route(15) == vsched.PATH_LADDER_SHARDED
+    # An unwarmed per-shard bucket must NOT route to the MSM.
+    cold = vsched.ShapeRegistry(n_devices=8)
+    assert cold.route(64) == vsched.PATH_LADDER_SHARDED
+    # Warming is keyed per-shard: marking any size on the same bucket
+    # unlocks every size that lands on it.
+    cold.mark_rlc_sharded(64)
+    assert cold.route(64) == vsched.PATH_RLC_SHARDED
+    assert cold.route(57) == vsched.PATH_RLC_SHARDED   # same bucket (8)
+    assert cold.route(128) == vsched.PATH_LADDER_SHARDED
+
+
+def test_mesh_shard_aligned_capacity():
+    from hotstuff_tpu.parallel.shard_shapes import (shard_aligned_rows,
+                                                    shard_bucket)
+
+    reg = vsched.ShapeRegistry(n_devices=8)
+    for n in (1, 5, 16, 20, 100, 375 * 8, 3000):
+        cap = reg.bucket_capacity(n)
+        assert cap == shard_aligned_rows(n, 8)
+        assert cap % 8 == 0, "mesh capacity must divide across devices"
+        per = cap // 8
+        assert per == shard_bucket(n, 8)
+        assert per & (per - 1) == 0 or per % eddsa.MAX_SUBBATCH == 0, \
+            "per-shard rows must be a pow2 bucket or whole chunks"
+        assert cap >= n
+    # The 375-row-shard regression: 3000 records on 8 devices must pad
+    # to a power-of-two per-shard bucket, not ceil(3000/8)=375.
+    assert reg.bucket_capacity(3000) == 8 * 512
+
+
+def test_mesh_pad_fill_room_uses_shard_aligned_capacity():
+    s = vsched.Scheduler(shapes=vsched.ShapeRegistry(n_devices=8))
+    s.offer(_req(5, 1), lambda m: None, cls=vsched.LATENCY)
+    for i in range(4):
+        s.offer(_req(3, 300 + i), lambda m: None, cls=vsched.BULK)
+    launch = s.next_launch(block=False)
+    assert launch.cls == vsched.LATENCY
+    # 5 unique -> shard-aligned capacity 8 (8 devices x 1-row bucket):
+    # room for exactly one 3-sig bulk fill without growing any shard.
+    assert launch.fill_count == 1
+    assert launch.total_sigs == 8
+
+
+def test_mesh_engine_masks_match_verify_batch(mesh_engine):
+    """Engine-routed mesh launches of >= 16 unique records take the
+    rlc_sharded path (visible in OP_STATS route counters), produce masks
+    bit-identical to verify_batch — all-valid AND tampered (forced
+    bisection) — and every launch's padded bucket divides evenly by the
+    device count, landing only on warmup-marked shapes."""
+    engine = mesh_engine
+    before = engine.stats_snapshot()["paths"].get("rlc_sharded", 0)
+    cases = [(16, set(), 70), (20, {3, 17}, 71), (31, {0}, 72)]
+    for n, tamper, seed in cases:
+        msgs, pks, sigs = _sigs(n, tamper=tamper, seed=seed)
+        got = _engine_mask(engine, msgs, pks, sigs)
+        want = eddsa.verify_batch(msgs, pks, sigs)
+        assert got == [bool(b) for b in want], (n, tamper)
+        assert got == [i not in tamper for i in range(n)]
+    snap = engine.stats_snapshot()
+    assert snap["paths"].get("rlc_sharded", 0) - before == len(cases)
+    assert snap["paths"].get("rlc_bisect", 0) >= 2  # the tampered cases
+    # Shard-aligned discipline, asserted via the shape registry: every
+    # mesh launch's per-shard bucket must have been warmed — no shape
+    # can have compiled cold after warmup.
+    mesh_stats = snap["mesh"]
+    assert mesh_stats["sharded_launches"] >= len(cases)
+    warmed = set(snap["shapes"]["rlc_shard_buckets"]) \
+        | set(snap["shapes"]["shard_buckets"])
+    launched = {int(b) for b in mesh_stats["shard_buckets"]}
+    assert launched and launched <= warmed, (launched, warmed)
+    # pipeline telemetry exists and is consistent
+    pipe = snap["pipeline"]
+    assert pipe["pack_ms"] > 0
+    assert 0.0 <= pipe["overlap_ratio"] <= 1.0
+
+
+def test_mesh_engine_small_batches_take_ladder_path(mesh_engine):
+    engine = mesh_engine
+    before = engine.stats_snapshot()["paths"].get("ladder_sharded", 0)
+    msgs, pks, sigs = _sigs(10, tamper={4}, seed=73)
+    got = _engine_mask(engine, msgs, pks, sigs)
+    assert got == [i != 4 for i in range(10)]
+    snap = engine.stats_snapshot()
+    assert snap["paths"].get("ladder_sharded", 0) == before + 1
 
 
 # ---------------------------------------------------------------------------

@@ -80,6 +80,18 @@ a live `harness local --fault-plan` run reports through its summary.
 Keys: plan_events, executed, recovered, injected_ok, max_recovery_ms,
 events[] (each with t/target/action/wall/recovery_ms).
 
+graftwan rides in the same field: `"chaos"."slo"` judges the probe's
+recovery latencies against the per-fault-class SLO table (--slo
+PATH|SPEC / HOTSTUFF_TPU_SLO, else chaos/slo.DEFAULT_SLO_MS) through
+the same chaos/slo.judge the LogParser raises on, and `"chaos"."wan"`
+proves the link-shape pipeline: the WAN spec (--wan PATH|SPEC /
+HOTSTUFF_TPU_WAN, else a miniature default link) is parsed, compiled to
+its per-host tc-netem command list, and realized by a real loopback
+WanProxy whose shaped round trip, partition black-hole, and heal are
+measured.  Keys: links, tc_commands, proxy_roundtrip_ms (one successful
+shaped round trip; null when the shape defeats every attempt),
+roundtrip_ok, partition_enforced, healed.
+
 Degraded mode (`"degraded": true`): the device probe is capped at
 HOTSTUFF_TPU_PROBE_ATTEMPTS tries (default 3) inside a
 HOTSTUFF_TPU_PROBE_WINDOW-second window (default 600) AND inside the
@@ -554,9 +566,12 @@ def sched_headline_probe() -> dict:
         engine.stop()
 
 
-# --fault-plan pass-through (set by main(); run_degraded reads it so the
-# degraded line carries the same chaos field as a healthy one).
+# --fault-plan/--wan/--slo pass-through (set by main(); run_degraded
+# reads them so the degraded line carries the same chaos field as a
+# healthy one).
 _FAULT_PLAN = None
+_WAN_SPEC = None
+_SLO_SPEC = None
 
 # Miniature default plan for the headline probe: one of every fault
 # class, timed inside a tenth of a (virtual) second.
@@ -564,8 +579,109 @@ _DEFAULT_CHAOS_SPEC = ("0.01 sidecar kill; 0.04 sidecar restart; "
                        "0.02 node:1 pause; 0.05 node:1 resume; "
                        "0.06 sidecar degrade shed=1")
 
+# Miniature default WAN spec for the headline probe: one shaped
+# node->sidecar link, small enough that the loopback proxy round trip
+# stays in the tens of milliseconds.
+_DEFAULT_WAN_SPEC = "node:0>sidecar latency_ms=5 name=probe-link"
 
-def chaos_headline_probe(plan_spec=None) -> dict:
+
+def wan_headline_probe(wan_spec=None) -> dict:
+    """The ``chaos.wan`` sub-field: prove the graftwan pipeline end to
+    end without a committee or root.  The spec (--wan, or a miniature
+    default) runs through the REAL parser, is compiled to the per-host
+    ``tc netem`` command list a fleet run would install, and is then
+    realized by a real loopback WanProxy: a byte round-trips through the
+    shaped link (paying its latency both ways), ``partition()`` must
+    black-hole a fresh connection, and ``heal()`` must restore it — the
+    exact executors a live ``--wan`` run uses, local and remote."""
+    import socket as _socket
+    import threading as _threading
+
+    from hotstuff_tpu.chaos import WanProxy, parse_wan
+    from hotstuff_tpu.chaos.netem import tc_setup_commands
+
+    spec = parse_wan(wan_spec if wan_spec else _DEFAULT_WAN_SPEC)
+    peers = {"node:0": "10.0.0.10", "node:1": "10.0.0.11",
+             "sidecar": "10.0.0.99"}
+    tc_commands = sum(
+        len(tc_setup_commands(spec, f"node:{i}", peers)) for i in range(2))
+
+    # Loopback echo server the proxy forwards to.
+    server = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    server.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", 0))
+    server.listen(4)
+    server.settimeout(10.0)
+
+    def _echo():
+        try:
+            while True:
+                conn, _ = server.accept()
+                conn.settimeout(10.0)
+                try:
+                    data = conn.recv(64)
+                    if data:
+                        conn.sendall(data)
+                finally:
+                    conn.close()
+        except OSError:
+            pass
+
+    _threading.Thread(target=_echo, daemon=True).start()
+    shape = spec.links[0].shape if spec.links else None
+    proxy = WanProxy(server.getsockname(), shape=shape)
+    proxy.start()
+    try:
+        if not proxy.wait_ready(10.0):
+            raise RuntimeError("WanProxy readiness gate never passed")
+        def _roundtrip():
+            with _socket.create_connection(("127.0.0.1", proxy.port),
+                                           timeout=10.0) as c:
+                c.settimeout(10.0)
+                c.sendall(b"ping")
+                return c.recv(64)
+
+        def _try_roundtrip(attempts=5):
+            # A lossy shape DROPS connections by design (see WanProxy);
+            # a dialing peer just reconnects, so the probe does too.  A
+            # spec lossy enough to defeat every attempt reports
+            # ok/healed False rather than erroring the whole sub-field.
+            # Returns the RTT of the one SUCCESSFUL attempt (None if
+            # all fail): timing the whole retry loop would fold failed
+            # dials and dropped attempts into the published number.
+            for _ in range(attempts):
+                try:
+                    t0 = time.perf_counter()
+                    if _roundtrip() == b"ping":
+                        return (time.perf_counter() - t0) * 1e3
+                except OSError:
+                    pass
+            return None
+
+        rtt_ms = _try_roundtrip()
+        proxy.partition()
+        try:
+            partitioned = _roundtrip() != b"ping"
+        except OSError:
+            partitioned = True  # dropped connection IS the black-hole
+        proxy.heal()
+        healed = _try_roundtrip() is not None
+        return {
+            "links": spec.link_names(),
+            "tc_commands": tc_commands,
+            "proxy_roundtrip_ms": round(rtt_ms, 3)
+            if rtt_ms is not None else None,
+            "roundtrip_ok": rtt_ms is not None,
+            "partition_enforced": partitioned,
+            "healed": healed,
+        }
+    finally:
+        proxy.stop()
+        server.close()
+
+
+def chaos_headline_probe(plan_spec=None, wan_spec=None,
+                         slo_spec=None) -> dict:
     """The headline's ``chaos`` field: prove the graftchaos pipeline end
     to end without booting a committee.  The fault plan (the passed
     ``--fault-plan``, or a miniature default) runs through the REAL
@@ -575,11 +691,16 @@ def chaos_headline_probe(plan_spec=None) -> dict:
     logs/chaos-events.json; and recovery latencies come from the same
     ``summarize_recovery`` the LogParser folds into a live run summary —
     commits are synthesized 250 ms after each event, so a healthy
-    pipeline reports ``recovered: true`` with per-event latencies."""
+    pipeline reports ``recovered: true`` with per-event latencies.
+
+    graftwan: the recoveries are additionally judged against the
+    per-fault-class SLO table (``slo`` sub-field, chaos/slo.judge — the
+    same verdicts the LogParser raises on), and the WAN link-shape
+    pipeline is proven by ``wan_headline_probe`` (``wan`` sub-field)."""
     import json as _json
 
-    from hotstuff_tpu.chaos import PlanRunner, parse_plan, \
-        summarize_recovery
+    from hotstuff_tpu.chaos import PlanRunner, judge, parse_plan, \
+        parse_slos, summarize_recovery
 
     plan = parse_plan(plan_spec if plan_spec else _DEFAULT_CHAOS_SPEC)
 
@@ -605,6 +726,11 @@ def chaos_headline_probe(plan_spec=None) -> dict:
     events = _json.loads(_json.dumps(runner.events()))
     commits = [e["wall"] + 0.25 for e in events]
     summary = summarize_recovery(events, commits)
+    slo_verdict = judge(summary, parse_slos(slo_spec))
+    try:
+        wan = wan_headline_probe(wan_spec)
+    except Exception as e:  # noqa: BLE001 — sub-probe isolation
+        wan = {"error": f"{e!r:.120}"}
     return {
         "plan_events": len(plan.events),
         "executed": len(events),
@@ -612,6 +738,8 @@ def chaos_headline_probe(plan_spec=None) -> dict:
         "injected_ok": summary["injected_ok"],
         "max_recovery_ms": summary["max_recovery_ms"],
         "events": summary["events"],
+        "slo": slo_verdict,
+        "wan": wan,
     }
 
 
@@ -752,7 +880,8 @@ def run_degraded(reason: str):
         except Exception as e:  # noqa: BLE001 — telemetry is best-effort
             sched = {"error": f"{e!r:.120}"}
         try:
-            chaos = chaos_headline_probe(_FAULT_PLAN)
+            chaos = chaos_headline_probe(_FAULT_PLAN, _WAN_SPEC,
+                                         _SLO_SPEC)
         except Exception as e:  # noqa: BLE001 — chaos probe is best-effort
             chaos = {"error": f"{e!r:.120}"}
         # The watchdog stays armed until the moment of the real emit: a
@@ -902,12 +1031,16 @@ def main(argv=None):
     # this bench does not own.
     import argparse
 
-    global _FAULT_PLAN
+    global _FAULT_PLAN, _WAN_SPEC, _SLO_SPEC
     ap = argparse.ArgumentParser(add_help=False)
     ap.add_argument("--fault-plan", default=None)
+    ap.add_argument("--wan", default=None)
+    ap.add_argument("--slo", default=None)
     known, _ = ap.parse_known_args(argv)
     _FAULT_PLAN = known.fault_plan \
         or os.environ.get("HOTSTUFF_TPU_FAULT_PLAN") or None
+    _WAN_SPEC = known.wan or os.environ.get("HOTSTUFF_TPU_WAN") or None
+    _SLO_SPEC = known.slo or os.environ.get("HOTSTUFF_TPU_SLO") or None
 
     # Watchdog: the tunneled TPU can wedge indefinitely (observed: a plain
     # 8x8 matmul never returning).  A hung bench is worse than a failed
@@ -1013,7 +1146,7 @@ def main(argv=None):
     except Exception as e:  # noqa: BLE001 — telemetry is best-effort
         sched = {"error": f"{e!r:.120}"}
     try:
-        chaos = chaos_headline_probe(_FAULT_PLAN)
+        chaos = chaos_headline_probe(_FAULT_PLAN, _WAN_SPEC, _SLO_SPEC)
     except Exception as e:  # noqa: BLE001 — chaos probe is best-effort
         chaos = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,

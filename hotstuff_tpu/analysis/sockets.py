@@ -14,16 +14,25 @@ Rule:
   unbounded-socket-op   a socket ``connect``/``accept``/``recv``/
                         ``recv_into`` call (or ``create_connection``
                         without a timeout argument) with no visible
-                        bound in its scope
+                        bound in its scope; also a ``subprocess.run``
+                        whose argv is ssh/scp with no ``timeout=``
+                        keyword — ssh's ConnectTimeout bounds the
+                        *dial*, not a hung remote command, so an
+                        unbounded ssh subprocess is the same parked
+                        thread a bare ``recv`` is (graftwan widened
+                        the rule to ``harness/remote.py`` for exactly
+                        this: a wedged fleet host must surface as an
+                        error, never hang the orchestrator)
 
 Receiver detection is deliberately name-based (identifiers containing
-``sock``/``socket``/``conn``), not dataflow: the boundary modules use
-conventional socket names, bare parameters carry no assignment history,
-and a rename that dodges the rule is exactly the kind of edit a reviewer
-should see.  The one deliberately unbounded op in the tree — the
-server-side frame read idling between requests in
-``sidecar/protocol._read_exact`` — carries the inline suppression with
-its rationale, per the suppression policy in analysis/README.md.
+``sock``/``socket``/``conn``; argv expressions mentioning ``ssh``/
+``scp``), not dataflow: the boundary modules use conventional socket
+names, bare parameters carry no assignment history, and a rename that
+dodges the rule is exactly the kind of edit a reviewer should see.  The
+one deliberately unbounded op in the tree — the server-side frame read
+idling between requests in ``sidecar/protocol._read_exact`` — carries
+the inline suppression with its rationale, per the suppression policy
+in analysis/README.md.
 """
 
 from __future__ import annotations
@@ -45,6 +54,7 @@ DEFAULT_TARGETS = (
 
 _SOCKET_NAME_RE = re.compile(r"sock|socket|conn", re.IGNORECASE)
 _SOCKET_OPS = {"connect", "accept", "recv", "recv_into", "recvfrom"}
+_SSH_ARGV_RE = re.compile(r"\bssh\b|\bscp\b|_ssh_", re.IGNORECASE)
 
 
 def _last_ident(node: ast.AST):
@@ -90,10 +100,28 @@ def _has_timeout_arg(call: ast.Call) -> bool:
     if len(call.args) >= 2:
         a = call.args[1]
         return not (isinstance(a, ast.Constant) and a.value is None)
+    return _has_timeout_kwarg(call)
+
+
+def _has_timeout_kwarg(call: ast.Call) -> bool:
     for kw in call.keywords:
         if kw.arg == "timeout":
             return not (isinstance(kw.value, ast.Constant)
                         and kw.value.value is None)
+    return False
+
+
+def _mentions_ssh(node: ast.AST) -> bool:
+    """True when an argv expression visibly involves ssh/scp: a string
+    literal naming the binary, or an identifier like ``_ssh_base``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and _SSH_ARGV_RE.search(sub.value):
+            return True
+        if isinstance(sub, ast.Name) and _SSH_ARGV_RE.search(sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and _SSH_ARGV_RE.search(sub.attr):
+            return True
     return False
 
 
@@ -113,6 +141,17 @@ def check_source(path: str, source: str) -> list:
                 ident = _last_ident(func.value)
                 if ident:
                     bounded.add(ident)
+            elif func.attr == "run" and isinstance(func.value, ast.Name) \
+                    and func.value.id == "subprocess":
+                if node.args and _mentions_ssh(node.args[0]) \
+                        and not _has_timeout_kwarg(node):
+                    findings.append(Finding(
+                        path, node.lineno, "unbounded-socket-op",
+                        "subprocess.run of an ssh/scp argv without a "
+                        "timeout= keyword: ssh's ConnectTimeout bounds "
+                        "the dial, not a hung remote command — a wedged "
+                        "fleet host parks this thread forever; pass an "
+                        "explicit subprocess timeout"))
             elif func.attr == "create_connection":
                 if not _has_timeout_arg(node):
                     findings.append(Finding(

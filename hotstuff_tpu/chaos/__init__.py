@@ -15,13 +15,25 @@ package is the declarative half of that testing story:
   recovery.py  per-fault recovery latency from the executed events and
                the committee's commit timeline (shared by the harness
                LogParser and bench.py's ``chaos`` headline field)
+  netem.py     graftwan link shaping: per-host-pair WAN specs compiled
+               to ``tc netem`` for fleets, with a root-free userspace
+               TCP proxy (``WanProxy``) so local/CI runs exercise the
+               identical plan schema
+  slo.py       per-fault-class recovery SLOs: pass/fail verdicts over
+               the recovery summary (shared by LogParser notes, the
+               strict testbed assertion, and the bench headline)
 
 The harness side (process murder, SIGSTOP partitions, sidecar chaos
-RPCs) lives in ``hotstuff_tpu/harness/faults.py``; the sidecar's
-in-process fault hook (``OP_CHAOS``) in ``sidecar/service.py``.
+RPCs, remote ssh injection) lives in ``hotstuff_tpu/harness/faults.py``;
+the sidecar's in-process fault hook (``OP_CHAOS``) in
+``sidecar/service.py``.
 """
 
-from .plan import ACTIONS, FaultEvent, FaultPlan, PlanError, node_index, \
-    parse_plan  # noqa: F401
+from .netem import LinkShape, WanError, WanProxy, WanSpec, \
+    parse_wan  # noqa: F401
+from .plan import ACTIONS, FaultEvent, FaultPlan, PlanError, link_name, \
+    node_index, parse_plan  # noqa: F401
 from .recovery import summarize_recovery  # noqa: F401
 from .runner import PlanRunner  # noqa: F401
+from .slo import DEFAULT_SLO_MS, SloError, fault_class, judge, \
+    parse_slos  # noqa: F401

@@ -7,6 +7,8 @@ knowledge; the runner hands each due event to an injector (the harness's
 Targets
     ``sidecar``      the verify sidecar process
     ``node:<i>``     replica i of the local committee (boot order index)
+    ``link:<name>``  a directed WAN link by its graftwan spec label
+                     (chaos/netem.py) — requires a WAN spec on the run
 
 Actions (per target)
     node:     ``kill`` (SIGKILL), ``restart`` (reboot on the same store),
@@ -19,11 +21,16 @@ Actions (per target)
               forced queue-full sheds) for testing client-side handling
               without process murder.  ``degrade`` params ride in the
               event's ``params`` dict (see sidecar/service.ChaosState).
+    link:     ``partition`` (the link black-holes: netem ``loss 100%``
+              remotely, a dropped WanProxy locally) and ``heal``
+              (restore the spec shape) — the netem partition-heal fault
+              class, measured like every other event.
 
 Validation is a per-target state machine over the time-ordered events:
-``restart`` must follow ``kill``, ``resume`` must follow ``pause``, and
-``degrade`` needs a live sidecar — a plan that cannot physically execute
-fails at parse time, not five seconds into a thirty-second bench.
+``restart`` must follow ``kill``, ``resume`` must follow ``pause``,
+``heal`` must follow ``partition``, and ``degrade`` needs a live
+sidecar — a plan that cannot physically execute fails at parse time,
+not five seconds into a thirty-second bench.
 """
 
 from __future__ import annotations
@@ -33,10 +40,12 @@ import os
 import re
 from dataclasses import dataclass, field
 
-ACTIONS = ("kill", "restart", "pause", "resume", "degrade")
+ACTIONS = ("kill", "restart", "pause", "resume", "degrade",
+           "partition", "heal")
 SIDECAR = "sidecar"
 
 _NODE_RE = re.compile(r"^node:(\d+)$")
+_LINK_RE = re.compile(r"^link:(\S+)$")
 
 
 def node_index(target: str):
@@ -45,11 +54,18 @@ def node_index(target: str):
     m = _NODE_RE.match(target)
     return int(m.group(1)) if m else None
 
+
+def link_name(target: str):
+    """``"link:<name>"`` -> the graftwan link label, else None."""
+    m = _LINK_RE.match(target)
+    return m.group(1) if m else None
+
 # Actions each target kind accepts (sidecar pause would stop the shared
 # verify engine for EVERY replica at once — use degrade for that class
 # of fault instead, it is observable and bounded).
 _NODE_ACTIONS = {"kill", "restart", "pause", "resume"}
 _SIDECAR_ACTIONS = {"kill", "restart", "degrade"}
+_LINK_ACTIONS = {"partition", "heal"}
 
 # degrade params the sidecar's ChaosState accepts (mirrored there; the
 # plan validates early so a typo fails at parse time).
@@ -90,6 +106,16 @@ class FaultPlan:
             i = node_index(e.target)
             if i is not None:
                 out.add(i)
+        return out
+
+    def link_names(self) -> set:
+        """Every graftwan link the plan faults (validated against the
+        run's WAN spec by the harness before anything boots)."""
+        out = set()
+        for e in self.events:
+            name = link_name(e.target)
+            if name is not None:
+                out.add(name)
         return out
 
     def max_time(self) -> float:
@@ -149,9 +175,11 @@ def _validate(events) -> FaultPlan:
             allowed = _SIDECAR_ACTIONS
         elif _NODE_RE.match(e.target):
             allowed = _NODE_ACTIONS
+        elif _LINK_RE.match(e.target):
+            allowed = _LINK_ACTIONS
         else:
-            raise PlanError(f"{e.label()}: target must be 'sidecar' or "
-                            "'node:<i>'")
+            raise PlanError(f"{e.label()}: target must be 'sidecar', "
+                            "'node:<i>', or 'link:<name>'")
         if e.action not in allowed:
             raise PlanError(f"{e.label()}: {e.target} does not support "
                             f"{e.action} (allowed: {', '.join(sorted(allowed))})")
@@ -184,9 +212,14 @@ def _validate(events) -> FaultPlan:
             raise PlanError(f"{e.label()}: resume must follow a pause")
         if e.action == "degrade" and cur != "up":
             raise PlanError(f"{e.label()}: degrade needs a live sidecar")
+        if e.action == "partition" and cur != "up":
+            raise PlanError(f"{e.label()}: link is already partitioned")
+        if e.action == "heal" and cur != "partitioned":
+            raise PlanError(f"{e.label()}: heal must follow a partition")
         state[e.target] = {"kill": "down", "restart": "up",
                            "pause": "paused", "resume": "up",
-                           "degrade": "up"}[e.action]
+                           "degrade": "up", "partition": "partitioned",
+                           "heal": "up"}[e.action]
     return FaultPlan(tuple(ordered))
 
 

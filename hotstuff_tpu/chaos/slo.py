@@ -1,0 +1,147 @@
+"""Per-fault-class recovery SLOs: pass/fail verdicts over the recovery
+summary instead of the bare "commits resume" assertion.
+
+A fault class is the target kind plus the action (``node-kill``,
+``sidecar-degrade``, ``link-heal``, ...), and the SLO is the maximum
+recovery latency — first commit after the event — the class is allowed
+to cost.  ``judge`` turns ``summarize_recovery`` output into per-event
+verdicts the LogParser surfaces as notes (and raises on, under the
+strict testbed assertion) and bench.py folds into the ``chaos``
+headline, so "recovered" always means "recovered fast enough", not
+merely "eventually".
+
+Defaults are deliberately generous multiples of the local testbed's
+view-change budget (timeout_delay defaults to 5 s and a kill can
+legitimately cost a couple of view changes plus the node-side circuit
+breaker's probe backoff); deployments with tighter targets override
+per class via ``--slo`` (file / dict / inline ``"node-kill=8000;
+link-heal=3000"``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+from .plan import SIDECAR, link_name, node_index
+
+# class -> max recovery_ms (the table --slo overlays).
+DEFAULT_SLO_MS = {
+    "node-kill": 30_000.0,
+    "node-restart": 20_000.0,
+    "node-pause": 30_000.0,
+    "node-resume": 20_000.0,
+    "sidecar-kill": 15_000.0,
+    "sidecar-restart": 15_000.0,
+    "sidecar-degrade": 10_000.0,
+    "link-partition": 30_000.0,
+    "link-heal": 20_000.0,
+}
+
+
+class SloError(ValueError):
+    """Malformed SLO table spec."""
+
+
+def fault_class(event: dict) -> str:
+    """Executed-event dict (PlanRunner.events shape) -> fault class."""
+    target = str(event.get("target", ""))
+    if target == SIDECAR:
+        kind = "sidecar"
+    elif node_index(target) is not None:
+        kind = "node"
+    elif link_name(target) is not None:
+        kind = "link"
+    else:
+        kind = "unknown"
+    return f"{kind}-{event.get('action')}"
+
+
+def parse_slos(spec) -> dict:
+    """Full SLO table (defaults overlaid with the spec's overrides) from
+    None / a dict / a JSON file path / an inline ``"class=ms;..."``
+    string.  Unknown classes and non-positive values fail here, not as a
+    silently never-matching verdict."""
+    table = dict(DEFAULT_SLO_MS)
+    if spec is None:
+        return table
+    if isinstance(spec, str):
+        if os.path.isfile(spec):
+            try:
+                with open(spec, encoding="utf-8") as f:
+                    spec = json.load(f)
+            except (OSError, ValueError) as e:
+                raise SloError(f"cannot read SLO table {spec!r}: {e}")
+        else:
+            entries = [e for e in re.split(r"[;\n]", spec) if e.strip()]
+            if not entries:
+                raise SloError("empty SLO spec")
+            parsed = {}
+            for entry in entries:
+                if "=" not in entry:
+                    raise SloError(f"bad SLO entry {entry!r} "
+                                   "(want class=ms)")
+                k, v = entry.split("=", 1)
+                parsed[k.strip()] = v.strip()
+            spec = parsed
+    if not isinstance(spec, dict):
+        raise SloError(f"unsupported SLO spec type {type(spec).__name__}")
+    for cls, raw in spec.items():
+        if cls not in DEFAULT_SLO_MS:
+            raise SloError(
+                f"unknown fault class {cls!r} (have "
+                f"{', '.join(sorted(DEFAULT_SLO_MS))})")
+        try:
+            ms = float(raw)
+        except (TypeError, ValueError):
+            raise SloError(f"SLO for {cls} must be a number (got {raw!r})")
+        if not ms > 0 or ms != ms or ms == float("inf"):
+            raise SloError(f"SLO for {cls} must be finite > 0 (got {ms:g})")
+        table[cls] = ms
+    return table
+
+
+def judge(summary: dict, slos: dict | None = None) -> dict:
+    """``summarize_recovery`` output + SLO table -> JSON-safe verdicts::
+
+        {"verdicts": [{"label", "class", "recovery_ms", "slo_ms",
+                       "ok", "reason"}, ...],
+         "ok": bool,                 # every event inside its SLO
+         "worst_headroom_ms": float} # min(slo - recovery); negative = miss
+
+    A failed injection or an unrecovered event fails its verdict (an SLO
+    cannot be met by a fault that never resolved), so ``ok`` subsumes
+    the old bare liveness assertion.
+    """
+    from .recovery import event_label
+
+    table = parse_slos(None)
+    if slos:
+        table.update(slos)
+    verdicts = []
+    worst = None
+    for e in summary.get("events", []):
+        cls = fault_class(e)
+        slo_ms = table.get(cls)
+        v = {"label": event_label(e), "class": cls,
+             "recovery_ms": e.get("recovery_ms"), "slo_ms": slo_ms}
+        if slo_ms is None:
+            v.update(ok=False, reason=f"no SLO for class {cls!r}")
+        elif not e.get("ok", True):
+            v.update(ok=False, reason="injection failed")
+        elif not e.get("recovered"):
+            v.update(ok=False, reason="no commit after event")
+        else:
+            headroom = slo_ms - e["recovery_ms"]
+            worst = headroom if worst is None else min(worst, headroom)
+            v.update(ok=e["recovery_ms"] <= slo_ms,
+                     reason="" if e["recovery_ms"] <= slo_ms else
+                     f"recovery {e['recovery_ms']:g} ms > SLO "
+                     f"{slo_ms:g} ms")
+        verdicts.append(v)
+    return {
+        "verdicts": verdicts,
+        "ok": all(v["ok"] for v in verdicts),
+        "worst_headroom_ms": worst if worst is not None else 0.0,
+    }

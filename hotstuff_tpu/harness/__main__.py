@@ -31,6 +31,9 @@ def cmd_local(args):
         "sidecar_mesh": args.sidecar_mesh,
         "scheme": args.scheme,
         "fault_plan": args.fault_plan,
+        "wan": args.wan,
+        "slo": args.slo,
+        "twins": args.twins,
     })
     node_params = NodeParameters.default(
         tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
@@ -53,21 +56,29 @@ def cmd_local(args):
 def cmd_aggregate(args):
     from .aggregate import LogAggregator
 
-    LogAggregator(max_latencies=args.max_latency).print()
-    print("aggregated series written to plots/")
+    agg = LogAggregator(max_latencies=args.max_latency)
+    agg.print()
+    agg.print_matrix()
+    print("aggregated series + matrix written to plots/")
 
 
 def cmd_plot(args):
     from .aggregate import LogAggregator
     from .plot import Ploter, PlotError
 
-    LogAggregator(max_latencies=args.max_latency).print()
+    agg = LogAggregator(max_latencies=args.max_latency)
+    agg.print()
+    agg.print_matrix()
     try:
         ploter = Ploter()
         ploter.plot_latency()
         ploter.plot_robustness()
         if args.max_latency:
             ploter.plot_tps()
+        try:
+            ploter.plot_matrix()
+        except PlotError:
+            pass  # a single-cell matrix has nothing to draw
         print("plots written to plots/")
     except PlotError as e:
         print(f"plot failed: {e}")
@@ -124,7 +135,9 @@ def cmd_remote(args):
             "runs": args.runs,
         })
         node_params = NodeParameters.default(chain=args.chain)
-        bench = Bench(settings, hosts, user=args.user)
+        bench = Bench(settings, hosts, user=args.user,
+                      fault_plan=args.fault_plan, wan=args.wan,
+                      slos=args.slo)
         if args.install:
             bench.install()
         if args.update:
@@ -235,6 +248,24 @@ def main(argv=None):
                         "12 node:1 pause; 15 node:1 resume' (times are "
                         "seconds into the run window; the summary "
                         "reports per-fault recovery latency)")
+    p.add_argument("--wan", default=None, metavar="PATH|SPEC",
+                   help="graftwan link-shape spec (chaos/netem.py): a "
+                        "JSON file or inline DSL like 'node:0>sidecar "
+                        "latency_ms=40 loss_pct=0.5 name=sc'; realized "
+                        "locally by userspace WanProxy instances, so "
+                        "link:<name> fault-plan events can partition/"
+                        "heal the named links")
+    p.add_argument("--slo", default=None, metavar="PATH|SPEC",
+                   help="per-fault-class recovery SLO table overrides "
+                        "(chaos/slo.py): a JSON file or inline "
+                        "'node-kill=8000; link-heal=3000' (ms); chaos "
+                        "recovery is judged pass/fail against the table")
+    p.add_argument("--twins", action="store_true",
+                   help="boot a Twins-style equivocating sibling of "
+                        "replica 0 (same keypair, own ports; the honest "
+                        "committee splits across the two views) and "
+                        "hold the run to the strict no-conflicting-"
+                        "commits safety assertion")
     p.add_argument("--debug", action="store_true")
     p.add_argument("--output", help="append summary to this result file")
     p.set_defaults(func=cmd_local)
@@ -274,6 +305,16 @@ def main(argv=None):
                    help="install toolchain on hosts first")
     p.add_argument("--update", action="store_true",
                    help="git pull + rebuild on hosts first")
+    p.add_argument("--fault-plan", default=None, metavar="PATH|SPEC",
+                   help="graftchaos fault plan executed across the fleet "
+                        "mid-run over ssh (same schema as local)")
+    p.add_argument("--wan", default=None, metavar="PATH|SPEC",
+                   help="graftwan link-shape spec compiled to per-host "
+                        "'tc qdisc netem' egress shaping (same schema "
+                        "as local; needs sudo tc on the hosts)")
+    p.add_argument("--slo", default=None, metavar="PATH|SPEC",
+                   help="per-fault-class recovery SLO table overrides "
+                        "(same schema as local)")
     p.add_argument("--debug", action="store_true")
     p.set_defaults(func=cmd_remote)
 

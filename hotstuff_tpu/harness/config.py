@@ -105,6 +105,41 @@ class LocalCommittee(Committee):
                          bls_pubkeys=bls_pubkeys)
 
 
+def twin_committee(committee, index, port):
+    """Committee view for a Twins-style equivocating replica (Bano et
+    al.): the SAME identity as replica ``index`` — same keypair, same
+    authority entry for every peer — but with its OWN entry's addresses
+    remapped to three consecutive ports from ``port``, so the twin
+    process binds fresh sockets while signing as its sibling.
+
+    The harness boots the twin with this view and splits the honest
+    committee across the two views (half dial the original's ports,
+    half the twin's), so BOTH replicas sharing the key receive votes
+    and either can propose in the shared identity's leader slots —
+    scripted equivocation, which safety must contain (the LogParser's
+    conflicting-commit assertion), not merely survive.
+    """
+    import copy
+
+    assert 0 <= index < len(committee.names)
+    name = committee.names[index]
+    data = copy.deepcopy(committee.json)
+    data["consensus"]["authorities"][name]["address"] = \
+        f"127.0.0.1:{port}"
+    entry = data["mempool"]["authorities"][name]
+    entry["transactions_address"] = f"127.0.0.1:{port + 1}"
+    entry["mempool_address"] = f"127.0.0.1:{port + 2}"
+    return data
+
+
+def write_committee_json(data, filename):
+    """Write a committee JSON view (twin_committee output) in the same
+    format Committee.print uses, so the C++ reader sees no difference."""
+    assert isinstance(filename, str)
+    with open(filename, "w") as f:
+        json.dump(data, f, indent=4, sort_keys=True)
+
+
 class NodeParameters:
     def __init__(self, json_input):
         inputs = []
@@ -207,6 +242,13 @@ class BenchParameters:
             # graftchaos: a fault-plan spec (path / inline DSL string /
             # event list); parsed + validated by LocalBench.
             self.fault_plan = json_input.get("fault_plan")
+            # graftwan: a WAN link-shape spec and a recovery-SLO table
+            # (each a path / inline DSL / dict), and the Twins toggle
+            # (boot an equivocating sibling of replica 0); parsed +
+            # validated by the bench.
+            self.wan = json_input.get("wan")
+            self.slo = json_input.get("slo")
+            self.twins = bool(json_input.get("twins", False))
         except KeyError as e:
             raise ConfigError(f"Malformed bench parameters: missing key {e}")
         except ValueError:

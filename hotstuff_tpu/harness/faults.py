@@ -1,12 +1,17 @@
-"""Harness fault injector: turns graftchaos plan events into process
-signals and sidecar RPCs against a running LocalBench.
+"""Harness fault injectors: turn graftchaos plan events into process
+signals, sidecar RPCs, and link faults against a running bench —
+locally (``LocalFaultInjector``) or across an ssh fleet
+(``RemoteFaultInjector``).
 
 Separation of concerns: ``hotstuff_tpu/chaos`` owns *what happens when*
-(plan model, runner thread, recovery math); this module owns *how* —
-which pid gets which signal, how a replica reboots on the same store,
-and how the sidecar's OP_CHAOS hook is reached.  The injector is handed
-the LocalBench instance itself, which tracks per-node boot commands and
-live processes exactly for this purpose.
+(plan model, runner thread, recovery math, link-shape compilation); this
+module owns *how* — which pid gets which signal, how a replica reboots
+on the same store, how the sidecar's OP_CHAOS hook is reached, and
+which host's ``tc`` gets the partition.  The local injector is handed
+the LocalBench instance itself, which tracks per-node boot commands,
+live processes, and WAN proxies exactly for this purpose; the remote
+injector is handed the RemoteRunner transport plus the per-host boot
+records the remote Bench keeps.
 
 Design notes:
   * kill is SIGKILL on the whole process group — no clean shutdown, the
@@ -27,10 +32,11 @@ Design notes:
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 
-from ..chaos.plan import SIDECAR, FaultEvent, node_index
+from ..chaos.plan import SIDECAR, FaultEvent, link_name, node_index
 
 
 class InjectionError(RuntimeError):
@@ -46,6 +52,10 @@ class LocalFaultInjector:
         if event.target == SIDECAR:
             fn = getattr(self, f"_sidecar_{event.action}")
             fn(**event.params)
+            return
+        name = link_name(event.target)
+        if name is not None:
+            getattr(self, f"_link_{event.action}")(name)
             return
         i = node_index(event.target)
         if i is None:
@@ -132,3 +142,232 @@ class LocalFaultInjector:
             raise InjectionError(
                 "sidecar is running without --chaos; the plan's degrade "
                 "event cannot be expressed")
+
+    # -- graftwan links -----------------------------------------------------
+
+    def _proxy(self, name: str):
+        proxy = getattr(self._bench, "_wan_proxies", {}).get(name)
+        if proxy is None:
+            raise InjectionError(
+                f"no WAN proxy realizes link {name!r} on this run "
+                "(pass --wan with a spec naming it)")
+        return proxy
+
+    def _link_partition(self, name: str):
+        self._proxy(name).partition()
+
+    def _link_heal(self, name: str):
+        self._proxy(name).heal()
+
+
+class RemoteFaultInjector:
+    """Executes fault plans across an ssh fleet (harness/remote.Bench).
+
+    Same plan schema as the local injector; the mechanisms change:
+
+      * node kill/pause/resume are ``pkill`` signals against the node
+        pattern on that replica's host (one node per host, the remote
+        bench's layout) — ``pkill`` exiting non-zero means no process
+        matched, which is an injection failure, not a transport one;
+      * node restart re-runs the recorded boot command via the
+        background wrapper in APPEND mode, so the pre-fault log
+        survives for the parser (the same same-store contract as the
+        local injector);
+      * link partition/heal compile to ``tc qdisc change`` on every
+        host whose egress carries the link (chaos/netem.py owns the
+        band numbering; the commands target the qdiscs ``Bench``
+        installed from the same spec);
+      * sidecar degrade reaches OP_CHAOS through a python one-liner on
+        the sidecar host's checkout (the RPC must originate next to the
+        sidecar: its port is not assumed reachable from the
+        orchestrator); kill/restart pkill + reboot it there.  All three
+        need a configured sidecar host — a plan demanding a fault the
+        deployment cannot express fails the injection, same contract as
+        a --chaos-less local sidecar.
+
+    Event wall stamps are taken by the PlanRunner on the orchestrator's
+    clock, while recovery comes from commit stamps in REMOTE logs —
+    per-fault recovery latency on a fleet therefore carries the fleet's
+    clock skew, exactly like the reference's measurement pipeline (its
+    client/node stamps span hosts too).  NTP-synced fleets keep this in
+    the low milliseconds.
+    """
+
+    # Bracketed dot: the ssh wrapper shell's own cmdline contains this
+    # pattern verbatim, and a regex that matches its own text makes
+    # ``pkill -f`` signal the wrapper too (a -KILL turns into rc=137 on
+    # a successful injection; a -STOP parks the ssh session until the
+    # transport timeout).  ``[.]`` matches the node's literal dot but
+    # not the bracketed pattern text itself.
+    NODE_PATTERN = r"[.]/node run"
+    SIDECAR_PATTERN = r"hotstuff_tpu[.]sidecar"
+
+    # Injections are milliseconds of remote work (pkill, tc change, one
+    # RPC); never let one share the transport's install-sized default
+    # bound — a wedged host must fail the EVENT fast, not stall the
+    # PlanRunner past the run window.
+    INJECT_TIMEOUT_S = 60.0
+
+    def __init__(self, runner, hosts, repo, node_boots, wan=None,
+                 peers=None, dev="eth0", sidecar_host=None,
+                 sidecar_port=7100, sidecar_boot=None):
+        self._runner = runner
+        self._hosts = list(hosts)
+        self._repo = repo
+        # {i: (command, log_file)} recorded by Bench._run_single.
+        self._node_boots = dict(node_boots)
+        self._wan = wan
+        self._peers = dict(peers or {})
+        self._dev = dev
+        self._sidecar_host = sidecar_host
+        self._sidecar_port = sidecar_port
+        self._sidecar_boot = sidecar_boot
+        self._paused: set[int] = set()
+
+    def apply(self, event: FaultEvent):
+        if event.target == SIDECAR:
+            getattr(self, f"_sidecar_{event.action}")(**event.params)
+            return
+        name = link_name(event.target)
+        if name is not None:
+            getattr(self, f"_link_{event.action}")(name)
+            return
+        i = node_index(event.target)
+        if i is None:
+            raise InjectionError(f"unknown target {event.target!r}")
+        getattr(self, f"_node_{event.action}")(i)
+
+    def cleanup(self):
+        """SIGCONT any host still paused (mirrors the local injector:
+        teardown's pkill queues behind a SIGSTOP forever otherwise)."""
+        for i in sorted(self._paused):
+            try:
+                self._pkill(i, "CONT")
+            except InjectionError:
+                pass
+        self._paused.clear()
+
+    # -- nodes --------------------------------------------------------------
+
+    def _host(self, i: int) -> str:
+        if not 0 <= i < len(self._hosts):
+            raise InjectionError(f"node {i} has no host (fleet of "
+                                 f"{len(self._hosts)})")
+        return self._hosts[i]
+
+    def _run(self, host, command, what):
+        from .remote import ExecutionError
+
+        try:
+            self._runner.run(host, command,
+                             timeout=self.INJECT_TIMEOUT_S)
+        except ExecutionError as e:
+            raise InjectionError(f"{what} failed on {host}: {e}")
+
+    def _pkill(self, i: int, sig: str, pattern=None):
+        self._run(self._host(i),
+                  f"pkill -{sig} -f '{pattern or self.NODE_PATTERN}'",
+                  f"node {i} pkill -{sig}")
+
+    def _node_kill(self, i: int):
+        self._pkill(i, "KILL")
+        self._paused.discard(i)
+
+    def _node_restart(self, i: int):
+        from .remote import ExecutionError
+
+        boot = self._node_boots.get(i)
+        if boot is None:
+            raise InjectionError(f"node {i} has no recorded boot command")
+        cmd, log = boot
+        try:
+            self._runner.run_background(self._host(i), cmd, log,
+                                        append=True,
+                                        timeout=self.INJECT_TIMEOUT_S)
+        except ExecutionError as e:
+            raise InjectionError(f"node {i} restart failed: {e}")
+
+    def _node_pause(self, i: int):
+        self._pkill(i, "STOP")
+        self._paused.add(i)
+
+    def _node_resume(self, i: int):
+        self._pkill(i, "CONT")
+        self._paused.discard(i)
+
+    # -- graftwan links -----------------------------------------------------
+
+    def _link_tc(self, name: str, compile_fn, what: str):
+        from ..chaos.netem import WanError
+
+        if self._wan is None:
+            raise InjectionError(
+                f"plan faults link {name!r} but this run shapes no WAN "
+                "(pass --wan)")
+        if self._wan.by_name(name) is None:
+            raise InjectionError(f"WAN spec names no link {name!r}")
+        ran = 0
+        for i, host in enumerate(self._hosts):
+            try:
+                cmds = compile_fn(self._wan, name, f"node:{i}",
+                                  self._peers, self._dev)
+            except WanError as e:
+                raise InjectionError(f"link {name!r}: {e}")
+            for cmd in cmds:
+                self._run(host, cmd, f"link {name!r} {what}")
+                ran += 1
+        if not ran:
+            raise InjectionError(
+                f"link {name!r} touches no egress on this fleet "
+                "(src/dst outside the booted hosts)")
+
+    def _link_partition(self, name: str):
+        from ..chaos.netem import tc_partition_commands
+
+        self._link_tc(name, tc_partition_commands, "partition")
+
+    def _link_heal(self, name: str):
+        from ..chaos.netem import tc_heal_commands
+
+        self._link_tc(name, tc_heal_commands, "heal")
+
+    # -- sidecar ------------------------------------------------------------
+
+    def _sidecar_host_or_fail(self) -> str:
+        if not self._sidecar_host:
+            raise InjectionError(
+                "plan targets the sidecar but this fleet runs none "
+                "(configure a sidecar host)")
+        return self._sidecar_host
+
+    def _sidecar_kill(self):
+        host = self._sidecar_host_or_fail()
+        self._run(host, f"pkill -KILL -f '{self.SIDECAR_PATTERN}'",
+                  "sidecar pkill -KILL")
+
+    def _sidecar_restart(self):
+        from .remote import ExecutionError
+
+        host = self._sidecar_host_or_fail()
+        if self._sidecar_boot is None:
+            raise InjectionError("sidecar has no recorded boot command")
+        cmd, log = self._sidecar_boot
+        try:
+            self._runner.run_background(host, cmd, log, append=True,
+                                        timeout=self.INJECT_TIMEOUT_S)
+        except ExecutionError as e:
+            raise InjectionError(f"sidecar restart failed: {e}")
+
+    def _sidecar_degrade(self, **params):
+        import shlex
+
+        host = self._sidecar_host_or_fail()
+        snippet = (
+            "import json, sys; "
+            "from hotstuff_tpu.sidecar.client import SidecarClient; "
+            f"c = SidecarClient(port={self._sidecar_port}, timeout=10.0); "
+            "ok = c.chaos(**json.loads(sys.argv[1])); c.close(); "
+            "sys.exit(0 if ok else 3)")
+        cmd = (f"cd {self._repo} && python3 -c {shlex.quote(snippet)} "
+               f"{shlex.quote(json.dumps(params))}")
+        self._run(host, cmd, "sidecar chaos RPC")

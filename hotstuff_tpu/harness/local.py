@@ -25,6 +25,12 @@ from .utils import BenchError, PathMaker, Print
 class LocalBench:
     BASE_PORT = 9000
     SIDECAR_PORT = 7100
+    # graftwan: the userspace WanProxy for a shaped node->sidecar link
+    # binds here; the parameters file points the nodes at it.
+    WAN_SIDECAR_PORT = 7101
+    # Twins: the equivocating replica binds three consecutive ports from
+    # here (clear of the committee's BASE_PORT + 3*n block).
+    TWIN_BASE_PORT = 9900
 
     def __init__(self, bench_parameters, node_parameters=None):
         self.nodes = bench_parameters.nodes[0]
@@ -67,6 +73,42 @@ class LocalBench:
                 raise BenchError("Invalid fault plan", e)
         else:
             self.fault_plan = None
+        # graftwan: WAN spec + SLO table, parsed/validated NOW (same
+        # fail-before-compile contract as the fault plan).  Locally the
+        # spec is realized by WanProxy instances; _check_wan below
+        # rejects links no proxy can stand in for.
+        self._wan_proxies = {}
+        self._twin_proc = None
+        wan = getattr(bench_parameters, "wan", None)
+        if wan:
+            from ..chaos import WanError, parse_wan
+
+            try:
+                self.wan = parse_wan(wan)
+            except WanError as e:
+                raise BenchError("Invalid WAN spec", e)
+        else:
+            self.wan = None
+        slo = getattr(bench_parameters, "slo", None)
+        from ..chaos import SloError, parse_slos
+
+        try:
+            self.slos = parse_slos(slo)
+        except SloError as e:
+            raise BenchError("Invalid SLO table", e)
+        self.twins = bool(getattr(bench_parameters, "twins", False))
+        if self.wan is not None and any(
+                link.dst == "sidecar" for link in self.wan.links):
+            if not self.tpu_sidecar:
+                raise BenchError(
+                    "WAN spec shapes the sidecar link but this run "
+                    "boots no sidecar (pass --tpu-sidecar / "
+                    "--sidecar-host-crypto)", None)
+            # Nodes reach the sidecar THROUGH the proxy: the link's
+            # shape applies to every verify RPC, and a link:<name>
+            # partition event black-holes the accelerator service.
+            self.node_parameters.json["tpu_sidecar"] = \
+                f"127.0.0.1:{self.WAN_SIDECAR_PORT}"
 
     def _background_run(self, command, log_file, append=False):
         name = command.split()[0]
@@ -115,6 +157,7 @@ class LocalBench:
         self._procs = []
         self._node_procs = {}
         self._sidecar_proc = None
+        self._twin_proc = None
         # Stale-state discipline (benchmark/local.py:31-37): also sweep by
         # pattern for processes from previous runs this harness no longer
         # tracks — including the sidecar, which a wedged device can leave
@@ -243,6 +286,112 @@ class LocalBench:
             raise BenchError(
                 "fault plan targets the sidecar but this run boots none "
                 "(pass --tpu-sidecar / --sidecar-host-crypto)")
+        missing = [name for name in self.fault_plan.link_names()
+                   if self.wan is None or self.wan.by_name(name) is None]
+        if missing:
+            raise BenchError(
+                f"fault plan faults link(s) {missing} the WAN spec does "
+                "not name (pass --wan with matching links)")
+
+    def _check_wan(self):
+        """Reject WAN links no local proxy can realize, BEFORE boot.
+        Locally shapeable: dst 'sidecar' (proxy in front of the verify
+        sidecar) and dst 'node:<i>' for an alive replica (proxy in
+        front of its client-facing front port).  Inter-replica consensus
+        links need real egress shaping — run them on a fleet, where the
+        same spec compiles to tc netem."""
+        if self.wan is None:
+            return
+        from ..chaos.plan import node_index
+
+        alive = self.nodes - self.faults
+        sidecar_links = [l for l in self.wan.links if l.dst == "sidecar"]
+        if len(sidecar_links) > 1:
+            # One shared proxy port fronts the sidecar locally; a
+            # second link would EADDRINUSE mid-boot.  Per-src sidecar
+            # shaping needs per-host egress — the remote harness.
+            raise BenchError(
+                f"WAN spec names {len(sidecar_links)} sidecar links "
+                "but a local run realizes at most one (a single proxy "
+                "fronts the shared sidecar; per-src sidecar shaping "
+                "needs the remote harness)")
+        for link in self.wan.links:
+            if link.dst == "sidecar":
+                if node_index(link.src) is not None:
+                    Print.warn(
+                        f"WAN link {link.label()!r}: locally the "
+                        "sidecar proxy sits in front of the SHARED "
+                        "service, so this shapes every replica's "
+                        f"verify path, not just {link.src}'s (per-src "
+                        "asymmetry needs the remote harness)")
+                continue
+            i = node_index(link.dst)
+            if i is not None and i < alive:
+                # The local proxy fronts the node's CLIENT-facing port:
+                # only the client->front hop is actually shaped.  A
+                # node/sidecar src would silently measure a different
+                # topology than the spec declares.
+                if link.src not in ("client", "*"):
+                    raise BenchError(
+                        f"WAN link {link.label()!r}: src {link.src!r} "
+                        "is not locally shapeable (the local proxy "
+                        "fronts node fronts, so only client->node:<i> "
+                        "links are realizable; inter-replica links "
+                        "need the remote harness)")
+                continue
+            raise BenchError(
+                f"WAN link {link.label()!r}: dst {link.dst!r} is not "
+                "locally shapeable (local runs proxy the sidecar link "
+                "and client->node:<i> fronts; use the remote harness "
+                "for inter-replica tc shaping)")
+
+    def _start_wan(self, committee, alive):
+        """Boot one WanProxy per realizable link; returns the client
+        target addresses with shaped fronts swapped for their proxies.
+        The sidecar proxy binds its fixed port (the parameters file
+        already points nodes at it)."""
+        addresses = list(committee.front_addresses()[:alive])
+        if self.wan is None:
+            return addresses
+        from ..chaos import WanProxy
+        from ..chaos.plan import node_index
+
+        for link in self.wan.links:
+            if link.dst == "sidecar":
+                proxy = WanProxy(("127.0.0.1", self.SIDECAR_PORT),
+                                 shape=link.shape,
+                                 listen_port=self.WAN_SIDECAR_PORT)
+            else:
+                i = node_index(link.dst)
+                host, port = addresses[i].split(":")
+                proxy = WanProxy((host, int(port)), shape=link.shape)
+            proxy.start()
+            self._wan_proxies[link.label()] = proxy
+            if link.dst != "sidecar":
+                addresses[node_index(link.dst)] = \
+                    f"127.0.0.1:{proxy.port}"
+        Print.info(f"WAN: {len(self._wan_proxies)} link prox(ies) up")
+        return addresses
+
+    def _stop_wan(self):
+        proxies, self._wan_proxies = self._wan_proxies, {}
+        for proxy in proxies.values():
+            proxy.stop()
+
+    def _boot_twin(self):
+        """Boot the Twins equivocating replica: replica 0's keypair, its
+        own ports/store/log, and the twin committee view (written by
+        run() before the honest half that shares it booted) where its
+        identity's addresses point at itself."""
+        cmd = CommandMaker.run_node(
+            PathMaker.key_file(0),
+            PathMaker.twin_committee_file(),
+            PathMaker.twin_db_path(),
+            PathMaker.parameters_file())
+        Print.info("Booting Twins replica (equivocating sibling of "
+                   "node 0)...")
+        self._twin_proc = self._background_run(
+            cmd, PathMaker.twin_log_file(0))
 
     def _start_fault_plan(self, alive: int):
         """Launch the graftchaos runner for this run window (None when no
@@ -292,10 +441,11 @@ class LocalBench:
         assert isinstance(debug, bool)
         Print.heading("Starting local benchmark")
 
-        # An unexecutable fault plan must fail HERE, before the bench
-        # pays compile + keygen + sidecar warmup for a run that cannot
-        # deliver its scripted scenario.
+        # An unexecutable fault plan or WAN spec must fail HERE, before
+        # the bench pays compile + keygen + sidecar warmup for a run
+        # that cannot deliver its scripted scenario.
         self._check_fault_plan()
+        self._check_wan()
 
         # Kill any previous testbed and cleanup.
         self._kill_nodes()
@@ -345,9 +495,25 @@ class LocalBench:
             # reference); clients only target alive nodes and split the rate
             # among them.
             alive = self.nodes - self.faults
-            addresses = committee.front_addresses()[:alive]
+            # graftwan: proxies come up before any node dials through
+            # them; shaped fronts are swapped for their proxy addresses
+            # in the clients' target list.
+            addresses = self._start_wan(committee, alive)
             rate_share = -(-self.rate // alive)  # ceil
             timeout = self.node_parameters.timeout_delay
+
+            # Twins: the equivocating sibling of node 0 binds its own
+            # ports, and the honest committee is SPLIT across the two
+            # views — the upper half dials identity 0 at the twin's
+            # ports — so both siblings receive votes and either can
+            # propose in the shared leader slots.
+            twin_view_from = alive if not self.twins else max(1, alive // 2)
+            if self.twins:
+                from .config import twin_committee, write_committee_json
+
+                write_committee_json(
+                    twin_committee(committee, 0, self.TWIN_BASE_PORT),
+                    PathMaker.twin_committee_file())
 
             # Nodes first, then clients with the alive fronts as their
             # --nodes wait list: the client retries those until reachable
@@ -356,13 +522,16 @@ class LocalBench:
             for i in range(alive):
                 cmd = CommandMaker.run_node(
                     PathMaker.key_file(i),
-                    PathMaker.committee_file(),
+                    PathMaker.committee_file() if i < twin_view_from
+                    else PathMaker.twin_committee_file(),
                     PathMaker.db_path(i),
                     PathMaker.parameters_file(),
                     debug=debug)
                 self._node_cmds[i] = (cmd, PathMaker.node_log_file(i))
                 self._node_procs[i] = self._background_run(
                     cmd, PathMaker.node_log_file(i))
+            if self.twins:
+                self._boot_twin()
 
             for i, address in enumerate(addresses):
                 cmd = CommandMaker.run_client(
@@ -382,6 +551,20 @@ class LocalBench:
             if self.tpu_sidecar:
                 self._fetch_sidecar_stats()
             self._kill_nodes()
+            self._stop_wan()
+
+            # Persist the chaos context next to the logs so the parser
+            # (and any later re-parse of the directory) judges this run
+            # exactly as the bench configured it: the WAN the numbers
+            # were shaped under, and the SLO table recovery is held to.
+            import json
+
+            if self.wan is not None:
+                with open(PathMaker.wan_file(), "w") as f:
+                    json.dump(self.wan.to_json(), f)
+            if self.fault_plan is not None:
+                with open(PathMaker.slo_file(), "w") as f:
+                    json.dump(self.slos, f)
 
             # Parse logs and return the summary.
             Print.info("Parsing logs...")
@@ -398,7 +581,9 @@ class LocalBench:
             # e.g. sidecar readiness failure after the host-crypto retry:
             # sweep everything (incl. a hung sidecar) before propagating.
             self._kill_nodes()
+            self._stop_wan()
             raise
         except (subprocess.SubprocessError, ParseError) as e:
             self._kill_nodes()
+            self._stop_wan()
             raise BenchError("Failed to run benchmark", e)

@@ -29,7 +29,7 @@ class ParseError(Exception):
 
 class LogParser:
     def __init__(self, clients, nodes, faults, chaos_events=None,
-                 strict_chaos=False):
+                 strict_chaos=False, twins=None, wan=None, slos=None):
         inputs = [clients, nodes]
         assert all(isinstance(x, list) for x in inputs)
         assert all(isinstance(x, str) for y in inputs for x in y)
@@ -37,6 +37,11 @@ class LogParser:
             raise ParseError("missing client or node logs")
 
         self.faults = faults
+        # graftwan: the WAN spec snapshot the run was shaped under and
+        # the SLO table chaos recovery is judged against (None = default
+        # table; both ride in from logs/*.json via process()).
+        self.wan = wan
+        self.slos = slos
         # graftchaos: executed fault events (PlanRunner.events shape).
         # Scripted faults change what counts as a client failure — a
         # client pinned to a replica the plan killed dies with it, which
@@ -73,8 +78,8 @@ class LogParser:
             results = [self._parse_node(x) for x in nodes]
         except (ValueError, IndexError, AttributeError) as e:
             raise ParseError(f"Failed to parse node logs: {e}")
-        proposals, commits, sizes, self.received_samples, timeouts, configs \
-            = zip(*results)
+        proposals, commits, sizes, self.received_samples, timeouts, \
+            configs, views = zip(*results)
         self.proposals = self._merge_earliest(proposals)
         self.commits = self._merge_earliest(commits)
         self.sizes = {
@@ -82,6 +87,22 @@ class LogParser:
         }
         self.timeouts = max(timeouts)
         self.configs = configs
+
+        # Twins: logs of equivocating replicas (same key as an honest
+        # node, own ports).  Parsed ONLY for their commit views — an
+        # adversarial replica's metrics/errors are its own business —
+        # and folded into the safety assertion below: their commits must
+        # agree with (or be behind) the honest committee's, never fork
+        # it.  Twin commits stay OUT of self.commits: a shadow replica
+        # must not move throughput/latency numbers.
+        self.twins = list(twins or [])
+        self._commit_views = list(views) + \
+            [self._parse_commit_view(log) for log in self.twins]
+        self._check_safety()
+        if self.twins:
+            self.notes.append(
+                f"Twins: {len(self.twins)} equivocating replica(s) "
+                "active; safety held (no conflicting commits)")
 
         if self.misses != 0:
             Print.warn(
@@ -106,8 +127,11 @@ class LogParser:
                 f"Sidecar circuit breaker: {opens} open / "
                 f"{closes} re-attach transition(s)")
 
+        if self.wan is not None:
+            self.note_wan(self.wan)
         if self.chaos_events is not None:
-            self.note_chaos_events(self.chaos_events, strict=strict_chaos)
+            self.note_chaos_events(self.chaos_events, strict=strict_chaos,
+                                   slos=self.slos)
 
     # -- parsing -------------------------------------------------------------
 
@@ -204,7 +228,51 @@ class LogParser:
                     search(r"Max batch delay .* (\d+)", log).group(1)),
             },
         }
-        return proposals, commits, sizes, samples, timeouts, configs
+        return proposals, commits, sizes, samples, timeouts, configs, \
+            self._parse_commit_view(log)
+
+    @staticmethod
+    def _parse_commit_view(log):
+        """``{height: {digests committed at that height}}`` for one log —
+        the per-replica commit view the safety assertion compares.
+        Lenient by design (no error/config checks): it also parses the
+        logs of Twins replicas, whose own health is irrelevant."""
+        view = {}
+        for h, d in findall(r"Committed B(\d+) -> ([^ ]+=)", log):
+            view.setdefault(int(h), set()).add(d)
+        return view
+
+    def _check_safety(self):
+        """STRICT safety assertion: no two logs may commit conflicting
+        blocks at the same height.  Every pair of commit views (honest
+        nodes AND twins) is compared per height: the digest sets must be
+        equal — or one a subset of the other, which teardown killing a
+        node mid-write legitimately produces.  (A digest appearing at
+        two DIFFERENT heights is payload duplication from re-proposal,
+        not a fork, and stays out of this check.)
+
+        Equivocation (Twins) must be CONTAINED — absorbed into one
+        agreed chain — not merely survived; any violation is a hard
+        ParseError, chaos plan or not."""
+        by_height = {}
+        for li, view in enumerate(self._commit_views):
+            for h, digests in view.items():
+                by_height.setdefault(h, []).append((li, digests))
+        violations = []
+        for h, entries in sorted(by_height.items()):
+            for i in range(len(entries)):
+                for j in range(i + 1, len(entries)):
+                    a, b = entries[i][1], entries[j][1]
+                    if not (a <= b or b <= a):
+                        violations.append(
+                            f"height {h}: log {entries[i][0]} committed "
+                            f"{sorted(x[:12] + '...' for x in a - b)} but "
+                            f"log {entries[j][0]} committed "
+                            f"{sorted(x[:12] + '...' for x in b - a)}")
+        if violations:
+            raise ParseError(
+                "SAFETY VIOLATION — conflicting commits: "
+                + "; ".join(violations[:5]))
 
     # -- metrics -------------------------------------------------------------
 
@@ -359,19 +427,46 @@ class LogParser:
             return
         self.notes.extend(lines)
 
-    def note_chaos_events(self, events, strict=False):
+    def note_wan(self, wan: dict):
+        """Fold the run's graftwan spec snapshot (logs/wan.json, the
+        WanSpec.to_json shape) into the CONFIG notes so shaped numbers
+        never masquerade as LAN numbers in the result files."""
+        if not isinstance(wan, dict):
+            return
+        links = wan.get("links") or []
+        parts = []
+        for link in links:
+            if not isinstance(link, dict):
+                continue
+            label = link.get("name") or \
+                f"{link.get('src')}>{link.get('dst')}"
+            shape = ", ".join(
+                f"{k.split('_')[0]} {link[k]:g}"
+                for k in ("latency_ms", "jitter_ms", "loss_pct",
+                          "rate_mbit") if link.get(k))
+            parts.append(f"{label} ({shape})" if shape else label)
+        note = f"WAN: {len(links)} shaped link(s)"
+        if parts:
+            note += ": " + "; ".join(parts)
+        if wan.get("default"):
+            note += " + default shape"
+        self.notes.append(note)
+
+    def note_chaos_events(self, events, strict=False, slos=None):
         """Fold executed graftchaos events into the summary: per-fault
         recovery latency (first merged commit strictly after each event's
-        wall stamp — hotstuff_tpu/chaos/recovery.py) as CONFIG notes, and
-        the machine-readable summary on ``self.chaos`` for bench.py's
+        wall stamp — hotstuff_tpu/chaos/recovery.py) as CONFIG notes,
+        per-fault-class SLO verdicts (chaos/slo.py) as notes plus the
+        machine-readable summary on ``self.chaos`` for bench.py's
         headline round trip.
 
-        ``strict`` is the liveness assertion the testbed runs under: a
-        failed injection, or ANY event with no commit after it, raises
-        ParseError — commit progress must resume after every scripted
-        fault (plans are validated to leave the run-window headroom this
-        needs)."""
-        from ..chaos import summarize_recovery
+        ``strict`` is the testbed's recovery assertion, now an SLO: a
+        failed injection, ANY event with no commit after it, or a
+        recovery slower than its fault class's SLO raises ParseError —
+        commit progress must resume after every scripted fault *within
+        budget* (plans are validated to leave the run-window headroom
+        this needs; the table is logs/slo.json, else the defaults)."""
+        from ..chaos import judge, summarize_recovery
         from ..chaos.recovery import event_label
 
         summary = summarize_recovery(events, self.commits.values())
@@ -392,6 +487,16 @@ class LogParser:
                 self.notes.append(
                     f"{label}: recovery UNCONFIRMED (no commit after "
                     "event)")
+        verdict = judge(summary, slos)
+        summary["slo"] = verdict
+        for v in verdict["verdicts"]:
+            if v["ok"]:
+                self.notes.append(
+                    f"Chaos SLO {v['class']}: {v['recovery_ms']:g} ms "
+                    f"<= {v['slo_ms']:g} ms PASS")
+            else:
+                self.notes.append(
+                    f"Chaos SLO {v['class']}: FAIL ({v['reason']})")
         if strict:
             if not summary["injected_ok"]:
                 raise ParseError("chaos injection failed: " + "; ".join(
@@ -401,6 +506,11 @@ class LogParser:
                 raise ParseError(
                     "consensus did not resume after chaos event(s): "
                     + ", ".join(summary["unrecovered"]))
+            if not verdict["ok"]:
+                raise ParseError(
+                    "chaos recovery SLO breached: " + "; ".join(
+                        f"{v['class']} ({v['reason']})"
+                        for v in verdict["verdicts"] if not v["ok"]))
 
     def print(self, filename):
         assert isinstance(filename, str)
@@ -433,8 +543,26 @@ class LogParser:
                 chaos_events = loaded
         except (OSError, ValueError):
             pass
+        # Twins: logs of equivocating replicas (harness names them
+        # twin-*.log, OUTSIDE the node glob) feed only the safety
+        # assertion.
+        twins = []
+        for filename in sorted(glob(join(directory, "twin-*.log"))):
+            with open(filename, "r") as f:
+                twins.append(f.read())
+
+        def _json_or_none(name):
+            try:
+                with open(join(directory, name)) as f:
+                    loaded = json.load(f)
+                return loaded if isinstance(loaded, dict) else None
+            except (OSError, ValueError):
+                return None
+
         parser = cls(clients, nodes, faults, chaos_events=chaos_events,
-                     strict_chaos=chaos_events is not None)
+                     strict_chaos=chaos_events is not None, twins=twins,
+                     wan=_json_or_none("wan.json"),
+                     slos=_json_or_none("slo.json"))
         # The harness drops the sidecar's scheduler telemetry here at
         # teardown (LocalBench._fetch_sidecar_stats); a missing or
         # malformed file simply means no sidecar ran.

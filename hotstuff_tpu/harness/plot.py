@@ -102,6 +102,10 @@ class Ploter:
         label = f"{m.group(1)} nodes" if m else "?"
         if f and int(f.group(1)):
             label += f" ({f.group(1)} faulty)"
+        if search(r"Scripted chaos/WAN: True", data):
+            # chaos runs aggregate apart from clean ones (no-masquerade
+            # contract); the legend must keep the two series apart too
+            label += " [chaos]"
         return label
 
     def plot_latency(self):
@@ -123,3 +127,62 @@ class Ploter:
         self._plot("Committee size", "Throughput (tx/s)",
                    lambda tps, tps_std, lat, lat_std: (tps, tps_std),
                    label, "tps-scalability", tps_y_axis=True)
+
+    def plot_matrix(self):
+        """graftwan matrix heatmap: one nodes×rate panel of end-to-end
+        TPS per (faults, tx_size) group from ``plots/matrix.json``
+        (LogAggregator.print_matrix).  Chaos/WAN cells are hatched so a
+        faulted or shaped number is visually distinct from a clean-LAN
+        one; an SLO breach gets a red edge."""
+        import json
+
+        path = join(PathMaker.plot_path(), "matrix.json")
+        try:
+            with open(path) as f:
+                groups = json.load(f)
+        except (OSError, ValueError):
+            raise PlotError("no matrix.json (run aggregate first)")
+        groups = {k: g for k, g in groups.items()
+                  if g.get("cells") and len(g["cells"]) >= 2}
+        if not groups:
+            raise PlotError("matrix has fewer than two cells")
+        self.plt.clf()
+        fig, axes = self.plt.subplots(
+            1, len(groups), squeeze=False,
+            figsize=(6.4 * len(groups), 4.8))
+        for ax, (key, group) in zip(axes[0], sorted(groups.items())):
+            nodes, rates = group["nodes"], group["rates"]
+            grid = [[float("nan")] * len(rates) for _ in nodes]
+            for (ni, n) in enumerate(nodes):
+                for (ri, r) in enumerate(rates):
+                    cell = group["cells"].get(f"{n}-{r}")
+                    if cell is None:
+                        continue
+                    grid[ni][ri] = cell["tps"]
+                    label = f"{cell['tps']:,}\n{cell['latency_ms']:,} ms"
+                    chaos = cell.get("chaos")
+                    if chaos:
+                        label += "\nC!" if chaos.get("slo_fail") else "\nC"
+                    ax.text(ri, ni, label, ha="center", va="center",
+                            fontsize=7)
+                    if chaos:
+                        from matplotlib.patches import Rectangle
+
+                        ax.add_patch(Rectangle(
+                            (ri - 0.5, ni - 0.5), 1, 1, fill=False,
+                            hatch="//",
+                            edgecolor="red" if chaos.get("slo_fail")
+                            else "gray", linewidth=1.5))
+            im = ax.imshow(grid, aspect="auto", cmap="viridis")
+            ax.set_xticks(range(len(rates)),
+                          [f"{r:,}" for r in rates], fontsize=7)
+            ax.set_yticks(range(len(nodes)), nodes)
+            ax.set_xlabel("Input rate (tx/s)")
+            ax.set_ylabel("Committee size")
+            ax.set_title(f"faults={group['faults']} "
+                         f"tx={group['tx_size']}B (TPS; C=chaos/WAN)")
+            fig.colorbar(im, ax=ax, shrink=0.8)
+        for ext in ("pdf", "png"):
+            fig.savefig(PathMaker.plot_file("matrix", ext),
+                        bbox_inches="tight")
+        self.plt.close(fig)

@@ -76,6 +76,37 @@ class PathMaker:
         return join(PathMaker.logs_path(), "chaos-events.json")
 
     @staticmethod
+    def wan_file():
+        """graftwan spec snapshot (chaos/netem.WanSpec.to_json); written
+        by the harness when a run shapes links so the parser can note
+        what WAN the numbers were measured under."""
+        return join(PathMaker.logs_path(), "wan.json")
+
+    @staticmethod
+    def slo_file():
+        """Per-fault-class recovery SLO table (chaos/slo schema) the
+        parser judges chaos events against; absent = defaults."""
+        return join(PathMaker.logs_path(), "slo.json")
+
+    @staticmethod
+    def twin_log_file(i):
+        """Log of a Twins equivocating replica — named OUTSIDE the
+        node-*.log glob so twin commits never pollute the committee
+        metrics (they only feed the safety assertion)."""
+        assert isinstance(i, int) and i >= 0
+        return join(PathMaker.logs_path(), f"twin-{i}.log")
+
+    @staticmethod
+    def twin_committee_file():
+        """Committee view booted into a Twins replica: identical address
+        book except the twin's own entry binds fresh ports."""
+        return ".committee-twin.json"
+
+    @staticmethod
+    def twin_db_path():
+        return ".db-twin"
+
+    @staticmethod
     def results_path():
         return "results"
 

@@ -40,6 +40,11 @@ if __name__ == "__main__":
                     "sockets:hotstuff_tpu/chaos/plan.py",
                     "sockets:hotstuff_tpu/chaos/runner.py",
                     "sockets:hotstuff_tpu/chaos/recovery.py",
-                    "sockets:hotstuff_tpu/harness/faults.py"):
+                    "sockets:hotstuff_tpu/chaos/netem.py",
+                    "sockets:hotstuff_tpu/chaos/slo.py",
+                    "sockets:hotstuff_tpu/harness/faults.py",
+                    "sockets:hotstuff_tpu/harness/remote.py",
+                    "sockets:hotstuff_tpu/harness/local.py",
+                    "sockets:hotstuff_tpu/harness/logs.py"):
             argv += ["--must-cover", pin]
     sys.exit(main(argv))

@@ -1,0 +1,612 @@
+"""graftwan tests: the WAN link-shape layer (chaos/netem.py), the
+per-fault-class recovery SLO table (chaos/slo.py), and Twins-style
+equivocation (config.twin_committee + the LogParser's STRICT safety
+assertion) — all exercised without root, real ssh, or a device.  The
+remote/tc compilation side is covered from the orchestration angle in
+test_remote.py; here the spec grammar, the userspace WanProxy executor,
+the SLO verdicts, and the safety assertion get direct coverage.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from hotstuff_tpu.chaos.netem import (
+    LinkShape, WanError, WanProxy, host_links, netem_args, parse_wan,
+    tc_heal_commands, tc_partition_commands, tc_setup_commands,
+    tc_teardown_command,
+)
+from hotstuff_tpu.chaos.slo import (
+    DEFAULT_SLO_MS, SloError, fault_class, judge, parse_slos,
+)
+from hotstuff_tpu.harness.logs import LogParser, ParseError
+
+from test_harness import GOLDEN_CLIENT, GOLDEN_NODE
+
+
+# ---------------------------------------------------------------------------
+# WAN spec grammar
+# ---------------------------------------------------------------------------
+
+
+def test_parse_wan_inline_dsl():
+    spec = parse_wan("node:0>node:1 latency_ms=200 loss_pct=0.5 name=wan01; "
+                     "*>sidecar latency_ms=20 jitter_ms=5 name=sc; "
+                     "default latency_ms=50")
+    assert spec.link_names() == ["wan01", "sc"]
+    wan01 = spec.by_name("wan01")
+    assert (wan01.src, wan01.dst) == ("node:0", "node:1")
+    assert wan01.shape.latency_ms == 200 and wan01.shape.loss_pct == 0.5
+    assert spec.by_name("sc").src == "*"
+    assert spec.default.latency_ms == 50
+    # asymmetric pair: each direction is its OWN link (partial
+    # partitions of a shared sidecar need exactly this)
+    asym = parse_wan("node:0>sidecar latency_ms=10; "
+                     "sidecar>node:0 loss_pct=100")
+    assert [l.label() for l in asym.links] == \
+        ["node:0>sidecar", "sidecar>node:0"]
+
+
+def test_parse_wan_file_dict_and_roundtrip(tmp_path):
+    data = {"links": [{"src": "node:0", "dst": "node:1",
+                       "latency_ms": 40, "name": "ab"}],
+            "default": {"latency_ms": 80, "rate_mbit": 100}}
+    path = tmp_path / "wan.json"
+    path.write_text(json.dumps(data))
+    from_file = parse_wan(str(path))
+    from_dict = parse_wan(data)
+    assert from_file == from_dict
+    # to_json is the logs/wan.json contract: parse(to_json(x)) == x
+    assert parse_wan(from_dict.to_json()) == from_dict
+    # a bare link list is accepted too
+    assert parse_wan(data["links"]).link_names() == ["ab"]
+
+
+@pytest.mark.parametrize("spec,fragment", [
+    ("", "empty WAN spec"),
+    ("node:0 latency_ms=5", "bad WAN entry"),
+    ("node:0>node:0 latency_ms=5", "must differ"),
+    ("node:0>* latency_ms=5", "bad dst"),
+    ("oven:0>node:1 latency_ms=5", "bad src"),
+    ("node:0>node:1 latency_ms=-5", "finite number"),
+    ("node:0>node:1 loss_pct=150", "<= 100"),
+    ("node:0>node:1 jitter_ms=5", "needs latency_ms"),
+    ("node:0>node:1 warp=9", "unknown link key"),
+    ("node:0>node:1 name=x; node:1>node:0 name=x", "duplicate link"),
+    # Overlapping coverage of one (src, dst) pair is unrealizable: tc
+    # installs two same-priority filters for one dst IP and only the
+    # first band carries traffic; the second link silently no-ops.
+    ("node:0>node:1 latency_ms=5 name=a; node:0>node:1 loss_pct=1 name=b",
+     "both shape"),
+    ("node:0>sidecar latency_ms=5 name=a; *>sidecar loss_pct=1 name=b",
+     "both shape"),
+    ({"links": "nope"}, "'links' must be a list"),
+    ({"flinks": []}, "unknown WAN spec key"),
+    ({"links": []}, "shapes nothing"),
+])
+def test_parse_wan_rejects(spec, fragment):
+    with pytest.raises(WanError) as exc:
+        parse_wan(spec)
+    assert fragment in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# tc/netem compilation (string-level; execution is test_remote.py's job)
+# ---------------------------------------------------------------------------
+
+
+def test_tc_setup_compiles_per_host_egress():
+    spec = parse_wan("node:0>node:1 latency_ms=40 name=ab; "
+                     "node:1>node:0 latency_ms=40 loss_pct=1 name=ba")
+    peers = {"node:0": "10.0.0.1", "node:1": "10.0.0.2"}
+    cmds = tc_setup_commands(spec, "node:0", peers)
+    # teardown-first (idempotent re-setup), one root prio qdisc, then a
+    # netem band + dst-ip filter for THIS host's single egress link.
+    assert cmds[0] == tc_teardown_command()
+    assert "tc qdisc add dev eth0 root handle 1: prio" in cmds[1]
+    assert any("netem delay 40ms" in c for c in cmds)
+    assert any("match ip dst 10.0.0.2/32" in c for c in cmds)
+    assert not any("10.0.0.1/32" in c for c in cmds)  # own egress only
+    # node:1's view carries the reverse link (with its loss term)
+    back = tc_setup_commands(spec, "node:1", peers)
+    assert any("delay 40ms loss 1%" in c for c in back)
+    # an endpoint with no shaped egress installs nothing
+    assert tc_setup_commands(spec, "sidecar", peers) == []
+
+
+def test_tc_partition_heal_restore_spec_shape():
+    spec = parse_wan("node:0>node:1 latency_ms=40 name=ab")
+    peers = {"node:0": "10.0.0.1", "node:1": "10.0.0.2"}
+    (part,) = tc_partition_commands(spec, "ab", "node:0", peers)
+    assert "netem loss 100%" in part and "change" in part
+    (heal,) = tc_heal_commands(spec, "ab", "node:0", peers)
+    assert "netem delay 40ms" in heal
+    # hosts whose egress does not carry the link compile to no-ops
+    assert tc_partition_commands(spec, "ab", "node:1", peers) == []
+
+
+def test_host_links_default_fills_unnamed_pairs():
+    spec = parse_wan("node:0>node:1 latency_ms=40 name=ab; "
+                     "default latency_ms=80")
+    peers = {"node:0": "10.0.0.1", "node:1": "10.0.0.2",
+             "node:2": "10.0.0.3"}
+    links = host_links(spec, "node:0", peers)
+    # explicit link first, then default-shaped fills in sorted peer
+    # order; bands count up from 4 deterministically (setup and mid-run
+    # partition/heal must agree on them).
+    assert [(l.label(), ip, band) for l, ip, band in links] == [
+        ("ab", "10.0.0.2", 4), ("node:0>node:2", "10.0.0.3", 5)]
+    assert links[1][0].shape.latency_ms == 80
+    assert netem_args(LinkShape(latency_ms=40, jitter_ms=5,
+                                loss_pct=1, rate_mbit=100)) == \
+        "delay 40ms 5ms loss 1% rate 100mbit"
+
+
+def test_tc_band_references_are_hex():
+    """tc parses classid minors and handle majors as HEX: band 10
+    written "1:10" would address minor 0x10 = 16, a class the prio root
+    never created — every tc add on a host with 7+ shaped links would
+    fail mid-provisioning.  All band references must render in hex."""
+    spec = parse_wan("default latency_ms=10")
+    peers = {f"node:{i}": f"10.0.0.{i + 1}" for i in range(11)}
+    cmds = tc_setup_commands(spec, "node:0", peers)  # bands 4..13
+    joined = "\n".join(cmds)
+    assert "parent 1:a " in joined and "flowid 1:a" in joined  # band 10
+    assert "parent 1:d " in joined  # band 13
+    assert "1:10" not in joined and "1:11" not in joined
+    # partition/heal agree with setup on the hex numbering
+    named = parse_wan(
+        "; ".join(f"node:0>node:{i} latency_ms=10 name=l{i}"
+                  for i in range(1, 11)))
+    (part,) = tc_partition_commands(named, "l10", "node:0", peers)
+    assert "parent 1:d " in part  # 10th link = band 13 = 0xd
+
+
+def test_host_links_rejects_prio_band_overflow():
+    """The prio qdisc caps at 16 bands (13 shaped links per egress);
+    an overfull spec must fail at compile time — which the remote
+    pre-flight runs before any host boots — not mid-fleet at tc time."""
+    spec = parse_wan("default latency_ms=10")
+    ok_peers = {f"node:{i}": f"10.0.0.{i + 1}" for i in range(14)}
+    assert len(host_links(spec, "node:0", ok_peers)) == 13  # at the cap
+    too_many = {f"node:{i}": f"10.0.0.{i + 1}" for i in range(15)}
+    with pytest.raises(WanError) as exc:
+        host_links(spec, "node:0", too_many)
+    assert "16 bands" in str(exc.value)
+    with pytest.raises(WanError):
+        tc_setup_commands(spec, "node:0", too_many)
+
+
+# ---------------------------------------------------------------------------
+# WanProxy — the root-free executor, over real loopback sockets
+# ---------------------------------------------------------------------------
+
+
+def _echo_server():
+    """One-shot echo server; returns (port, stop)."""
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    srv.settimeout(0.25)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(5.0)
+
+            def pump(c=conn):
+                try:
+                    while True:
+                        data = c.recv(65536)
+                        if not data:
+                            return
+                        c.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+
+            threading.Thread(target=pump, daemon=True).start()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv.getsockname()[1], lambda: (stop.set(), srv.close())
+
+
+def _roundtrip(port, payload=b"ping", timeout=5.0):
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(payload)
+        got = b""
+        while len(got) < len(payload):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            got += chunk
+        return got
+
+
+def test_wanproxy_forwards_and_pays_latency():
+    port, stop_srv = _echo_server()
+    proxy = WanProxy(("127.0.0.1", port),
+                     shape=LinkShape(latency_ms=120))
+    try:
+        proxy.start()
+        assert proxy.wait_ready(5.0)
+        t0 = time.monotonic()
+        assert _roundtrip(proxy.port, b"payload-xyz") == b"payload-xyz"
+        elapsed = time.monotonic() - t0
+        # The shape applies to BOTH pump directions (like netem on both
+        # hosts' egress): one echo round trip pays >= 2 x 120 ms.
+        assert elapsed >= 0.24, f"latency not applied ({elapsed:.3f}s)"
+    finally:
+        proxy.stop()
+        stop_srv()
+
+
+def test_wanproxy_partition_heal_and_loss():
+    port, stop_srv = _echo_server()
+
+    class LossyRng:
+        """random() = 0.999 -> below a 100% loss threshold only."""
+
+        def random(self):
+            return 0.999
+
+        def uniform(self, a, b):
+            return 0.0
+
+    proxy = WanProxy(("127.0.0.1", port), shape=LinkShape())
+    try:
+        proxy.start()
+        assert proxy.wait_ready(5.0)
+        assert _roundtrip(proxy.port) == b"ping"
+        proxy.partition()
+        # A dialing peer sees a black-holed route: connect may succeed
+        # (the listener is up) but no byte ever comes back.
+        with pytest.raises((OSError, AssertionError)):
+            got = _roundtrip(proxy.port, timeout=1.0)
+            assert got == b"ping"
+        proxy.heal()
+        assert _roundtrip(proxy.port) == b"ping"
+        # 100% loss drops the CONNECTION (TCP can't lose single
+        # segments): the proxied conversation dies mid-flight.
+        proxy.set_shape(LinkShape(loss_pct=100.0))
+        proxy._rng = LossyRng()
+        with pytest.raises((OSError, AssertionError)):
+            got = _roundtrip(proxy.port, timeout=1.0)
+            assert got == b"ping"
+    finally:
+        proxy.stop()
+        stop_srv()
+
+
+def test_wan_headline_probe_tolerates_lossy_spec():
+    """A user --wan with loss_pct drops connections BY DESIGN; the bench
+    probe must report roundtrip_ok/healed False on a link lossy enough
+    to defeat its retries — never collapse the whole wan sub-field to an
+    error on exactly the shapes it claims to prove."""
+    import bench
+
+    out = bench.wan_headline_probe(
+        "node:0>sidecar latency_ms=1 loss_pct=100 name=lossy")
+    assert out["roundtrip_ok"] is False
+    assert out["partition_enforced"] is True
+    assert out["healed"] is False
+    assert out["links"] == ["lossy"]
+
+
+# ---------------------------------------------------------------------------
+# SLO table + verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_parse_slos_defaults_overlay_and_rejects(tmp_path):
+    assert parse_slos(None) == DEFAULT_SLO_MS
+    table = parse_slos("node-kill=8000; link-heal=3000")
+    assert table["node-kill"] == 8000 and table["link-heal"] == 3000
+    assert table["sidecar-degrade"] == DEFAULT_SLO_MS["sidecar-degrade"]
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({"node-pause": 12000}))
+    assert parse_slos(str(path))["node-pause"] == 12000
+    for bad, fragment in [("warp-drive=1", "unknown fault class"),
+                          ("node-kill=zero", "must be a number"),
+                          ("node-kill=-5", "finite > 0"),
+                          ("node-kill", "want class=ms"),
+                          ("", "empty SLO spec"),
+                          (42, "unsupported SLO spec type")]:
+        with pytest.raises(SloError) as exc:
+            parse_slos(bad)
+        assert fragment in str(exc.value)
+
+
+def test_fault_class_and_judge_verdicts():
+    assert fault_class({"target": "node:3", "action": "kill"}) == "node-kill"
+    assert fault_class({"target": "sidecar", "action": "degrade"}) == \
+        "sidecar-degrade"
+    assert fault_class({"target": "link:ab", "action": "heal"}) == "link-heal"
+
+    summary = {"events": [
+        {"target": "node:0", "action": "kill", "t": 5.0, "ok": True,
+         "recovered": True, "recovery_ms": 800.0},
+        {"target": "link:ab", "action": "heal", "t": 9.0, "ok": True,
+         "recovered": True, "recovery_ms": 9_000.0},
+        {"target": "node:1", "action": "pause", "t": 11.0, "ok": True,
+         "recovered": False, "recovery_ms": None},
+        {"target": "sidecar", "action": "kill", "t": 13.0, "ok": False,
+         "error": "ssh died", "recovered": False, "recovery_ms": None},
+    ]}
+    verdict = judge(summary, {"link-heal": 3_000.0})
+    by_class = {v["class"]: v for v in verdict["verdicts"]}
+    assert by_class["node-kill"]["ok"]
+    assert not by_class["link-heal"]["ok"]
+    assert "recovery 9000 ms > SLO 3000 ms" in by_class["link-heal"]["reason"]
+    assert by_class["node-pause"]["reason"] == "no commit after event"
+    assert by_class["sidecar-kill"]["reason"] == "injection failed"
+    assert not verdict["ok"]
+    # headroom only counts RECOVERED events; worst is the heal's miss
+    assert verdict["worst_headroom_ms"] == 3_000.0 - 9_000.0
+    # all-green plans are ok with the default table
+    green = {"events": [summary["events"][0]]}
+    assert judge(green)["ok"] and judge(green)["worst_headroom_ms"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Twins: committee view + the STRICT safety assertion
+# ---------------------------------------------------------------------------
+
+
+def _node_log_committing(height_digests):
+    """Minimal node log committing {height: digest} (the lenient
+    commit-view grammar: 'Committed B<h> -> <digest>=')."""
+    lines = [GOLDEN_NODE]
+    for h, d in sorted(height_digests.items()):
+        lines.append(f"[2026-07-29T14:54:58.000Z INFO consensus::core] "
+                     f"Committed B{h}\n")
+        lines.append(f"[2026-07-29T14:54:58.000Z INFO consensus::core] "
+                     f"Committed B{h} -> {d}=\n")
+    return "".join(lines)
+
+
+def test_twin_committee_shares_identity_remaps_ports():
+    from hotstuff_tpu.harness.config import LocalCommittee, twin_committee
+
+    names = ["a=", "b=", "c=", "d="]
+    committee = LocalCommittee(names, 9000)
+    view = twin_committee(committee, 0, 9900)
+    # same identities — the twin SIGNS as its sibling
+    assert set(view["consensus"]["authorities"]) == set(names)
+    # ... but its own entry binds three fresh consecutive ports
+    assert view["consensus"]["authorities"]["a="]["address"] == \
+        "127.0.0.1:9900"
+    memp = view["mempool"]["authorities"]["a="]
+    assert memp["transactions_address"] == "127.0.0.1:9901"
+    assert memp["mempool_address"] == "127.0.0.1:9902"
+    # every OTHER entry is untouched (both views dial the same peers)
+    assert view["consensus"]["authorities"]["b="] == \
+        committee.json["consensus"]["authorities"]["b="]
+    # and the original committee object was not mutated
+    assert committee.json["consensus"]["authorities"]["a="]["address"] == \
+        "127.0.0.1:9000"
+
+
+def test_parser_safety_rejects_conflicting_commits():
+    """Two honest logs committing DIFFERENT digests at the same height
+    is a fork: hard ParseError, chaos plan or not."""
+    a = _node_log_committing({7: "forkA"})
+    b = _node_log_committing({7: "forkB"})
+    with pytest.raises(ParseError) as exc:
+        LogParser([GOLDEN_CLIENT], [a, b], faults=0)
+    assert "SAFETY VIOLATION" in str(exc.value)
+    assert "height 7" in str(exc.value)
+
+
+def test_parser_safety_allows_prefix_views():
+    """A node killed mid-write commits a PREFIX of the chain: subset
+    views at a height are agreement, not a fork."""
+    ahead = _node_log_committing({7: "same", 8: "later"})
+    behind = _node_log_committing({7: "same"})
+    parser = LogParser([GOLDEN_CLIENT], [ahead, behind], faults=0)
+    assert parser._commit_views  # parsed, no violation
+
+
+def test_parser_twin_fork_is_contained_not_survived():
+    """A twin whose log forks the honest chain MUST fail the run even
+    though every honest node agrees — equivocation has to be contained
+    by the protocol, and the parser is the assertion."""
+    honest = _node_log_committing({7: "agreed"})
+    twin_forked = _node_log_committing({7: "equivocated"})
+    with pytest.raises(ParseError) as exc:
+        LogParser([GOLDEN_CLIENT], [honest, honest], faults=0,
+                  twins=[twin_forked])
+    assert "SAFETY VIOLATION" in str(exc.value)
+
+    # A twin ABSORBED into the agreed chain passes, surfaces the note,
+    # and stays out of the throughput numbers.
+    twin_behind = _node_log_committing({7: "agreed"})
+    parser = LogParser([GOLDEN_CLIENT], [honest, honest], faults=0,
+                      twins=[twin_behind])
+    assert any("Twins: 1 equivocating replica(s) active" in n
+               for n in parser.notes)
+    # twin commits never count toward committee throughput: B7 appears
+    # once via the honest logs regardless of the twin's copy.
+    assert "agreed=" in " ".join(parser.commits)
+
+
+def test_parser_process_reads_twin_and_wan_slo_files(tmp_path):
+    """LogParser.process folds the whole on-disk graftwan contract:
+    twin-*.log into the safety assertion, wan.json into the WAN note,
+    slo.json into the verdict table."""
+    (tmp_path / "client-0.log").write_text(GOLDEN_CLIENT)
+    (tmp_path / "node-0.log").write_text(_node_log_committing({7: "agreed"}))
+    (tmp_path / "twin-0.log").write_text(
+        _node_log_committing({7: "equivocated"}))
+    (tmp_path / "wan.json").write_text(json.dumps(
+        parse_wan("node:0>sidecar latency_ms=40 name=sc").to_json()))
+    with pytest.raises(ParseError) as exc:
+        LogParser.process(str(tmp_path), faults=0)
+    assert "SAFETY VIOLATION" in str(exc.value)
+
+    # contained twin: the run parses and carries the WAN + SLO context
+    (tmp_path / "twin-0.log").write_text(
+        _node_log_committing({7: "agreed"}))
+    wall = time.mktime(time.strptime("2026-07-29T14:54:57",
+                                     "%Y-%m-%dT%H:%M:%S")) \
+        - time.timezone - 0.5
+    (tmp_path / "chaos-events.json").write_text(json.dumps(
+        [{"t": 5.0, "target": "node:0", "action": "kill",
+          "wall": wall, "ok": True}]))
+    (tmp_path / "slo.json").write_text(json.dumps({"node-kill": 9_000}))
+    parser = LogParser.process(str(tmp_path), faults=0)
+    out = parser.result()
+    assert "Twins: 1 equivocating replica(s)" in out
+    assert "WAN: 1 shaped link(s)" in out
+    assert "Chaos SLO node-kill" in out and "PASS" in out
+    assert parser.chaos["slo"]["ok"]
+    # ... and a too-tight SLO table flips the verdict AND the strict
+    # assertion (chaos mode): "recovered" must mean "fast enough".
+    (tmp_path / "slo.json").write_text(json.dumps({"node-kill": 0.001}))
+    with pytest.raises(ParseError) as exc:
+        LogParser.process(str(tmp_path), faults=0)
+    assert "SLO breached" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# Local bench wiring
+# ---------------------------------------------------------------------------
+
+
+def test_bench_parameters_carry_graftwan_fields():
+    from hotstuff_tpu.harness.config import BenchParameters
+
+    params = BenchParameters({
+        "faults": 0, "nodes": 4, "rate": 1000, "tx_size": 512,
+        "duration": 30, "twins": True,
+        "wan": "node:0>sidecar latency_ms=40 name=sc",
+        "slo": "node-kill=9000"})
+    assert params.twins is True
+    assert params.wan and params.slo
+    assert BenchParameters({
+        "faults": 0, "nodes": 4, "rate": 1000, "tx_size": 512,
+        "duration": 30}).twins is False
+
+
+def test_local_bench_rejects_unshapeable_wan():
+    from hotstuff_tpu.harness.config import BenchParameters
+    from hotstuff_tpu.harness.local import LocalBench
+    from hotstuff_tpu.harness.utils import BenchError
+
+    def bench(wan, **extra):
+        return LocalBench(BenchParameters({
+            "faults": 1, "nodes": 4, "rate": 1000, "tx_size": 512,
+            "duration": 30, "wan": wan, **extra}))
+
+    # sidecar + alive-node fronts are locally shapeable
+    bench("node:0>sidecar latency_ms=40; client>node:2 latency_ms=10",
+          sidecar_host_crypto=True)._check_wan()
+    # ... but shaping the sidecar link requires a sidecar in the run
+    with pytest.raises(BenchError) as exc:
+        bench("node:0>sidecar latency_ms=40")
+    assert "boots no sidecar" in str(exc.value)
+    # a dead replica's front is not (faults=1 -> node:3 never boots)
+    with pytest.raises(BenchError) as exc:
+        bench("client>node:3 latency_ms=10")._check_wan()
+    assert "not locally shapeable" in str(exc.value)
+    # inter-replica consensus links need real egress shaping (fleet)
+    with pytest.raises(BenchError) as exc:
+        bench("node:0>client latency_ms=10")._check_wan()
+    assert "remote harness" in str(exc.value)
+    # malformed specs die at construction, before any boot
+    with pytest.raises(BenchError):
+        bench("nonsense")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end chaos matrix (slow lane; needs the native build)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_matrix_e2e_local(tmp_path, monkeypatch):
+    """The whole graftwan pipeline against REAL processes: a 4-node
+    committee behind a loopback WanProxy, a scripted mid-run node kill,
+    and per-fault SLO verdicts out of the parser — no root, no ssh.
+    The strict assertions inside LocalBench.run make this self-judging:
+    a stalled recovery, an SLO miss, or a safety violation raises."""
+    import os
+
+    from conftest import NODE_BIN, REPO
+    from hotstuff_tpu.harness.config import BenchParameters, NodeParameters
+    from hotstuff_tpu.harness.local import LocalBench
+
+    if not os.path.exists(NODE_BIN):
+        pytest.skip("native binaries not built (cmake --build native/build)")
+    monkeypatch.chdir(tmp_path)
+    # reuse the repo's build: compile() is an up-to-date no-op through
+    # the symlink, and alias_binaries links node/client from it
+    os.symlink(os.path.join(REPO, "native"), tmp_path / "native")
+
+    params = BenchParameters({
+        "faults": 0, "nodes": 4, "rate": 500, "tx_size": 64,
+        "duration": 10,
+        "fault_plan": "3 node:1 kill",
+        "wan": "client>node:0 latency_ms=30 name=c0",
+        "slo": "node-kill=9000"})
+    node_params = NodeParameters.default()
+    node_params.json["consensus"]["timeout_delay"] = 1_000
+    node_params.timeout_delay = 1_000
+    parser = LocalBench(params, node_params).run()
+
+    out = parser.result()
+    # the kill was injected, recovery was measured, and the verdict is
+    # a PASS against the run's own SLO table
+    assert "Chaos node:1 kill" in out
+    assert "Chaos SLO node-kill" in out and "PASS" in out
+    assert parser.chaos["slo"]["ok"], parser.chaos["slo"]
+    assert "WAN: 1 shaped link(s)" in out
+    # the on-disk contract a re-parse (or the aggregator) consumes
+    events = json.load(open("logs/chaos-events.json"))
+    assert [e["action"] for e in events] == ["kill"] and events[0]["ok"]
+    assert json.load(open("logs/wan.json"))["links"][0]["name"] == "c0"
+    assert json.load(open("logs/slo.json"))["node-kill"] == 9_000
+
+
+@pytest.mark.slow
+def test_twins_e2e_contained(tmp_path, monkeypatch):
+    """Twins scenario against real processes: replica 0's keypair runs
+    in TWO node processes with the honest committee split across the
+    views.  The run passes only if equivocation was CONTAINED — the
+    parser's safety assertion raises on any conflicting commit."""
+    import os
+
+    from conftest import NODE_BIN, REPO
+    from hotstuff_tpu.harness.config import BenchParameters, NodeParameters
+    from hotstuff_tpu.harness.local import LocalBench
+
+    if not os.path.exists(NODE_BIN):
+        pytest.skip("native binaries not built (cmake --build native/build)")
+    monkeypatch.chdir(tmp_path)
+    os.symlink(os.path.join(REPO, "native"), tmp_path / "native")
+
+    params = BenchParameters({
+        "faults": 0, "nodes": 4, "rate": 500, "tx_size": 64,
+        "duration": 10, "twins": True})
+    node_params = NodeParameters.default()
+    node_params.json["consensus"]["timeout_delay"] = 1_000
+    node_params.timeout_delay = 1_000
+    parser = LocalBench(params, node_params).run()
+
+    out = parser.result()
+    assert "Twins: 1 equivocating replica(s) active; safety held" in out
+    # the twin's log exists and fed the assertion
+    assert os.path.exists("logs/twin-0.log")

@@ -92,6 +92,16 @@ measured.  Keys: links, tc_commands, proxy_roundtrip_ms (one successful
 shaped round trip; null when the shape defeats every attempt),
 roundtrip_ok, partition_enforced, healed.
 
+grafttrace (`"trace"` field): the cross-layer tracing pipeline proven
+end to end — two synthetic replica logs with a known clock skew run
+through the real node-TRACE parser, the RTT-midpoint offset estimator,
+per-block stitching (one deliberately partial trace), the critical-path
+p50/p99 breakdown, and a Chrome-trace JSON round trip (the exact
+pipeline a live run's logs/trace.json artifact and "Commit critical
+path" parser note come from).  Keys: blocks, complete, segments
+({name: {n, p50_ms, p99_ms}}), chrome_events, offset_applied_ms,
+roundtrip_ok.
+
 Degraded mode (`"degraded": true`): the device probe is capped at
 HOTSTUFF_TPU_PROBE_ATTEMPTS tries (default 3) inside a
 HOTSTUFF_TPU_PROBE_WINDOW-second window (default 600) AND inside the
@@ -527,6 +537,75 @@ def mesh_rlc_headline(n_devices: int = 8,
         return {"error": f"{e!r:.120}{detail}"}
 
 
+def trace_headline_probe() -> dict:
+    """The headline's ``trace`` field: prove the grafttrace pipeline end
+    to end without booting a committee.  Two synthetic replica logs
+    with a KNOWN clock skew run through the REAL node-TRACE parser
+    (obs/trace.py — the exact regex that mines live node logs), the
+    RTT-midpoint offset estimator, per-block stitching (one block's
+    trace is deliberately partial: a dropped span must degrade the
+    sample count, not the breakdown), the critical-path percentiles,
+    and a Chrome-trace JSON serialization round trip.  Keys: blocks,
+    complete, segments ({name: {n, p50_ms, p99_ms}}), chrome_events,
+    offset_applied_ms, roundtrip_ok."""
+    import json as _json
+
+    from hotstuff_tpu.obs import trace as obstrace
+
+    def line(sec, stage, block, rnd):
+        return (f"[2026-08-03T12:00:{sec:06.3f}Z INFO consensus::core] "
+                f"TRACE stage={stage} block={block} round={rnd}")
+
+    # Replica 0: the reference clock.  Block bbb='s trace is partial
+    # (no verify stages — the cached-certificate path).
+    log_a = "\n".join([
+        line(1.000, "proposal", "aaa=", 2),
+        line(1.010, "verify_submit", "aaa=", 2),
+        line(1.034, "verify_reply", "aaa=", 2),
+        line(1.050, "commit", "aaa=", 2),
+        line(1.100, "proposal", "bbb=", 3),
+        line(1.180, "commit", "bbb=", 3),
+    ])
+    # Replica 1: same events observed later, stamped by a clock running
+    # a known skew AHEAD — alignment must bring them back onto (not
+    # before) the reference observations.
+    skew_s = 0.125
+    log_b = "\n".join([
+        line(1.020 + skew_s, "proposal", "aaa=", 2),
+        line(1.060 + skew_s, "commit", "aaa=", 2),
+    ])
+    spans = obstrace.parse_node_trace(log_a, host="node-0.log")
+    spans_b = obstrace.parse_node_trace(log_b, host="node-1.log")
+    # Offset probe with synthetic stamps: local sends at t, the skewed
+    # host answers mid-flight, local receives at t + rtt.
+    rtt = 0.004
+    probes = [(t, t + rtt / 2 + skew_s, t + rtt) for t in (5.0, 6.0, 7.0)]
+    offset = obstrace.estimate_offset(probes)
+    spans += obstrace.apply_offset(spans_b, offset)
+    traces = obstrace.stitch_blocks(spans)
+    summary = obstrace.critical_path(traces)
+    sidecar_spans = [
+        {"stage": "queue", "t": 1785751201.01, "dur_ms": 1.5, "rid": 1,
+         "cls": "latency"},
+        {"stage": "device", "t": 1785751201.02, "dur_ms": 18.0, "rid": 1},
+    ]
+    chrome = obstrace.chrome_trace(traces, sidecar_spans)
+    decoded = _json.loads(_json.dumps(chrome))
+    events = decoded.get("traceEvents", [])
+    roundtrip_ok = (
+        len(events) == len(chrome["traceEvents"])
+        and all(e.get("ph") in ("X", "M") for e in events)
+        and all(isinstance(e.get("ts", 0), (int, float)) for e in events))
+    return {
+        "blocks": summary["blocks"],
+        "complete": summary["complete"],
+        "segments": summary["segments"],
+        "chrome_events": len(events),
+        "offset_applied_ms": round(offset * 1e3, 3),
+        "roundtrip_ok": roundtrip_ok,
+    }
+
+
 def sched_headline_probe() -> dict:
     """Round-trip the verifysched STATS counters through the wire
     encoding and return the decoded snapshot for the headline's "sched"
@@ -884,6 +963,10 @@ def run_degraded(reason: str):
                                          _SLO_SPEC)
         except Exception as e:  # noqa: BLE001 — chaos probe is best-effort
             chaos = {"error": f"{e!r:.120}"}
+        try:
+            trace = trace_headline_probe()
+        except Exception as e:  # noqa: BLE001 — trace probe is best-effort
+            trace = {"error": f"{e!r:.120}"}
         # The watchdog stays armed until the moment of the real emit: a
         # stall anywhere above (including the sched probe) must still
         # produce a parseable line, which is this path's whole contract.
@@ -892,7 +975,7 @@ def run_degraded(reason: str):
         # device backend wins over the cpu config flip above).
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
              note=reason, rlc=rlc, mesh_rlc=mesh_rlc, sched=sched,
-             chaos=chaos)
+             chaos=chaos, trace=trace)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -1149,8 +1232,12 @@ def main(argv=None):
         chaos = chaos_headline_probe(_FAULT_PLAN, _WAN_SPEC, _SLO_SPEC)
     except Exception as e:  # noqa: BLE001 — chaos probe is best-effort
         chaos = {"error": f"{e!r:.120}"}
+    try:
+        trace = trace_headline_probe()
+    except Exception as e:  # noqa: BLE001 — trace probe is best-effort
+        trace = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
-               mesh_rlc=mesh_rlc, sched=sched, chaos=chaos)
+               mesh_rlc=mesh_rlc, sched=sched, chaos=chaos, trace=trace)
 
 
 if __name__ == "__main__":

@@ -12,11 +12,13 @@ import fnmatch
 import os
 import sys
 
-CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets")
+CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
+            "obsspan")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import hotpath, padshape, sanitize, sockets, timing, wirecheck
+    from . import hotpath, obsspan, padshape, sanitize, sockets, timing, \
+        wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -31,6 +33,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += timing.check(root)
     if "sockets" in checkers:
         findings += sockets.check(root)
+    if "obsspan" in checkers:
+        findings += obsspan.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -54,7 +58,7 @@ def check_coverage(root: str, must_cover) -> list:
     accepts any checker.  scripts/lint_gate.py pins the RLC scalar
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
-    from . import hotpath, padshape, sockets, timing
+    from . import hotpath, obsspan, padshape, sockets, timing
     from .common import Finding
 
     target_sets = {
@@ -62,6 +66,7 @@ def check_coverage(root: str, must_cover) -> list:
         "sockets": tuple(sockets.DEFAULT_TARGETS),
         "timing": tuple(timing.DEFAULT_TARGETS),
         "padshape": tuple(padshape.DEFAULT_TARGETS),
+        "obsspan": tuple(obsspan.DEFAULT_TARGETS),
     }
     findings = []
     for pin in must_cover:

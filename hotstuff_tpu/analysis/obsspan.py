@@ -1,0 +1,131 @@
+"""graftlint obsspan checker: grafttrace instrumentation discipline.
+
+The obs span API (``hotstuff_tpu/obs/spans.py``) has two invariants the
+type system cannot hold for us, so this checker holds them mechanically
+over the instrumented modules:
+
+Rules:
+  unclosed-span       a ``.begin_span(`` call in a function scope with
+                      no ``.end_span(`` inside a ``finally`` block of
+                      that scope.  An exception (or early return)
+                      between begin and a bare end leaks the span and
+                      skews every downstream percentile — pair them in
+                      ``try/finally``, or use the ``with tracer.span()``
+                      form, which needs no pairing at all.  (A scope
+                      named ``__enter__`` is exempt: the context-manager
+                      protocol IS the pairing — its ``__exit__`` closes
+                      the span.)
+  span-inline-clock   a direct ``time.time()`` / ``time.monotonic()``
+                      (or bare imported ``time()``/``monotonic()``)
+                      CALL inside an ``obs/`` module.  Observability
+                      code must read time through the injected clock
+                      only — the virtual-clock tests and the trace
+                      merger's cross-host offset math both assume one
+                      substitutable time source per process.  A clock
+                      *reference* (``clock=time.time`` as a default
+                      parameter) is legal; calling it inline is not.
+
+Scope model is lexical per function, the timing checker's convention
+(nested functions and lambdas are their own scopes).
+"""
+
+from __future__ import annotations
+
+import ast
+import glob as _glob
+import os
+
+from .common import Finding, apply_suppressions
+from .timing import _scopes
+
+# Modules that open/close obs spans, relative to the repo root (globs
+# allowed).  The obs package itself plus the sidecar engine, the one
+# production emitter; the span-inline-clock rule applies to the obs/
+# paths only (the engine legitimately uses monotonic() for OP_STATS).
+DEFAULT_TARGETS = (
+    "hotstuff_tpu/obs/*.py",
+    "hotstuff_tpu/sidecar/service.py",
+)
+
+_CLOCK_NAMES = {"time", "monotonic", "perf_counter", "perf_counter_ns",
+                "monotonic_ns"}
+
+
+def _is_inline_clock_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _CLOCK_NAMES \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id in ("time", "_time"):
+        return True
+    return isinstance(func, ast.Name) and func.id in _CLOCK_NAMES
+
+
+def _finally_nodes(scope_nodes):
+    """All nodes lexically inside a ``finally`` block of the scope."""
+    out = set()
+    for node in scope_nodes:
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                out.add(stmt)
+                for child in ast.walk(stmt):
+                    out.add(child)
+    return out
+
+
+def check_source(path: str, source: str) -> list:
+    findings = []
+    tree = ast.parse(source, filename=path)
+    in_obs = "obs/" in path.replace(os.sep, "/")
+    for scope, nodes in _scopes(tree):
+        scope_name = getattr(scope, "name", "")
+        begins = []
+        ends = []
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "begin_span":
+                    begins.append(node)
+                elif func.attr == "end_span":
+                    ends.append(node)
+            if in_obs and _is_inline_clock_call(node):
+                findings.append(Finding(
+                    path, node.lineno, "span-inline-clock",
+                    "inline clock call in an obs module: timestamps "
+                    "must come from the injected clock (store the "
+                    "callable at construction; time.time as a DEFAULT "
+                    "is fine, calling it here is not)"))
+        if not begins or scope_name == "__enter__":
+            continue
+        fin = _finally_nodes(nodes)
+        if not any(e in fin for e in ends):
+            for b in begins:
+                findings.append(Finding(
+                    path, b.lineno, "unclosed-span",
+                    "begin_span without an end_span in a finally block "
+                    "of the same scope: an exception or early return "
+                    "leaks the span and skews the trace percentiles — "
+                    "pair them in try/finally or use the "
+                    "`with tracer.span(...)` form"))
+    return findings
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: source} mapping (the unit-test entry point)."""
+    findings = []
+    for path, src in sources.items():
+        findings += check_source(path, src)
+    return sorted(apply_suppressions(findings, sources),
+                  key=lambda f: (f.path, f.line))
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    sources = {}
+    for target in targets:
+        for path in sorted(_glob.glob(os.path.join(root, target))):
+            if not path.endswith(".py"):
+                continue
+            with open(path, encoding="utf-8") as fh:
+                sources[os.path.relpath(path, root)] = fh.read()
+    return check_sources(sources)

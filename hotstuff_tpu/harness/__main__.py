@@ -79,6 +79,14 @@ def cmd_plot(args):
             ploter.plot_matrix()
         except PlotError:
             pass  # a single-cell matrix has nothing to draw
+        # grafttrace artifacts from the LAST run's logs dir (per-stage
+        # latency histograms + the sampled metrics time series); absent
+        # when the last run predates tracing or booted no sidecar.
+        for fn in (ploter.plot_trace, ploter.plot_metrics):
+            try:
+                fn()
+            except PlotError:
+                pass
         print("plots written to plots/")
     except PlotError as e:
         print(f"plot failed: {e}")

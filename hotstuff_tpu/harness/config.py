@@ -158,6 +158,9 @@ class NodeParameters:
         sidecar = json_input.get("tpu_sidecar")
         if sidecar is not None and not isinstance(sidecar, str):
             raise ConfigError("tpu_sidecar must be an address string")
+        trace = json_input.get("trace")
+        if trace is not None and not isinstance(trace, bool):
+            raise ConfigError("trace must be a bool")
         chain = json_input["consensus"].get("chain_depth", 2)
         if chain not in (2, 3):
             raise ConfigError("chain_depth must be 2 or 3")
@@ -171,6 +174,9 @@ class NodeParameters:
 
     @classmethod
     def default(cls, tpu_sidecar=None, scheme=None, chain=2):
+        # grafttrace's node-side "trace" flag is not a kwarg here: the
+        # harnesses enable it via json.setdefault("trace", True) on
+        # whatever parameters the caller built (local.py / remote.py).
         data = {
             "consensus": {"timeout_delay": 5_000, "sync_retry_delay": 10_000},
             "mempool": {

@@ -31,6 +31,10 @@ class LocalBench:
     # Twins: the equivocating replica binds three consecutive ports from
     # here (clear of the committee's BASE_PORT + 3*n block).
     TWIN_BASE_PORT = 9900
+    # grafttrace: OP_STATS sampling cadence during the run window.  1 Hz
+    # keeps even a minimum-duration run at a handful of in-window
+    # samples while costing the sidecar one connection thread per tick.
+    METRICS_INTERVAL_S = 1.0
 
     def __init__(self, bench_parameters, node_parameters=None):
         self.nodes = bench_parameters.nodes[0]
@@ -54,6 +58,12 @@ class LocalBench:
             tpu_sidecar=(f"127.0.0.1:{self.SIDECAR_PORT}"
                          if self.tpu_sidecar else None),
             scheme=self.scheme if self.scheme != "ed25519" else None)
+        # grafttrace: benched runs always trace (the span lines are one
+        # relaxed atomic load when the committee config disables them,
+        # and the critical-path breakdown is what makes the run's
+        # numbers attributable).  setdefault, so an explicit
+        # "trace": false in caller-provided parameters wins.
+        self.node_parameters.json.setdefault("trace", True)
         self._procs = []
         self._degraded = False
         # graftchaos: per-node boot info + the sidecar boot command are
@@ -116,8 +126,15 @@ class LocalBench:
         # stdout pipe, or an orphaned node keeps a killed harness's caller
         # blocked on that pipe forever (logs go to stderr).
         cmd = f"{command} > /dev/null 2{'>>' if append else '>'} {log_file}"
+        # Python children (the sidecar) must find hotstuff_tpu regardless
+        # of the harness cwd — `python -m` in the child does not inherit
+        # the parent interpreter's implicit cwd sys.path entry.
+        env = os.environ.copy()
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         proc = subprocess.Popen(
-            ["/bin/sh", "-c", cmd], preexec_fn=os.setsid)
+            ["/bin/sh", "-c", cmd], preexec_fn=os.setsid, env=env)
         self._procs.append((name, proc))
         return proc
 
@@ -209,10 +226,13 @@ class LocalBench:
         # (sidecar/sched/scheduler.size_queue_caps) instead of the static
         # defaults.
         chaos = " --chaos" if getattr(self, "fault_plan", None) else ""
+        # grafttrace: sidecar stage spans ride a JSONL file next to the
+        # logs (appended across chaos restarts, like the log itself).
+        trace = f" --trace {PathMaker.sidecar_spans_file()}"
         cmd = (f"python -m hotstuff_tpu.sidecar "
                f"--port {self.SIDECAR_PORT}"
                f" --committee {self.nodes} --client-rate {self.rate}"
-               f"{warm_bls}{warm_rlc}{mesh}{hc}{chaos}")
+               f"{warm_bls}{warm_rlc}{mesh}{hc}{chaos}{trace}")
         # The degraded reboot appends to the log: the dead device
         # sidecar's output is the evidence needed to diagnose the wedge.
         self._sidecar_cmd = (cmd, PathMaker.sidecar_log_file())
@@ -238,10 +258,34 @@ class LocalBench:
             self._degraded = True
             self._boot_sidecar(host_crypto=True)
 
+    def _start_metrics_sampler(self):
+        """Poll OP_STATS at a fixed interval for the whole run window
+        (obs/sampler.py), appending the time series to logs/metrics.jsonl
+        — so throughput/queue-wait over time is plottable and a
+        chaos-killed sidecar's telemetry survives as the last good
+        sample.  Each tick dials a fresh connection: the sampler must
+        outlive a sidecar kill/restart, not die with the first socket."""
+        if not self.tpu_sidecar:
+            return None
+        from ..obs import MetricsSampler
+        from ..sidecar.client import SidecarClient
+
+        def fetch():
+            with SidecarClient(port=self.SIDECAR_PORT,
+                               timeout=5.0) as client:
+                return client.stats()
+
+        self._sampler = MetricsSampler(
+            fetch, PathMaker.metrics_file(),
+            interval_s=self.METRICS_INTERVAL_S)
+        return self._sampler.start()
+
     def _fetch_sidecar_stats(self):
         """Write the sidecar's OP_STATS snapshot next to the logs; best
-        effort — a wedged or already-dead sidecar loses telemetry, never
-        the run."""
+        effort — but a sidecar that died before teardown (chaos kill)
+        no longer loses its telemetry silently: the periodic sampler's
+        last good snapshot becomes the fallback, marked so the parser
+        says where the numbers came from."""
         import json
 
         from ..sidecar.client import SidecarClient
@@ -250,10 +294,17 @@ class LocalBench:
             with SidecarClient(port=self.SIDECAR_PORT,
                                timeout=10.0) as client:
                 stats = client.stats()
-            with open(PathMaker.sidecar_stats_file(), "w") as f:
-                json.dump(stats, f)
         except (OSError, ConnectionError, ValueError) as e:
-            Print.warn(f"Could not fetch sidecar scheduler stats: {e}")
+            sampler = getattr(self, "_sampler", None)
+            if sampler is None or sampler.last is None:
+                Print.warn(f"Could not fetch sidecar scheduler stats: {e}")
+                return
+            sampled_at, snap = sampler.last
+            Print.warn(f"Sidecar stats fetch failed ({e}); falling back "
+                       "to the last periodic sample")
+            stats = dict(snap, _from_sample_at=sampled_at)
+        with open(PathMaker.sidecar_stats_file(), "w") as f:
+            json.dump(stats, f)
 
     def _check_fault_plan(self):
         """Reject an unexecutable plan BEFORE anything boots: every input
@@ -542,12 +593,17 @@ class LocalBench:
             # Wait for all transactions to be processed.
             Print.info(f"Running benchmark ({self.duration} sec)...")
             sleep(2 * timeout / 1000)
+            sampler = self._start_metrics_sampler()
             runner = self._start_fault_plan(alive)
             sleep(self.duration)
             self._finish_fault_plan(runner)
+            if sampler is not None:
+                sampler.stop()
             # Snapshot the scheduler telemetry BEFORE teardown (the
             # OP_STATS counters die with the sidecar process); the parser
-            # folds the file into the summary's CONFIG notes.
+            # folds the file into the summary's CONFIG notes.  A sidecar
+            # a fault plan killed falls back to the sampler's last
+            # in-window snapshot instead of losing the section.
             if self.tpu_sidecar:
                 self._fetch_sidecar_stats()
             self._kill_nodes()
@@ -580,10 +636,17 @@ class LocalBench:
         except BenchError:
             # e.g. sidecar readiness failure after the host-crypto retry:
             # sweep everything (incl. a hung sidecar) before propagating.
+            self._stop_sampler()
             self._kill_nodes()
             self._stop_wan()
             raise
         except (subprocess.SubprocessError, ParseError) as e:
+            self._stop_sampler()
             self._kill_nodes()
             self._stop_wan()
             raise BenchError("Failed to run benchmark", e)
+
+    def _stop_sampler(self):
+        sampler = getattr(self, "_sampler", None)
+        if sampler is not None:
+            sampler.stop()

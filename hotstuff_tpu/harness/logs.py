@@ -11,6 +11,7 @@ phrasings (see native/src/*/: "NOTE: ... used to compute performance").
 
 from __future__ import annotations
 
+import re
 from datetime import datetime
 from glob import glob
 from os.path import join
@@ -22,6 +23,17 @@ from .utils import Print
 SIGNATURE_LENGTH = 0
 PUBLICKEY_LENGTH = 0
 
+# A well-formed line of the frozen log grammar (common/log.hpp):
+# "[<RFC3339 ms>Z <LEVEL> <module>] <message>".  Concurrent writers to
+# one fd (a chaos-restarted node appending to its old log, the C++
+# node's multiple threads under memory pressure) can interleave or tear
+# lines; anything that does not match this prefix is dropped and
+# counted BEFORE the regex mining, so a torn fragment can neither fake
+# a fatal " ERROR " hit nor crash a config search().
+_WELL_FORMED_LINE = re.compile(
+    r"^\[\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3}Z "
+    r"(?:ERROR|WARN|INFO|DEBUG) [\w:.\-]+\] ")
+
 
 class ParseError(Exception):
     pass
@@ -29,12 +41,27 @@ class ParseError(Exception):
 
 class LogParser:
     def __init__(self, clients, nodes, faults, chaos_events=None,
-                 strict_chaos=False, twins=None, wan=None, slos=None):
+                 strict_chaos=False, twins=None, wan=None, slos=None,
+                 strict_lines=False):
         inputs = [clients, nodes]
         assert all(isinstance(x, list) for x in inputs)
         assert all(isinstance(x, str) for y in inputs for x in y)
         if not clients or not nodes:
             raise ParseError("missing client or node logs")
+
+        # Torn-line tolerance: sanitize every log up front (skip-and-
+        # count).  Non-strict mode — the default — NEVER raises on a
+        # malformed line; the count is surfaced as a parser note so a
+        # torn-log run is visible, not silent.  strict_lines is for
+        # tests that want to assert a log grammar regression loudly.
+        self.malformed_lines = 0
+        clients = [self._sanitize_log(x) for x in clients]
+        nodes = [self._sanitize_log(x) for x in nodes]
+        twins = [self._sanitize_log(x) for x in (twins or [])]
+        if strict_lines and self.malformed_lines:
+            raise ParseError(
+                f"{self.malformed_lines} malformed log line(s) "
+                "(strict_lines mode)")
 
         self.faults = faults
         # graftwan: the WAN spec snapshot the run was shaped under and
@@ -61,6 +88,15 @@ class LogParser:
         # invisible to the frozen result-grammar parsers, which match
         # labelled fields only.
         self.notes = []
+        # grafttrace: the critical-path summary (note_trace) and the
+        # sampled metrics time series (note_metrics) land here for
+        # bench.py's machine-readable round trip.
+        self.trace = None
+        self.metrics = None
+        if self.malformed_lines:
+            self.notes.append(
+                f"Parser: skipped {self.malformed_lines} torn/malformed "
+                "log line(s) (concurrent writers)")
         if isinstance(faults, int):
             self.committee_size = len(nodes) + int(faults)
         else:
@@ -134,6 +170,26 @@ class LogParser:
                                    slos=self.slos)
 
     # -- parsing -------------------------------------------------------------
+
+    def _sanitize_log(self, log: str) -> str:
+        """Drop (and count) lines outside the frozen log grammar.  The
+        regex miners below would mostly skip garbage anyway; the fatal
+        checks (`` ERROR ``, ``panic``) and the labelled config
+        ``search()``es are what a torn fragment could corrupt.  C++
+        runtime-abort output (libstdc++'s ``terminate called ...``) is
+        printed with NO log prefix, so it is explicitly kept — dropping
+        it would let ``_parse_node``'s crash check parse a dead replica
+        as a clean run."""
+        good = []
+        for line in log.splitlines():
+            if not line.strip():
+                continue
+            if _WELL_FORMED_LINE.match(line) or \
+                    search(r"terminate called|panic", line) is not None:
+                good.append(line)
+            else:
+                self.malformed_lines += 1
+        return "\n".join(good) + ("\n" if good else "")
 
     @staticmethod
     def _merge_earliest(dicts):
@@ -383,6 +439,16 @@ class LogParser:
         if not isinstance(stats, dict) or not stats.get("launches"):
             return
         lines = []
+        # grafttrace fallback marker: the harness could not reach the
+        # sidecar at teardown (chaos-killed before the final fetch) and
+        # substituted the periodic sampler's last good snapshot — say
+        # so, instead of letting sampled numbers masquerade as final.
+        sampled_at = stats.get("_from_sample_at")
+        if isinstance(sampled_at, (int, float)):
+            ts = datetime.utcfromtimestamp(sampled_at).strftime(
+                "%Y-%m-%dT%H:%M:%SZ")
+            lines.append(f"Sidecar stats from last sample @ {ts} "
+                         "(sidecar unreachable at teardown)")
         try:
             by_class = stats.get("launches_by_class", {})
             lines.append(
@@ -426,6 +492,87 @@ class LogParser:
         except (TypeError, ValueError, AttributeError):
             return
         self.notes.extend(lines)
+
+    def note_trace(self, summary: dict):
+        """Fold the grafttrace critical-path summary (obs/trace.py
+        critical_path + sidecar_breakdown shape) into the CONFIG notes
+        and onto ``self.trace`` for bench.py's headline round trip.
+        Best-effort like every telemetry note: a hostile summary adds
+        nothing rather than raising."""
+        if not isinstance(summary, dict):
+            return
+        try:
+            segs = summary.get("segments") or {}
+            from ..obs.trace import SEGMENTS, TOTAL_SEGMENT
+
+            parts = []
+            for name in SEGMENTS + (TOTAL_SEGMENT,):
+                entry = segs.get(name)
+                if entry and entry.get("n"):
+                    parts.append(f"{name} p50 {entry['p50_ms']:g} ms / "
+                                 f"p99 {entry['p99_ms']:g} ms")
+            if not parts:
+                return
+            self.trace = summary
+            self.notes.append(
+                f"Commit critical path ({summary.get('blocks', 0)} "
+                f"block(s), {summary.get('complete', 0)} fully traced): "
+                + "; ".join(parts))
+            sc = summary.get("sidecar") or {}
+            sc_parts = [f"{stage} p50 {e['p50_ms']:g} ms / "
+                        f"p99 {e['p99_ms']:g} ms"
+                        for stage, e in sorted(sc.items())
+                        if e.get("n") and stage in ("queue", "pack",
+                                                    "device")]
+            if sc_parts:
+                self.notes.append("Sidecar stage latency: "
+                                  + "; ".join(sc_parts))
+        except (TypeError, ValueError, AttributeError, KeyError):
+            self.trace = None
+            return
+
+    def note_metrics(self, samples, malformed: int = 0):
+        """Fold the sampled OP_STATS time series (obs/sampler.py JSONL)
+        into the summary: the in-window sample count as a CONFIG note,
+        and — under a chaos plan — the per-event recovery curve, so an
+        SLO verdict cites "telemetry resumed N ms after the fault"
+        rather than a single post-fault commit scalar."""
+        if not samples:
+            return
+        try:
+            self.metrics = samples
+            ok = [s for s in samples if s.get("ok")]
+            window = max(s["t"] for s in samples) - \
+                min(s["t"] for s in samples)
+            note = (f"Sidecar metrics: {len(samples)} sample(s) "
+                    f"({len(ok)} ok) over {window:g} s")
+            if malformed:
+                note += f", {malformed} torn line(s) skipped"
+            self.notes.append(note)
+            if not self.chaos:
+                return
+            from ..chaos.recovery import event_label
+            from ..obs import recovery_curve
+
+            for e in self.chaos.get("events", []):
+                wall = e.get("wall")
+                if not isinstance(wall, (int, float)):
+                    continue
+                curve = recovery_curve(samples, wall)
+                e["telemetry"] = curve
+                label = f"Chaos {event_label(e)}"
+                if curve["resumed"]:
+                    self.notes.append(
+                        f"{label}: telemetry resumed "
+                        f"{curve['resume_ms']:g} ms after event "
+                        f"({curve['failed_ticks']} failed tick(s))")
+                else:
+                    self.notes.append(
+                        f"{label}: telemetry did NOT resume "
+                        f"({curve['failed_ticks']} failed tick(s) after "
+                        "event)")
+        except (TypeError, ValueError, AttributeError, KeyError):
+            return
 
     def note_wan(self, wan: dict):
         """Fold the run's graftwan spec snapshot (logs/wan.json, the
@@ -570,5 +717,20 @@ class LogParser:
             with open(join(directory, "sidecar-stats.json")) as f:
                 parser.note_sidecar_stats(json.load(f))
         except (OSError, ValueError):
+            pass
+        # grafttrace: merge the run's spans (node TRACE lines + sidecar
+        # JSONL + clock offsets) into the Perfetto-loadable trace.json
+        # artifact and the commit critical-path notes, and fold the
+        # sampled metrics time series in.  All best-effort: a run that
+        # traced nothing parses exactly as before.
+        try:
+            from ..obs import read_samples, write_run_trace
+
+            summary = write_run_trace(directory)
+            if summary is not None:
+                parser.note_trace(summary)
+            samples, torn = read_samples(join(directory, "metrics.jsonl"))
+            parser.note_metrics(samples, malformed=torn)
+        except (OSError, ValueError, TypeError, KeyError):
             pass
         return parser

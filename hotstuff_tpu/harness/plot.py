@@ -128,6 +128,94 @@ class Ploter:
                    lambda tps, tps_std, lat, lat_std: (tps, tps_std),
                    label, "tps-scalability", tps_y_axis=True)
 
+    def plot_trace(self, trace_path=None):
+        """grafttrace: per-stage latency histograms from the run's
+        ``logs/trace.json`` (Chrome trace events; obs/trace.py).  One
+        panel per segment/stage that has samples — the visual form of
+        the "Commit critical path" parser note."""
+        import json
+
+        path = trace_path or PathMaker.trace_file()
+        try:
+            with open(path) as f:
+                chrome = json.load(f)
+        except (OSError, ValueError):
+            raise PlotError(f"no trace artifact at {path} (run a traced "
+                            "bench first)")
+        by_stage = {}
+        for ev in chrome.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            by_stage.setdefault(ev["name"], []).append(
+                float(ev.get("dur", 0)) / 1e3)  # us -> ms
+        by_stage = {k: v for k, v in by_stage.items() if v}
+        if not by_stage:
+            raise PlotError("trace.json has no duration events")
+        fig, axes = self.plt.subplots(
+            1, len(by_stage), squeeze=False,
+            figsize=(3.2 * len(by_stage), 3.2))
+        for ax, (stage, durs) in zip(axes[0], sorted(by_stage.items())):
+            ax.hist(durs, bins=min(30, max(5, len(durs) // 2)))
+            ax.set_title(f"{stage} (n={len(durs)})", fontsize=8)
+            ax.set_xlabel("Latency (ms)", fontsize=7)
+            ax.grid(True, alpha=0.3)
+        axes[0][0].set_ylabel("Spans")
+        for ext in ("pdf", "png"):
+            fig.savefig(PathMaker.plot_file("trace-hist", ext),
+                        bbox_inches="tight")
+        self.plt.close(fig)
+
+    def plot_metrics(self, metrics_path=None):
+        """grafttrace: the sampled OP_STATS time series as throughput /
+        queue-wait curves (``logs/metrics.jsonl``), with failed ticks —
+        a chaos-killed sidecar's telemetry blackout — marked, so a
+        recovery transition is visible as a curve, not a scalar."""
+        from ..obs import read_samples
+
+        path = metrics_path or PathMaker.metrics_file()
+        samples, _ = read_samples(path)
+        if len(samples) < 2:
+            raise PlotError(f"fewer than two metrics samples at {path}")
+        t0 = min(s["t"] for s in samples)
+        xs_ok, sig_rate, wait_p99 = [], [], []
+        xs_bad = []
+        prev = None
+        for s in sorted(samples, key=lambda s: s["t"]):
+            if not s.get("ok"):
+                xs_bad.append(s["t"] - t0)
+                prev = None  # a blackout breaks the rate delta chain
+                continue
+            stats = s.get("stats") or {}
+            sigs = stats.get("sigs_launched", 0)
+            if prev is not None and s["t"] > prev[0]:
+                xs_ok.append(s["t"] - t0)
+                sig_rate.append((sigs - prev[1]) / (s["t"] - prev[0]))
+                wait = (stats.get("queue_wait") or {}).get("latency") or {}
+                wait_p99.append(wait.get("p99_ms", 0))
+            prev = (s["t"], sigs)
+        self.plt.clf()
+        fig, ax = self.plt.subplots(figsize=(6.4, 4.8))
+        ax.plot(xs_ok, sig_rate, marker="o", markersize=3,
+                label="verify throughput (sigs/s)")
+        ax.set_xlabel("Run time (s)")
+        ax.set_ylabel("Sigs/s launched")
+        ax2 = ax.twinx()
+        ax2.plot(xs_ok, wait_p99, color="tab:orange", marker="s",
+                 markersize=3, label="latency queue-wait p99 (ms)")
+        ax2.set_ylabel("Queue wait p99 (ms)")
+        for i, x in enumerate(xs_bad):
+            ax.axvline(x, color="red", alpha=0.4, linestyle="--",
+                       label="failed sample (sidecar down)"
+                       if i == 0 else None)
+        lines, labels = ax.get_legend_handles_labels()
+        l2, lb2 = ax2.get_legend_handles_labels()
+        ax.legend(lines + l2, labels + lb2, loc="best", fontsize="small")
+        ax.grid(True, alpha=0.3)
+        for ext in ("pdf", "png"):
+            fig.savefig(PathMaker.plot_file("metrics", ext),
+                        bbox_inches="tight")
+        self.plt.close(fig)
+
     def plot_matrix(self):
         """graftwan matrix heatmap: one nodes×rate panel of end-to-end
         TPS per (faults, tx_size) group from ``plots/matrix.json``

@@ -436,6 +436,35 @@ class Bench:
             self.runner.run(host, "pkill -f '[.]/client '", check=False,
                             timeout=60.0)
 
+    def _clock_offsets(self, hosts):
+        """grafttrace: estimate each host's wall-clock offset through
+        the ssh transport (RTT-midpoint probes, obs/trace.py) and
+        persist logs/clock-offsets.json keyed by log file name, so the
+        trace merger aligns per-host TRACE stamps before stitching.
+        Best-effort: an unreachable host contributes offset 0."""
+        from time import time as wall
+
+        from ..obs.trace import probe_host_offset
+
+        offsets = {}
+        for i, host in enumerate(hosts):
+            try:
+                # A clock probe is a sub-second `date`: a tight timeout
+                # bounds what a dead host can cost the log-collection
+                # path (probe_host_offset also bails after one failed
+                # dial when no probe has succeeded yet).
+                off = probe_host_offset(
+                    lambda h, c: self.runner.run(
+                        h, c, timeout=10.0).stdout,
+                    host, clock=wall, samples=3)
+            except (ExecutionError, FabricError):
+                continue
+            if off:
+                offsets[f"node-{i}.log"] = round(off, 6)
+        if offsets:
+            with open(PathMaker.clock_offsets_file(), "w") as f:
+                json.dump(offsets, f)
+
     def _logs(self, hosts, faults, chaos_events=None):
         subprocess.run(["/bin/sh", "-c", CommandMaker.clean_logs()],
                        check=True)
@@ -447,6 +476,7 @@ class Bench:
                             PathMaker.node_log_file(i))
             self.runner.get(host, f"{repo}/{PathMaker.client_log_file(i)}",
                             PathMaker.client_log_file(i))
+        self._clock_offsets(alive)
         # The same on-disk contract as the local harness: the parser
         # reads chaos-events.json / wan.json / slo.json from the logs
         # dir and switches into chaos mode (recovery + SLO verdicts,
@@ -465,6 +495,9 @@ class Bench:
         """Full matrix: nodes x rate x runs, appending to result files
         (remote.py:245-300 analogue)."""
         Print.heading("Starting remote benchmark")
+        # grafttrace: fleet runs trace by default too (same setdefault
+        # contract as LocalBench — an explicit "trace": false wins).
+        node_parameters.json.setdefault("trace", True)
         for n in bench_parameters.nodes:
             hosts = self.hosts[:n]
             if len(hosts) < n:

@@ -69,6 +69,31 @@ class PathMaker:
         return join(PathMaker.logs_path(), "sidecar-stats.json")
 
     @staticmethod
+    def sidecar_spans_file():
+        """grafttrace sidecar span JSONL (obs/spans.py schema), written
+        live by the sidecar behind --trace; obs/trace.py merges it into
+        the run's trace.json."""
+        return join(PathMaker.logs_path(), "sidecar-spans.jsonl")
+
+    @staticmethod
+    def metrics_file():
+        """Live OP_STATS time series (obs/sampler.py JSONL), appended
+        at a fixed interval DURING the run window."""
+        return join(PathMaker.logs_path(), "metrics.jsonl")
+
+    @staticmethod
+    def trace_file():
+        """Chrome-trace-event / Perfetto-loadable artifact built from
+        the run's merged spans (obs/trace.write_run_trace)."""
+        return join(PathMaker.logs_path(), "trace.json")
+
+    @staticmethod
+    def clock_offsets_file():
+        """Per-log-file clock offsets in seconds (obs/trace.py), probed
+        over the ssh transport on remote runs; absent locally."""
+        return join(PathMaker.logs_path(), "clock-offsets.json")
+
+    @staticmethod
     def chaos_events_file():
         """graftchaos executed-event record (JSON list, PlanRunner.events
         shape); written after the run window, read back by LogParser for

@@ -1,0 +1,60 @@
+"""grafttrace: cross-layer span tracing + live metrics sampling.
+
+The repo's perf and chaos claims used to rest on end-of-run aggregates
+(LogParser scraping logs, one OP_STATS snapshot at teardown).  This
+package makes every claim attributable to a *place in the pipeline*:
+
+``spans``
+    The span record schema and the :class:`Tracer` JSONL writer the
+    sidecar threads its hot-path stages through (admit -> queue ->
+    pack -> dispatch -> device -> reply), tagged with the request rid
+    and scheduler class.  Timestamps always come from the injected
+    clock — graftlint's ``unclosed-span`` checker enforces both that
+    and the begin/end pairing discipline.
+
+``trace``
+    The collector/merger: parses the C++ node's ``TRACE`` lines
+    (proposal -> verify_submit -> verify_reply -> commit, keyed on
+    block digest + round), estimates per-host clock offsets (RTT
+    midpoint), stitches per-block commit traces across replica logs,
+    computes the critical-path breakdown (p50/p99 per stage), and
+    exports a Chrome-trace-event / Perfetto-loadable ``trace.json``.
+
+``sampler``
+    The live metrics sampler: polls OP_STATS at a fixed interval
+    DURING the run window (not only at teardown), appending time-series
+    samples to ``logs/metrics.jsonl`` so throughput/queue-wait over
+    time can be plotted, chaos SLO verdicts can cite the recovery
+    curve, and a chaos-killed sidecar's telemetry survives as the last
+    good sample.
+"""
+
+from __future__ import annotations
+
+from .sampler import MetricsSampler, read_samples, recovery_curve
+from .spans import SpanError, Tracer, parse_spans
+from .trace import (
+    build_run_trace,
+    chrome_trace,
+    clock_offset,
+    critical_path,
+    parse_node_trace,
+    stitch_blocks,
+    write_run_trace,
+)
+
+__all__ = [
+    "MetricsSampler",
+    "SpanError",
+    "Tracer",
+    "build_run_trace",
+    "chrome_trace",
+    "clock_offset",
+    "critical_path",
+    "parse_node_trace",
+    "parse_spans",
+    "read_samples",
+    "recovery_curve",
+    "stitch_blocks",
+    "write_run_trace",
+]

@@ -1,0 +1,153 @@
+"""Live metrics sampler: OP_STATS as a time series, not a teardown shot.
+
+The harness used to fetch ONE scheduler-telemetry snapshot at teardown —
+so a chaos-killed sidecar lost its stats entirely, and nothing could
+show throughput/queue-wait/breaker behavior *over time*.  The sampler
+polls a fetch callable at a fixed interval for the whole run window and
+appends one JSONL sample per tick to ``logs/metrics.jsonl``::
+
+    {"t": <wall s>, "ok": true,  "stats": {<OP_STATS snapshot>}}
+    {"t": <wall s>, "ok": false, "error": "<why>"}
+
+Failed ticks are RECORDED, not skipped: a sidecar kill shows up as a
+run of ``ok: false`` samples and the restart as the samples resuming —
+that visible gap is how chaos SLO verdicts cite the recovery curve.
+The last good snapshot stays available (``last``) so teardown can fall
+back to it when the sidecar died before the final fetch.
+
+Every tick dials a FRESH connection: a sampler pinned to one socket
+would die with the first kill and miss the restart it exists to show.
+
+Clocks are injected (``clock``/``wall``/``wait``) — the virtual-clock
+tests drive ticks manually, and graftlint's span checker keeps inline
+``time.time()`` out of this package.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from time import time as _wall_clock
+
+
+class MetricsSampler:
+    def __init__(self, fetch, path: str, interval_s: float = 1.0,
+                 wall=_wall_clock, wait=None):
+        """``fetch()`` returns one JSON-safe stats snapshot dict (and may
+        raise OSError/ConnectionError/ValueError on a dead or garbled
+        sidecar); ``wait(seconds) -> bool`` returns True when the
+        sampler should stop (default: the stop event's own ``wait``,
+        which a test replaces with a virtual clock)."""
+        self._fetch = fetch
+        self._path = path
+        self._interval_s = interval_s
+        self._wall = wall
+        self._stop = threading.Event()
+        self._wait = wait if wait is not None else self._stop.wait
+        self._lock = threading.Lock()
+        self._file = None
+        self._thread = None
+        self.samples = 0
+        self.ok_samples = 0
+        self.last = None  # (wall_ts, snapshot) of the last GOOD sample
+
+    # -- one tick (the unit tests drive this directly) -----------------------
+
+    def sample_once(self):
+        """Fetch + record one sample; returns the record written (or
+        None once the sink failed — telemetry never raises)."""
+        t = self._wall()
+        try:
+            snap = self._fetch()
+            if not isinstance(snap, dict):
+                raise ValueError(f"snapshot is {type(snap).__name__}, "
+                                 "not a dict")
+            rec = {"t": t, "ok": True, "stats": snap}
+            self.last = (t, snap)
+            self.ok_samples += 1
+        except (OSError, ConnectionError, ValueError, RuntimeError) as e:
+            rec = {"t": t, "ok": False, "error": f"{e!r:.200}"}
+        self.samples += 1
+        return rec if self._write(rec) else None
+
+    def _write(self, rec: dict) -> bool:
+        with self._lock:
+            try:
+                if self._file is None:
+                    self._file = open(self._path, "a", encoding="utf-8")
+                self._file.write(json.dumps(rec, sort_keys=True) + "\n")
+                self._file.flush()
+                return True
+            except (OSError, TypeError, ValueError):
+                return False
+
+    # -- thread lifecycle ----------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="metrics-sampler")
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while True:
+            self.sample_once()
+            if self._wait(self._interval_s):
+                return
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+
+
+def read_samples(path: str):
+    """``metrics.jsonl`` -> ``(samples, malformed)`` with torn lines
+    skipped and counted (a SIGKILLed harness can cut a line short;
+    spans.parse_jsonl is the shared tolerance contract)."""
+    from .spans import parse_jsonl
+
+    try:
+        with open(path, errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return [], 0
+    return parse_jsonl(
+        text,
+        lambda rec: isinstance(rec.get("t"), (int, float))
+        and "ok" in rec)
+
+
+def recovery_curve(samples, event_wall: float) -> dict:
+    """What the sampled time series says about one fault event::
+
+        {"resumed": bool,        # a good sample exists after the event
+         "resume_ms": float,     # event -> first good sample after
+         "failed_ticks": int,    # ok=false samples after the event,
+                                 # before telemetry resumed
+         "samples_after": int}
+
+    This is the curve behind an SLO verdict: "recovered in 2.1 s" plus
+    "telemetry blacked out for 3 failed ticks" tells the reader the
+    sidecar actually died and came back, where the commit-only scalar
+    could not distinguish a kill from a hiccup."""
+    after = sorted((s for s in samples if s["t"] > event_wall),
+                   key=lambda s: s["t"])
+    failed = 0
+    for s in after:
+        if s.get("ok"):
+            return {"resumed": True,
+                    "resume_ms": round((s["t"] - event_wall) * 1e3, 3),
+                    "failed_ticks": failed,
+                    "samples_after": len(after)}
+        failed += 1
+    return {"resumed": False, "resume_ms": None,
+            "failed_ticks": failed, "samples_after": len(after)}

@@ -146,7 +146,8 @@ class VerifyEngine:
 
     def __init__(self, mesh_devices: int | None = None, use_host: bool = False,
                  committee: int | None = None,
-                 client_rate: int | None = None):
+                 client_rate: int | None = None,
+                 tracer=None):
         # All launch-shape policy lives in the scheduler subsystem: the
         # shape registry records what the warmup compiled (until
         # enable_bulk, launches cap at MAX_SUBBATCH; _warmup covers every
@@ -166,6 +167,13 @@ class VerifyEngine:
                                        latency_cap_sigs=lat_cap,
                                        bulk_cap_sigs=bulk_cap)
         self._use_host = use_host
+        # grafttrace: span emission through every engine stage (admit ->
+        # queue -> pack -> dispatch -> device -> reply), tagged with the
+        # request rid and scheduler class.  The null tracer short-circuits
+        # every call, so the un-traced hot path pays only a method call.
+        from ..obs.spans import Tracer
+
+        self._tracer = tracer if tracer is not None else Tracer.disabled()
         # Device multi-digest pairing programs compile one shape per vote
         # count (minutes each); only counts warmed via _warmup_bls_multi
         # may launch on device — others verify on host so a surprise TC
@@ -201,7 +209,11 @@ class VerifyEngine:
         queue-full — nothing was retained and the CALLER must reply
         (the handler sends the explicit empty-mask backpressure reply);
         never blocks the calling connection thread."""
-        return self._sched.offer(request, reply_fn, cls=cls, is_bls=is_bls)
+        ok = self._sched.offer(request, reply_fn, cls=cls, is_bls=is_bls)
+        self._tracer.event("admit", rid=request.request_id, cls=cls,
+                           ok=ok, n=len(getattr(request, "msgs", ()) or ())
+                           or 1)
+        return ok
 
     def stats_snapshot(self) -> dict:
         """The OP_STATS reply body: scheduler telemetry + warmed shapes."""
@@ -295,7 +307,7 @@ class VerifyEngine:
         from concurrent import futures as cfut
 
         packing = collections.deque()   # (batch, Future[dispatch_fn])
-        inflight = collections.deque()  # (batch, fetch_fn)
+        inflight = collections.deque()  # (batch, fetch_fn, dispatched_at)
         while not self._stopped.is_set():
             # 1) A FINISHED pack moves onto the device whenever there is
             #    dispatch room.  Unfinished packs are waited out in step
@@ -314,6 +326,7 @@ class VerifyEngine:
                 launch = self._sched.next_launch(timeout=0.25) if idle \
                     else self._sched.next_launch(block=False)
                 if launch is not None:
+                    self._trace_queue_waits(launch)
                     # BLS requests run individually (a QC aggregate is
                     # one check; there is nothing to coalesce) on the
                     # same device thread, after the whole Ed25519
@@ -322,11 +335,14 @@ class VerifyEngine:
                         (item,) = launch.items
                         while inflight:
                             self._drain_one(inflight)
-                        try:
-                            self._execute_bls(item)
-                        except Exception:
-                            log.exception("BLS request failed")
-                            item.reply_fn(None)
+                        with self._tracer.span(
+                                "device", kind="bls",
+                                rid=item.request.request_id):
+                            try:
+                                self._execute_bls(item)
+                            except Exception:
+                                log.exception("BLS request failed")
+                                item.reply_fn(None)
                         continue
                     batch = launch.items
                     packing.append(
@@ -354,6 +370,23 @@ class VerifyEngine:
             self._drain_one(inflight)
         self._pack_pool.shutdown(wait=False)
 
+    def _trace_queue_waits(self, launch):
+        """One ``queue`` span per launched item (duration = admission ->
+        launch assembly, the same wait the OP_STATS reservoirs sample)."""
+        if not self._tracer.enabled:
+            return
+        now = monotonic()
+        for p in launch.items:
+            self._tracer.event("queue", dur_ms=(now - p.enqueued_at) * 1e3,
+                               rid=p.request.request_id, cls=p.cls)
+
+    def _trace_replies(self, batch):
+        if not self._tracer.enabled:
+            return
+        for p in batch:
+            self._tracer.event("reply", rid=p.request.request_id,
+                               cls=p.cls)
+
     def _dispatch_one(self, packing, inflight):
         """Move the oldest staged pack onto the device (engine thread)."""
         batch, fut = packing.popleft()
@@ -363,12 +396,14 @@ class VerifyEngine:
             log.exception("verify batch pack/dispatch failed")
             for p in batch:
                 p.reply_fn([False] * len(p.request.msgs))
+            self._trace_replies(batch)
             return
-        inflight.append((batch, fetch))
+        self._tracer.event("dispatch", reqs=len(batch))
+        inflight.append((batch, fetch, monotonic()))
         self._inflight_n = len(inflight)
 
     def _drain_one(self, inflight):
-        batch, fetch = inflight.popleft()
+        batch, fetch, dispatched_at = inflight.popleft()
         self._inflight_n = len(inflight)
         try:
             mask = fetch()
@@ -376,12 +411,20 @@ class VerifyEngine:
             log.exception("verify batch failed")
             for p in batch:
                 p.reply_fn([False] * len(p.request.msgs))
+            self._trace_replies(batch)
             return
+        # The device stage spans dispatch -> fetch completion: it
+        # includes the tunnel round trip, exactly what the engine pays.
+        self._tracer.event("device",
+                           dur_ms=(monotonic() - dispatched_at) * 1e3,
+                           reqs=len(batch),
+                           sigs=sum(len(p.request.msgs) for p in batch))
         off = 0
         for p in batch:
             n = len(p.request.msgs)
             p.reply_fn([bool(b) for b in mask[off:off + n]])
             off += n
+        self._trace_replies(batch)
 
     def _submit(self, batch):
         """Two-stage form of the launch path (pack + dispatch in one
@@ -478,6 +521,9 @@ class VerifyEngine:
                                                    m_sigs[i:i + step])
                            for i in range(0, len(m_msgs), step)]
         stats.note_pack(monotonic() - t0, hidden)
+        self._tracer.event("pack", dur_ms=(monotonic() - t0) * 1e3,
+                           reqs=len(batch), uniq=len(uniq_records),
+                           path=path, hidden=hidden)
 
         def dispatch():
             fetchers = [d() for d in dispatchers]
@@ -828,9 +874,17 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
           warm_bls_multi: int = 0, warm_bulk: bool = False,
           warm_rlc: bool = False, warm_rlc_sharded: bool = False,
           chaos: bool = False,
-          committee: int | None = None, client_rate: int | None = None):
+          committee: int | None = None, client_rate: int | None = None,
+          trace_path: str | None = None):
+    tracer = None
+    if trace_path:
+        from ..obs.spans import Tracer
+
+        tracer = Tracer(trace_path)
+        log.info("grafttrace span emission -> %s", trace_path)
     engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host,
-                          committee=committee, client_rate=client_rate)
+                          committee=committee, client_rate=client_rate,
+                          tracer=tracer)
     # Warm the jit cache BEFORE binding: until the socket exists, node
     # crypto gets ECONNREFUSED and falls back to host verify instead of
     # connecting into a server whose device thread is still compiling.
@@ -876,6 +930,8 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
     finally:
         engine.stop()
         server.server_close()
+        if tracer is not None:
+            tracer.close()
     return server
 
 
@@ -1082,6 +1138,11 @@ def main(argv=None):
                          "coalesced batches of %d+ signatures route "
                          "through the sharded combined check"
                          % vsched.RLC_MIN_LAUNCH)
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="append grafttrace JSONL spans (admit/queue/"
+                         "pack/dispatch/device/reply, tagged rid + "
+                         "scheduler class) to PATH; obs/trace.py merges "
+                         "them into the run's trace.json")
     ap.add_argument("--chaos", action="store_true",
                     help="enable the OP_CHAOS fault-injection hook "
                          "(bounded reply delay, forced connection drops, "
@@ -1105,7 +1166,8 @@ def main(argv=None):
           warm_bulk=args.warm_bulk, warm_rlc=args.warm_rlc,
           warm_rlc_sharded=args.warm_rlc_sharded,
           chaos=args.chaos, committee=args.committee or None,
-          client_rate=args.client_rate or None)
+          client_rate=args.client_rate or None,
+          trace_path=args.trace)
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ namespace hotstuff {
 
 namespace {
 std::atomic<int> g_level{static_cast<int>(LogLevel::kInfo)};
+std::atomic<bool> g_trace{false};
 std::mutex g_sink_mutex;
 
 const char* level_name(LogLevel l) {
@@ -29,6 +30,12 @@ void log_set_level(LogLevel level) {
 }
 
 LogLevel log_level() { return static_cast<LogLevel>(g_level.load()); }
+
+void log_set_trace(bool on) { g_trace.store(on, std::memory_order_relaxed); }
+
+bool log_trace_enabled() {
+  return g_trace.load(std::memory_order_relaxed);
+}
 
 void log_write(LogLevel level, const std::string& module,
                const std::string& message) {

@@ -16,6 +16,15 @@ enum class LogLevel { kError = 1, kWarn, kInfo, kDebug };
 void log_set_level(LogLevel level);
 LogLevel log_level();
 
+// grafttrace span emission (default off; the parameters-file "trace"
+// flag turns it on).  Disabled cost is one relaxed atomic load per
+// instrumented site — the hot path pays nothing measurable, and the
+// TRACE line grammar ("TRACE stage=<s> block=<digest> round=<r>") is
+// mined by hotstuff_tpu/obs/trace.py, so it is frozen like the rest of
+// the log grammar.
+void log_set_trace(bool on);
+bool log_trace_enabled();
+
 // Sink is stderr by default (the harness redirects per-process to
 // logs/node-i.log, matching benchmark/local.py:25-28).
 void log_write(LogLevel level, const std::string& module,

@@ -15,6 +15,18 @@ namespace consensus {
 
 namespace {
 
+// grafttrace: one machine-parseable span line per consensus hot-path
+// stage, keyed on block digest + round so obs/trace.py can stitch the
+// per-block commit critical path across replica logs.  Disabled cost is
+// the one relaxed atomic load in log_trace_enabled() — digest
+// serialization is only paid when tracing is on.
+void trace_stage(const char* stage, const Block& block) {
+  if (!log_trace_enabled()) return;
+  LOG_INFO("consensus::core")
+      << "TRACE stage=" << stage << " block=" << block.digest().to_base64()
+      << " round=" << block.round;
+}
+
 // The replica state machine (one instance on one thread).
 class CoreImpl {
  public:
@@ -160,6 +172,7 @@ class CoreImpl {
     state_dirty_ = true;
 
     for (const Block& b : to_commit) {
+      trace_stage("commit", b);
       if (!b.payload.empty()) {
         LOG_INFO("consensus::core") << "Committed B" << b.round;
         // NOTE: These log entries are used to compute performance
@@ -525,6 +538,7 @@ class CoreImpl {
   // Completion loopback of an async certificate verification.
   VerifyResult handle_verdict(const Block& block,
                               std::optional<bool> verdict) {
+    trace_stage("verify_reply", block);
     pending_verify_.erase(block.digest());
     if (!verdict.has_value()) {
       // Transport failure: the sidecar is backed off, so the synchronous
@@ -558,6 +572,7 @@ class CoreImpl {
   }
 
   VerifyResult handle_proposal(const Block& block) {
+    trace_stage("proposal", block);
     // Leader check (core.rs:399-406).
     if (block.author != leader_elector_->get_leader(block.round)) {
       return VerifyResult::bad("wrong leader for round " +
@@ -607,6 +622,7 @@ class CoreImpl {
 
     if ((need_qc || need_tc) &&
         try_dispatch_verify(block, need_qc, need_tc)) {
+      trace_stage("verify_submit", block);
       // The expiry covers a lost verdict event: transport failures arrive
       // well inside the scheme's sidecar deadline, so anything older is
       // gone for good and the next delivery of the block must re-verify.

@@ -71,6 +71,9 @@ Parameters Parameters::from_json(const Json& j) {
       throw JsonError("unknown scheme: " + p.scheme);
     }
   }
+  if (auto* v = j.find("trace")) {
+    p.trace = v->as_bool();
+  }
   return p;
 }
 

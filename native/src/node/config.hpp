@@ -40,6 +40,9 @@ struct Parameters {
   // "ed25519" (default) or "bls" — the reference's branch-level scheme
   // choice as a runtime knob (README.md:1-3).
   std::string scheme = "ed25519";
+  // grafttrace: emit machine-parseable TRACE span lines at the
+  // consensus hot-path stages (hotstuff_tpu/obs/trace.py mines them).
+  bool trace = false;
 
   static Parameters read(const std::string& path);
   static Parameters from_json(const Json& j);

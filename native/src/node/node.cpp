@@ -21,6 +21,14 @@ std::unique_ptr<Node> Node::create(const std::string& committee_file,
   node->store_ = Store::open(store_path);
   node->commit_ = make_channel<consensus::Block>();
 
+  // grafttrace: span lines are opt-in per deployment; the harness turns
+  // them on for benched runs so commit latency is attributable per
+  // stage (obs/trace.py stitches them into per-block critical paths).
+  if (parameters.trace) {
+    log_set_trace(true);
+    LOG_INFO("node::node") << "Consensus tracing enabled (TRACE spans)";
+  }
+
   // Device dispatch for QC batch verification (process-wide; the crypto
   // layer falls back to host verify when absent/unreachable).
   if (parameters.tpu_sidecar) {

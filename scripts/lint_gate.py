@@ -45,6 +45,17 @@ if __name__ == "__main__":
                     "sockets:hotstuff_tpu/harness/faults.py",
                     "sockets:hotstuff_tpu/harness/remote.py",
                     "sockets:hotstuff_tpu/harness/local.py",
-                    "sockets:hotstuff_tpu/harness/logs.py"):
+                    "sockets:hotstuff_tpu/harness/logs.py",
+                    # grafttrace: every obs module stays inside the span
+                    # checker AND the timing checker's scans (the
+                    # critical-path numbers those modules compute feed
+                    # every future perf claim).
+                    "obsspan:hotstuff_tpu/obs/__init__.py",
+                    "obsspan:hotstuff_tpu/obs/spans.py",
+                    "obsspan:hotstuff_tpu/obs/trace.py",
+                    "obsspan:hotstuff_tpu/obs/sampler.py",
+                    "obsspan:hotstuff_tpu/sidecar/service.py",
+                    "timing:hotstuff_tpu/obs/trace.py",
+                    "timing:hotstuff_tpu/obs/sampler.py"):
             argv += ["--must-cover", pin]
     sys.exit(main(argv))

@@ -746,3 +746,135 @@ def test_unbounded_socket_op_suppression():
 
 def test_sockets_rule_quiet_on_real_tree():
     assert sockets.check(REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# obsspan rules (grafttrace instrumentation discipline)
+# ---------------------------------------------------------------------------
+
+from hotstuff_tpu.analysis import obsspan
+
+
+def olint(src: str, path: str = "hotstuff_tpu/obs/mod.py"):
+    return obsspan.check_sources({path: textwrap.dedent(src)})
+
+
+def test_unclosed_span_fires_without_finally():
+    findings = olint("""
+        def pack(tracer):
+            tok = tracer.begin_span("pack")
+            do_work()
+            tracer.end_span(tok)   # an exception above leaks the span
+    """)
+    assert [f.rule for f in findings] == ["unclosed-span"]
+
+
+def test_unclosed_span_quiet_on_try_finally_and_with():
+    assert olint("""
+        def pack(tracer):
+            tok = tracer.begin_span("pack")
+            try:
+                do_work()
+            finally:
+                tracer.end_span(tok)
+
+        def bls(tracer):
+            with tracer.span("device"):
+                do_work()
+    """) == []
+
+
+def test_unclosed_span_exempts_context_manager_enter():
+    # The _SpanCtx protocol: __enter__ begins, __exit__ ends — the
+    # pairing is the interpreter's job, not a finally block's.
+    assert olint("""
+        class Ctx:
+            def __enter__(self):
+                self._tok = self._tracer.begin_span(self._stage)
+                return self._tok
+
+            def __exit__(self, *exc):
+                self._tracer.end_span(self._tok)
+    """) == []
+
+
+def test_unclosed_span_scopes_are_per_function():
+    # An end_span in a DIFFERENT function does not close this one.
+    findings = olint("""
+        def a(tracer):
+            tok = tracer.begin_span("x")
+            return tok
+
+        def b(tracer, tok):
+            try:
+                pass
+            finally:
+                tracer.end_span(tok)
+    """)
+    assert [f.rule for f in findings] == ["unclosed-span"]
+
+
+def test_span_inline_clock_fires_in_obs_modules_only():
+    src = """
+        import time
+        def sample(self):
+            return time.time()
+    """
+    findings = olint(src)
+    assert [f.rule for f in findings] == ["span-inline-clock"]
+    # the engine module may read monotonic() for OP_STATS; the clock
+    # rule is scoped to obs/
+    assert olint(src, path="hotstuff_tpu/sidecar/service.py") == []
+
+
+def test_span_inline_clock_allows_injected_default():
+    # A clock REFERENCE as a default parameter is the sanctioned idiom.
+    assert olint("""
+        from time import time as _wall_clock
+
+        class Tracer:
+            def __init__(self, clock=_wall_clock):
+                self._clock = clock
+
+            def now(self):
+                return self._clock()
+    """) == []
+
+
+def test_span_inline_clock_catches_bare_imported_names():
+    findings = olint("""
+        from time import monotonic
+        def tick(self):
+            return monotonic()
+    """)
+    assert [f.rule for f in findings] == ["span-inline-clock"]
+
+
+def test_obsspan_suppression_comment():
+    assert olint("""
+        import time
+        def sample(self):
+            # graftlint: disable=span-inline-clock
+            return time.time()
+    """) == []
+
+
+def test_obsspan_quiet_on_real_tree():
+    assert obsspan.check(REPO) == []
+
+
+def test_obs_modules_pinned_to_span_and_timing_scans():
+    from hotstuff_tpu.analysis.__main__ import check_coverage
+
+    assert check_coverage(REPO, [
+        "obsspan:hotstuff_tpu/obs/__init__.py",
+        "obsspan:hotstuff_tpu/obs/spans.py",
+        "obsspan:hotstuff_tpu/obs/trace.py",
+        "obsspan:hotstuff_tpu/obs/sampler.py",
+        "obsspan:hotstuff_tpu/sidecar/service.py",
+        "timing:hotstuff_tpu/obs/trace.py",
+        "timing:hotstuff_tpu/obs/sampler.py",
+    ]) == []
+    # a module outside the obsspan targets fails its qualified pin
+    out = check_coverage(REPO, ["obsspan:hotstuff_tpu/harness/logs.py"])
+    assert [f.rule for f in out] == ["must-cover"]

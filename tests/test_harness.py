@@ -351,3 +351,186 @@ def test_save_cache_keeps_best_for_same_kernel(bench_mod):
     bench_mod.save_cache(100_000.0, 10.0, 10_000.0)
     bench_mod.save_cache(60_000.0, 6.0, 10_000.0)  # lower: not stored
     assert bench_mod.load_cache()["value"] == 100_000.0
+
+
+# ---------------------------------------------------------------------------
+# grafttrace: torn-line tolerance, critical-path notes, metrics series,
+# sampled-stats fallback (PR 7)
+# ---------------------------------------------------------------------------
+
+
+def test_parser_tolerates_torn_log_lines():
+    """Torn/interleaved lines from concurrent writers are skipped and
+    counted — including a fragment that would otherwise fake a fatal
+    ' ERROR ' hit — and never raise in non-strict mode."""
+    torn = (GOLDEN_NODE
+            + "mpool::batch_maker] torn tail with ERROR inside\n"
+            + "[2026-07-29T14:5[2026-07-29T14:54:58.000Z INFO x] mix\n")
+    parser = LogParser([GOLDEN_CLIENT], [torn], faults=0)
+    assert parser.malformed_lines == 2
+    assert any("skipped 2 torn/malformed log line(s)" in n
+               for n in parser.notes)
+    assert len(parser.commits) == 2  # metrics unaffected
+    with pytest.raises(ParseError):
+        LogParser([GOLDEN_CLIENT], [torn], faults=0, strict_lines=True)
+
+
+def test_parser_keeps_crash_evidence_through_sanitizer():
+    """libstdc++ prints 'terminate called ...' with NO log prefix; the
+    torn-line sanitizer must keep such lines so a crashed replica still
+    raises 'Node(s) failed' instead of parsing as a clean run."""
+    crashed = (GOLDEN_NODE
+               + "terminate called after throwing an instance of "
+               "'std::runtime_error'\n"
+               + "  what():  store wedged\n")
+    with pytest.raises(ParseError, match="Node"):
+        LogParser([GOLDEN_CLIENT], [crashed], faults=0)
+
+
+def test_parser_notes_commit_critical_path():
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_trace({
+        "blocks": 5, "complete": 4,
+        "segments": {
+            "proposal->verify_submit": {"n": 4, "p50_ms": 1.5,
+                                        "p99_ms": 3.0},
+            "verify_submit->verify_reply": {"n": 4, "p50_ms": 22.0,
+                                            "p99_ms": 41.0},
+            "verify_reply->commit": {"n": 4, "p50_ms": 9.0,
+                                     "p99_ms": 12.0},
+            "proposal->commit": {"n": 5, "p50_ms": 50.0, "p99_ms": 80.0},
+        },
+        "sidecar": {"queue": {"n": 9, "p50_ms": 0.8, "p99_ms": 2.0},
+                    "device": {"n": 9, "p50_ms": 17.0, "p99_ms": 25.0},
+                    "reply": {"n": 9, "p50_ms": 0.1, "p99_ms": 0.2}},
+    })
+    out = parser.result()
+    assert "Commit critical path (5 block(s), 4 fully traced)" in out
+    assert "verify_submit->verify_reply p50 22 ms / p99 41 ms" in out
+    assert "proposal->commit p50 50 ms / p99 80 ms" in out
+    assert "Sidecar stage latency: device p50 17 ms / p99 25 ms; " \
+           "queue p50 0.8 ms / p99 2 ms" in out
+    assert parser.trace is not None
+    # labelled RESULTS grammar untouched
+    assert "End-to-end TPS" in out
+    # hostile summaries add nothing and never raise
+    quiet = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    quiet.note_trace({"segments": {"proposal->commit": {"n": 1}}})
+    quiet.note_trace("garbage")
+    quiet.note_trace({"segments": None})
+    assert quiet.notes == [] and quiet.trace is None
+
+
+def test_parser_process_builds_trace_artifact(tmp_path):
+    """End-to-end: TRACE lines in a node log -> trace.json artifact +
+    'Commit critical path' note out of LogParser.process."""
+    trace_lines = "\n".join([
+        "[2026-07-29T14:54:56.800Z INFO consensus::core] TRACE "
+        "stage=proposal block=xyz= round=2",
+        "[2026-07-29T14:54:56.820Z INFO consensus::core] TRACE "
+        "stage=verify_submit block=xyz= round=2",
+        "[2026-07-29T14:54:56.860Z INFO consensus::core] TRACE "
+        "stage=verify_reply block=xyz= round=2",
+        "[2026-07-29T14:54:56.900Z INFO consensus::core] TRACE "
+        "stage=commit block=xyz= round=2",
+    ])
+    (tmp_path / "client-0.log").write_text(GOLDEN_CLIENT)
+    (tmp_path / "node-0.log").write_text(GOLDEN_NODE + trace_lines + "\n")
+    parser = LogParser.process(str(tmp_path), faults=0)
+    assert parser.trace is not None
+    assert parser.trace["segments"]["proposal->commit"]["n"] == 1
+    assert any("Commit critical path" in n for n in parser.notes)
+    with open(tmp_path / "trace.json") as f:
+        chrome = json.load(f)
+    assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+
+
+def test_parser_notes_metrics_and_chaos_recovery_curve():
+    """The sampled time series lands as a CONFIG note, and under a
+    chaos plan each event's verdict cites the telemetry recovery curve
+    (resumed N ms after the event, M failed ticks) instead of only the
+    first post-fault commit scalar."""
+    wall = LogParser._to_posix("2026-07-29T14:54:56.800Z")
+    events = [{"t": 5.0, "target": "sidecar", "action": "kill",
+               "wall": wall, "ok": True}]
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0,
+                       chaos_events=events, strict_chaos=True)
+    samples = [
+        {"t": wall - 1.0, "ok": True, "stats": {"launches": 3}},
+        {"t": wall + 0.5, "ok": False, "error": "down"},
+        {"t": wall + 1.5, "ok": True, "stats": {"launches": 4}},
+    ]
+    parser.note_metrics(samples, malformed=1)
+    out = parser.result()
+    assert "Sidecar metrics: 3 sample(s) (2 ok) over 2.5 s" in out
+    assert "1 torn line(s) skipped" in out
+    assert "telemetry resumed 1500 ms after event (1 failed tick(s))" \
+        in out
+    assert parser.chaos["events"][0]["telemetry"]["resumed"] is True
+    # without samples: nothing added
+    quiet = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    quiet.note_metrics([])
+    assert quiet.notes == [] and quiet.metrics is None
+
+
+def test_parser_notes_sampled_stats_fallback():
+    """A sidecar-stats.json recovered from the periodic sampler (the
+    sidecar was chaos-killed before teardown) says so in the notes."""
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_sidecar_stats({
+        "launches": 7, "launches_by_class": {"latency": 7},
+        "bulk_fill_sigs": 0, "pad_waste_sigs": 0,
+        "_from_sample_at": 1753800000.0})
+    out = parser.result()
+    assert "Sidecar stats from last sample @ 2025-07-29T" in out
+    assert "(sidecar unreachable at teardown)" in out
+    assert "Sidecar launches: 7" in out
+
+
+def test_fetch_sidecar_stats_falls_back_to_last_sample(tmp_path,
+                                                       monkeypatch):
+    """LocalBench._fetch_sidecar_stats: when the live OP_STATS fetch
+    fails (dead sidecar), the sampler's last good snapshot is persisted
+    with the _from_sample_at marker instead of dropping the section."""
+    import hotstuff_tpu.harness.local as local_mod
+    from hotstuff_tpu.harness.local import LocalBench
+    from hotstuff_tpu.harness.utils import PathMaker
+
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "logs").mkdir()
+    bench = LocalBench.__new__(LocalBench)
+    bench.SIDECAR_PORT = 1  # nothing listens: the fetch must fail
+
+    class _Sampler:
+        last = (1753800123.0, {"launches": 5, "sigs_launched": 640})
+
+    bench._sampler = _Sampler()
+    bench._fetch_sidecar_stats()
+    with open(PathMaker.sidecar_stats_file()) as f:
+        stats = json.load(f)
+    assert stats["launches"] == 5
+    assert stats["_from_sample_at"] == 1753800123.0
+
+    # No sampler snapshot at all: nothing written, no exception.
+    (tmp_path / "logs" / "sidecar-stats.json").unlink()
+    bench._sampler = None
+    bench._fetch_sidecar_stats()
+    assert not (tmp_path / "logs" / "sidecar-stats.json").exists()
+
+
+def test_trace_headline_probe_schema(bench_mod):
+    """The headline `trace` field: known skew recovered, partial trace
+    tolerated, Chrome round trip intact (the field rides the degraded
+    line too, so this schema is what a no-device run publishes)."""
+    out = bench_mod.trace_headline_probe()
+    assert out["roundtrip_ok"] is True
+    assert out["blocks"] == 2 and out["complete"] == 1
+    assert out["offset_applied_ms"] == pytest.approx(125.0)
+    segs = out["segments"]
+    # replica 1's skewed observations aligned BEHIND replica 0's, so
+    # the earliest-wins totals are replica 0's own
+    assert segs["proposal->commit"]["n"] == 2
+    assert segs["proposal->commit"]["p50_ms"] == pytest.approx(50.0)
+    assert segs["verify_submit->verify_reply"]["p50_ms"] == \
+        pytest.approx(24.0)
+    assert out["chrome_events"] > 0

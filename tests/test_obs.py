@@ -1,0 +1,536 @@
+"""grafttrace tests: span writer/parser, clock-offset alignment,
+per-block critical-path stitching (including dropped/partial spans),
+Chrome trace JSON schema round trip, the live metrics sampler on a
+virtual clock across a sidecar kill/restart, and the directory-level
+trace build the harness + LogParser drive.
+
+All CPU-only and fast (no jax, no device, no sleeps beyond thread
+joins) — the suite runs in tier-1.
+"""
+
+import json
+import threading
+
+import pytest
+
+from hotstuff_tpu.obs import (
+    MetricsSampler,
+    Tracer,
+    build_run_trace,
+    chrome_trace,
+    clock_offset,
+    critical_path,
+    parse_node_trace,
+    parse_spans,
+    read_samples,
+    recovery_curve,
+    stitch_blocks,
+    write_run_trace,
+)
+from hotstuff_tpu.obs.trace import (
+    apply_offset,
+    estimate_offset,
+    probe_host_offset,
+    sidecar_breakdown,
+)
+
+
+def _trace_line(sec, stage, block="aaa=", rnd=2, ms="000"):
+    return (f"[2026-08-03T12:00:{sec:02d}.{ms}Z INFO consensus::core] "
+            f"TRACE stage={stage} block={block} round={rnd}")
+
+
+# ---------------------------------------------------------------------------
+# span writer / parser
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_writes_jsonl_spans(tmp_path):
+    path = str(tmp_path / "spans.jsonl")
+    now = [100.0]
+    tracer = Tracer(path, clock=lambda: now[0])
+    tok = tracer.begin_span("pack", rid=7, cls="latency")
+    now[0] += 0.005
+    tracer.end_span(tok)
+    tracer.event("device", dur_ms=18.5, rid=7)
+    with tracer.span("bls", rid=9):
+        now[0] += 0.002
+    tracer.close()
+    spans, malformed = parse_spans((tmp_path / "spans.jsonl").read_text())
+    assert malformed == 0
+    assert [s["stage"] for s in spans] == ["pack", "device", "bls"]
+    assert spans[0]["rid"] == 7 and spans[0]["cls"] == "latency"
+    assert spans[0]["dur_ms"] == pytest.approx(5.0)
+    assert spans[1]["dur_ms"] == 18.5
+    assert spans[2]["dur_ms"] == pytest.approx(2.0)
+
+
+def test_disabled_tracer_is_noop(tmp_path):
+    tracer = Tracer.disabled()
+    tok = tracer.begin_span("pack")
+    tracer.end_span(tok)
+    tracer.event("device", dur_ms=1.0)
+    with tracer.span("x"):
+        pass
+    assert not tracer.enabled and tracer.dropped == 0
+
+
+def test_tracer_survives_dead_sink(tmp_path):
+    # A directory as the sink path: open() fails -> tracer disables
+    # itself and the caller never sees an exception.
+    tracer = Tracer(str(tmp_path))
+    tracer.event("pack", dur_ms=1.0)
+    assert not tracer.enabled and tracer.dropped == 1
+    tracer.event("pack", dur_ms=1.0)  # still silent
+
+
+def test_parse_spans_skips_torn_lines():
+    text = (json.dumps({"stage": "pack", "t": 1.0, "dur_ms": 2.0})
+            + "\n{\"stage\": \"dev"              # torn mid-write
+            + "\nnot json at all\n"
+            + json.dumps({"no_stage": True, "t": 2.0}) + "\n"
+            + json.dumps({"stage": "device", "t": "bad"}) + "\n"
+            + json.dumps({"stage": "device", "t": 3.0, "dur_ms": 1.0})
+            + "\n")
+    spans, malformed = parse_spans(text)
+    assert [s["stage"] for s in spans] == ["pack", "device"]
+    assert malformed == 4
+
+
+# ---------------------------------------------------------------------------
+# node TRACE parsing + clock alignment
+# ---------------------------------------------------------------------------
+
+
+def test_parse_node_trace_mines_trace_lines():
+    log = "\n".join([
+        "[2026-08-03T12:00:01.000Z INFO node::node] Node abc= booted",
+        _trace_line(1, "proposal"),
+        _trace_line(1, "verify_submit", ms="010"),
+        _trace_line(1, "bogus_stage"),          # unknown stage: skipped
+        _trace_line(2, "commit"),
+    ])
+    spans = parse_node_trace(log, host="node-0.log")
+    assert [s["stage"] for s in spans] == \
+        ["proposal", "verify_submit", "commit"]
+    assert all(s["block"] == "aaa=" and s["round"] == 2 for s in spans)
+    assert spans[1]["t"] - spans[0]["t"] == pytest.approx(0.010)
+
+
+def test_clock_offset_two_fake_hosts_with_known_skew():
+    """The satellite test: two hosts, one running 2.5 s ahead; the
+    RTT-midpoint estimator recovers the skew and alignment makes the
+    merged trace causally consistent."""
+    skew = 2.5
+    rtt = 0.010
+    probes = [(t, t + rtt / 2 + skew, t + rtt) for t in (10.0, 11.0, 12.0)]
+    offset = estimate_offset(probes)
+    assert offset == pytest.approx(skew, abs=1e-9)
+
+    # Host A (reference) sees proposal at 100.0; host B's stamps carry
+    # the skew.  After alignment the earliest-wins merge must order the
+    # stages causally: B's commit observation lands AFTER A's proposal.
+    spans_a = [{"host": "a", "stage": "proposal", "t": 100.0,
+                "block": "x=", "round": 4}]
+    spans_b = [{"host": "b", "stage": "commit", "t": 100.2 + skew,
+                "block": "x=", "round": 4}]
+    aligned = spans_a + apply_offset(spans_b, offset)
+    traces = stitch_blocks(aligned)
+    stages = traces[("x=", 4)]
+    assert stages["commit"] - stages["proposal"] == pytest.approx(0.2)
+
+
+def test_estimate_offset_median_discards_outlier():
+    skew = 1.0
+    probes = [(0.0, 0.005 + skew, 0.01),
+              (1.0, 1.005 + skew, 1.01),
+              (2.0, 2.9 + skew, 3.8)]  # one delayed round trip
+    assert estimate_offset(probes) == pytest.approx(skew, abs=1e-6)
+    assert estimate_offset([]) == 0.0
+    assert clock_offset(0.0, 5.05, 0.1) == pytest.approx(5.0)
+
+
+def test_probe_host_offset_through_fake_transport():
+    skew = 0.75
+    local = [50.0]
+
+    def clock():
+        local[0] += 0.002  # 4 ms RTT (clock read before and after)
+        return local[0]
+
+    def run_fn(host, command):
+        assert command == "date +%s.%N"
+        return f"{local[0] + 0.002 + skew:.9f}\n"
+
+    off = probe_host_offset(run_fn, "host-b", clock, samples=3)
+    assert off == pytest.approx(skew, abs=1e-3)
+
+    def broken_run(host, command):
+        raise OSError("unreachable")
+
+    assert probe_host_offset(broken_run, "host-b", clock) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# stitching + critical path (incl. dropped/partial spans)
+# ---------------------------------------------------------------------------
+
+
+def _full_block(block, rnd, t0, host="node-0.log"):
+    return [
+        {"host": host, "stage": "proposal", "t": t0, "block": block,
+         "round": rnd},
+        {"host": host, "stage": "verify_submit", "t": t0 + 0.010,
+         "block": block, "round": rnd},
+        {"host": host, "stage": "verify_reply", "t": t0 + 0.030,
+         "block": block, "round": rnd},
+        {"host": host, "stage": "commit", "t": t0 + 0.050,
+         "block": block, "round": rnd},
+    ]
+
+
+def test_critical_path_stitching_with_dropped_span():
+    spans = _full_block("a=", 2, 100.0)
+    # Partial trace: the verify_reply span was dropped (chaos-killed
+    # replica mid-write) — the block still counts for the segments whose
+    # endpoints exist, and for the total.
+    partial = [s for s in _full_block("b=", 3, 101.0)
+               if s["stage"] != "verify_reply"]
+    traces = stitch_blocks(spans + partial)
+    out = critical_path(traces)
+    assert out["blocks"] == 2 and out["complete"] == 1
+    segs = out["segments"]
+    assert segs["proposal->verify_submit"]["n"] == 2
+    assert segs["verify_submit->verify_reply"]["n"] == 1
+    assert segs["verify_reply->commit"]["n"] == 1
+    assert segs["proposal->commit"]["n"] == 2
+    assert segs["proposal->commit"]["p50_ms"] == pytest.approx(50.0)
+
+
+def test_stitch_merges_earliest_across_replicas():
+    # Two replicas observe the same block; the earliest stamp per stage
+    # wins (the committee's critical path, the LogParser convention).
+    a = _full_block("a=", 2, 100.0, host="node-0.log")
+    b = _full_block("a=", 2, 100.020, host="node-1.log")
+    stages = stitch_blocks(a + b)[("a=", 2)]
+    assert stages["proposal"] == pytest.approx(100.0)
+    assert stages["commit"] == pytest.approx(100.050)
+
+
+def test_sidecar_breakdown_percentiles():
+    spans = [{"stage": "queue", "t": 1.0, "dur_ms": d}
+             for d in (1.0, 2.0, 3.0, 100.0)]
+    spans.append({"stage": "device", "t": 1.0, "dur_ms": 20.0})
+    spans.append({"stage": "reply", "t": 1.0})  # no dur: skipped
+    out = sidecar_breakdown(spans)
+    assert out["queue"]["n"] == 4
+    assert out["queue"]["p99_ms"] == pytest.approx(100.0)
+    assert out["device"]["p50_ms"] == pytest.approx(20.0)
+    assert "reply" not in out
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_schema_roundtrip():
+    traces = stitch_blocks(_full_block("a=", 2, 100.0))
+    sc = [{"stage": "device", "t": 100.015, "dur_ms": 12.0, "rid": 3,
+           "cls": "latency"}]
+    chrome = chrome_trace(traces, sc)
+    decoded = json.loads(json.dumps(chrome))
+    assert decoded["displayTimeUnit"] == "ms"
+    events = decoded["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert len(xs) == 4 and len(metas) == 2  # 3 segments + 1 sidecar
+    for e in xs:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["name"]
+    # Timestamps are normalized to the earliest span.
+    assert min(e["ts"] for e in xs) == 0
+    # The sidecar event carries its tags through args.
+    dev = next(e for e in xs if e["name"] == "device")
+    assert dev["args"] == {"rid": 3, "cls": "latency"}
+
+
+def test_build_and_write_run_trace_directory(tmp_path):
+    log0 = "\n".join([_trace_line(1, "proposal"),
+                      _trace_line(1, "verify_submit", ms="010"),
+                      _trace_line(1, "verify_reply", ms="030"),
+                      _trace_line(1, "commit", ms="050")])
+    # Replica 1 observed the same block 0.2 s "later" on a clock the
+    # offsets file says runs 0.2 s ahead: after alignment its stamps
+    # coincide with replica 0's, so the breakdown is unchanged.
+    log1 = "\n".join([_trace_line(1, "proposal", ms="200"),
+                      _trace_line(1, "commit", ms="250")])
+    (tmp_path / "node-0.log").write_text(log0 + "\n")
+    (tmp_path / "node-1.log").write_text(log1 + "\n")
+    (tmp_path / "clock-offsets.json").write_text(
+        json.dumps({"node-1.log": 0.2}))
+    (tmp_path / "sidecar-spans.jsonl").write_text(
+        json.dumps({"stage": "pack", "t": 1785751201.0, "dur_ms": 3.0})
+        + "\ntorn lin")
+    summary, chrome = build_run_trace(str(tmp_path))
+    assert summary["blocks"] == 1 and summary["complete"] == 1
+    assert summary["malformed_spans"] == 1
+    assert summary["segments"]["proposal->commit"]["p50_ms"] == \
+        pytest.approx(50.0)
+    assert summary["sidecar"]["pack"]["p50_ms"] == pytest.approx(3.0)
+    assert summary["chrome_events"] == len(chrome["traceEvents"])
+
+    assert write_run_trace(str(tmp_path))["blocks"] == 1
+    with open(tmp_path / "trace.json") as f:
+        assert json.load(f)["traceEvents"]
+
+
+def test_write_run_trace_without_spans_writes_nothing(tmp_path):
+    (tmp_path / "node-0.log").write_text(
+        "[2026-08-03T12:00:01.000Z INFO consensus::core] Committed B2\n")
+    assert write_run_trace(str(tmp_path)) is None
+    assert not (tmp_path / "trace.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# metrics sampler (virtual clock; sidecar kill/restart)
+# ---------------------------------------------------------------------------
+
+
+class _FlakySidecar:
+    """fetch() stand-in: healthy, then dead (kill), then healthy again
+    (restart) — the exact sequence a chaos plan scripts."""
+
+    def __init__(self, fail_from, fail_until):
+        self.calls = 0
+        self.fail_from = fail_from
+        self.fail_until = fail_until
+
+    def __call__(self):
+        self.calls += 1
+        if self.fail_from <= self.calls <= self.fail_until:
+            raise ConnectionRefusedError("sidecar down")
+        return {"launches": self.calls, "sigs_launched": 100 * self.calls}
+
+
+def test_sampler_keeps_flowing_across_kill_restart(tmp_path):
+    """The satellite test: on a virtual clock, samples keep flowing
+    across a sidecar kill/restart — failed ticks are recorded, the last
+    good snapshot survives, and the gap is visible in the series."""
+    path = str(tmp_path / "metrics.jsonl")
+    now = [1000.0]
+    fetch = _FlakySidecar(fail_from=3, fail_until=4)
+    sampler = MetricsSampler(fetch, path, interval_s=1.0,
+                             wall=lambda: now[0])
+    for _ in range(6):
+        sampler.sample_once()
+        now[0] += 1.0
+    sampler.stop()
+    samples, malformed = read_samples(path)
+    assert malformed == 0
+    assert [s["ok"] for s in samples] == \
+        [True, True, False, False, True, True]
+    assert sampler.samples == 6 and sampler.ok_samples == 4
+    # The failure ticks carry the error, the good ticks the snapshot.
+    assert "sidecar down" in samples[2]["error"]
+    assert samples[5]["stats"]["launches"] == 6
+    # Last good snapshot survives for the stats-file fallback.
+    t_last, snap = sampler.last
+    assert t_last == pytest.approx(1005.0)
+    assert snap["launches"] == 6
+
+
+def test_sampler_thread_lifecycle(tmp_path):
+    """The real thread path (no virtual clock): ticks flow until stop().
+    The injected wait hooks the stop event so the test never sleeps."""
+    path = str(tmp_path / "metrics.jsonl")
+    ticked = threading.Event()
+
+    def fetch():
+        ticked.set()
+        return {"launches": 1}
+
+    sampler = MetricsSampler(fetch, path, interval_s=0.01)
+    sampler.start()
+    assert ticked.wait(5.0)
+    sampler.stop()
+    samples, _ = read_samples(path)
+    assert samples and all(s["ok"] for s in samples)
+    assert sampler.last is not None
+
+
+def test_read_samples_tolerates_garbage(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    path.write_text(json.dumps({"t": 1.0, "ok": True, "stats": {}})
+                    + "\n{\"t\": 2.0, \"ok\"\ngarbage\n"
+                    + json.dumps({"no_t": True, "ok": True}) + "\n")
+    samples, malformed = read_samples(str(path))
+    assert len(samples) == 1 and malformed == 3
+    assert read_samples(str(tmp_path / "absent.jsonl")) == ([], 0)
+
+
+def test_recovery_curve_cites_the_gap():
+    samples = [
+        {"t": 10.0, "ok": True},
+        {"t": 11.0, "ok": True},
+        {"t": 12.0, "ok": False},   # kill at 11.5
+        {"t": 13.0, "ok": False},
+        {"t": 14.0, "ok": True},    # restart visible here
+    ]
+    curve = recovery_curve(samples, 11.5)
+    assert curve["resumed"] is True
+    assert curve["resume_ms"] == pytest.approx(2500.0)
+    assert curve["failed_ticks"] == 2
+    assert curve["samples_after"] == 3
+    dead = recovery_curve(samples[:4], 11.5)
+    assert dead["resumed"] is False and dead["resume_ms"] is None
+    assert dead["failed_ticks"] == 2
+
+
+# ---------------------------------------------------------------------------
+# engine integration: the sidecar emits the full stage chain
+# ---------------------------------------------------------------------------
+
+
+def test_verify_engine_emits_stage_spans(tmp_path):
+    """A host-mode VerifyEngine with a live tracer: one latency verify
+    must leave the whole admit -> queue -> pack -> dispatch -> device ->
+    reply chain in the span file, tagged with the rid and class."""
+    from hotstuff_tpu.crypto import ref_ed25519 as ref
+    from hotstuff_tpu.sidecar import protocol as proto
+    from hotstuff_tpu.sidecar.service import VerifyEngine
+
+    sk = bytes(range(32))
+    _, pk = ref.generate_keypair(sk)
+    msg = b"\x05" * 32
+    sig = ref.sign(sk, msg)
+
+    path = str(tmp_path / "spans.jsonl")
+    engine = VerifyEngine(use_host=True, tracer=Tracer(path))
+    try:
+        done = []
+        cond = threading.Condition()
+
+        def reply(mask):
+            with cond:
+                done.append(mask)
+                cond.notify()
+
+        assert engine.submit(
+            proto.VerifyRequest(42, [msg], [pk], [sig]), reply)
+        with cond:
+            assert cond.wait_for(lambda: done, timeout=60.0)
+        assert done[0] == [True]
+    finally:
+        engine.stop()
+        engine._tracer.close()
+    spans, malformed = parse_spans((tmp_path / "spans.jsonl").read_text())
+    assert malformed == 0
+    stages = [s["stage"] for s in spans]
+    for stage in ("admit", "queue", "pack", "dispatch", "device", "reply"):
+        assert stage in stages, f"missing {stage} span in {stages}"
+    admit = next(s for s in spans if s["stage"] == "admit")
+    assert admit["rid"] == 42 and admit["cls"] == "latency" \
+        and admit["ok"] is True
+    queue = next(s for s in spans if s["stage"] == "queue")
+    assert queue["rid"] == 42 and queue["dur_ms"] >= 0
+    pack = next(s for s in spans if s["stage"] == "pack")
+    assert pack["path"] == "host" and pack["uniq"] == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end grafttrace (slow lane; needs the native build)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_grafttrace_e2e_local_bench(tmp_path, monkeypatch):
+    """The acceptance run: a real LocalBench (host-crypto sidecar, a
+    scripted sidecar kill/restart) must produce logs/trace.json
+    (Perfetto-loadable), logs/metrics.jsonl with >= 2 in-window samples
+    showing the kill/restart transition, and a 'Commit critical path'
+    note with per-stage percentiles."""
+    import os
+
+    from conftest import NODE_BIN, REPO
+    from hotstuff_tpu.harness.config import BenchParameters, NodeParameters
+    from hotstuff_tpu.harness.local import LocalBench
+
+    if not os.path.exists(NODE_BIN):
+        pytest.skip("native binaries not built (cmake --build native/build)")
+    monkeypatch.chdir(tmp_path)
+    os.symlink(os.path.join(REPO, "native"), tmp_path / "native")
+
+    params = BenchParameters({
+        "faults": 0, "nodes": 4, "rate": 500, "tx_size": 64,
+        "duration": 12, "sidecar_host_crypto": True,
+        "fault_plan": "3 sidecar kill; 5 sidecar restart"})
+    node_params = NodeParameters.default(tpu_sidecar="127.0.0.1:7100")
+    node_params.json["consensus"]["timeout_delay"] = 1_000
+    node_params.timeout_delay = 1_000
+    parser = LocalBench(params, node_params).run()
+
+    out = parser.result()
+    # critical path out of real node TRACE lines
+    assert any("Commit critical path" in n for n in parser.notes), out
+    assert parser.trace["segments"]["proposal->commit"]["n"] > 0
+    # the Chrome trace artifact
+    with open("logs/trace.json") as f:
+        chrome = json.load(f)
+    assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+    # >= 2 in-window samples, with the kill/restart visible as a
+    # failed->ok transition in the series
+    samples, _ = read_samples("logs/metrics.jsonl")
+    assert len(samples) >= 2, samples
+    assert any("Sidecar metrics:" in n for n in parser.notes)
+    oks = [s["ok"] for s in samples]
+    assert False in oks and True in oks[oks.index(False):], \
+        "sidecar kill/restart not visible in the sampled series"
+    # sidecar spans were written and merged
+    assert os.path.exists("logs/sidecar-spans.jsonl")
+    # the per-event telemetry curve rode into the chaos summary
+    assert any("telemetry" in e for e in parser.chaos["events"])
+
+
+# ---------------------------------------------------------------------------
+# plots (per-stage histograms + the metrics time series)
+# ---------------------------------------------------------------------------
+
+
+def test_plot_trace_and_metrics(tmp_path, monkeypatch):
+    matplotlib = pytest.importorskip("matplotlib")  # noqa: F841
+    from hotstuff_tpu.harness.plot import Ploter, PlotError
+
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(PlotError):
+        Ploter().plot_trace()  # no artifact yet
+    with pytest.raises(PlotError):
+        Ploter().plot_metrics()
+    (tmp_path / "logs").mkdir()
+    (tmp_path / "plots").mkdir()
+    traces = stitch_blocks(_full_block("a=", 2, 100.0)
+                           + _full_block("b=", 3, 101.0))
+    (tmp_path / "logs" / "trace.json").write_text(
+        json.dumps(chrome_trace(traces)))
+    lines = []
+    for i in range(6):
+        ok = i != 3  # one failed tick: the blackout marker path
+        rec = {"t": 1000.0 + i, "ok": ok}
+        if ok:
+            rec["stats"] = {
+                "sigs_launched": 100 * i,
+                "queue_wait": {"latency": {"n": 4, "p50_ms": 1.0,
+                                           "p99_ms": 2.0 + i}}}
+        else:
+            rec["error"] = "down"
+        lines.append(json.dumps(rec))
+    (tmp_path / "logs" / "metrics.jsonl").write_text(
+        "\n".join(lines) + "\n")
+    ploter = Ploter()
+    ploter.plot_trace()
+    ploter.plot_metrics()
+    for name in ("trace-hist", "metrics"):
+        assert (tmp_path / "plots" / f"{name}.png").exists()
+        assert (tmp_path / "plots" / f"{name}.pdf").exists()

@@ -674,3 +674,53 @@ def test_plot_matrix_draws_from_matrix_json(tmp_path, monkeypatch):
     Ploter().plot_matrix()
     assert (tmp_path / "plots" / "matrix.png").exists()
     assert (tmp_path / "plots" / "matrix.pdf").exists()
+
+
+# ---------------------------------------------------------------------------
+# grafttrace: per-host clock offsets through the ssh transport (PR 7)
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offsets_probed_and_persisted(tmp_path, monkeypatch):
+    """_clock_offsets: one RTT-midpoint probe per alive host through the
+    runner, persisted keyed by log file name for the trace merger."""
+    import os
+    import time
+
+    monkeypatch.chdir(tmp_path)
+    os.makedirs("logs")
+    skew = 2.0
+
+    class FakeRunner:
+        def run(self, host, command, timeout=None):
+            assert command == "date +%s.%N"
+            assert timeout is not None  # transport discipline holds
+
+            class R:
+                stdout = f"{time.time() + skew:.9f}\n"
+
+            return R()
+
+    bench = Bench.__new__(Bench)
+    bench.runner = FakeRunner()
+    bench._clock_offsets(["10.0.0.1", "10.0.0.2"])
+    with open("logs/clock-offsets.json") as f:
+        offsets = json.load(f)
+    assert set(offsets) == {"node-0.log", "node-1.log"}
+    assert all(1.5 < v < 2.5 for v in offsets.values())
+
+
+def test_clock_offsets_tolerates_dead_hosts(tmp_path, monkeypatch):
+    import os
+
+    monkeypatch.chdir(tmp_path)
+    os.makedirs("logs")
+
+    class DeadRunner:
+        def run(self, host, command, timeout=None):
+            raise ExecutionError("unreachable")
+
+    bench = Bench.__new__(Bench)
+    bench.runner = DeadRunner()
+    bench._clock_offsets(["10.0.0.1"])  # must not raise
+    assert not os.path.exists("logs/clock-offsets.json")

@@ -1,24 +1,28 @@
 """graftlint CLI: ``python -m hotstuff_tpu.analysis [options]``.
 
-Runs the hot-path lint, the wire/constants cross-checker, and the
-sanitizer-wiring check; prints one line per finding and exits non-zero
-when anything fires.  ``scripts/lint_gate.py`` is the CI entry point.
+Runs every registered checker (hot path, wire, sanitizer wiring, launch
+shapes, timing fences, socket bounds, trace spans, thread discipline,
+C++ lock discipline); prints one line per finding — or the
+``graftlint-findings-v1`` JSON document under ``--json``/``--json-out``
+— and exits non-zero when anything fires.  ``scripts/lint_gate.py`` is
+the CI entry point.
 """
 
 from __future__ import annotations
 
 import argparse
 import fnmatch
+import json
 import os
 import sys
 
 CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
-            "obsspan")
+            "obsspan", "threads", "cxxsync")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import hotpath, obsspan, padshape, sanitize, sockets, timing, \
-        wirecheck
+    from . import cxxsync, hotpath, obsspan, padshape, sanitize, sockets, \
+        threads, timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -35,6 +39,10 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += sockets.check(root)
     if "obsspan" in checkers:
         findings += obsspan.check(root)
+    if "threads" in checkers:
+        findings += threads.check(root)
+    if "cxxsync" in checkers:
+        findings += cxxsync.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -50,7 +58,8 @@ def check_coverage(root: str, must_cover) -> list:
     for 'this new module MUST be linted' requirements.
 
     A pin may be checker-qualified (``hotpath:path``, ``sockets:path``,
-    ``timing:path``, ``padshape:path``) to demand coverage by THAT
+    ``timing:path``, ``padshape:path``, ``threads:path``,
+    ``cxxsync:path``) to demand coverage by THAT
     checker's target set: a device module pinned to hotpath stays
     covered-by-hotpath even though the sockets checker happens to scan
     the same directory (a union would let the hot-path scan silently
@@ -58,7 +67,8 @@ def check_coverage(root: str, must_cover) -> list:
     accepts any checker.  scripts/lint_gate.py pins the RLC scalar
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
-    from . import hotpath, obsspan, padshape, sockets, timing
+    from . import cxxsync, hotpath, obsspan, padshape, sockets, threads, \
+        timing
     from .common import Finding
 
     target_sets = {
@@ -67,6 +77,8 @@ def check_coverage(root: str, must_cover) -> list:
         "timing": tuple(timing.DEFAULT_TARGETS),
         "padshape": tuple(padshape.DEFAULT_TARGETS),
         "obsspan": tuple(obsspan.DEFAULT_TARGETS),
+        "threads": tuple(threads.DEFAULT_TARGETS),
+        "cxxsync": tuple(cxxsync.DEFAULT_TARGETS),
     }
     findings = []
     for pin in must_cover:
@@ -123,18 +135,53 @@ def main(argv=None) -> int:
                          "checker (hotpath/sockets) when qualified, of "
                          "any checker when bare (guards against a module "
                          "silently escaping its lint; repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="print machine-readable findings JSON to stdout "
+                         "instead of one line per finding (exit status "
+                         "unchanged: 0 clean, 1 findings)")
+    ap.add_argument("--json-out", default=None, metavar="PATH",
+                    help="additionally write the findings JSON to PATH "
+                         "(CI artifact; text output stays on stdout)")
     args = ap.parse_args(argv)
     checkers = tuple(args.checker) if args.checker else CHECKERS
     findings = run_all(args.root, checkers)
     findings += check_coverage(args.root, args.must_cover or ())
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
-        print(f.render())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if args.json or args.json_out:
+        doc = findings_json(findings, checkers)
+        if args.json_out:
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        if not findings:
+            print(f"graftlint: clean [checkers: {', '.join(checkers)}]")
     if findings:
         print(f"graftlint: {len(findings)} finding(s) "
               f"[checkers: {', '.join(checkers)}]", file=sys.stderr)
         return 1
-    print(f"graftlint: clean [checkers: {', '.join(checkers)}]")
     return 0
+
+
+def findings_json(findings, checkers) -> dict:
+    """The machine-readable findings document (``--json``/``--json-out``):
+    CI and future tooling consume this instead of scraping the text
+    renderer, so the schema is part of the gate's contract — additive
+    changes only."""
+    return {
+        "schema": "graftlint-findings-v1",
+        "checkers": list(checkers),
+        "clean": not findings,
+        "findings": [
+            {"rule": f.rule, "file": f.path, "line": f.line,
+             "evidence": f.message}
+            for f in findings
+        ],
+    }
 
 
 if __name__ == "__main__":

@@ -1,8 +1,10 @@
-"""Shared graftlint plumbing: findings, suppressions, constant parsing."""
+"""Shared graftlint plumbing: findings, suppressions, constant parsing,
+and the per-run parse/read caches every checker shares."""
 
 from __future__ import annotations
 
 import ast
+import os
 import re
 from dataclasses import dataclass
 
@@ -16,6 +18,56 @@ class Finding:
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Per-run parse + read caches
+#
+# Seven checkers scan overlapping target sets (sidecar/service.py alone
+# is parsed by hotpath, padshape, sockets, obsspan and threads), and the
+# gate used to pay a fresh open() + ast.parse() per checker per file.
+# Both are memoized here instead: parse_source keys on the (path, source)
+# pair — so unit-test fixtures that lint many different sources under one
+# fake path never collide — and read_source keys on (abspath, mtime) so a
+# file edited between two in-process runs is re-read.  One process run of
+# `python -m hotstuff_tpu.analysis` therefore parses each module exactly
+# once no matter how many rules visit it.
+# ---------------------------------------------------------------------------
+
+_PARSE_CACHE: dict = {}
+_READ_CACHE: dict = {}
+
+
+def parse_source(source: str, path: str = "<src>") -> ast.Module:
+    """``ast.parse`` memoized on (path, source).  All AST rules route
+    through this so a multi-checker run parses each file once."""
+    key = (path, source)
+    tree = _PARSE_CACHE.get(key)
+    if tree is None:
+        tree = ast.parse(source, filename=path)
+        _PARSE_CACHE[key] = tree
+    return tree
+
+
+def read_source(abspath: str) -> str:
+    """Read a source file, memoized on (path, mtime)."""
+    try:
+        mtime = os.stat(abspath).st_mtime_ns
+    except OSError:
+        mtime = None
+    key = (abspath, mtime)
+    text = _READ_CACHE.get(key)
+    if text is None:
+        with open(abspath, encoding="utf-8") as fh:
+            text = fh.read()
+        _READ_CACHE[key] = text
+    return text
+
+
+def clear_caches():
+    """Drop both caches (long-lived embedders; the CLI never needs to)."""
+    _PARSE_CACHE.clear()
+    _READ_CACHE.clear()
 
 
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-, ]+)")
@@ -95,7 +147,7 @@ def _eval_int(node: ast.AST, env: dict):
 def module_int_constants(source: str, path: str = "<src>") -> dict:
     """Top-level ``NAME = <int expr>`` assignments of a module, evaluated
     in order so later constants may reference earlier ones."""
-    tree = ast.parse(source, filename=path)
+    tree = parse_source(source, path)
     env: dict[str, int] = {}
     for node in tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \

@@ -1,0 +1,423 @@
+"""graftsync cxxsync checker: lock-discipline annotations and atomic
+memory-order hygiene over the native tree (lexer/brace-scope based —
+clang-free by design, like the wire checker).
+
+The C++ side shares state across the reactor thread, the store worker,
+the sidecar reader/probe threads and the consensus actors.  Rust would
+hold the discipline in the type system; here it is held by ANNOTATIONS
+the checker enforces mechanically:
+
+  ``// GUARDED_BY(<mutex>)`` on a member declaration
+      Every access to that member in the declaring file and its sibling
+      .cpp/.hpp must sit lexically inside a ``std::lock_guard`` /
+      ``unique_lock`` / ``scoped_lock`` scope whose mutex expression's
+      last component names ``<mutex>`` — except in functions whose name
+      ends in ``_locked``/``_locked_`` (the repo convention for
+      "caller holds the lock", shared with sched/scheduler.py's
+      ``_assemble_locked``).  ``unique_lock`` regions are interrupted
+      by ``lk.unlock()`` and resumed by ``lk.lock()``.
+  ``// OWNED_BY(<role>)`` / ``// SHARED_OK(<why>)``
+      Documentation annotations for single-thread-confined members
+      (loop thread, store worker) and members that are safe to share
+      without this file's mutex (atomics, internally-synchronized
+      channels, immutable-after-construction handles).  The checker
+      parses but does not enforce them — they exist so every member of
+      an annotated struct carries an explicit sharing story.
+
+Rules:
+  guarded-member-unlocked   access to a GUARDED_BY member outside a
+                            lock scope naming its mutex (and outside
+                            ``*_locked`` functions).  Lambdas inherit
+                            the lexical lock scopes they are written in
+                            — correct for cv predicates; a DEFERRED
+                            callback that touches guarded state is the
+                            dynamic-race class the TSan gate owns.
+  unannotated-mutex         a ``std::mutex`` member in a scanned file
+                            with no GUARDED_BY naming it: a mutex that
+                            guards nothing on paper guards nothing in
+                            review either.
+  atomic-missing-order      ``.load()/.store()/fetch_*/exchange/
+                            compare_exchange`` without an explicit
+                            ``std::memory_order`` argument anywhere in
+                            ``native/src``.  Sequential consistency by
+                            default is not the problem — UNSTATED
+                            intent is: the PR 7 trace-flag load
+                            (common/log.cpp) is the exemplar, one
+                            relaxed load per instrumented site with the
+                            ordering claim written at the site.
+
+Suppression: ``// graftlint: disable=<rule>`` on the access's line or
+the line above, same contract as the Python checkers; every suppression
+carries its evidence comment.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from .common import Finding
+
+# File pairs for the annotation rules: the subsystems whose state is
+# genuinely cross-thread.  Annotations declared in one file of a pair
+# bind accesses in both.
+DEFAULT_TARGETS = (
+    "native/src/network/event_loop.hpp",
+    "native/src/network/event_loop.cpp",
+    "native/src/network/reliable_sender.hpp",
+    "native/src/network/reliable_sender.cpp",
+    "native/src/store/store.hpp",
+    "native/src/store/store.cpp",
+    "native/src/crypto/sidecar_client.hpp",
+    "native/src/crypto/sidecar_client.cpp",
+    "native/src/consensus/mempool_driver.hpp",
+    "native/src/consensus/mempool_driver.cpp",
+    "native/src/consensus/core.hpp",
+    "native/src/consensus/core.cpp",
+)
+
+# The atomic rule scans the whole native tree (any .cpp/.hpp under here).
+ATOMIC_ROOT = "native/src"
+
+_GUARDED_RE = re.compile(r"//\s*GUARDED_BY\((\w+)\)")
+_DOC_ANNOT_RE = re.compile(r"//\s*(?:OWNED_BY|SHARED_OK)\(")
+_SUPPRESS_RE = re.compile(r"//\s*graftlint:\s*disable=([\w\-, ]+)")
+_MEMBER_DECL_RE = re.compile(
+    r"([A-Za-z_]\w*)\s*(?:\{[^{}]*\}|=[^;]*)?\s*;\s*$")
+_LOCK_DECL_RE = re.compile(
+    r"std\s*::\s*(lock_guard|unique_lock|scoped_lock)\s*"
+    r"(?:<[^<>;]*(?:<[^<>;]*>)?[^<>;]*>)?\s+(\w+)\s*[({]([^;)}]*)[)}]")
+_MUTEX_MEMBER_RE = re.compile(
+    r"^\s*(?:mutable\s+)?std\s*::\s*mutex\s+(\w+)\s*;", re.MULTILINE)
+_ATOMIC_OP_RE = re.compile(
+    r"(?:\.|->)\s*(load|store|exchange|fetch_add|fetch_sub|fetch_and|"
+    r"fetch_or|fetch_xor|compare_exchange_weak|compare_exchange_strong)"
+    r"\s*\(")
+_LAST_IDENT_RE = re.compile(r"([A-Za-z_]\w*)\s*$")
+
+
+def cpp_suppressed_rules(source: str) -> dict:
+    """Line (1-based) -> rules silenced there; a ``// graftlint:
+    disable=...`` comment silences its own line and the next."""
+    out: dict[int, set] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        out.setdefault(i + 1, set()).update(rules)
+    return out
+
+
+def _strip(source: str) -> str:
+    """Blank comments and string/char literals, preserving offsets and
+    newlines, so scope/token scans cannot be fooled by either."""
+    out = list(source)
+    i, n = 0, len(source)
+    while i < n:
+        c = source[i]
+        two = source[i:i + 2]
+        if two == "//":
+            j = source.find("\n", i)
+            j = n if j < 0 else j
+            for k in range(i, j):
+                out[k] = " "
+            i = j
+        elif two == "/*":
+            j = source.find("*/", i + 2)
+            j = n - 2 if j < 0 else j
+            for k in range(i, j + 2):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j + 2
+        elif c in "\"'":
+            q = c
+            j = i + 1
+            while j < n and source[j] != q:
+                j += 2 if source[j] == "\\" else 1
+            for k in range(i + 1, min(j, n)):
+                if out[k] != "\n":
+                    out[k] = " "
+            i = j + 1
+        else:
+            i += 1
+    return "".join(out)
+
+
+class _Blocks:
+    """Brace-matched block ranges of a stripped source, with the
+    enclosing function name (if any) per block."""
+
+    _FUNC_TAIL_RE = re.compile(
+        r"([A-Za-z_~][\w]*)\s*\([^;{}()]*(?:\([^()]*\)[^;{}()]*)*\)\s*"
+        r"(?:const|noexcept|override|final|mutable|->\s*[\w:<>,\s*&]+|\s)*$")
+
+    def __init__(self, stripped: str):
+        self.ranges = []  # (start, end, func_name|None) per block
+        self._n = len(stripped)
+        stack = []
+        for i, c in enumerate(stripped):
+            if c == "{":
+                stack.append(i)
+            elif c == "}" and stack:
+                start = stack.pop()
+                self.ranges.append(
+                    (start, i, self._func_name(stripped, start)))
+        for start in stack:  # unclosed (truncated fixture): run to EOF
+            self.ranges.append(
+                (start, len(stripped), self._func_name(stripped, start)))
+
+    def _func_name(self, stripped: str, open_pos: int):
+        """Name of the function this brace opens, None for non-function
+        blocks (class/namespace/control).  A ``)...{`` shape is a
+        function (or lambda — named ``<lambda>``)."""
+        head = stripped[max(0, open_pos - 400):open_pos]
+        m = self._FUNC_TAIL_RE.search(head)
+        if m:
+            name = m.group(1)
+            if name in ("if", "while", "for", "switch", "catch",
+                        "return", "sizeof", "new", "delete"):
+                return None
+            return name.split("::")[-1]
+        if re.search(r"\)\s*(?:const|noexcept|mutable|\s)*$", head) or \
+                re.search(r"\]\s*$", head):
+            return "<lambda>"
+        return None
+
+    def enclosing_functions(self, pos: int):
+        """Function names of every function block containing ``pos``
+        (innermost last)."""
+        out = []
+        for start, end, name in sorted(self.ranges):
+            if start < pos < end and name is not None:
+                out.append(name)
+        return out
+
+    def block_end(self, pos: int) -> int:
+        """End of the innermost block containing ``pos``."""
+        best = None
+        for start, end, _name in self.ranges:
+            if start < pos < end and (best is None or
+                                      end - start < best[1] - best[0]):
+                best = (start, end)
+        return best[1] if best else self._n
+
+
+class _LockScope:
+    __slots__ = ("mutexes", "ranges")
+
+    def __init__(self, mutexes, ranges):
+        self.mutexes = mutexes
+        self.ranges = ranges  # [(start, end)] positions where held
+
+    def holds(self, pos: int, mutex: str) -> bool:
+        return mutex in self.mutexes and \
+            any(a <= pos <= b for a, b in self.ranges)
+
+
+def _last_ident(expr: str):
+    expr = expr.strip().rstrip(")")
+    m = _LAST_IDENT_RE.search(expr)
+    return m.group(1) if m else None
+
+
+def _lock_scopes(stripped: str, blocks: _Blocks):
+    scopes = []
+    for m in _LOCK_DECL_RE.finditer(stripped):
+        kind, var, args = m.group(1), m.group(2), m.group(3)
+        mutexes = {i for i in
+                   (_last_ident(a) for a in args.split(","))
+                   if i}
+        if not mutexes:
+            continue
+        end = blocks.block_end(m.start())
+        if kind == "unique_lock":
+            # cut the held range at lk.unlock(), resume at lk.lock()
+            ranges = []
+            held_from = m.end()
+            pos = m.end()
+            pat = re.compile(r"\b%s\s*\.\s*(un)?lock\s*\(" % re.escape(var))
+            for call in pat.finditer(stripped, m.end(), end):
+                if call.group(1):  # unlock
+                    if held_from is not None:
+                        ranges.append((held_from, call.start()))
+                        held_from = None
+                else:  # lock
+                    if held_from is None:
+                        held_from = call.end()
+                pos = call.end()
+            del pos
+            if held_from is not None:
+                ranges.append((held_from, end))
+        else:
+            ranges = [(m.end(), end)]
+        scopes.append(_LockScope(mutexes, ranges))
+    return scopes
+
+
+def _parse_annotations(source: str):
+    """{member: mutex} for GUARDED_BY lines, plus the set of annotated
+    declaration line numbers (excluded from the access scan)."""
+    guarded = {}
+    decl_lines = set()
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        g = _GUARDED_RE.search(line)
+        if not g:
+            continue
+        code = line[:g.start()]
+        dm = _MEMBER_DECL_RE.search(code.rstrip())
+        if dm:
+            guarded[dm.group(1)] = g.group(1)
+            decl_lines.add(lineno)
+    return guarded, decl_lines
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def _access_iter(stripped: str, member: str):
+    """Positions where ``member`` is accessed: ``.member`` / ``->member``
+    always; bare ``member`` too when it carries the trailing-underscore
+    member naming convention."""
+    pat = re.compile(r"(?:(?:\.|->)\s*|\b)(%s)\b" % re.escape(member)) \
+        if member.endswith("_") else \
+        re.compile(r"(?:\.|->)\s*(%s)\b" % re.escape(member))
+    for m in pat.finditer(stripped):
+        # skip declarations/annotations lines are handled by caller;
+        # skip member-function definitions ``Type Class::member(...)``
+        yield m.start(1)
+
+
+def _pair_key(rel: str) -> str:
+    base, _ext = os.path.splitext(rel)
+    return base
+
+
+def check_sources(sources: dict, atomic_sources: dict | None = None) -> list:
+    """Lint {relpath: source}.  ``sources`` feeds the annotation rules
+    (files are paired by basename); ``atomic_sources`` (default: the
+    same mapping) feeds the memory-order rule."""
+    findings = []
+    atomic_sources = sources if atomic_sources is None else atomic_sources
+
+    stripped = {rel: _strip(src) for rel, src in sources.items()}
+    blocks = {rel: _Blocks(stripped[rel]) for rel in sources}
+    locks = {rel: _lock_scopes(stripped[rel], blocks[rel])
+             for rel in sources}
+    suppressed = {rel: cpp_suppressed_rules(src)
+                  for rel, src in sources.items()}
+
+    # Annotations bind across a .hpp/.cpp pair.
+    guarded_by_pair: dict[str, dict] = {}
+    decl_lines: dict[str, set] = {}
+    for rel, src in sources.items():
+        guarded, decls = _parse_annotations(src)
+        guarded_by_pair.setdefault(_pair_key(rel), {}).update(guarded)
+        decl_lines[rel] = decls
+
+    # -- guarded-member-unlocked -------------------------------------------
+    for rel, src in sources.items():
+        guarded = guarded_by_pair.get(_pair_key(rel), {})
+        if not guarded:
+            continue
+        text = stripped[rel]
+        for member, mutex in sorted(guarded.items()):
+            for pos in _access_iter(text, member):
+                line = _line_of(text, pos)
+                if line in decl_lines[rel]:
+                    continue
+                if "guarded-member-unlocked" in \
+                        suppressed[rel].get(line, ()):
+                    continue
+                funcs = blocks[rel].enclosing_functions(pos)
+                if any(f.endswith("_locked") or f.endswith("_locked_")
+                       for f in funcs):
+                    continue
+                if not funcs:
+                    continue  # declaration scope, not executable code
+                if any(s.holds(pos, mutex) for s in locks[rel]):
+                    continue
+                findings.append(Finding(
+                    rel, line, "guarded-member-unlocked",
+                    f"access to '{member}' (GUARDED_BY({mutex})) outside "
+                    f"a lock_guard/unique_lock scope naming '{mutex}' "
+                    f"and outside any *_locked function: take the lock, "
+                    f"rename the function to the _locked convention, or "
+                    f"carry an evidence-comment suppression"))
+
+    # -- unannotated-mutex --------------------------------------------------
+    for rel, src in sources.items():
+        guarded = guarded_by_pair.get(_pair_key(rel), {})
+        text = stripped[rel]
+        for m in _MUTEX_MEMBER_RE.finditer(text):
+            name = m.group(1)
+            line = _line_of(text, m.start())
+            if "unannotated-mutex" in suppressed[rel].get(line, ()):
+                continue
+            if blocks[rel].enclosing_functions(m.start()):
+                continue  # function-local mutex, not a shared member
+            if name in guarded.values():
+                continue
+            findings.append(Finding(
+                rel, line, "unannotated-mutex",
+                f"std::mutex member '{name}' with no GUARDED_BY({name}) "
+                f"annotation on any member it protects: write the "
+                f"sharing story down so the checker (and the reviewer) "
+                f"can hold it"))
+
+    # -- atomic-missing-order ----------------------------------------------
+    for rel, src in atomic_sources.items():
+        text = stripped.get(rel)
+        if text is None:
+            text = _strip(src)
+        sup = suppressed.get(rel)
+        if sup is None:
+            sup = cpp_suppressed_rules(src)
+        for m in _ATOMIC_OP_RE.finditer(text):
+            # argument list with paren matching
+            depth, j = 1, m.end()
+            while j < len(text) and depth:
+                if text[j] == "(":
+                    depth += 1
+                elif text[j] == ")":
+                    depth -= 1
+                j += 1
+            args = text[m.end():j - 1]
+            if "memory_order" in args:
+                continue
+            line = _line_of(text, m.start())
+            if "atomic-missing-order" in sup.get(line, ()):
+                continue
+            findings.append(Finding(
+                rel, line, "atomic-missing-order",
+                f".{m.group(1)}() without an explicit std::memory_order "
+                f"argument: state the ordering claim at the site "
+                f"(relaxed for flags polled in loops, acq_rel for "
+                f"join counters that publish data) — the trace-flag "
+                f"load in common/log.cpp is the exemplar"))
+
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def check(root: str, targets=DEFAULT_TARGETS, atomic_root=ATOMIC_ROOT) -> list:
+    from .common import read_source
+
+    sources = {}
+    for rel in targets:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        sources[rel] = read_source(path)
+    atomic_sources = dict(sources)
+    base = os.path.join(root, atomic_root)
+    if os.path.isdir(base):
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for f in sorted(filenames):
+                if not f.endswith((".cpp", ".hpp", ".h")):
+                    continue
+                path = os.path.join(dirpath, f)
+                rel = os.path.relpath(path, root)
+                atomic_sources.setdefault(rel, read_source(path))
+    return check_sources(sources, atomic_sources)

@@ -37,7 +37,8 @@ from __future__ import annotations
 import ast
 import os
 
-from .common import Finding, apply_suppressions
+from .common import Finding, apply_suppressions, parse_source, \
+    read_source
 
 # Paths scanned by default, relative to the repo root.
 #
@@ -86,7 +87,7 @@ class _Module:
         self.path = path
         self.name = os.path.splitext(os.path.basename(path))[0]
         self.source = source
-        self.tree = ast.parse(source, filename=path)
+        self.tree = parse_source(source, path)
         self.functions: dict[str, ast.FunctionDef] = {}
         # alias -> module basename, for imports of *scanned* modules
         # (``from . import field25519 as F``, ``from ..ops import ed25519``)
@@ -683,6 +684,5 @@ def check(root: str, targets=DEFAULT_TARGETS) -> list:
         else:
             continue
         for f in files:
-            with open(f, encoding="utf-8") as fh:
-                sources[os.path.relpath(f, root)] = fh.read()
+            sources[os.path.relpath(f, root)] = read_source(f)
     return check_sources(sources)

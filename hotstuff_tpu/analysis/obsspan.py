@@ -35,7 +35,8 @@ import ast
 import glob as _glob
 import os
 
-from .common import Finding, apply_suppressions
+from .common import Finding, apply_suppressions, parse_source, \
+    read_source
 from .timing import _scopes
 
 # Modules that open/close obs spans, relative to the repo root (globs
@@ -74,7 +75,7 @@ def _finally_nodes(scope_nodes):
 
 def check_source(path: str, source: str) -> list:
     findings = []
-    tree = ast.parse(source, filename=path)
+    tree = parse_source(source, path)
     in_obs = "obs/" in path.replace(os.sep, "/")
     for scope, nodes in _scopes(tree):
         scope_name = getattr(scope, "name", "")
@@ -126,6 +127,5 @@ def check(root: str, targets=DEFAULT_TARGETS) -> list:
         for path in sorted(_glob.glob(os.path.join(root, target))):
             if not path.endswith(".py"):
                 continue
-            with open(path, encoding="utf-8") as fh:
-                sources[os.path.relpath(path, root)] = fh.read()
+            sources[os.path.relpath(path, root)] = read_source(path)
     return check_sources(sources)

@@ -46,7 +46,7 @@ import os
 import re
 
 from .common import (Finding, _eval_int, apply_suppressions,
-                     module_int_constants)
+                     module_int_constants, parse_source, read_source)
 from .hotpath import _attr_chain
 
 # The modules whose functions launch padded device programs.
@@ -114,7 +114,7 @@ def _is_launch(call: ast.Call, name: str) -> bool:
 
 def _check_launch_bucketing(path: str, source: str) -> list:
     findings = []
-    tree = ast.parse(source, filename=path)
+    tree = parse_source(source, path)
     for fn in tree.body:
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
@@ -170,7 +170,7 @@ def _check_shard_alignment(path: str, source: str) -> list:
     math (mul/div/mod) against an ``n_dev``/``n_devices`` operand must
     call a shard-alignment helper."""
     findings = []
-    tree = ast.parse(source, filename=path)
+    tree = parse_source(source, path)
     for fn in _outer_functions(tree):
         shard_helper_called = False
         evidence = []  # (node, what)
@@ -209,7 +209,7 @@ def _line_of(source: str, pattern: str) -> int:
 
 def _warmup_floor(service_src: str) -> int | None:
     """The literal start size _warmup hands _warm_shapes."""
-    tree = ast.parse(service_src)
+    tree = parse_source(service_src)
     for fn in ast.walk(tree):
         if isinstance(fn, ast.FunctionDef) and fn.name == "_warmup":
             for node in ast.walk(fn):
@@ -228,8 +228,7 @@ def _check_warmup_constants(root: str) -> list:
 
     def _read(rel):
         try:
-            with open(os.path.join(root, rel), encoding="utf-8") as f:
-                return f.read()
+            return read_source(os.path.join(root, rel))
         except OSError:
             return None
 
@@ -278,7 +277,7 @@ def _check_warmup_constants(root: str) -> list:
         # MAX_COALESCED = 16 * MAX_SUBBATCH references an import the
         # plain constant scrape cannot see; evaluate it with the eddsa
         # constants in scope.
-        tree = ast.parse(service_src)
+        tree = parse_source(service_src)
         for node in tree.body:
             if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                     and isinstance(node.targets[0], ast.Name) \
@@ -324,8 +323,7 @@ def check(root: str, targets=DEFAULT_TARGETS) -> list:
     for rel in targets:
         path = os.path.join(root, rel)
         try:
-            with open(path, encoding="utf-8") as f:
-                sources[rel] = f.read()
+            sources[rel] = read_source(path)
         except OSError:
             continue
     findings = check_sources(sources)

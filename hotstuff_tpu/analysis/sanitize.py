@@ -11,7 +11,10 @@ slow-marked test in tests/test_analysis.py).
 Rule:
   sanitizer-wiring   native/CMakeLists.txt lacks the GRAFT_SANITIZE
                      presets, or scripts/native_sanitize.sh is missing /
-                     not executable / doesn't drive the sanitizers
+                     not executable / doesn't drive the sanitizers, or
+                     the TSan gate pieces (scripts/tsan_gate.sh,
+                     scripts/tsan.supp, the clockwait shim thread-mode
+                     builds depend on) have rotted
 """
 
 from __future__ import annotations
@@ -22,6 +25,9 @@ from .common import Finding
 
 CMAKELISTS = "native/CMakeLists.txt"
 SCRIPT = "scripts/native_sanitize.sh"
+TSAN_GATE = "scripts/tsan_gate.sh"
+TSAN_SUPP = "scripts/tsan.supp"
+TSAN_SHIM = "native/sanitize/tsan_clockwait_shim.cpp"
 MODES = ("address", "undefined", "thread")
 
 
@@ -67,4 +73,30 @@ def check(root: str) -> list:
         if mode not in script:
             bad(SCRIPT, f"native_sanitize.sh does not support the "
                 f"'{mode}' sanitizer")
+
+    # The tier-2 TSan gate: driver + suppression file + the clockwait
+    # shim without which this toolchain's TSan drowns in cv false
+    # positives (617 on the pre-shim baseline).
+    gate_path = os.path.join(root, TSAN_GATE)
+    if not os.path.isfile(gate_path):
+        bad(TSAN_GATE, "scripts/tsan_gate.sh missing: the tier-2 TSan "
+            "gate has no driver")
+    else:
+        if not os.access(gate_path, os.X_OK):
+            bad(TSAN_GATE, "scripts/tsan_gate.sh is not executable")
+        with open(gate_path, encoding="utf-8") as f:
+            gate = f.read()
+        if "tsan.supp" not in gate or "TSAN_OPTIONS" not in gate:
+            bad(TSAN_GATE, "tsan_gate.sh does not wire the suppression "
+                "file through TSAN_OPTIONS")
+    if not os.path.isfile(os.path.join(root, TSAN_SUPP)):
+        bad(TSAN_SUPP, "scripts/tsan.supp missing: the TSan gate's "
+            "suppression policy file is part of the wiring")
+    if not os.path.isfile(os.path.join(root, TSAN_SHIM)):
+        bad(TSAN_SHIM, "tsan_clockwait_shim.cpp missing: without it, "
+            "thread-mode builds on this toolchain report a false "
+            "double-lock + data races for every steady-clock cv wait")
+    elif "shim" not in script and "tsan_clockwait" not in script:
+        bad(SCRIPT, "native_sanitize.sh does not link the clockwait "
+            "shim into thread-mode builds")
     return findings

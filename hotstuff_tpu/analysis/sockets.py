@@ -41,7 +41,8 @@ import ast
 import os
 import re
 
-from .common import Finding, apply_suppressions
+from .common import Finding, apply_suppressions, parse_source, \
+    read_source
 
 # Modules on the process/socket boundary: the sidecar (service, client,
 # protocol), the harness (local/remote orchestration), and the graftchaos
@@ -127,7 +128,7 @@ def _mentions_ssh(node: ast.AST) -> bool:
 
 def check_source(path: str, source: str) -> list:
     findings = []
-    tree = ast.parse(source, filename=path)
+    tree = parse_source(source, path)
     for _scope, nodes in _scopes(tree):
         bounded = set()   # receiver idents with a settimeout in scope
         suspects = []     # (node, op, receiver ident)
@@ -202,6 +203,5 @@ def check(root: str, targets=DEFAULT_TARGETS) -> list:
         for path in paths:
             if not path.endswith(".py"):
                 continue
-            with open(path, encoding="utf-8") as fh:
-                sources[os.path.relpath(path, root)] = fh.read()
+            sources[os.path.relpath(path, root)] = read_source(path)
     return check_sources(sources)

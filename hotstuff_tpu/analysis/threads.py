@@ -1,0 +1,387 @@
+"""graftsync threads checker: cross-thread sharing discipline in the
+threaded Python modules.
+
+PRs 3-7 made the Python side genuinely concurrent — the sidecar engine
+and pack worker, connection reader/writer threads, the obs
+``MetricsSampler``, and the chaos ``PlanRunner`` all share instance
+state across threads — but nothing held the sharing discipline
+mechanically.  This checker does, per class, from the thread entries the
+class itself creates:
+
+Thread model.  A method is a THREAD ENTRY when the class starts it on
+its own thread: ``threading.Thread(target=self.m, ...)`` or a pool
+submit ``self._pool.submit(self.m, ...)``.  The thread body is the
+call-graph closure of the entry over ``self.<method>`` references
+(method references passed as callbacks count — a lexical tool cannot
+see which thread later calls them, so it assumes the spawning entry
+does; over-approximation here is deliberate, the suppression comment is
+where a human records the sharper fact).
+
+Rules:
+  unlocked-shared-write
+      An instance attribute written from a thread body AND from outside
+      it (or from two distinct entries' bodies) where the write sites do
+      not all sit under ``with self.<lock>:`` of one shared
+      ``threading.Lock``/``RLock``/``Condition`` attribute.  Writes are
+      assignments (``self.x = ...``, ``self.x[...] = ...``, augmented)
+      and the mutating container calls (append/add/pop/update/...).
+      ``__init__`` writes are exempt — construction happens-before
+      ``Thread.start()``.  Evidence-comment suppressions carry the cases
+      the lexical model over-approximates (e.g. a closure built on one
+      thread but executed on another).
+  daemon-thread-without-stop-flag
+      A ``threading.Thread(..., daemon=True, target=self.m)`` whose
+      body never consults a stop flag: a ``threading.Event`` attribute
+      (or an attribute derived from one in ``__init__``, like the
+      sampler's ``self._wait = ... or self._stop.wait``).  Daemonized
+      loops with no stop signal die only with the interpreter — a
+      teardown that cannot stop its threads leaks them into the next
+      test and tears files out from under them.
+  thread-loop-inline-clock
+      An inline clock/sleep call (``time.time()``, ``monotonic()``,
+      ``time.sleep()``, ...) inside a thread body of a class that takes
+      an INJECTABLE clock (``clock``/``wall``/``wait``/``sleep``
+      parameters on ``__init__``, the obs convention): the virtual-clock
+      tests drive those loops manually, and one inline read splits the
+      time base mid-loop.  Classes without injected clocks are out of
+      scope — the engine's ``monotonic()`` telemetry reads are the
+      documented legitimate use (see analysis/README.md).
+
+Lock detection is name-assisted like the sockets rule: an attribute
+assigned ``threading.Lock()``/``RLock()``/``Condition()`` in
+``__init__`` is a lock; so is one assigned from an ``__init__``
+parameter whose name mentions lock/cond (the scheduler hands its
+Condition to each ClassQueue that way).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .common import Finding, apply_suppressions, parse_source, read_source
+
+# The threaded modules: every file that calls threading.Thread(target=
+# self.*) or runs a pool worker today.  lint_gate --must-cover pins each
+# one so a module cannot silently leave the scan.
+DEFAULT_TARGETS = (
+    "hotstuff_tpu/sidecar/service.py",
+    "hotstuff_tpu/sidecar/sched",
+    "hotstuff_tpu/obs/sampler.py",
+    "hotstuff_tpu/chaos/runner.py",
+    "hotstuff_tpu/harness/faults.py",
+    "hotstuff_tpu/harness/local.py",
+)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_EVENT_CTOR = "Event"
+_LOCKISH_PARAM = ("lock", "cond")
+_CLOCK_PARAMS = {"clock", "wall", "wait", "sleep", "now"}
+_CLOCK_CALLS = {"time", "monotonic", "sleep", "perf_counter",
+                "perf_counter_ns", "monotonic_ns"}
+# Container mutations that count as writes (shared-state hazards the
+# assignment scan alone would miss).  Deliberately excludes ``set`` —
+# Event.set()/Oneshot.set() are synchronization, not shared mutation.
+_MUTATORS = {"append", "appendleft", "add", "extend", "insert", "update",
+             "setdefault", "pop", "popleft", "popitem", "remove",
+             "discard", "clear"}
+
+
+def _self_attr(node):
+    """'x' for a ``self.x`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+class _Write:
+    __slots__ = ("attr", "line", "method", "locks")
+
+    def __init__(self, attr, line, method, locks):
+        self.attr = attr
+        self.line = line
+        self.method = method
+        self.locks = frozenset(locks)
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body: writes (with the self-lock attrs
+    held at each site), self.<name> references, thread spawns, and
+    inline clock calls."""
+
+    def __init__(self, lock_attrs):
+        self._lock_attrs = lock_attrs
+        self._held: list[str] = []
+        self.writes: list[tuple] = []      # (attr, line, held-locks)
+        self.refs: set[str] = set()        # every self.<name> referenced
+        self.spawns: list[tuple] = []      # (target-method|None, daemon, line)
+        self.clock_calls: list[tuple] = []  # (line, rendered-name)
+
+    def visit_With(self, node: ast.With):
+        held = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self._lock_attrs:
+                held.append(attr)
+        self._held += held
+        self.generic_visit(node)
+        if held:
+            del self._held[-len(held):]
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None:
+            self.refs.add(attr)
+        self.generic_visit(node)
+
+    def _note_write(self, target):
+        # self.x = / self.x[...] = / self.x.y = … — the attribute whose
+        # object is mutated is the shared state.  Tuple targets unpack.
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._note_write(elt)
+            return
+        node = target
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            attr = _self_attr(node)
+            if attr is not None:
+                self.writes.append((attr, target.lineno, tuple(self._held)))
+                return
+            node = node.value
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._note_write(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._note_write(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._note_write(node.target)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        # self.x.append(...) and friends are writes to self.x
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = _self_attr(f.value)
+            if attr is not None:
+                self.writes.append((attr, node.lineno, tuple(self._held)))
+        # thread spawns
+        if _is_thread_ctor(node):
+            target = None
+            daemon = False
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = _self_attr(kw.value)
+                elif kw.arg == "daemon":
+                    daemon = isinstance(kw.value, ast.Constant) and \
+                        bool(kw.value.value)
+            self.spawns.append((target, daemon, node.lineno))
+        elif isinstance(f, ast.Attribute) and f.attr == "submit" \
+                and node.args:
+            target = _self_attr(node.args[0])
+            if target is not None:
+                self.spawns.append((target, False, node.lineno))
+        # inline clocks: time.time()/monotonic()/sleep() called directly
+        name = None
+        if isinstance(f, ast.Attribute) and f.attr in _CLOCK_CALLS and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in ("time", "_time"):
+            name = f"time.{f.attr}"
+        elif isinstance(f, ast.Name) and f.id in _CLOCK_CALLS:
+            name = f.id
+        if name is not None:
+            self.clock_calls.append((node.lineno, name))
+        self.generic_visit(node)
+
+
+def _init_attr_facts(init: ast.FunctionDef | None):
+    """(lock_attrs, stopish_attrs, clock_injected) from ``__init__``."""
+    lock_attrs: set[str] = set()
+    stopish: set[str] = set()
+    clock_injected = False
+    if init is None:
+        return lock_attrs, stopish, clock_injected
+    args = init.args
+    params = [a.arg for a in args.args + args.kwonlyargs]
+    clock_injected = bool(_CLOCK_PARAMS & set(params))
+    lockish_params = {p for p in params
+                      if any(s in p.lower() for s in _LOCKISH_PARAM)}
+    for node in ast.walk(init):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        attr = _self_attr(node.targets[0])
+        if attr is None:
+            continue
+        v = node.value
+        if isinstance(v, ast.Call):
+            f = v.func
+            ctor = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if ctor in _LOCK_CTORS:
+                lock_attrs.add(attr)
+            elif ctor == _EVENT_CTOR:
+                stopish.add(attr)
+        if isinstance(v, ast.Name) and v.id in lockish_params:
+            lock_attrs.add(attr)
+        # an attr derived from a stop event (``self._wait = wait or
+        # self._stop.wait``) is itself a stop signal
+        for sub in ast.walk(v):
+            if _self_attr(sub) in stopish:
+                stopish.add(attr)
+                break
+    return lock_attrs, stopish, clock_injected
+
+
+def _check_class(path: str, cls: ast.ClassDef) -> list:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    lock_attrs, stopish, clock_injected = _init_attr_facts(
+        methods.get("__init__"))
+
+    scans = {name: _MethodScan(lock_attrs) for name in methods}
+    for name, node in methods.items():
+        scans[name].visit(node)
+
+    entries = {}  # entry method name -> (daemon, spawn line)
+    for scan in scans.values():
+        for target, daemon, line in scan.spawns:
+            if target in methods:
+                prev = entries.get(target)
+                entries[target] = (daemon or (prev and prev[0]) or False,
+                                   line if prev is None else prev[1])
+    if not entries:
+        return []
+
+    # call-graph closure per entry over self.<method> references
+    reach = {}
+    for entry in entries:
+        seen = {entry}
+        frontier = [entry]
+        while frontier:
+            m = frontier.pop()
+            for ref in scans[m].refs:
+                if ref in methods and ref not in seen:
+                    seen.add(ref)
+                    frontier.append(ref)
+        reach[entry] = seen
+
+    findings = []
+
+    # -- unlocked-shared-write ---------------------------------------------
+    by_attr: dict[str, list[_Write]] = {}
+    for name, scan in scans.items():
+        if name == "__init__":
+            continue  # construction happens-before Thread.start()
+        for attr, line, held in scan.writes:
+            by_attr.setdefault(attr, []).append(
+                _Write(attr, line, name, held))
+    thread_methods = {m for e in entries for m in reach[e]}
+    for attr, writes in sorted(by_attr.items()):
+        entry_sets = set()
+        outside = False
+        for w in writes:
+            reached_by = frozenset(e for e in entries
+                                   if w.method in reach[e])
+            if reached_by:
+                entry_sets.add(reached_by)
+            else:
+                outside = True
+        inside = bool(entry_sets)
+        multi_entry = len({e for s in entry_sets for e in s}) > 1
+        if not (inside and (outside or multi_entry)):
+            continue
+        common = frozenset.intersection(
+            *(w.locks for w in writes)) if writes else frozenset()
+        if common:
+            continue  # every write site holds the same lock
+        for w in writes:
+            where = f"in the thread body of {w.method}()" \
+                if w.method in thread_methods else "outside any thread body"
+            findings.append(Finding(
+                path, w.line, "unlocked-shared-write",
+                f"self.{attr} is written cross-thread (site {where}) "
+                f"without one shared lock over every write site: wrap "
+                f"each write in `with self.<lock>:` of the same "
+                f"threading.Lock/RLock attribute, or carry an "
+                f"evidence-comment suppression saying why this site "
+                f"cannot race (class {cls.name})"))
+
+    # -- daemon-thread-without-stop-flag -----------------------------------
+    for entry, (daemon, line) in sorted(entries.items()):
+        if not daemon:
+            continue
+        consulted = any(s in scans[m].refs
+                        for m in reach[entry] for s in stopish)
+        if not consulted:
+            findings.append(Finding(
+                path, line, "daemon-thread-without-stop-flag",
+                f"daemon thread target {cls.name}.{entry}() never "
+                f"consults a stop flag: give the class a threading.Event "
+                f"the loop checks (is_set/wait) so teardown can stop the "
+                f"thread instead of leaking it into the next run"))
+
+    # -- thread-loop-inline-clock ------------------------------------------
+    if clock_injected:
+        for m in sorted(thread_methods):
+            for line, name in scans[m].clock_calls:
+                findings.append(Finding(
+                    path, line, "thread-loop-inline-clock",
+                    f"inline {name}() in the thread body {cls.name}."
+                    f"{m}() of a clock-injected class: read time through "
+                    f"the injected clock/wall/wait/sleep callables only "
+                    f"— one inline read splits the time base the "
+                    f"virtual-clock tests drive"))
+
+    return findings
+
+
+def check_source(path: str, source: str) -> list:
+    findings = []
+    tree = parse_source(source, path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            findings += _check_class(path, node)
+    return findings
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: source} mapping (the unit-test entry point)."""
+    findings = []
+    for path, src in sources.items():
+        findings += check_source(path, src)
+    return sorted(apply_suppressions(findings, sources),
+                  key=lambda f: (f.path, f.line))
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    sources = {}
+    for target in targets:
+        base = os.path.join(root, target)
+        if os.path.isfile(base):
+            paths = [base]
+        elif os.path.isdir(base):
+            paths = []
+            for dirpath, _dirnames, filenames in os.walk(base):
+                paths += [os.path.join(dirpath, f)
+                          for f in sorted(filenames)]
+        else:
+            continue
+        for path in paths:
+            if not path.endswith(".py"):
+                continue
+            sources[os.path.relpath(path, root)] = read_source(path)
+    return check_sources(sources)

@@ -33,7 +33,8 @@ import ast
 import glob as _glob
 import os
 
-from .common import Finding, apply_suppressions
+from .common import Finding, apply_suppressions, parse_source, \
+    read_source
 
 # Profiling / experiment scripts, relative to the repo root (globs
 # allowed): the scripts whose printed numbers feed optimization
@@ -82,7 +83,7 @@ def _scopes(tree: ast.Module):
 
 def check_source(path: str, source: str) -> list:
     findings = []
-    tree = ast.parse(source, filename=path)
+    tree = parse_source(source, path)
     for _scope, nodes in _scopes(tree):
         timer_lines = []
         blockers = []
@@ -127,6 +128,5 @@ def check(root: str, targets=DEFAULT_TARGETS) -> list:
         for path in sorted(_glob.glob(os.path.join(root, target))):
             if not path.endswith(".py"):
                 continue
-            with open(path, encoding="utf-8") as fh:
-                sources[os.path.relpath(path, root)] = fh.read()
+            sources[os.path.relpath(path, root)] = read_source(path)
     return check_sources(sources)

@@ -30,7 +30,8 @@ from __future__ import annotations
 import os
 import re
 
-from .common import Finding, module_int_constants
+from .common import Finding, module_int_constants, parse_source, \
+    read_source
 
 # (python constant in protocol.py, C++ constant in sidecar_client.cpp)
 _TAG_PAIRS = (
@@ -68,8 +69,7 @@ BLS12381 = "hotstuff_tpu/offchain/bls12381.py"
 def _read(root: str, rel: str):
     path = os.path.join(root, rel)
     try:
-        with open(path, encoding="utf-8") as f:
-            return f.read()
+        return read_source(path)
     except OSError:
         return None
 
@@ -119,7 +119,7 @@ def py_struct_formats(source: str) -> dict:
     import ast
 
     out = {}
-    tree = ast.parse(source)
+    tree = parse_source(source)
     for node in tree.body:
         if not (isinstance(node, ast.Assign) and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)

@@ -1,0 +1,47 @@
+// TSan-build-only shim: route pthread_cond_clockwait through the
+// intercepted pthread_cond_timedwait.
+//
+// This toolchain's libstdc++ inlines a direct pthread_cond_clockwait
+// call (glibc 2.30+) for every steady-clock condition_variable
+// wait_for/wait_until, but GCC 10's libtsan ships NO interceptor for it
+// (added in GCC 11).  ThreadSanitizer therefore never sees the mutex
+// release/reacquire inside the wait: every cv handoff in the tree —
+// Channel::recv_until/send_until, Oneshot::wait, the proposer and
+// quorum-waiter stake waits, the sidecar probe backoff — reports as a
+// "double lock of a mutex" plus data races on everything the channel
+// carried (617 reports on a baseline run, all of this one shape; a
+// 15-line obviously-correct cv program reproduces it).
+//
+// The fix is to give TSan a wait it DOES understand: translate the
+// absolute clockid deadline to a CLOCK_REALTIME deadline and call
+// pthread_cond_timedwait, whose interceptor models the mutex hand-off
+// correctly.  The conversion inherits realtime-clock skew for the
+// duration of one wait slice — irrelevant for tests, and this object is
+// linked ONLY into -DGRAFT_SANITIZE=thread builds (CMakeLists.txt /
+// scripts/native_sanitize.sh), never into production binaries.
+//
+// Defining the symbol in the link unit preempts the versioned libc
+// reference, so no LD_PRELOAD is needed.
+
+#include <pthread.h>
+#include <time.h>
+
+extern "C" int pthread_cond_clockwait(pthread_cond_t* cond,
+                                      pthread_mutex_t* mtx,
+                                      clockid_t clockid,
+                                      const struct timespec* abstime) {
+  struct timespec now_clock;
+  struct timespec now_rt;
+  struct timespec target;
+  clock_gettime(clockid, &now_clock);
+  clock_gettime(CLOCK_REALTIME, &now_rt);
+  long long rel_ns =
+      (abstime->tv_sec - now_clock.tv_sec) * 1000000000LL +
+      (abstime->tv_nsec - now_clock.tv_nsec);
+  if (rel_ns < 0) rel_ns = 0;
+  long long tgt_ns =
+      now_rt.tv_sec * 1000000000LL + now_rt.tv_nsec + rel_ns;
+  target.tv_sec = static_cast<time_t>(tgt_ns / 1000000000LL);
+  target.tv_nsec = static_cast<long>(tgt_ns % 1000000000LL);
+  return pthread_cond_timedwait(cond, mtx, &target);
+}

@@ -26,10 +26,12 @@ const char* level_name(LogLevel l) {
 }  // namespace
 
 void log_set_level(LogLevel level) {
-  g_level.store(static_cast<int>(level));
+  g_level.store(static_cast<int>(level), std::memory_order_relaxed);
 }
 
-LogLevel log_level() { return static_cast<LogLevel>(g_level.load()); }
+LogLevel log_level() {
+  return static_cast<LogLevel>(g_level.load(std::memory_order_relaxed));
+}
 
 void log_set_trace(bool on) { g_trace.store(on, std::memory_order_relaxed); }
 

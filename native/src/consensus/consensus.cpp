@@ -98,7 +98,7 @@ std::unique_ptr<Consensus> Consensus::spawn(
 void Consensus::stop() {
   if (stopped_) return;
   stopped_ = true;
-  stop_flag_->store(true);
+  stop_flag_->store(true, std::memory_order_relaxed);
   for (auto& close : closers_) close();
   receiver_.stop();
   for (auto& t : threads_) {

@@ -487,10 +487,15 @@ class CoreImpl {
       TpuVerifier* tpu = TpuVerifier::instance();
       if (!tpu) return false;
       struct Join {
-        std::atomic<int> remaining;
-        std::atomic<bool> all_ok{true};
-        ChannelPtr<CoreEvent> ch;
-        Block block;
+        // graftsync: the two atomics are the synchronization (acq_rel
+        // on the decrement publishes all_ok to the last callback); ch
+        // and block are written before either callback is registered
+        // and only READ afterwards — the thread-start/submit edge is
+        // the happens-before.
+        std::atomic<int> remaining;      // SHARED_OK(atomic join counter)
+        std::atomic<bool> all_ok{true};  // SHARED_OK(atomic)
+        ChannelPtr<CoreEvent> ch;  // SHARED_OK(written pre-registration)
+        Block block;               // SHARED_OK(written pre-registration)
       };
       auto join = std::make_shared<Join>();
       join->remaining = (need_qc ? 1 : 0) + (need_tc ? 1 : 0);
@@ -499,10 +504,16 @@ class CoreImpl {
       auto complete = [join](std::optional<bool> ok) {
         // Transport failure is a definitive reject under BLS (no host
         // pairing exists) — same policy as the synchronous path.
-        if (!ok.value_or(false)) join->all_ok = false;
-        if (join->remaining.fetch_sub(1) == 1) {
-          CoreEvent e = CoreEvent::verdict_of(join->block,
-                                              join->all_ok.load());
+        // Ordering: each callback's relaxed all_ok store is published
+        // to the LAST decrementer through the acq_rel RMW chain on
+        // `remaining` (release on every decrement, acquire on the one
+        // that reads 1), so the final load may stay relaxed.
+        if (!ok.value_or(false)) {
+          join->all_ok.store(false, std::memory_order_relaxed);
+        }
+        if (join->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+          CoreEvent e = CoreEvent::verdict_of(
+              join->block, join->all_ok.load(std::memory_order_relaxed));
           join->ch->try_send(std::move(e));
         }
       };

@@ -49,7 +49,12 @@ MempoolDriver::MempoolDriver(
             // (consensus/src/mempool.rs:110-125 try_join_all analogue).
             store.notify_read(digest.to_bytes())
                 .on_ready([rx, remaining, block_digest](const Bytes&) {
-                  if (remaining->fetch_sub(1) == 1) {
+                  // acq_rel: the last decrementer must observe every
+                  // earlier callback's effects before looping the
+                  // kComplete command back (the channel send would
+                  // order it anyway; the RMW states the intent).
+                  if (remaining->fetch_sub(
+                          1, std::memory_order_acq_rel) == 1) {
                     WaiterMessage done;
                     done.kind = WaiterMessage::Kind::kComplete;
                     done.completed = block_digest;

@@ -42,10 +42,15 @@ class MempoolDriver {
     Digest completed;             // kComplete (internal: payload arrived)
   };
 
-  Store store_;
-  ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool_;
-  ChannelPtr<WaiterMessage> tx_payload_waiter_;
-  std::thread thread_;
+  // graftsync: verify()/cleanup() run on the core thread, the waiter
+  // lambda on thread_, notify_read completions on the store thread —
+  // every member they share synchronizes through the Store/Channel
+  // internals, so no mutex lives here (the per-block join counter in
+  // the .cpp is the one atomic, acq_rel at its decrement).
+  Store store_;  // SHARED_OK(channel-backed handle)
+  ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool_;  // SHARED_OK(Channel)
+  ChannelPtr<WaiterMessage> tx_payload_waiter_;  // SHARED_OK(Channel)
+  std::thread thread_;  // SHARED_OK(set in ctor, joined in dtor)
 };
 
 }  // namespace consensus

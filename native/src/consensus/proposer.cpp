@@ -68,7 +68,7 @@ void make_block(const PublicKey& name, const Committee& committee,
   std::unique_lock<std::mutex> lk(*m);
   // Bounded waits so teardown (stop set, peers gone) can't wedge the
   // proposer inside its backpressure wait; live ACKs wake us immediately.
-  while (*total < quorum && !stop.load()) {
+  while (*total < quorum && !stop.load(std::memory_order_relaxed)) {
     cv->wait_for(lk, std::chrono::milliseconds(50));
   }
 }

@@ -49,6 +49,10 @@ void write_header(Writer* w, uint8_t opcode, uint32_t rid, uint32_t count) {
 
 TpuVerifier::TpuVerifier(const Address& addr)
     : addr_(addr), inner_(std::make_shared<Inner>()) {
+  // Construction precedes every reader/probe thread (ensure_connected_
+  // locked_ spawns the first one later); the thread-start edge is the
+  // happens-before, so this one pre-publication write needs no lock.
+  // graftlint: disable=guarded-member-unlocked
   inner_->addr = addr;
 }
 
@@ -298,7 +302,10 @@ void TpuVerifier::reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
     if (rc == 0) continue;
     Bytes reply;
     // Safe without the lock: this reader is the only thread reading, and
-    // only this reader closes the gen's socket (writers only shutdown()).
+    // only this reader closes the gen's socket (writers only shutdown(),
+    // which is async-signal-safe against a concurrent read); holding m
+    // across a blocking read_frame would wedge every submitter.
+    // graftlint: disable=guarded-member-unlocked
     if (!inner->sock.read_frame(&reply)) {
       fail_all_(inner, gen, "connection closed by sidecar");
       return;

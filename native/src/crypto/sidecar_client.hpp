@@ -155,28 +155,34 @@ class TpuVerifier {
 
   // Connection state shared with (detached) reader/probe threads, so a
   // thread draining a dead socket can never touch a destroyed client.
+  // Every member below is guarded by `m` (analysis/cxxsync.py enforces
+  // the annotations; *_locked_ helpers document caller-held locking).
   struct Inner {
     mutable std::mutex m;
-    Socket sock;
-    Address addr;      // dial target (probe thread re-dials off Inner)
-    uint64_t gen = 0;  // bumped per socket lifetime; stale readers exit
-    std::unordered_map<uint32_t, PendingReq> pending;
-    uint32_t next_id = 0;
-    bool ever_connected = false;
-    std::chrono::steady_clock::time_point backoff_until{};
-    std::chrono::steady_clock::time_point last_rx{};
+    Socket sock;       // GUARDED_BY(m) — reader's read_frame carries the
+                       // one worked suppression (it is the sole reader)
+    Address addr;      // GUARDED_BY(m) — dial target; written pre-thread
+                       // in the ctor, re-read by the probe under m
+    uint64_t gen = 0;  // GUARDED_BY(m) — bumped per socket lifetime;
+                       // stale readers exit
+    std::unordered_map<uint32_t, PendingReq> pending;  // GUARDED_BY(m)
+    uint32_t next_id = 0;                              // GUARDED_BY(m)
+    bool ever_connected = false;                       // GUARDED_BY(m)
+    std::chrono::steady_clock::time_point backoff_until{};  // GUARDED_BY(m)
+    std::chrono::steady_clock::time_point last_rx{};        // GUARDED_BY(m)
     // Circuit breaker + probe state (constants on TpuVerifier).
-    BreakerState breaker = BreakerState::kClosed;
-    int consecutive_failures = 0;
-    int backoff_ms = kBackoffMs;       // current probe interval
-    int backoff_base_ms = kBackoffMs;  // reset target (test hook)
-    int backoff_max_ms = kBackoffMaxMs;
-    bool probe_running = false;
-    bool closing = false;  // destructor: probes must exit
-    std::condition_variable cv;  // wakes a sleeping probe on shutdown
+    BreakerState breaker = BreakerState::kClosed;  // GUARDED_BY(m)
+    int consecutive_failures = 0;                  // GUARDED_BY(m)
+    int backoff_ms = kBackoffMs;       // GUARDED_BY(m) — probe interval
+    int backoff_base_ms = kBackoffMs;  // GUARDED_BY(m) — reset target
+    int backoff_max_ms = kBackoffMaxMs;  // GUARDED_BY(m)
+    bool probe_running = false;          // GUARDED_BY(m)
+    bool closing = false;  // GUARDED_BY(m) — destructor: probes must exit
+    std::condition_variable cv;  // SHARED_OK(cv is self-synchronizing;
+                                 // waited on under m)
     // Adaptive async budget (OP_STATS-driven).
-    int inflight_budget = kInflightBudgetMax;
-    std::chrono::steady_clock::time_point last_stats_tx{};
+    int inflight_budget = kInflightBudgetMax;  // GUARDED_BY(m)
+    std::chrono::steady_clock::time_point last_stats_tx{};  // GUARDED_BY(m)
   };
 
   static void reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
@@ -203,8 +209,9 @@ class TpuVerifier {
   bool append_bls_record_(BlsContext* bls, Writer* w, const PublicKey& pk,
                           const Signature& sig);
 
-  Address addr_;
-  std::shared_ptr<Inner> inner_;
+  Address addr_;                  // SHARED_OK(immutable after ctor)
+  std::shared_ptr<Inner> inner_;  // SHARED_OK(the pointer is immutable
+                                  // after ctor; the pointee locks m)
 };
 
 }  // namespace hotstuff

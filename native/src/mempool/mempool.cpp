@@ -158,7 +158,7 @@ std::unique_ptr<Mempool> Mempool::spawn(
 void Mempool::stop() {
   if (stopped_) return;
   stopped_ = true;
-  stop_flag_->store(true);
+  stop_flag_->store(true, std::memory_order_relaxed);
   for (auto& close : closers_) close();
   tx_receiver_.stop();
   peer_receiver_.stop();

@@ -40,7 +40,8 @@ std::thread QuorumWaiter::spawn(Committee committee, Stake my_stake,
       std::unique_lock<std::mutex> lk(*m);
       // Bounded waits so a teardown (stop set, peers gone) can't wedge the
       // actor; in steady state the notify wakes us immediately.
-      while (*total < quorum && !stop->load()) {
+      while (*total < quorum &&
+             !stop->load(std::memory_order_relaxed)) {
         cv->wait_for(lk, std::chrono::milliseconds(50));
       }
       if (*total < quorum) break;  // stopped mid-wait

@@ -118,28 +118,34 @@ class EventLoop {
   int next_timeout_ms() const;
   void fire_due_timers();
 
-  int epfd_ = -1;
-  int wakeup_fd_ = -1;  // eventfd for post()
-  std::thread thread_;
-  bool stopping_ = false;
+  // graftsync annotations (analysis/cxxsync.py enforces GUARDED_BY;
+  // OWNED_BY documents single-thread confinement — here, the loop
+  // thread per the threading contract above).
+  int epfd_ = -1;           // SHARED_OK(set in ctor, then read-only)
+  int wakeup_fd_ = -1;      // SHARED_OK(set in ctor; eventfd writes are
+                            // thread-safe by contract)
+  std::thread thread_;      // SHARED_OK(set in ctor, joined in dtor)
+  bool stopping_ = false;   // OWNED_BY(loop thread — set via posted task)
 
-  uint64_t next_id_ = 1;
-  uint64_t next_timer_seq_ = 1;
+  uint64_t next_id_ = 1;          // OWNED_BY(loop thread)
+  uint64_t next_timer_seq_ = 1;   // OWNED_BY(loop thread)
   // Id of the connection whose on_frame callback is currently executing
   // (0 = none; ids start at 1): destroy() of that id is deferred until
   // the callback returns (see destroy()).
-  uint64_t in_callback_id_ = 0;
-  bool defer_destroy_ = false;
-  bool defer_run_closed_ = false;
-  std::unordered_map<uint64_t, Conn> conns_;
-  std::unordered_map<uint64_t, Listener_> listeners_;
-  std::unordered_map<uint64_t, Connecting> connecting_;
+  uint64_t in_callback_id_ = 0;   // OWNED_BY(loop thread)
+  bool defer_destroy_ = false;    // OWNED_BY(loop thread)
+  bool defer_run_closed_ = false;  // OWNED_BY(loop thread)
+  std::unordered_map<uint64_t, Conn> conns_;  // OWNED_BY(loop thread)
+  std::unordered_map<uint64_t, Listener_> listeners_;  // OWNED_BY(loop thread)
+  std::unordered_map<uint64_t, Connecting> connecting_;  // OWNED_BY(loop thread)
   std::priority_queue<Timer, std::vector<Timer>, std::greater<Timer>>
-      timers_;
-  std::vector<uint64_t> cancelled_timers_;
+      timers_;  // OWNED_BY(loop thread)
+  std::vector<uint64_t> cancelled_timers_;  // OWNED_BY(loop thread)
 
+  // The ONE cross-thread ingress: post/post_wait/run_after enqueue
+  // under tasks_m_ from any thread; run() swaps the deque out under it.
   std::mutex tasks_m_;
-  std::deque<Task> tasks_;
+  std::deque<Task> tasks_;  // GUARDED_BY(tasks_m_)
 };
 
 }  // namespace hotstuff

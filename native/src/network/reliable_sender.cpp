@@ -45,9 +45,9 @@ struct ReliableSender::State {
     std::chrono::milliseconds backoff = kInitialBackoff;
   };
 
-  EventLoop* loop = &EventLoop::instance();
-  std::unordered_map<Address, Peer, AddressHash> peers;
-  bool stopped = false;
+  EventLoop* loop = &EventLoop::instance();  // SHARED_OK(immutable)
+  std::unordered_map<Address, Peer, AddressHash> peers;  // OWNED_BY(loop thread)
+  bool stopped = false;                                  // OWNED_BY(loop thread)
 
   void submit(const std::shared_ptr<State>& self, const Address& addr,
               Msg msg) {
@@ -203,7 +203,7 @@ CancelHandler ReliableSender::send_shared(
   State::Msg m;
   m.data = std::move(data);
   CancelHandler handler = m.ack;
-  if (stop_ && stop_->load()) {
+  if (stop_ && stop_->load(std::memory_order_relaxed)) {
     handler.set(Bytes{});  // stopping: cancelled, waiters must not hang
     return handler;
   }

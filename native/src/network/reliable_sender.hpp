@@ -40,8 +40,13 @@ class ReliableSender {
  private:
   struct State;
 
-  std::shared_ptr<std::atomic<bool>> stop_;
-  std::shared_ptr<State> state_;
+  // graftsync: no mutex here by design — State lives its whole life on
+  // the EventLoop thread (submit/teardown reach it only via post), the
+  // reference's task-confinement model.  See the OWNED_BY annotations
+  // on State's members in the .cpp.
+  std::shared_ptr<std::atomic<bool>> stop_;  // SHARED_OK(atomic flag)
+  std::shared_ptr<State> state_;  // SHARED_OK(pointer immutable after
+                                  // ctor; pointee loop-thread-only)
 };
 
 }  // namespace hotstuff

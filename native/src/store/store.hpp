@@ -72,8 +72,13 @@ class Store {
     Oneshot<Stats> stats_reply;           // stats
   };
 
-  ChannelPtr<Command> ch_;
-  std::shared_ptr<std::thread> worker_;
+  // graftsync: the handle is freely copyable across threads — all
+  // storage state (index, resident cache, WAL handle) is OWNED_BY the
+  // worker thread inside the .cpp lambda; these two members are the
+  // only shared surface and both synchronize themselves.
+  ChannelPtr<Command> ch_;  // SHARED_OK(Channel is internally locked)
+  std::shared_ptr<std::thread> worker_;  // SHARED_OK(set in open(),
+                                         // then read-only)
 };
 
 }  // namespace hotstuff

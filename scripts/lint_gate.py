@@ -4,7 +4,12 @@
 Exit status is the number-of-findings truth: 0 clean, 1 findings, 2 bad
 usage.  Every perf PR runs this before benching — the rules it enforces
 are exactly the silent-degradation class (host syncs, retraces, wire
-drift) that a green unit-test run does not catch.
+drift, unlocked sharing) that a green unit-test run does not catch.
+
+All CLI flags pass through to the analysis module, so
+``scripts/lint_gate.py --json-out findings.json`` emits the
+machine-readable findings document next to the text output (CI and
+tooling consume that instead of scraping lines).
 """
 
 import os
@@ -56,6 +61,28 @@ if __name__ == "__main__":
                     "obsspan:hotstuff_tpu/obs/sampler.py",
                     "obsspan:hotstuff_tpu/sidecar/service.py",
                     "timing:hotstuff_tpu/obs/trace.py",
-                    "timing:hotstuff_tpu/obs/sampler.py"):
+                    "timing:hotstuff_tpu/obs/sampler.py",
+                    # graftsync: every threaded Python module stays
+                    # inside the THREADS scan, and every annotated
+                    # native file inside the CXXSYNC scan — a module
+                    # that grows a thread (or a header that grows a
+                    # mutex) outside these sets must consciously join
+                    # the pin list.
+                    "threads:hotstuff_tpu/sidecar/service.py",
+                    "threads:hotstuff_tpu/sidecar/sched/scheduler.py",
+                    "threads:hotstuff_tpu/sidecar/sched/classes.py",
+                    "threads:hotstuff_tpu/obs/sampler.py",
+                    "threads:hotstuff_tpu/chaos/runner.py",
+                    "threads:hotstuff_tpu/harness/faults.py",
+                    "threads:hotstuff_tpu/harness/local.py",
+                    "cxxsync:native/src/network/event_loop.hpp",
+                    "cxxsync:native/src/network/event_loop.cpp",
+                    "cxxsync:native/src/network/reliable_sender.hpp",
+                    "cxxsync:native/src/network/reliable_sender.cpp",
+                    "cxxsync:native/src/store/store.hpp",
+                    "cxxsync:native/src/crypto/sidecar_client.hpp",
+                    "cxxsync:native/src/crypto/sidecar_client.cpp",
+                    "cxxsync:native/src/consensus/mempool_driver.hpp",
+                    "cxxsync:native/src/consensus/core.cpp"):
             argv += ["--must-cover", pin]
     sys.exit(main(argv))

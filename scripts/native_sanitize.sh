@@ -61,6 +61,12 @@ fi
 # Core sources (everything but the executables' main() files).
 mapfile -t SRCS < <(find "$NATIVE/src" -name '*.cpp' \
   ! -name main.cpp ! -name client.cpp ! -name offchain_bench.cpp | sort)
+if [ "$MODE" = thread ]; then
+  # GCC 10's libtsan lacks the pthread_cond_clockwait interceptor this
+  # libstdc++ inlines for steady-clock cv waits; without the shim every
+  # Channel/Oneshot handoff reports as a false double-lock + data race.
+  SRCS+=("$NATIVE/sanitize/tsan_clockwait_shim.cpp")
+fi
 
 compile() {  # compile $1 into $2 unless the object is current
   local src="$1" obj="$2"

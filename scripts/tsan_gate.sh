@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Tier-2 TSan gate: build the native tree under ThreadSanitizer and run
+# the curated unit-test subset inside a bounded window.
+#
+#   scripts/tsan_gate.sh [test ...]
+#
+# Closes the ROADMAP item "TSan in the tier-2 gate: preset wired,
+# runtime too slow for the CI window".  Two things made it fit:
+#
+#   1. The runtime was never the sanitizer — it was triage.  GCC 10's
+#      libtsan has no pthread_cond_clockwait interceptor, and this
+#      libstdc++ inlines that call for every steady-clock cv wait, so a
+#      baseline run drowned in 617 false reports (every Channel/Oneshot
+#      handoff as a double-lock + data races).  Thread-mode builds now
+#      link native/sanitize/tsan_clockwait_shim.cpp, which reroutes the
+#      wait through the intercepted pthread_cond_timedwait; the real
+#      suite runs clean (see scripts/tsan.supp for the policy).
+#   2. The curated subset is the six unit binaries (serde store crypto
+#      network mempool consensus) — test_e2e spawns whole committees
+#      and stays in the plain build, same curation as ASan/UBSan.
+#      Measured on this container: ~2m20s cold (full instrumented
+#      build), ~21s warm — both far inside the default 600 s budget.
+#
+# TSAN_GATE_BUDGET_S overrides the window; the gate FAILS (rc 124) if
+# the budget is exceeded, so a runtime regression is a loud CI signal,
+# never a silently-lengthening job.
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+BUDGET="${TSAN_GATE_BUDGET_S:-600}"
+TESTS=("$@")
+if [ ${#TESTS[@]} -eq 0 ]; then
+  TESTS=(serde store crypto network mempool consensus)
+fi
+
+# exitcode=66 makes any report fatal at process exit even where the
+# test harness would otherwise return 0; second_deadlock_stack gives
+# both lock orders on a deadlock report.
+export TSAN_OPTIONS="suppressions=$ROOT/scripts/tsan.supp \
+exitcode=66 second_deadlock_stack=1 ${TSAN_OPTIONS:-}"
+
+start=$(date +%s)
+rc=0
+timeout -k 10 "$BUDGET" \
+    "$ROOT/scripts/native_sanitize.sh" thread "${TESTS[@]}" || rc=$?
+if [ "$rc" -ne 0 ]; then
+  if [ "$rc" -eq 124 ]; then
+    echo "tsan_gate: exceeded the ${BUDGET}s budget" >&2
+  else
+    echo "tsan_gate: FAILED (rc=$rc)" >&2
+  fi
+  exit "$rc"
+fi
+end=$(date +%s)
+echo "tsan_gate: clean in $((end - start))s (budget ${BUDGET}s; tests: ${TESTS[*]})"

@@ -92,6 +92,20 @@ measured.  Keys: links, tc_commands, proxy_roundtrip_ms (one successful
 shaped round trip; null when the shape defeats every attempt),
 roundtrip_ok, partition_enforced, healed.
 
+graftsurge (`"surge"` field): the overload-robustness pipeline proven
+end to end — a seeded heavy-tailed multi-user generator
+(harness/loadgen.py) offers 4x a modeled drain capacity into the REAL
+verifysched scheduler + surge admission controller on a virtual clock,
+with shed bulk feeding BUSY backoff hints back into the generator; plus
+the OP_BUSY wire round trip (protocol v4) and the metrics-driven
+recovery-to-baseline SLO judge on a synthetic blackout series.  Keys:
+offered_x, latency {offered, shed, wait_p99_ms}, bulk {offered,
+admitted, shed, deferred_by_busy}, fairness_violations,
+bulk_before_latency, derate, busy_roundtrip, baseline_slo, and the
+acceptance-bar "ok" (>=3x overload, consensus p99 bounded, sheds
+bulk-before-latency, baseline SLO PASS).  Emitted on BOTH the live and
+degraded lines.
+
 grafttrace (`"trace"` field): the cross-layer tracing pipeline proven
 end to end — two synthetic replica logs with a known clock skew run
 through the real node-TRACE parser, the RTT-midpoint offset estimator,
@@ -653,10 +667,12 @@ _WAN_SPEC = None
 _SLO_SPEC = None
 
 # Miniature default plan for the headline probe: one of every fault
-# class, timed inside a tenth of a (virtual) second.
+# class — including a graftsurge flash crowd — timed inside a tenth of
+# a (virtual) second.
 _DEFAULT_CHAOS_SPEC = ("0.01 sidecar kill; 0.04 sidecar restart; "
                        "0.02 node:1 pause; 0.05 node:1 resume; "
-                       "0.06 sidecar degrade shed=1")
+                       "0.06 sidecar degrade shed=1; "
+                       "0.07 client:0 surge x5 for 0.02")
 
 # Miniature default WAN spec for the headline probe: one shaped
 # node->sidecar link, small enough that the loopback proxy round trip
@@ -822,6 +838,156 @@ def chaos_headline_probe(plan_spec=None, wan_spec=None,
     }
 
 
+def surge_headline_probe(offered_x: float = 4.0,
+                         seconds: float = 3.0) -> dict:
+    """The headline's ``surge`` field: prove the graftsurge overload
+    pipeline end to end without booting a committee.
+
+    A seeded heavy-tailed multi-user generator (harness/loadgen.py, the
+    python twin of the C++ client's UserLoadModel) offers ``offered_x``
+    times a modeled drain capacity of BULK verify work, plus a steady
+    consensus-class stream, into the REAL verifysched scheduler with its
+    REAL surge admission controller on a virtual clock.  Shed bulk
+    requests feed BUSY backoff hints back into the generator — the full
+    backpressure loop.  The probe then proves the OP_BUSY wire round
+    trip (protocol v4 encode -> decode -> SidecarOverloaded with the
+    retry hint attached) and the metrics-driven recovery-to-baseline SLO
+    judge on a synthetic sampled series with a blackout.
+
+    The acceptance bar rides in ``ok``: at >= 3x offered overload the
+    consensus-class wait p99 stays bounded (no queue collapse), sheds
+    are bulk-before-latency (zero latency sheds, zero fairness
+    violations), and the surge event is judged PASS by the
+    recovery-to-baseline judge."""
+    from hotstuff_tpu.chaos import judge_baseline_recovery
+    from hotstuff_tpu.harness.loadgen import UserLoad
+    from hotstuff_tpu.sidecar import protocol as proto
+    from hotstuff_tpu.sidecar import sched as vsched
+    from hotstuff_tpu.sidecar.client import SidecarClient, \
+        SidecarOverloaded
+
+    TICK_S = 0.01
+    CAP_SIGS_PER_TICK = 128       # modeled device drain per tick
+    QC_SIGS = 16                  # one consensus verify
+    LAT_PER_TICK = 2              # consensus offers per tick (well
+                                  # under capacity: it must never shed)
+    BULK_REQ_SIGS = 32
+    cap_sigs_per_s = CAP_SIGS_PER_TICK / TICK_S
+    bulk_req_rate = offered_x * cap_sigs_per_s / BULK_REQ_SIGS
+
+    sched = vsched.Scheduler(latency_cap_sigs=4 * 1024,
+                             bulk_cap_sigs=8 * 1024)
+    # Coalesce at the modeled per-tick drain so launch granularity and
+    # drain capacity speak the same units (the real engine's cap is the
+    # compiled-shape budget; here the "device" IS the tick budget).
+    sched.shapes.launch_cap = CAP_SIGS_PER_TICK
+    adm = sched.admission
+    load = UserLoad(rate=bulk_req_rate, users=200, seed=11)
+
+    rid = [0]
+
+    def request(n):
+        rid[0] += 1
+        recs = [rid[0].to_bytes(6, "big") + i.to_bytes(2, "big")
+                for i in range(n)]
+        return proto.VerifyRequest(rid[0], recs, recs, recs)
+
+    offered_at = {}
+    lat_waits = []
+    lat_offered = bulk_offered = 0
+    ticks = int(round(seconds / TICK_S))
+    for k in range(1, ticks + 1):
+        t = k * TICK_S
+        for _ in range(LAT_PER_TICK):
+            req = request(QC_SIGS)
+            offered_at[req.request_id] = t
+            lat_offered += 1
+            sched.offer(req, lambda m: None, cls=vsched.LATENCY)
+        for _ in range(load.arrivals(t)):
+            bulk_offered += 1
+            if not sched.offer(request(BULK_REQ_SIGS), lambda m: None,
+                               cls=vsched.BULK):
+                # The generator honors the BUSY hint: per-user backoff.
+                load.busy(t, sched.retry_after_ms(vsched.BULK) / 1e3)
+        budget = CAP_SIGS_PER_TICK
+        while budget > 0:
+            launch = sched.next_launch(block=False)
+            if launch is None:
+                break
+            for p in launch.items:
+                if p.cls == vsched.LATENCY:
+                    lat_waits.append(
+                        (t - offered_at.pop(p.request.request_id, t))
+                        * 1e3)
+            budget -= launch.total_sigs
+            # Pipeline evidence for the derate controller: a tick whose
+            # offered load exceeds drain capacity packs in the open
+            # (overlap collapsed) — exactly the surge regime.
+            adm.note_pack(0.001, hidden=offered_x <= 1.0)
+    snap = adm.snapshot()
+    lat_waits.sort()
+    wait_p99 = lat_waits[int(0.99 * (len(lat_waits) - 1))] \
+        if lat_waits else 0.0
+
+    # OP_BUSY wire round trip: server encode -> client decode -> the
+    # typed overload error with the retry hint attached.
+    frame = proto.encode_busy_reply(9, 137)
+    opcode, brid, body = proto.decode_reply_raw(frame[4:])
+    try:
+        SidecarClient._unwrap(opcode, body)
+        busy_ok, hint = False, None
+    except SidecarOverloaded as e:
+        hint = e.retry_after_ms
+        busy_ok = brid == 9 and hint == 137
+
+    # Metrics-driven recovery-to-baseline judge on a synthetic series:
+    # steady 1000 sigs/s, a surge-window blackout, then recovery.
+    base_wall = 1_700_000_000.0
+    samples = []
+    launched = 0
+    for s in range(31):
+        t = base_wall + s
+        if 10 <= s < 13:
+            samples.append({"t": t, "ok": False, "error": "surge"})
+            continue
+        launched += 1000
+        samples.append({"t": t, "ok": True,
+                        "stats": {"sigs_launched": launched}})
+    surge_event = {"t": 10.0, "target": "client:0", "action": "surge",
+                   "wall": base_wall + 10, "ok": True,
+                   "params": {"x": 5, "for": 3}}
+    baseline = judge_baseline_recovery(samples, [surge_event])
+
+    ok = (offered_x >= 3.0
+          and wait_p99 <= 3 * TICK_S * 1e3
+          and snap["shed"].get(vsched.LATENCY, 0) == 0
+          and snap["shed"].get(vsched.BULK, 0) > 0
+          and snap["fairness_violations"] == 0
+          and busy_ok
+          and baseline["ok"] and baseline["judged"] == 1)
+    return {
+        "offered_x": offered_x,
+        "ticks": ticks,
+        "latency": {
+            "offered": lat_offered,
+            "shed": snap["shed"].get(vsched.LATENCY, 0),
+            "wait_p99_ms": round(wait_p99, 3),
+        },
+        "bulk": {
+            "offered": bulk_offered,
+            "admitted": snap["admitted"].get(vsched.BULK, 0),
+            "shed": snap["shed"].get(vsched.BULK, 0),
+            "deferred_by_busy": load.deferred,
+        },
+        "fairness_violations": snap["fairness_violations"],
+        "bulk_before_latency": snap["shed"].get(vsched.LATENCY, 0) == 0,
+        "derate": snap["derate"],
+        "busy_roundtrip": {"ok": busy_ok, "retry_after_ms": hint},
+        "baseline_slo": baseline,
+        "ok": ok,
+    }
+
+
 def probe_device(window: float | None = None,
                  max_attempts: int | None = None, run=None,
                  sleep=time.sleep, now=time.monotonic):
@@ -967,6 +1133,10 @@ def run_degraded(reason: str):
             trace = trace_headline_probe()
         except Exception as e:  # noqa: BLE001 — trace probe is best-effort
             trace = {"error": f"{e!r:.120}"}
+        try:
+            surge = surge_headline_probe()
+        except Exception as e:  # noqa: BLE001 — surge probe is best-effort
+            surge = {"error": f"{e!r:.120}"}
         # The watchdog stays armed until the moment of the real emit: a
         # stall anywhere above (including the sched probe) must still
         # produce a parseable line, which is this path's whole contract.
@@ -975,7 +1145,7 @@ def run_degraded(reason: str):
         # device backend wins over the cpu config flip above).
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
              note=reason, rlc=rlc, mesh_rlc=mesh_rlc, sched=sched,
-             chaos=chaos, trace=trace)
+             chaos=chaos, trace=trace, surge=surge)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -1236,8 +1406,13 @@ def main(argv=None):
         trace = trace_headline_probe()
     except Exception as e:  # noqa: BLE001 — trace probe is best-effort
         trace = {"error": f"{e!r:.120}"}
+    try:
+        surge = surge_headline_probe()
+    except Exception as e:  # noqa: BLE001 — surge probe is best-effort
+        surge = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
-               mesh_rlc=mesh_rlc, sched=sched, chaos=chaos, trace=trace)
+               mesh_rlc=mesh_rlc, sched=sched, chaos=chaos, trace=trace,
+               surge=surge)
 
 
 if __name__ == "__main__":

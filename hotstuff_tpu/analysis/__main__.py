@@ -17,12 +17,12 @@ import os
 import sys
 
 CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
-            "obsspan", "threads", "cxxsync")
+            "obsspan", "threads", "cxxsync", "ingress")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import cxxsync, hotpath, obsspan, padshape, sanitize, sockets, \
-        threads, timing, wirecheck
+    from . import cxxsync, hotpath, ingress, obsspan, padshape, sanitize, \
+        sockets, threads, timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -43,6 +43,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += threads.check(root)
     if "cxxsync" in checkers:
         findings += cxxsync.check(root)
+    if "ingress" in checkers:
+        findings += ingress.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -67,8 +69,8 @@ def check_coverage(root: str, must_cover) -> list:
     accepts any checker.  scripts/lint_gate.py pins the RLC scalar
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
-    from . import cxxsync, hotpath, obsspan, padshape, sockets, threads, \
-        timing
+    from . import cxxsync, hotpath, ingress, obsspan, padshape, sockets, \
+        threads, timing
     from .common import Finding
 
     target_sets = {
@@ -79,6 +81,7 @@ def check_coverage(root: str, must_cover) -> list:
         "obsspan": tuple(obsspan.DEFAULT_TARGETS),
         "threads": tuple(threads.DEFAULT_TARGETS),
         "cxxsync": tuple(cxxsync.DEFAULT_TARGETS),
+        "ingress": tuple(ingress.DEFAULT_TARGETS),
     }
     findings = []
     for pin in must_cover:

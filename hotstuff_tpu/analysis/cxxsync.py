@@ -74,6 +74,9 @@ DEFAULT_TARGETS = (
     "native/src/consensus/mempool_driver.cpp",
     "native/src/consensus/core.hpp",
     "native/src/consensus/core.cpp",
+    # graftsurge: the bounded-ingress gate is reactor-thread +
+    # batch-maker-thread shared state behind one mutex.
+    "native/src/mempool/ingress.hpp",
 )
 
 # The atomic rule scans the whole native tree (any .cpp/.hpp under here).

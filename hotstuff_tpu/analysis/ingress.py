@@ -1,0 +1,144 @@
+"""graftlint bounded-ingress checker: every enqueue onto a scheduler- or
+mempool-facing queue in the graftsurge modules must route through the
+admission controller.
+
+The whole point of graftsurge is that NOTHING enters the verify
+scheduler's class queues (or their harness-side models) without passing
+the admission policy — byte/record caps, the overlap-driven bulk
+derate, bulk-before-latency shedding.  One helper that appends to
+``self.items`` directly would silently bypass all of it: the queue
+would still look bounded in review, and the first overload would show
+an admitted backlog the caps never saw.  This rule makes that bypass a
+lint finding instead of a production incident.
+
+Rule:
+  bounded-ingress   a ``.append`` / ``.appendleft`` / ``.put`` /
+                    ``.put_nowait`` call whose receiver is a
+                    queue-carrying attribute (``items`` / ``queue`` /
+                    ``queues`` / ``backlog`` / ``pending`` /
+                    ``outbox``) in a surge module, OUTSIDE an admission
+                    scope.  Admission scopes are the queue's own
+                    ``offer`` / ``_offer_locked`` methods and any
+                    method of ``AdmissionController`` — the audited
+                    places where cap checks live.
+
+Receiver detection is name-based like the sockets rule: the surge
+modules use these conventional names for their admission-guarded
+queues, and a rename that dodges the rule is exactly the edit a
+reviewer should see.  Internal bookkeeping containers (the load
+generator's arrival heap, telemetry rings) use other names and stay out
+of scope by construction.  Inline ``# graftlint: disable=bounded-ingress``
+suppressions follow the standard policy (analysis/README.md): only with
+a worked justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .common import Finding, apply_suppressions, parse_source, \
+    read_source
+
+# The graftsurge modules: scheduler-side admission and the harness-side
+# load model that exercises it.
+DEFAULT_TARGETS = (
+    "hotstuff_tpu/sidecar/sched",
+    "hotstuff_tpu/harness/loadgen.py",
+)
+
+_ENQUEUE_OPS = {"append", "appendleft", "put", "put_nowait"}
+_QUEUE_NAMES = {"items", "queue", "queues", "backlog", "pending",
+                "outbox"}
+_ADMISSION_FUNCS = {"offer", "_offer_locked"}
+_ADMISSION_CLASSES = {"AdmissionController"}
+
+
+def _queue_receiver(node: ast.AST):
+    """Rightmost queue-ish identifier of an enqueue receiver
+    (``self.items.append`` -> ``items``; ``self._queues[cls].put`` ->
+    ``_queues``), else None.  Attribute receivers only: a bare local
+    list that happens to be named ``items`` is function-private state,
+    not a shared queue anything could bypass admission into."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Subscript):
+        return _queue_receiver(node.value)
+    else:
+        return None
+    if name.lstrip("_") in _QUEUE_NAMES:
+        return name
+    return None
+
+
+def _walk_with_context(tree: ast.Module):
+    """Yield ``(node, func_name, class_name)`` with the nearest
+    enclosing function and class tracked."""
+    def visit(node, func, cls):
+        for child in ast.iter_child_nodes(node):
+            child_func, child_cls = func, cls
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_func = child.name
+            elif isinstance(child, ast.ClassDef):
+                child_cls = child.name
+            yield child, child_func, child_cls
+            yield from visit(child, child_func, child_cls)
+
+    yield from visit(tree, None, None)
+
+
+def _check_source(rel: str, source: str) -> list:
+    findings = []
+    tree = parse_source(source)
+    for node, func, cls in _walk_with_context(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in _ENQUEUE_OPS):
+            continue
+        queue = _queue_receiver(fn.value)
+        if queue is None:
+            continue
+        if func in _ADMISSION_FUNCS or cls in _ADMISSION_CLASSES:
+            continue
+        where = f"{cls}.{func}" if cls and func else (func or cls or
+                                                      "<module>")
+        findings.append(Finding(
+            rel, node.lineno, "bounded-ingress",
+            f"{where} enqueues onto {queue!r} via .{fn.attr}() outside "
+            "the admission controller: surge-module queues admit only "
+            "through offer/_offer_locked (or AdmissionController "
+            "methods) so the byte caps, bulk derate, and "
+            "bulk-before-latency policy can never be bypassed"))
+    return findings
+
+
+def _iter_targets(root: str, targets):
+    for rel in targets:
+        path = os.path.join(root, rel)
+        if os.path.isfile(path):
+            yield rel, path
+        elif os.path.isdir(path):
+            for dirpath, _dirnames, filenames in os.walk(path):
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        yield os.path.relpath(full, root), full
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    findings = []
+    sources = {}
+    for rel, path in _iter_targets(root, targets):
+        try:
+            source = read_source(path)
+        except OSError:
+            continue
+        sources[rel] = source
+        try:
+            findings += _check_source(rel, source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rel, e.lineno or 1, "bounded-ingress",
+                f"cannot parse module: {e.msg}"))
+    return apply_suppressions(findings, sources)

@@ -47,6 +47,8 @@ _TAG_PAIRS = (
     ("OP_STATS", "kOpStats"),
     # protocol v3 (graftchaos): the sidecar fault-injection hook.
     ("OP_CHAOS", "kOpChaos"),
+    # protocol v4 (graftsurge): the reply-only BUSY/retry-after opcode.
+    ("OP_BUSY", "kOpBusy"),
     ("PROTOCOL_VERSION", "kProtocolVersion"),
 )
 

@@ -31,9 +31,9 @@ the sidecar's in-process fault hook (``OP_CHAOS``) in
 
 from .netem import LinkShape, WanError, WanProxy, WanSpec, \
     parse_wan  # noqa: F401
-from .plan import ACTIONS, FaultEvent, FaultPlan, PlanError, link_name, \
-    node_index, parse_plan  # noqa: F401
+from .plan import ACTIONS, FaultEvent, FaultPlan, PlanError, \
+    client_index, link_name, node_index, parse_plan  # noqa: F401
 from .recovery import summarize_recovery  # noqa: F401
 from .runner import PlanRunner  # noqa: F401
 from .slo import DEFAULT_SLO_MS, SloError, fault_class, judge, \
-    parse_slos  # noqa: F401
+    judge_baseline_recovery, parse_slos, throughput_series  # noqa: F401

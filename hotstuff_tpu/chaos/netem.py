@@ -399,6 +399,61 @@ _CHUNK = 65536
 _POLL_S = 0.25
 
 
+class _TokenBucket:
+    """Byte-rate limiter for one pump direction of a WanProxy link.
+
+    The old model charged every chunk ``len * 8 / rate`` of sleep
+    regardless of how much wall clock had already passed between chunks
+    — a sender with natural gaps was double-charged (its idle time
+    earned no credit), which made shaped caps increasingly inaccurate
+    as the cap dropped (ROADMAP item-5 follow-up: coarse below
+    ~1 Mbit).  A token bucket fixes both ends: tokens accrue with
+    elapsed time at the link rate (idle time earns credit up to
+    ``burst``), each chunk spends its byte count, and only a deficit
+    sleeps — so the long-run rate equals the cap for any send pattern.
+
+    ``delay(n)`` returns the seconds the pump must sleep BEFORE
+    forwarding the chunk; tokens may go negative (the debt is the sleep
+    being returned), and the next refill credits that slept time back.
+    Thread-safe: every connection of the direction shares one bucket —
+    the link's rate is a property of the link, not of a socket pair.
+    The clock is injectable for deterministic tests."""
+
+    def __init__(self, rate_mbit: float, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = 0.0
+        self._last = None
+        self.set_rate(rate_mbit)
+
+    def set_rate(self, rate_mbit: float):
+        with self._lock:
+            self._rate = rate_mbit * 1e6 / 8.0  # bytes per second
+            # Burst: enough for a short scheduling hiccup, never so much
+            # that a low cap stops binding (50 ms of line rate, floored
+            # at 8 KiB so tiny caps still make progress chunk by chunk).
+            self._burst = max(self._rate * 0.05, 8192.0)
+            self._tokens = min(self._tokens, self._burst)
+
+    def delay(self, nbytes: int) -> float:
+        """Seconds to sleep before forwarding an nbytes chunk."""
+        with self._lock:
+            if self._rate <= 0:
+                return 0.0
+            now = self._clock()
+            if self._last is not None:
+                self._tokens = min(self._burst,
+                                   self._tokens + (now - self._last)
+                                   * self._rate)
+            else:
+                self._tokens = self._burst  # first chunk rides the burst
+            self._last = now
+            self._tokens -= nbytes
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self._rate
+
+
 class WanProxy:
     """Userspace delay/loss/rate TCP proxy for ONE directed link.
 
@@ -414,6 +469,12 @@ class WanProxy:
     partition looks like to a dialing peer); ``heal()`` restores the
     spec shape.  ``rng`` is injectable so loss is deterministic in
     tests.
+
+    Rate caps are enforced by a per-direction shared token bucket
+    (``_TokenBucket``): elapsed time earns byte credit at the link
+    rate, each forwarded chunk spends its size, and only a deficit
+    sleeps — accurate at ANY cap (the old per-chunk charge ignored
+    inter-chunk idle time, so caps under ~1 Mbit over-shaped).
 
     ``start()`` returns before the proxy accepts connections: the accept
     loop first waits for the upstream target to answer a dial (so a peer
@@ -439,6 +500,10 @@ class WanProxy:
         self._threads = []
         self._conns = []
         self.port = None
+        # One bucket per pump direction, shared across connections: the
+        # cap is the LINK's rate each way, like netem on a host's egress.
+        self._bucket_fwd = _TokenBucket(self.shape.rate_mbit)
+        self._bucket_rev = _TokenBucket(self.shape.rate_mbit)
 
     # -- control ------------------------------------------------------------
 
@@ -483,6 +548,8 @@ class WanProxy:
         shape.validate("WanProxy")
         with self._lock:
             self.shape = shape
+        self._bucket_fwd.set_rate(shape.rate_mbit)
+        self._bucket_rev.set_rate(shape.rate_mbit)
 
     def partition(self):
         """Black-hole the link: kill live connections, drop new ones."""
@@ -553,14 +620,15 @@ class WanProxy:
                 # would retain every dead thread until stop().
                 self._threads = [t for t in self._threads
                                  if t.is_alive()]
-            for a, b in ((conn, upstream), (upstream, conn)):
-                t = threading.Thread(target=self._pump, args=(a, b),
+            for a, b, bucket in ((conn, upstream, self._bucket_fwd),
+                                 (upstream, conn, self._bucket_rev)):
+                t = threading.Thread(target=self._pump, args=(a, b, bucket),
                                      daemon=True)
                 t.start()
                 with self._lock:
                     self._threads.append(t)
 
-    def _pump(self, src_conn, dst_conn):
+    def _pump(self, src_conn, dst_conn, bucket: "_TokenBucket"):
         try:
             # Both ends were bounded at accept time; re-assert here so
             # the bound is visible in the scope doing the recv (the
@@ -594,7 +662,10 @@ class WanProxy:
                               if shape.jitter_ms else 0.0)
                     delay += max(0.0, shape.latency_ms + jitter) / 1e3
                 if shape.rate_mbit:
-                    delay += len(data) * 8 / (shape.rate_mbit * 1e6)
+                    # Token bucket, not per-chunk charging: idle time
+                    # between chunks earns credit, so the cap is what
+                    # the spec says at any rate (see _TokenBucket).
+                    delay += bucket.delay(len(data))
                 if delay:
                     time.sleep(delay)
                 try:

@@ -9,6 +9,7 @@ Targets
     ``node:<i>``     replica i of the local committee (boot order index)
     ``link:<name>``  a directed WAN link by its graftwan spec label
                      (chaos/netem.py) — requires a WAN spec on the run
+    ``client:<i>``   the load generator aimed at replica i (graftsurge)
 
 Actions (per target)
     node:     ``kill`` (SIGKILL), ``restart`` (reboot on the same store),
@@ -25,12 +26,20 @@ Actions (per target)
               remotely, a dropped WanProxy locally) and ``heal``
               (restore the spec shape) — the netem partition-heal fault
               class, measured like every other event.
+    client:   ``surge`` — a flash crowd (graftsurge): the offered load
+              aimed at that replica multiplies by ``x`` for ``for``
+              seconds, then returns to baseline.  DSL sugar:
+              ``"10 client:0 surge x5 for 20"`` (also accepted as
+              ``x=5 for=20``).  Injectors realize it as an extra
+              load-generator process at ``(x-1)``× the client's rate,
+              killed when the window closes.
 
 Validation is a per-target state machine over the time-ordered events:
 ``restart`` must follow ``kill``, ``resume`` must follow ``pause``,
-``heal`` must follow ``partition``, and ``degrade`` needs a live
-sidecar — a plan that cannot physically execute fails at parse time,
-not five seconds into a thirty-second bench.
+``heal`` must follow ``partition``, ``degrade`` needs a live sidecar,
+and surges on one client must not overlap — a plan that cannot
+physically execute fails at parse time, not five seconds into a
+thirty-second bench.
 """
 
 from __future__ import annotations
@@ -41,11 +50,12 @@ import re
 from dataclasses import dataclass, field
 
 ACTIONS = ("kill", "restart", "pause", "resume", "degrade",
-           "partition", "heal")
+           "partition", "heal", "surge")
 SIDECAR = "sidecar"
 
 _NODE_RE = re.compile(r"^node:(\d+)$")
 _LINK_RE = re.compile(r"^link:(\S+)$")
+_CLIENT_RE = re.compile(r"^client:(\d+)$")
 
 
 def node_index(target: str):
@@ -60,12 +70,35 @@ def link_name(target: str):
     m = _LINK_RE.match(target)
     return m.group(1) if m else None
 
+
+def client_index(target: str):
+    """``"client:<i>"`` -> i, else None (graftsurge load targets)."""
+    m = _CLIENT_RE.match(target)
+    return int(m.group(1)) if m else None
+
+
+# Surge parameter defaults — ONE definition shared by validation, the
+# injectors, the window math (max_time), the SLO judge, and the parser's
+# goodput notes, so a plan omitting a param means the same thing at
+# every layer.
+SURGE_DEFAULT_X = 2.0
+SURGE_DEFAULT_FOR_S = 10.0
+
+
+def surge_window_s(params) -> float:
+    """The surge's active-window length in seconds (default applied)."""
+    try:
+        return float((params or {}).get("for", SURGE_DEFAULT_FOR_S))
+    except (TypeError, ValueError):
+        return SURGE_DEFAULT_FOR_S
+
 # Actions each target kind accepts (sidecar pause would stop the shared
 # verify engine for EVERY replica at once — use degrade for that class
 # of fault instead, it is observable and bounded).
 _NODE_ACTIONS = {"kill", "restart", "pause", "resume"}
 _SIDECAR_ACTIONS = {"kill", "restart", "degrade"}
 _LINK_ACTIONS = {"partition", "heal"}
+_CLIENT_ACTIONS = {"surge"}
 
 # degrade params the sidecar's ChaosState accepts (mirrored there; the
 # plan validates early so a typo fails at parse time).
@@ -119,7 +152,15 @@ class FaultPlan:
         return out
 
     def max_time(self) -> float:
-        return max((e.t for e in self.events), default=0.0)
+        """Latest event activity: a surge occupies ``[t, t + for]``, so
+        its END is what run-window headroom must clear."""
+        out = 0.0
+        for e in self.events:
+            end = e.t
+            if e.action == "surge":
+                end += surge_window_s(e.params)
+            out = max(out, end)
+        return out
 
 
 def _event_from_dict(obj: dict) -> FaultEvent:
@@ -139,7 +180,11 @@ def _event_from_dict(obj: dict) -> FaultEvent:
 
 
 def _event_from_text(entry: str) -> FaultEvent:
-    """``"<t> <target> <action> [k=v ...]"`` -> event (the inline DSL)."""
+    """``"<t> <target> <action> [k=v ...]"`` -> event (the inline DSL).
+
+    Surge sugar: ``"10 client:0 surge x5 for 20"`` — an ``xN`` token is
+    the multiplier, ``for N`` the window seconds (both also accepted in
+    k=v form)."""
     toks = entry.split()
     if len(toks) < 3:
         raise PlanError(
@@ -150,20 +195,40 @@ def _event_from_text(entry: str) -> FaultEvent:
     except ValueError:
         raise PlanError(f"bad event time {toks[0]!r} in {entry!r}")
     params = {}
-    for tok in toks[3:]:
+    rest = list(toks[3:])
+    i = 0
+    while i < len(rest):
+        tok = rest[i]
+        if toks[2] == "surge" and re.fullmatch(r"x\d+(\.\d+)?", tok):
+            params["x"] = float(tok[1:])
+            i += 1
+            continue
+        if toks[2] == "surge" and tok == "for" and i + 1 < len(rest):
+            try:
+                params["for"] = float(rest[i + 1])
+            except ValueError:
+                raise PlanError(
+                    f"bad surge duration {rest[i + 1]!r} in {entry!r}")
+            i += 2
+            continue
         if "=" not in tok:
             raise PlanError(f"bad param {tok!r} in {entry!r} (want k=v)")
         k, v = tok.split("=", 1)
         try:
             params[k] = int(v)
         except ValueError:
-            params[k] = v
+            try:
+                params[k] = float(v)
+            except ValueError:
+                params[k] = v
+        i += 1
     return FaultEvent(t, toks[1], toks[2], params)
 
 
 def _validate(events) -> FaultPlan:
     # Per-target liveness state machine over the time-ordered sequence.
     state: dict[str, str] = {}
+    surge_until: dict[str, float] = {}
     ordered = sorted(events, key=lambda e: e.t)
     for e in ordered:
         if not (e.t >= 0.0 and e.t == e.t and e.t != float("inf")):
@@ -177,14 +242,36 @@ def _validate(events) -> FaultPlan:
             allowed = _NODE_ACTIONS
         elif _LINK_RE.match(e.target):
             allowed = _LINK_ACTIONS
+        elif _CLIENT_RE.match(e.target):
+            allowed = _CLIENT_ACTIONS
         else:
             raise PlanError(f"{e.label()}: target must be 'sidecar', "
-                            "'node:<i>', or 'link:<name>'")
+                            "'node:<i>', 'link:<name>', or 'client:<i>'")
         if e.action not in allowed:
             raise PlanError(f"{e.label()}: {e.target} does not support "
                             f"{e.action} (allowed: {', '.join(sorted(allowed))})")
-        if e.params and e.action != "degrade":
-            raise PlanError(f"{e.label()}: only degrade takes params")
+        if e.params and e.action not in ("degrade", "surge"):
+            raise PlanError(f"{e.label()}: only degrade and surge take "
+                            "params")
+        if e.action == "surge":
+            bad = set(e.params) - {"x", "for"}
+            if bad:
+                raise PlanError(f"{e.label()}: unknown surge param(s) "
+                                f"{sorted(bad)} (have x, for)")
+            x = e.params.get("x", SURGE_DEFAULT_X)
+            dur = e.params.get("for", SURGE_DEFAULT_FOR_S)
+            for key, v, lo in (("x", x, 1.0), ("for", dur, 0.0)):
+                if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                        or v != v or v == float("inf") or not v > lo:
+                    raise PlanError(
+                        f"{e.label()}: surge {key} must be a finite "
+                        f"number > {lo:g} (got {v!r})")
+            if e.t < surge_until.get(e.target, -1.0):
+                raise PlanError(
+                    f"{e.label()}: overlaps the previous surge on "
+                    f"{e.target} (still running until "
+                    f"t={surge_until[e.target]:g}s)")
+            surge_until[e.target] = e.t + float(dur)
         if e.action == "degrade":
             bad = set(e.params) - set(DEGRADE_KEYS)
             if bad:
@@ -219,7 +306,7 @@ def _validate(events) -> FaultPlan:
         state[e.target] = {"kill": "down", "restart": "up",
                            "pause": "paused", "resume": "up",
                            "degrade": "up", "partition": "partitioned",
-                           "heal": "up"}[e.action]
+                           "heal": "up", "surge": "up"}[e.action]
     return FaultPlan(tuple(ordered))
 
 

@@ -24,7 +24,7 @@ import json
 import os
 import re
 
-from .plan import SIDECAR, link_name, node_index
+from .plan import SIDECAR, client_index, link_name, node_index
 
 # class -> max recovery_ms (the table --slo overlays).
 DEFAULT_SLO_MS = {
@@ -37,7 +37,22 @@ DEFAULT_SLO_MS = {
     "sidecar-degrade": 10_000.0,
     "link-partition": 30_000.0,
     "link-heal": 20_000.0,
+    # graftsurge: a flash crowd ends at t + for; the system must be back
+    # at its pre-surge baseline within this budget of the window CLOSING
+    # (the commit-scalar verdict measures from the injection like every
+    # other class; the metrics verdict below measures from the end).
+    "client-surge": 30_000.0,
 }
+
+# Metrics-driven recovery-to-baseline defaults (judge_baseline_recovery):
+# the pre-event baseline is the median sampled throughput over this
+# window before the event, and "recovered" means the sampled curve is
+# back to at least this fraction of it.
+BASELINE_WINDOW_S = 10.0
+BASELINE_FRACTION = 0.7
+# Fewer good samples than this before the event -> not judged (a verdict
+# off two points would be noise presented as policy).
+BASELINE_MIN_SAMPLES = 3
 
 
 class SloError(ValueError):
@@ -53,9 +68,140 @@ def fault_class(event: dict) -> str:
         kind = "node"
     elif link_name(target) is not None:
         kind = "link"
+    elif client_index(target) is not None:
+        kind = "client"
     else:
         kind = "unknown"
     return f"{kind}-{event.get('action')}"
+
+
+def event_window_end(event: dict) -> float | None:
+    """Wall time a fault's ACTIVE window closes: the injection stamp,
+    plus the surge duration for surge events (recovery-to-baseline is
+    only meaningful once the extra load is gone).  The surge duration
+    default is plan.surge_window_s — the SAME default the validator and
+    the injector apply, so an omitted ``for`` means one thing at every
+    layer."""
+    from .plan import surge_window_s
+
+    wall = event.get("wall")
+    if not isinstance(wall, (int, float)):
+        return None
+    end = float(wall)
+    if event.get("action") == "surge":
+        end += surge_window_s(event.get("params"))
+    return end
+
+
+def throughput_series(samples) -> list:
+    """Sampled OP_STATS series (obs/sampler.py JSONL records) ->
+    ``[(t, sigs_per_s)]`` from consecutive good samples' cumulative
+    ``sigs_launched`` deltas.  A sidecar restart resets the counter —
+    a negative delta clamps to 0 (an honest gap) rather than poisoning
+    the curve."""
+    good = [(s["t"], s["stats"].get("sigs_launched"))
+            for s in samples
+            if s.get("ok") and isinstance(s.get("stats"), dict)
+            and isinstance(s["stats"].get("sigs_launched"), (int, float))]
+    out = []
+    for (t0, v0), (t1, v1) in zip(good, good[1:]):
+        dt = t1 - t0
+        if dt <= 0:
+            continue
+        out.append((t1, max(0.0, (v1 - v0)) / dt))
+    return out
+
+
+def judge_baseline_recovery(samples, events, slos: dict | None = None,
+                            window_s: float = BASELINE_WINDOW_S,
+                            fraction: float = BASELINE_FRACTION) -> dict:
+    """Metrics-driven recovery verdicts (the PR 7 follow-up): judge each
+    fault off the SAMPLED throughput curve returning to its pre-event
+    baseline, not just off the first commit after the injection.
+
+    Per event: baseline = median throughput over ``window_s`` before the
+    injection; the event recovers when the curve first reaches
+    ``fraction`` x baseline AFTER the event's active window closes
+    (surges: after t + for).  The recovery budget is the event's fault
+    class SLO from the same table ``judge`` uses.  Events without
+    enough pre-event telemetry are reported ``judged: false`` and do
+    not fail the run — absence of evidence is surfaced, not punished.
+
+    Returns ``{"verdicts": [...], "ok": bool, "judged": int}``.
+    """
+    from statistics import median
+
+    table = parse_slos(None)
+    if slos:
+        table.update(slos)
+    series = throughput_series(samples)
+    verdicts = []
+    judged = 0
+    for e in events:
+        cls = fault_class(e)
+        wall = e.get("wall")
+        end = event_window_end(e)
+        v = {"label": f"t={e.get('t')}s {e.get('action')} "
+                      f"{e.get('target')}", "class": cls,
+             "judged": False, "ok": True,
+             "baseline_sigs_per_s": None, "recovered_ms": None}
+        if wall is None or end is None:
+            v["reason"] = "no wall stamp"
+            verdicts.append(v)
+            continue
+        base_pts = [r for t, r in series if wall - window_s <= t < wall]
+        if len(base_pts) < BASELINE_MIN_SAMPLES:
+            v["reason"] = (f"insufficient pre-event telemetry "
+                           f"({len(base_pts)} sample(s))")
+            verdicts.append(v)
+            continue
+        baseline = median(base_pts)
+        v["baseline_sigs_per_s"] = round(baseline, 1)
+        if baseline <= 0:
+            v["reason"] = "pre-event baseline is zero"
+            verdicts.append(v)
+            continue
+        slo_ms = table.get(cls)
+        target = fraction * baseline
+        recovered_ms = None
+        for t, r in series:
+            if t > end and r >= target:
+                recovered_ms = round((t - end) * 1e3, 1)
+                break
+        if recovered_ms is None:
+            # Fail only when the sampled series actually COVERS the
+            # recovery budget: a run whose sampler stopped before the
+            # SLO elapsed gave the event no fair chance — that is
+            # absence of evidence (surfaced, unjudged), not a breach.
+            last_t = series[-1][0]
+            horizon = end + (slo_ms / 1e3 if slo_ms else 0.0)
+            if last_t < horizon:
+                v["reason"] = ("sampled series ends "
+                               f"{(horizon - last_t):.1f} s before the "
+                               "recovery budget elapsed")
+                verdicts.append(v)
+                continue
+        judged += 1
+        v["judged"] = True
+        v["recovered_ms"] = recovered_ms
+        v["slo_ms"] = slo_ms
+        if recovered_ms is None:
+            v.update(ok=False,
+                     reason=f"throughput never returned to "
+                            f"{fraction:.0%} of baseline "
+                            f"({target:.1f} sigs/s)")
+        elif slo_ms is not None and recovered_ms > slo_ms:
+            v.update(ok=False,
+                     reason=f"baseline recovery {recovered_ms:g} ms > "
+                            f"SLO {slo_ms:g} ms")
+        else:
+            v["reason"] = ""
+        verdicts.append(v)
+    return {
+        "verdicts": verdicts,
+        "ok": all(v["ok"] for v in verdicts),
+        "judged": judged,
+    }
 
 
 def parse_slos(spec) -> dict:

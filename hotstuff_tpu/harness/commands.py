@@ -58,17 +58,26 @@ class CommandMaker:
         )
 
     @staticmethod
-    def run_client(address, size, rate, timeout, nodes=None):
+    def run_client(address, size, rate, timeout, nodes=None, users=None,
+                   seed=None):
+        """``users``/``seed`` opt into the graftsurge multi-user
+        heavy-tailed generator (client --users/--seed); omitted, the
+        client keeps its legacy constant-rate stream."""
         assert isinstance(address, str)
         assert isinstance(size, int) and size > 0
         assert isinstance(rate, int) and rate >= 0
         assert isinstance(nodes, list) or nodes is None
+        assert users is None or (isinstance(users, int) and users > 0)
+        assert seed is None or isinstance(seed, int)
         nodes = nodes or []
         assert all(isinstance(x, str) for x in nodes)
         nodes_str = f" --nodes {' '.join(nodes)}" if nodes else ""
+        users_str = f" --users {users}" if users else ""
+        seed_str = f" --seed {seed}" if seed is not None else ""
         return (
             f"./client {address} --size {size} "
-            f"--rate {rate} --timeout {timeout}{nodes_str}"
+            f"--rate {rate} --timeout {timeout}{users_str}{seed_str}"
+            f"{nodes_str}"
         )
 
     @staticmethod

@@ -35,8 +35,10 @@ from __future__ import annotations
 import json
 import os
 import signal
+import threading
 
-from ..chaos.plan import SIDECAR, FaultEvent, link_name, node_index
+from ..chaos.plan import SIDECAR, FaultEvent, client_index, link_name, \
+    node_index
 
 
 class InjectionError(RuntimeError):
@@ -47,6 +49,10 @@ class LocalFaultInjector:
     def __init__(self, bench):
         self._bench = bench
         self._paused: set[int] = set()
+        # graftsurge: live flash-crowd generators ([(proc, timer)]); the
+        # timer kills each when its window closes, cleanup() reaps any
+        # the run window cut short.
+        self._surges: list = []
 
     def apply(self, event: FaultEvent):
         if event.target == SIDECAR:
@@ -57,6 +63,11 @@ class LocalFaultInjector:
         if name is not None:
             getattr(self, f"_link_{event.action}")(name)
             return
+        ci = client_index(event.target)
+        if ci is not None:
+            # ``for`` is a keyword, so surge params route as a dict.
+            getattr(self, f"_client_{event.action}")(ci, event.params)
+            return
         i = node_index(event.target)
         if i is None:
             raise InjectionError(f"unknown target {event.target!r}")
@@ -64,13 +75,18 @@ class LocalFaultInjector:
 
     def cleanup(self):
         """SIGCONT any group still paused (teardown's SIGTERM queues
-        behind a SIGSTOP forever otherwise)."""
+        behind a SIGSTOP forever otherwise), and reap surge generators
+        whose window the run outlived."""
         for i in sorted(self._paused):
             try:
                 self._signal_node(i, signal.SIGCONT)
             except InjectionError:
                 pass
         self._paused.clear()
+        surges, self._surges = self._surges, []
+        for proc, timer in surges:
+            timer.cancel()
+            self._kill_surge_proc(proc)
 
     # -- nodes --------------------------------------------------------------
 
@@ -142,6 +158,60 @@ class LocalFaultInjector:
             raise InjectionError(
                 "sidecar is running without --chaos; the plan's degrade "
                 "event cannot be expressed")
+
+    # -- graftsurge client surges -------------------------------------------
+
+    @staticmethod
+    def _kill_surge_proc(proc):
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def _client_surge(self, i: int, params: dict):
+        """Flash crowd against replica i: boot an EXTRA load generator
+        at (x-1)x the baseline client's rate for ``for`` seconds, then
+        kill it.  The surge client logs to surge-client-<i>.log —
+        outside the parser's client glob, so offered surge load never
+        counts as benchmark input rate (goodput under surge is judged
+        from the commit/metrics timelines instead)."""
+        from .commands import CommandMaker
+        from .utils import PathMaker
+
+        targets = getattr(self._bench, "_client_targets", {})
+        info = targets.get(i)
+        if info is None:
+            raise InjectionError(
+                f"client {i} was never booted (crash-faulted replica or "
+                "out of range); the surge has no baseline to multiply")
+        address, tx_size, rate_share = info
+        from ..chaos.plan import SURGE_DEFAULT_X, surge_window_s
+
+        x = float(params.get("x", SURGE_DEFAULT_X))
+        duration = surge_window_s(params)
+        extra_rate = max(1, int(round((x - 1) * rate_share)))
+        # Heavy-tailed by default: a flash crowd IS bursty arrivals, so
+        # the surge generator simulates users rather than a constant
+        # stream (seeded off the replica index for reproducible runs).
+        cmd = CommandMaker.run_client(
+            address, tx_size, extra_rate, 0,
+            users=max(2, extra_rate // 10), seed=1000 + i)
+        proc = self._bench._background_run(
+            cmd, PathMaker.surge_client_log_file(i), append=True)
+
+        def _end():
+            # Late-bound closure: `timer` is assigned below, before
+            # start() can fire this.
+            self._kill_surge_proc(proc)
+            try:
+                self._surges.remove((proc, timer))
+            except ValueError:
+                pass  # cleanup() already reaped it
+
+        timer = threading.Timer(duration, _end)
+        timer.daemon = True
+        self._surges.append((proc, timer))
+        timer.start()
 
     # -- graftwan links -----------------------------------------------------
 
@@ -232,6 +302,12 @@ class RemoteFaultInjector:
         if name is not None:
             getattr(self, f"_link_{event.action}")(name)
             return
+        if client_index(event.target) is not None:
+            # Pre-flight (remote._check_fault_plan) rejects surge plans
+            # before boot; this is the belt for hand-driven injectors.
+            raise InjectionError(
+                "client surge events are local-harness only (the remote "
+                "bench tracks no client boot commands)")
         i = node_index(event.target)
         if i is None:
             raise InjectionError(f"unknown target {event.target!r}")

@@ -73,6 +73,10 @@ class LocalBench:
         self._node_cmds = {}
         self._sidecar_proc = None
         self._sidecar_cmd = None
+        # graftsurge: {i: (address, tx_size, rate_share)} for the booted
+        # clients, so a plan's client:<i> surge event can boot an extra
+        # generator at a multiple of the baseline (harness/faults.py).
+        self._client_targets = {}
         fp = getattr(bench_parameters, "fault_plan", None)
         if fp:
             from ..chaos import PlanError, parse_plan
@@ -332,6 +336,16 @@ class LocalBench:
             raise BenchError(
                 f"fault plan targets node(s) {bad} but only {alive} "
                 "replicas will be booted (crash faults are never booted)")
+        from ..chaos.plan import client_index
+
+        bad_clients = sorted({
+            client_index(e.target) for e in self.fault_plan.events
+            if client_index(e.target) is not None
+            and client_index(e.target) >= alive})
+        if bad_clients:
+            raise BenchError(
+                f"fault plan surges client(s) {bad_clients} but only "
+                f"{alive} clients will be booted (one per alive replica)")
         if any(e.target == "sidecar" for e in self.fault_plan.events) \
                 and not self.tpu_sidecar:
             raise BenchError(
@@ -588,6 +602,8 @@ class LocalBench:
                 cmd = CommandMaker.run_client(
                     address, self.tx_size, rate_share, timeout,
                     nodes=addresses)
+                self._client_targets[i] = (address, self.tx_size,
+                                           rate_share)
                 self._background_run(cmd, PathMaker.client_log_file(i))
 
             # Wait for all transactions to be processed.

@@ -78,6 +78,10 @@ class LogParser:
         # bug and still fatal.
         self.chaos_events = chaos_events
         self.chaos = None
+        # Strictness rides with chaos mode: a scripted run (incl. surge
+        # overload scenarios) must satisfy the recovery/fairness
+        # assertions; a plain bench is merely described.
+        self._strict_chaos = bool(strict_chaos)
         self._tolerable_client_deaths = len({
             e.get("target") for e in (chaos_events or ())
             if e.get("action") in ("kill", "pause")
@@ -162,6 +166,21 @@ class LogParser:
             self.notes.append(
                 f"Sidecar circuit breaker: {opens} open / "
                 f"{closes} re-attach transition(s)")
+
+        # graftsurge overload evidence: the node's bounded ingress logs
+        # watermark crossings, and clients log (rate-limited) BUSY
+        # backoffs.  Surfaced so an overloaded-but-surviving run reads
+        # as exactly that, not as a quiet healthy one.
+        pauses = sum(len(findall(r"Ingress paused", log)) for log in nodes)
+        resumes = sum(len(findall(r"Ingress resumed", log))
+                      for log in nodes)
+        busy_lines = sum(len(findall(r"Node busy \(retry-after", log))
+                         for log in clients)
+        if pauses or resumes or busy_lines:
+            self.notes.append(
+                f"Ingress backpressure: {pauses} receiver pause(s) / "
+                f"{resumes} resume(s); clients logged {busy_lines} busy "
+                "backoff line(s)")
 
         if self.wan is not None:
             self.note_wan(self.wan)
@@ -335,6 +354,15 @@ class LogParser:
     def _tx_bytes(self):
         return self.size[0] + PUBLICKEY_LENGTH + SIGNATURE_LENGTH
 
+    def _window_tps(self, t0: float, t1: float) -> float:
+        """Committed tx/s over the wall-clock window [t0, t1)."""
+        if t1 <= t0:
+            return 0.0
+        byte_total = sum(self.sizes.get(d, 0)
+                         for d, c in self.commits.items()
+                         if t0 <= c < t1)
+        return byte_total / self._tx_bytes() / (t1 - t0)
+
     def _consensus_throughput(self):
         if not self.commits:
             return 0, 0, 0
@@ -438,6 +466,20 @@ class LogParser:
         notes at all rather than raising or leaving a partial block."""
         if not isinstance(stats, dict) or not stats.get("launches"):
             return
+        # Strict fairness (graftsurge) FIRST, before any cosmetic note
+        # formatting: under a scripted run, shedding a latency-class
+        # (consensus) request while bulk slipped past the
+        # bulk-before-latency gate is a policy regression, not weather —
+        # and the assertion must not depend on sibling telemetry keys
+        # formatting cleanly.
+        surge = stats.get("surge")
+        if self._strict_chaos and isinstance(surge, dict):
+            violations = surge.get("fairness_violations")
+            if isinstance(violations, (int, float)) and violations:
+                raise ParseError(
+                    f"surge fairness violated: {violations:g} bulk "
+                    "request(s) admitted while the latency class was "
+                    "shedding (bulk-before-latency)")
         lines = []
         # grafttrace fallback marker: the harness could not reach the
         # sidecar at teardown (chaos-killed before the final fetch) and
@@ -489,9 +531,37 @@ class LogParser:
             if any(full.values()):
                 lines.append("Sidecar queue-full sheds: " + ", ".join(
                     f"{k}={v:,}" for k, v in sorted(full.items())))
+            surge = stats.get("surge")
+            if isinstance(surge, dict):
+                lines.extend(self._surge_lines(surge))
         except (TypeError, ValueError, AttributeError):
             return
         self.notes.extend(lines)
+
+    @staticmethod
+    def _surge_lines(surge: dict) -> list:
+        """CONFIG-note lines for the OP_STATS ``surge`` section."""
+        lines = []
+        shed = surge.get("shed", {})
+        admitted = surge.get("admitted", {})
+        if any(shed.values()) or any(admitted.values()):
+            fair = "bulk-before-latency held" \
+                if not surge.get("fairness_violations") else \
+                f"{surge['fairness_violations']} fairness VIOLATION(S)"
+            lines.append(
+                "Sidecar surge: admitted "
+                + ", ".join(f"{k}={v:,}"
+                            for k, v in sorted(admitted.items()))
+                + "; shed "
+                + ", ".join(f"{k}={v:,}" for k, v in sorted(shed.items()))
+                + f" ({fair})")
+        derate = surge.get("derate", {})
+        if derate.get("engagements"):
+            lines.append(
+                f"Sidecar surge derate: engaged {derate['engagements']} "
+                f"time(s), factor {derate.get('factor', 1.0)} "
+                f"(recent overlap {derate.get('overlap_recent')})")
+        return lines
 
     def note_trace(self, summary: dict):
         """Fold the grafttrace critical-path summary (obs/trace.py
@@ -573,6 +643,42 @@ class LogParser:
                         "event)")
         except (TypeError, ValueError, AttributeError, KeyError):
             return
+        self._judge_metrics_recovery(samples)
+
+    def _judge_metrics_recovery(self, samples):
+        """Metrics-driven recovery-to-baseline verdicts (graftsurge /
+        the PR 7 follow-up): the sampled throughput curve must RETURN to
+        its pre-event baseline after every chaos event — the commit
+        scalar proves liveness, this proves the system came back at
+        strength.  Judged events that miss their class SLO fail the run
+        under the strict chaos assertion; events without enough
+        telemetry are surfaced as unjudged, never failed."""
+        from ..chaos import judge_baseline_recovery
+
+        if not self.chaos:
+            return
+        try:
+            verdict = judge_baseline_recovery(
+                samples, self.chaos.get("events", []), self.slos)
+        except (TypeError, ValueError, KeyError, AttributeError):
+            return
+        self.chaos["slo_metrics"] = verdict
+        for v in verdict["verdicts"]:
+            label = f"Chaos SLO (baseline) {v['class']}"
+            if not v["judged"]:
+                self.notes.append(
+                    f"{label}: not judged ({v.get('reason')})")
+            elif v["ok"]:
+                self.notes.append(
+                    f"{label}: back to baseline in "
+                    f"{v['recovered_ms']:g} ms PASS")
+            else:
+                self.notes.append(f"{label}: FAIL ({v.get('reason')})")
+        if self._strict_chaos and not verdict["ok"]:
+            raise ParseError(
+                "metrics-driven recovery SLO breached: " + "; ".join(
+                    f"{v['class']} ({v.get('reason')})"
+                    for v in verdict["verdicts"] if not v["ok"]))
 
     def note_wan(self, wan: dict):
         """Fold the run's graftwan spec snapshot (logs/wan.json, the
@@ -622,6 +728,29 @@ class LogParser:
             self.notes.append(
                 f"Chaos plan: {len(summary['events'])} event(s), "
                 f"max recovery {summary['max_recovery_ms']:g} ms")
+        # graftsurge: goodput retained under each surge window, from the
+        # committed-bytes timeline (the offered surge load itself rides
+        # a separate generator whose log is outside the client glob).
+        from ..chaos.plan import surge_window_s
+
+        for e in summary["events"]:
+            if e.get("action") != "surge" or e.get("wall") is None:
+                continue
+            dur = surge_window_s(e.get("params"))
+            if dur <= 0:
+                continue
+            wall = float(e["wall"])
+            before = self._window_tps(wall - dur, wall)
+            during = self._window_tps(wall, wall + dur)
+            e["goodput"] = {"before_tps": round(before, 1),
+                            "during_tps": round(during, 1)}
+            if before > 0:
+                retained = during / before
+                e["goodput"]["retained"] = round(retained, 3)
+                self.notes.append(
+                    f"Chaos {event_label(e)}: goodput retained "
+                    f"{retained:.0%} under surge ({during:.0f} vs "
+                    f"{before:.0f} tx/s)")
         for e in summary["events"]:
             label = f"Chaos {event_label(e)}"
             if not e["ok"]:

@@ -251,6 +251,12 @@ class Bench:
                 "fault plan targets the sidecar but the remote bench "
                 "boots none (sidecar faults are local-harness only for "
                 "now)")
+        if any(e.action == "surge" for e in self.fault_plan.events):
+            raise BenchError(
+                "fault plan schedules client surge events, which the "
+                "remote bench cannot express yet (it does not track "
+                "per-host client boot commands); run the surge scenario "
+                "on the local harness")
         missing = [name for name in self.fault_plan.link_names()
                    if self.wan is None or self.wan.by_name(name) is None]
         if missing:

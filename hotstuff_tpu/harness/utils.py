@@ -60,6 +60,15 @@ class PathMaker:
         return join(PathMaker.logs_path(), f"client-{i}.log")
 
     @staticmethod
+    def surge_client_log_file(i):
+        """graftsurge flash-crowd generator aimed at replica i.  OUTSIDE
+        the client-*.log glob on purpose: surge load is offered on top
+        of the baseline, and its (killed) generator must not parse as a
+        failed benchmark client or inflate the input rate."""
+        assert isinstance(i, int) and i >= 0
+        return join(PathMaker.logs_path(), f"surge-client-{i}.log")
+
+    @staticmethod
     def sidecar_log_file():
         return join(PathMaker.logs_path(), "sidecar.log")
 

@@ -11,8 +11,14 @@ from . import protocol as proto
 
 class SidecarOverloaded(RuntimeError):
     """The sidecar's class queue was full and it shed this request
-    (explicit empty-body backpressure reply — see protocol.py).  The
-    caller decides: retry after a backoff, or verify on host."""
+    (explicit OP_BUSY backpressure reply, or the legacy empty-body form
+    — see protocol.py).  ``retry_after_ms`` carries the sidecar's hint
+    when the reply had one (None on the legacy form).  The caller
+    decides: retry after ~the hint, or verify on host."""
+
+    def __init__(self, message: str, retry_after_ms: int | None = None):
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms
 
 
 class SidecarClient:
@@ -112,8 +118,9 @@ class SidecarClient:
 
     def bls_sign(self, msg: bytes, sk: bytes) -> bytes:
         """BLS sign via the sidecar's host signer -> 192 B G2 signature.
-        Raises on failure (signing errors and queue-full sheds both
-        reply with an empty body; either way the caller retries)."""
+        A queue-full shed raises :class:`SidecarOverloaded` (v4 OP_BUSY,
+        with ``retry_after_ms``); a signing failure replies an empty
+        body and raises RuntimeError.  Either way the caller retries."""
         rid = self._send(lambda r: proto.encode_bls_sign_request(r, msg, sk))
         sig = bytes(self._await(rid))
         if len(sig) != proto.BLS_SIG_LEN:
@@ -130,26 +137,42 @@ class SidecarClient:
             self._sock.sendall(frame)
             return rid
 
+    @staticmethod
+    def _unwrap(opcode, body):
+        """Reply -> body, surfacing OP_BUSY sheds as SidecarOverloaded
+        with the server's retry-after hint attached."""
+        if opcode == proto.OP_BUSY:
+            try:
+                hint = proto.decode_busy_body(bytes(body))
+            except ValueError:
+                hint = None
+            raise SidecarOverloaded(
+                "sidecar shed request (queue full; retry after "
+                f"{hint} ms)", retry_after_ms=hint)
+        return body
+
     def _await(self, rid):
         try:
             while True:
                 with self._cond:
                     if rid in self._results:
-                        return self._results.pop(rid)
+                        return self._unwrap(*self._results.pop(rid))
                 # one thread at a time drains the socket; results are
                 # published under the condition so pipelined waiters wake up
                 if self._recv_lock.acquire(timeout=0.05):
                     try:
                         with self._cond:
                             if rid in self._results:
-                                return self._results.pop(rid)
+                                return self._unwrap(
+                                    *self._results.pop(rid))
                         payload = proto.read_frame(self._sock)
-                        _, got_rid, body = proto.decode_reply_raw(payload)
+                        opcode, got_rid, body = \
+                            proto.decode_reply_raw(payload)
                         with self._cond:
                             if got_rid in self._abandoned:
                                 self._abandoned.discard(got_rid)
                             else:
-                                self._results[got_rid] = body
+                                self._results[got_rid] = (opcode, body)
                                 self._cond.notify_all()
                     finally:
                         self._recv_lock.release()

@@ -69,23 +69,35 @@ OP_STATS = 8
 # Only honored behind the explicit --chaos flag — a production sidecar
 # cannot be degraded over the wire.
 OP_CHAOS = 9
+# Protocol v4 (graftsurge): explicit BUSY reply.  When a class queue is
+# full (or the surge admission controller sheds), the sidecar answers
+# with OP_BUSY — request id echoed, count = 2, body one u16 LE
+# retry-after hint in milliseconds — instead of the v2/v3 empty-count
+# echo of the request opcode.  Reply-only: a request frame carrying
+# OP_BUSY is malformed.  Clients back off for ~the hint (python raises
+# SidecarOverloaded with retry_after_ms; the C++ node falls back to host
+# verify, its in-flight AIMD already pacing resubmission).
+OP_BUSY = 10
 
 # Version of this wire protocol, bumped when the opcode set or any frame
-# layout changes (v2: OP_VERIFY_BULK + OP_STATS; v3: OP_CHAOS).  Mirrored
-# by the C++ client's kProtocolVersion; graftlint's wire cross-checker
-# pins the pair.  Replies an unknown-opcode ValueError on older peers
-# rather than desyncing, so the constant is documentation + lint anchor,
-# not a handshake.
-PROTOCOL_VERSION = 3
+# layout changes (v2: OP_VERIFY_BULK + OP_STATS; v3: OP_CHAOS; v4:
+# OP_BUSY retry-after replies).  Mirrored by the C++ client's
+# kProtocolVersion; graftlint's wire cross-checker pins the pair.
+# Replies an unknown-opcode ValueError on older peers rather than
+# desyncing, so the constant is documentation + lint anchor, not a
+# handshake.
+PROTOCOL_VERSION = 4
 
-# Backpressure contract (v2): when a class queue is full, the sidecar
-# replies immediately with an EMPTY body (count 0) for a request that
-# carried records — unambiguous, because a real verdict mask always has
-# exactly the request's record count.  Clients shed to host verify (C++)
-# or raise SidecarOverloaded (python) instead of blocking.
+# Backpressure contract: v2/v3 shed replies were an EMPTY body (count 0)
+# for a request that carried records — unambiguous, because a real
+# verdict mask always has exactly the request's record count.  v4 sheds
+# reply OP_BUSY with a retry-after hint instead; clients keep accepting
+# the empty-body form so a version-skewed sidecar still reads as
+# overload, never as a verdict.
 
 _HDR = struct.Struct("<BIIH")  # opcode, request id, count, msg_len
 _REPLY_HDR = struct.Struct("<BII")
+_BUSY_BODY = struct.Struct("<H")  # retry-after hint, ms
 
 MAX_FRAME = 64 * 1024 * 1024
 
@@ -193,6 +205,20 @@ def decode_stats_body(body: bytes) -> dict:
     if not isinstance(out, dict):
         raise ValueError("stats body is not a JSON object")
     return out
+
+
+def encode_busy_reply(request_id: int, retry_after_ms: int) -> bytes:
+    """Queue-full shed -> OP_BUSY reply carrying the retry-after hint
+    (clamped to the u16 range; 0 means 'immediately' and is legal)."""
+    ms = max(0, min(0xFFFF, int(retry_after_ms)))
+    return encode_reply_raw(OP_BUSY, request_id, _BUSY_BODY.pack(ms))
+
+
+def decode_busy_body(body: bytes) -> int:
+    """OP_BUSY reply body -> retry-after ms (ValueError on garbage)."""
+    if len(body) != _BUSY_BODY.size:
+        raise ValueError(f"bad busy body: {len(body)} byte(s)")
+    return _BUSY_BODY.unpack(body)[0]
 
 
 def encode_chaos_request(request_id: int, spec: dict) -> bytes:

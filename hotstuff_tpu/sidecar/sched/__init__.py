@@ -6,6 +6,8 @@ Modules:
   shapes.py     warmed-shape registry + verify-path routing (per-sig vs RLC)
   scheduler.py  admission, strict-priority coalescing, pad-fill, carry-over
   stats.py      per-launch telemetry behind the OP_STATS wire request
+  surge.py      graftsurge pack-side admission: overlap-driven bulk
+                derate, bulk-before-latency shedding, retry-after hints
 
 ``sidecar/service.VerifyEngine`` consumes launches; policy lives here.
 See scheduler.py for the policy rationale and sidecar/README notes.
@@ -19,3 +21,4 @@ from .shapes import PATH_HOST, PATH_LADDER_SHARDED, PATH_MESH, \
     PATH_PER_SIG, PATH_RLC, PATH_RLC_SHARDED, RLC_MIN_LAUNCH, \
     ShapeRegistry  # noqa: F401
 from .stats import SchedStats  # noqa: F401
+from .surge import AdmissionController  # noqa: F401

@@ -112,16 +112,23 @@ class ClassQueue:
 
     def offer(self, pending: Pending) -> bool:
         with self._lock:
-            # A request is admitted whole or not at all; a single request
-            # bigger than the whole cap is still admitted when the queue
-            # is empty (it slices inside the engine) so a legal client
-            # can never be starved by its own size.
-            if self.sigs and self.sigs + len(pending) > self.cap_sigs:
-                return False
-            self.items.append(pending)
-            self.sigs += len(pending)
-            self._lock.notify()
-            return True
+            return self._offer_locked(pending)
+
+    def _offer_locked(self, pending: Pending, cap_sigs: int | None = None)\
+            -> bool:
+        # A request is admitted whole or not at all; a single request
+        # bigger than the whole cap is still admitted when the queue
+        # is empty (it slices inside the engine) so a legal client
+        # can never be starved by its own size.  ``cap_sigs`` lets the
+        # scheduler admit against a DERATED cap (graftsurge) without the
+        # queue itself knowing about admission policy.
+        cap = self.cap_sigs if cap_sigs is None else cap_sigs
+        if self.sigs and self.sigs + len(pending) > cap:
+            return False
+        self.items.append(pending)
+        self.sigs += len(pending)
+        self._lock.notify()
+        return True
 
     def _pop_locked(self) -> Pending:
         p = self.items.popleft()

@@ -50,6 +50,7 @@ from ...crypto.eddsa import MAX_SUBBATCH
 from .classes import BULK, LATENCY, ClassQueue, Launch, Pending
 from .shapes import ShapeRegistry
 from .stats import SchedStats
+from .surge import AdmissionController
 
 # Admission caps (signature records queued, not requests).  Latency is
 # sized for bursts of full-committee QC verifies; bulk for a few whole
@@ -126,9 +127,18 @@ class Scheduler:
     def __init__(self, shapes: ShapeRegistry | None = None,
                  stats: SchedStats | None = None,
                  latency_cap_sigs: int = LATENCY_QUEUE_CAP_SIGS,
-                 bulk_cap_sigs: int = BULK_QUEUE_CAP_SIGS):
+                 bulk_cap_sigs: int = BULK_QUEUE_CAP_SIGS,
+                 admission: AdmissionController | None = None):
         self.shapes = shapes if shapes is not None else ShapeRegistry()
         self.stats = stats if stats is not None else SchedStats()
+        # graftsurge: the pack-side admission controller (sched/surge.py)
+        # derates bulk intake off the pipeline overlap stats and enforces
+        # bulk-before-latency shedding; the stats object forwards the
+        # engine's note_pack/note_launch observations into it and folds
+        # its counters into the OP_STATS ``surge`` section.
+        self.admission = admission if admission is not None \
+            else AdmissionController()
+        self.stats.surge = self.admission
         self._cond = threading.Condition()
         self._queues = {
             LATENCY: ClassQueue(latency_cap_sigs, self._cond),
@@ -140,13 +150,49 @@ class Scheduler:
     def offer(self, request, reply_fn, cls: str = LATENCY,
               is_bls: bool = False) -> bool:
         """Admit one request; False means queue-full (the caller must
-        reply explicitly — nothing was retained)."""
+        reply explicitly — nothing was retained; ``retry_after_ms``
+        gives the hint the BUSY reply should carry).
+
+        Admission policy (graftsurge) on top of the plain byte caps:
+        bulk is shed outright while the latency class is under shed
+        pressure (bulk-before-latency — under overload the consensus
+        class is the last to lose capacity), and bulk admits against a
+        cap derated by the pipeline-overlap controller (a pack-bound
+        engine sheds bulk earlier instead of queueing work the pack
+        worker cannot drain).  All checks run under the one admission
+        lock, so a bulk request can never be admitted concurrently with
+        a latency shed — the fairness guarantee the strict parser mode
+        asserts."""
         pending = Pending(request, reply_fn, cls, is_bls=is_bls)
-        if self._queues[cls].offer(pending):
+        adm = self.admission
+        with self._cond:
+            if cls == BULK:
+                lat = self._queues[LATENCY]
+                if adm.latency_pressure() or (
+                        lat.sigs and lat.sigs >= lat.cap_sigs):
+                    adm.note_shed(BULK, before_latency=True)
+                    self.stats.note_queue_full(cls)
+                    return False
+                cap = int(self._queues[BULK].cap_sigs * adm.bulk_derate())
+                if not self._queues[BULK]._offer_locked(pending,
+                                                        cap_sigs=cap):
+                    adm.note_shed(BULK)
+                    self.stats.note_queue_full(cls)
+                    return False
+            elif not self._queues[cls]._offer_locked(pending):
+                if cls == LATENCY:
+                    adm.note_latency_shed()
+                adm.note_shed(cls)
+                self.stats.note_queue_full(cls)
+                return False
+            adm.note_admitted(cls)
             self.stats.note_admitted(cls)
             return True
-        self.stats.note_queue_full(cls)
-        return False
+
+    def retry_after_ms(self, cls: str) -> int:
+        """Hint for a BUSY reply: the time this class's backlog needs to
+        drain at the recent launch rate (clamped; see surge.py)."""
+        return self.admission.retry_after_ms(cls, self._queues[cls].sigs)
 
     def wake(self):
         """Unblock a next_launch() waiter (shutdown path)."""

@@ -68,6 +68,12 @@ class SchedStats:
         self.pack_hidden_s = 0.0
         self._waits = {c: deque(maxlen=self.WAIT_SAMPLES_CAP)
                        for c in ("latency", "bulk")}
+        # graftsurge: the admission controller (sched/surge.py), attached
+        # by the Scheduler.  note_pack/note_launch forward the engine's
+        # observations into it (outside this object's lock — the nesting
+        # is always stats-caller -> surge lock, never back), and
+        # snapshot() folds its counters in as the ``surge`` section.
+        self.surge = None
 
     # -- recording ----------------------------------------------------------
 
@@ -86,6 +92,8 @@ class SchedStats:
     def note_launch(self, launch, capacity: int, now: float):
         """One assembled launch: size/pad/fill accounting + queue waits.
         ``capacity`` is the padded device shape the batch rides in."""
+        if self.surge is not None:
+            self.surge.note_launch(launch.total_sigs, now)
         with self._lock:
             self.launches += 1
             self.launches_by_class[launch.cls] = \
@@ -122,6 +130,8 @@ class SchedStats:
         overlapped this pack with device compute (the approximation is
         conservative per-launch and exact in the steady state, where
         pack N+1 runs entirely under launch N)."""
+        if self.surge is not None:
+            self.surge.note_pack(duration_s, hidden)
         with self._lock:
             self.pack_s += duration_s
             if hidden:
@@ -131,6 +141,7 @@ class SchedStats:
 
     def snapshot(self) -> dict:
         """JSON-safe dict: the OP_STATS reply body, byte-for-byte."""
+        surge = self.surge.snapshot() if self.surge is not None else None
         with self._lock:
             waits = {}
             for cls, samples in self._waits.items():
@@ -140,7 +151,7 @@ class SchedStats:
                     "p50_ms": round(_percentile(vals, 0.50) * 1e3, 3),
                     "p99_ms": round(_percentile(vals, 0.99) * 1e3, 3),
                 }
-            return {
+            out = {
                 "launches": self.launches,
                 "launches_by_class": dict(self.launches_by_class),
                 "coalesce_hist": {str(k): v for k, v in
@@ -167,3 +178,6 @@ class SchedStats:
                     if self.pack_s else 0.0,
                 },
             }
+            if surge is not None:
+                out["surge"] = surge
+            return out

@@ -1,0 +1,219 @@
+"""graftsurge: pack-side admission control for the verify scheduler.
+
+The queue caps (`classes.ClassQueue`) bound how much work the sidecar
+will *hold*; this module decides how much it should *accept* while the
+device pipeline is telling us the pack stage — not the device — is the
+bottleneck.  It closes the second half of the control loop ROADMAP
+item 4 named: the C++ client's queue-wait-p99 AIMD already shrinks the
+per-replica async in-flight budget when the engine is congested
+(crypto/sidecar_client.adapt_budget), and the pack-side admission here
+derates BULK intake off the pipeline overlap stats, so the two compose —
+the client sends less, and what still arrives is shed earlier when the
+host cannot hide pack work behind device execution anyway.
+
+Three policies, one controller:
+
+Overlap-driven bulk derate.
+    ``note_pack`` feeds the controller the same per-pack (duration,
+    hidden) observations the OP_STATS ``pipeline`` section aggregates.
+    While the recent overlap ratio is healthy (pack time hidden behind
+    device execution), bulk admission runs at the full queue cap.  When
+    overlap collapses — pack runs in the open, i.e. the host pack stage
+    is the bottleneck — admitting more bulk only grows a queue the pack
+    worker cannot drain, so the effective bulk cap scales down linearly
+    to ``DERATE_FLOOR``.  Engagement/disengagement transitions are
+    counted (``derate.engagements``) the way the native ingress gate
+    counts watermark crossings.
+
+Bulk-before-latency shedding.
+    A latency-class shed (queue full) opens a pressure window during
+    which every bulk offer is shed outright: under overload the
+    consensus class must be the LAST to lose capacity.  The
+    ``fairness_violations`` counter records any bulk admission that
+    slips through while latency is under pressure — the scheduler's
+    lock makes that impossible by construction, so a non-zero value is
+    a policy regression the LogParser's strict mode fails the run on.
+
+Retry-after hints.
+    ``retry_after_ms`` turns queue depth and the recent drain rate into
+    the hint a BUSY reply carries (protocol v4): roughly the time the
+    backlog needs to drain, clamped so a client neither hammers a
+    saturated sidecar every millisecond nor parks for a minute on a
+    blip.
+
+Writers: connection threads (offers) and the engine/pack threads
+(note_pack / note_launch).  One controller-private lock guards all
+mutable state; callers may hold the scheduler's admission lock when
+calling in — the order is always scheduler-lock -> controller-lock and
+nothing here calls back out, so the nesting cannot invert.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from time import monotonic
+
+from .classes import BULK, LATENCY
+
+# Overlap ratio above which pack work is considered hidden (healthy
+# pipeline -> full bulk cap); below it the effective cap scales linearly
+# down to DERATE_FLOOR at overlap 0.  0.5 matches the depth-2 pipeline's
+# break-even point: below half overlap the engine spends more wall clock
+# packing in the open than dispatching.
+OVERLAP_KNEE = 0.5
+DERATE_FLOOR = 0.25
+# Judged over the most recent packs only — a surge decision off minutes-
+# old telemetry would derate long after the burst passed.
+PACK_WINDOW = 64
+# Minimum evidence before derating: a cold engine must not shed bulk off
+# one unlucky pack.
+MIN_PACKS = 8
+MIN_PACK_S = 0.005
+
+# A latency-class shed opens this pressure window (s): while it is open,
+# bulk is shed before latency ever is.
+LATENCY_PRESSURE_S = 1.0
+
+# Launches contributing to the drain-rate estimate behind retry-after.
+LAUNCH_WINDOW = 64
+RETRY_MIN_MS = 25
+RETRY_MAX_MS = 2000
+# Fallbacks when no drain rate is known yet (cold queue): the latency
+# class retries fast (its backlog is bounded by design), bulk waits a
+# coalesced-launch's worth.
+RETRY_DEFAULT_MS = {LATENCY: 50, BULK: 250}
+
+
+class AdmissionController:
+    """Overlap-driven admission state + the OP_STATS ``surge`` section."""
+
+    def __init__(self, clock=monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._packs = deque(maxlen=PACK_WINDOW)     # (dur_s, hidden)
+        self._launches = deque(maxlen=LAUNCH_WINDOW)  # (t, sigs)
+        self._lat_pressure_until = 0.0
+        self._derate_engaged = False
+        self.admitted = {LATENCY: 0, BULK: 0}
+        self.shed = {LATENCY: 0, BULK: 0}
+        self.busy_replies = {LATENCY: 0, BULK: 0}
+        self.bulk_before_latency_sheds = 0
+        self.fairness_violations = 0
+        self.derate_engagements = 0
+
+    # -- pipeline evidence (engine / pack threads) --------------------------
+
+    def note_pack(self, duration_s: float, hidden: bool):
+        with self._lock:
+            self._packs.append((duration_s, bool(hidden)))
+            self._update_engagement_locked()
+
+    def note_launch(self, sigs: int, now: float | None = None):
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._launches.append((now, sigs))
+
+    def recent_overlap(self) -> float | None:
+        """Hidden share of recent pack time, or None without evidence."""
+        with self._lock:
+            return self._recent_overlap_locked()
+
+    def _recent_overlap_locked(self):
+        if len(self._packs) < MIN_PACKS:
+            return None
+        total = sum(d for d, _ in self._packs)
+        if total < MIN_PACK_S:
+            return None
+        return sum(d for d, h in self._packs if h) / total
+
+    def _derate_factor_locked(self) -> float:
+        o = self._recent_overlap_locked()
+        if o is None or o >= OVERLAP_KNEE:
+            return 1.0
+        return DERATE_FLOOR + (1.0 - DERATE_FLOOR) * (o / OVERLAP_KNEE)
+
+    def _update_engagement_locked(self):
+        engaged = self._derate_factor_locked() < 1.0
+        if engaged and not self._derate_engaged:
+            self.derate_engagements += 1
+        self._derate_engaged = engaged
+
+    def bulk_derate(self) -> float:
+        """Multiplier on the bulk queue cap, in [DERATE_FLOOR, 1.0]."""
+        with self._lock:
+            return self._derate_factor_locked()
+
+    # -- admission outcomes (connection threads, under the scheduler lock) --
+
+    def note_latency_shed(self, now: float | None = None):
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._lat_pressure_until = now + LATENCY_PRESSURE_S
+
+    def latency_pressure(self, now: float | None = None) -> bool:
+        now = self._clock() if now is None else now
+        with self._lock:
+            return now < self._lat_pressure_until
+
+    def note_admitted(self, cls: str, now: float | None = None):
+        now = self._clock() if now is None else now
+        with self._lock:
+            self.admitted[cls] = self.admitted.get(cls, 0) + 1
+            if cls == BULK and now < self._lat_pressure_until:
+                # Bulk slipped in while latency was shedding: the
+                # bulk-before-latency policy failed.  The scheduler's
+                # admission lock makes this unreachable; the counter is
+                # the proof the LogParser's strict fairness check reads.
+                self.fairness_violations += 1
+
+    def note_shed(self, cls: str, before_latency: bool = False,
+                  busy_reply: bool = True):
+        with self._lock:
+            self.shed[cls] = self.shed.get(cls, 0) + 1
+            if busy_reply:
+                self.busy_replies[cls] = self.busy_replies.get(cls, 0) + 1
+            if before_latency:
+                self.bulk_before_latency_sheds += 1
+
+    # -- retry-after --------------------------------------------------------
+
+    def drain_rate_sigs_per_s(self, now: float | None = None):
+        """Recent launch throughput, or None without enough launches."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if len(self._launches) < 2:
+                return None
+            t0 = self._launches[0][0]
+            span = max(now - t0, 1e-6)
+            total = sum(s for _, s in self._launches)
+            return total / span
+
+    def retry_after_ms(self, cls: str, queued_sigs: int = 0) -> int:
+        rate = self.drain_rate_sigs_per_s()
+        if rate is None or rate <= 0 or queued_sigs <= 0:
+            ms = RETRY_DEFAULT_MS.get(cls, RETRY_DEFAULT_MS[BULK])
+        else:
+            ms = queued_sigs / rate * 1e3
+        return int(max(RETRY_MIN_MS, min(RETRY_MAX_MS, ms)))
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe ``surge`` section of the OP_STATS reply."""
+        with self._lock:
+            overlap = self._recent_overlap_locked()
+            return {
+                "admitted": dict(self.admitted),
+                "shed": dict(self.shed),
+                "busy_replies": dict(self.busy_replies),
+                "bulk_before_latency_sheds": self.bulk_before_latency_sheds,
+                "fairness_violations": self.fairness_violations,
+                "derate": {
+                    "factor": round(self._derate_factor_locked(), 3),
+                    "engaged": self._derate_engaged,
+                    "engagements": self.derate_engagements,
+                    "overlap_recent": round(overlap, 3)
+                    if overlap is not None else None,
+                },
+            }

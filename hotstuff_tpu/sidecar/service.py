@@ -215,6 +215,12 @@ class VerifyEngine:
                            or 1)
         return ok
 
+    def retry_after_ms(self, cls: str) -> int:
+        """Hint for a BUSY reply after a shed of class ``cls`` (the
+        scheduler's surge controller turns queue depth + drain rate
+        into milliseconds)."""
+        return self._sched.retry_after_ms(cls)
+
     def stats_snapshot(self) -> dict:
         """The OP_STATS reply body: scheduler telemetry + warmed shapes."""
         snap = self._sched.stats.snapshot()
@@ -787,8 +793,9 @@ class _Handler(socketserver.BaseRequestHandler):
                         return
                     if shed:
                         log.warning("chaos: forcing queue-full shed")
-                        outbox.put(proto.encode_reply(
-                            opcode, req.request_id, []))
+                        outbox.put(proto.encode_busy_reply(
+                            req.request_id, engine.retry_after_ms(
+                                vsched.class_of_opcode(opcode))))
                         continue
 
                 def send(frame, _delay=delay_s):
@@ -849,20 +856,16 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send(frame)
 
                 # Admission is bounded: a full class queue is answered
-                # HERE with an explicit empty-body reply (count 0 where
-                # records were sent — unambiguous, since a real verdict
-                # mask always matches the request count).  Clients shed
-                # to host verify / retry; no connection thread ever
-                # blocks on a saturated engine.
-                if not engine.submit(req, reply,
-                                     cls=vsched.class_of_opcode(opcode),
-                                     is_bls=is_bls):
-                    if opcode == proto.OP_BLS_SIGN:
-                        outbox.put(proto.encode_reply_raw(
-                            opcode, req.request_id, b""))
-                    else:
-                        outbox.put(proto.encode_reply(
-                            opcode, req.request_id, []))
+                # HERE with an explicit OP_BUSY reply carrying the
+                # retry-after hint (protocol v4; clients that predate it
+                # still read the off-opcode reply as overload, never as
+                # a verdict).  Clients back off / shed to host verify;
+                # no connection thread ever blocks on a saturated
+                # engine.
+                cls = vsched.class_of_opcode(opcode)
+                if not engine.submit(req, reply, cls=cls, is_bls=is_bls):
+                    outbox.put(proto.encode_busy_reply(
+                        req.request_id, engine.retry_after_ms(cls)))
         finally:
             outbox.put(None)
 

@@ -29,7 +29,13 @@ constexpr uint8_t kOpStats = 8;
 // Protocol v3 (graftchaos): sidecar fault-injection hook. The node never
 // sends it (the chaos harness does, via the python client).
 constexpr uint8_t kOpChaos = 9;  // NOLINT (wire constant, unused here)
-constexpr uint8_t kProtocolVersion = 3;  // NOLINT (lint anchor; no handshake)
+// Protocol v4 (graftsurge): reply-only BUSY opcode — a queue-full shed
+// answers OP_BUSY with a u16 LE retry-after hint instead of the old
+// empty-count echo.  This client treats it exactly like the legacy shed
+// (host fallback now); the in-flight AIMD already paces resubmission,
+// so the hint is logged, not slept on.
+constexpr uint8_t kOpBusy = 10;
+constexpr uint8_t kProtocolVersion = 4;  // NOLINT (lint anchor; no handshake)
 constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
@@ -467,10 +473,27 @@ void TpuVerifier::verify_batch_multi_async(
               uint8_t got_op = r.u8();
               uint32_t got_rid = r.u32();
               uint32_t n = r.u32();
+              if (got_op == kOpBusy && got_rid == rid) {
+                // Explicit backpressure (v4): the sidecar shed this
+                // request; the body's u16 retry-after hint is advisory
+                // — the host fallback answers now and the async budget
+                // AIMD paces resubmission.
+                uint32_t hint_ms = 0;
+                if (n == 2) {
+                  // Sequenced reads: the | operands are unsequenced in
+                  // C++17 and u8() advances the reader.
+                  uint32_t lo = r.u8();
+                  hint_ms = lo | uint32_t(r.u8()) << 8;
+                }
+                LOG_DEBUG("crypto::sidecar")
+                    << "sidecar busy (retry-after " << hint_ms
+                    << " ms); falling back to host";
+                cb(std::nullopt);
+                return;
+              }
               if (got_op == opcode && got_rid == rid && n == 0 &&
                   n_items != 0) {
-                // Explicit backpressure: the sidecar shed this request
-                // (class queue full).  nullopt -> caller's host fallback.
+                // Legacy (v2/v3) shed form: empty-count echo.
                 LOG_DEBUG("crypto::sidecar") << "sidecar queue full; "
                                                 "falling back to host";
                 cb(std::nullopt);
@@ -532,6 +555,12 @@ void parse_bool_reply(uint8_t opcode, uint32_t rid,
     uint8_t got_op = r.u8();
     uint32_t got_rid = r.u32();
     uint32_t n = r.u32();
+    if (got_op == kOpBusy && got_rid == rid) {
+      // v4 shed: overload is nullopt (caller's host fallback), never a
+      // 'false' verdict — an overload must not read as forged.
+      cb(std::nullopt);
+      return;
+    }
     if (got_op != opcode || got_rid != rid || n != 1) {
       cb(std::nullopt);
       return;

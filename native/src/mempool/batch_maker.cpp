@@ -59,10 +59,11 @@ std::thread BatchMaker::spawn(
     ChannelPtr<Transaction> rx_transaction,
     ChannelPtr<QuorumWaiterMessage> tx_message,
     std::vector<std::pair<PublicKey, Address>> mempool_addresses,
-    std::shared_ptr<std::atomic<bool>> stop) {
+    std::shared_ptr<std::atomic<bool>> stop,
+    std::shared_ptr<IngressGate> gate) {
   return std::thread([batch_size, max_batch_delay, rx_transaction, tx_message,
                peers = std::move(mempool_addresses),
-               stop = std::move(stop)] {
+               stop = std::move(stop), gate = std::move(gate)] {
     set_thread_name("batch-maker");
     ReliableSender network(stop);
     Batch current;
@@ -82,6 +83,10 @@ std::thread BatchMaker::spawn(
         deadline = std::chrono::steady_clock::now() + delay;
         continue;
       }
+      // Unwind the ingress gate's backlog accounting the moment the tx
+      // leaves the channel: a paused tx receiver resumes off this edge
+      // (low-water mark), so it must track actual drain, not sealing.
+      if (gate) gate->on_consumed(tx.size());
       current_size += tx.size();
       current.push_back(std::move(tx));
       if (current_size >= batch_size) {

@@ -11,6 +11,7 @@
 
 #include "common/channel.hpp"
 #include "mempool/config.hpp"
+#include "mempool/ingress.hpp"
 #include "mempool/messages.hpp"
 #include "network/reliable_sender.hpp"
 
@@ -26,13 +27,17 @@ class BatchMaker {
  public:
   // Returns the actor thread; it exits when rx_transaction is closed and
   // drained. The caller owns the join. `stop` makes the broadcast sends
-  // interruptible at teardown (see ReliableSender).
+  // interruptible at teardown (see ReliableSender).  `gate` (optional)
+  // is the graftsurge ingress gate: every drained transaction unwinds
+  // its backlog accounting, which is what resumes a paused receiver at
+  // the low-water mark.
   static std::thread spawn(size_t batch_size, uint64_t max_batch_delay,
                            ChannelPtr<Transaction> rx_transaction,
                            ChannelPtr<QuorumWaiterMessage> tx_message,
                            std::vector<std::pair<PublicKey, Address>>
                                mempool_addresses,
-                           std::shared_ptr<std::atomic<bool>> stop);
+                           std::shared_ptr<std::atomic<bool>> stop,
+                           std::shared_ptr<IngressGate> gate = nullptr);
 };
 
 }  // namespace mempool

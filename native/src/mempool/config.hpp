@@ -24,6 +24,12 @@ struct Parameters {
   size_t sync_retry_nodes = 3;
   size_t batch_size = 500'000;  // bytes
   uint64_t max_batch_delay = 100;  // ms
+  // graftsurge bounded ingress (mempool/ingress.hpp): client txs
+  // buffered ahead of the BatchMaker before the gate sheds with BUSY
+  // (tx count AND byte budget; the receiver pauses entirely when BUSY
+  // is ignored).
+  size_t ingress_tx_budget = 20'000;
+  size_t ingress_byte_budget = 16u << 20;  // 16 MiB
 
   static Parameters from_json(const Json& j) {
     Parameters p;
@@ -34,6 +40,12 @@ struct Parameters {
     }
     if (auto* v = j.find("batch_size")) p.batch_size = size_t(v->as_u64());
     if (auto* v = j.find("max_batch_delay")) p.max_batch_delay = v->as_u64();
+    if (auto* v = j.find("ingress_tx_budget")) {
+      p.ingress_tx_budget = size_t(v->as_u64());
+    }
+    if (auto* v = j.find("ingress_byte_budget")) {
+      p.ingress_byte_budget = size_t(v->as_u64());
+    }
     return p;
   }
 
@@ -49,6 +61,10 @@ struct Parameters {
     LOG_INFO("mempool::config") << "Batch size set to " << batch_size << " B";
     LOG_INFO("mempool::config")
         << "Max batch delay set to " << max_batch_delay << " ms";
+    LOG_INFO("mempool::config")
+        << "Ingress tx budget set to " << ingress_tx_budget << " txs";
+    LOG_INFO("mempool::config")
+        << "Ingress byte budget set to " << ingress_byte_budget << " B";
   }
 };
 

@@ -1,0 +1,165 @@
+// graftsurge bounded ingress: the admission gate between the client-tx
+// receiver and the BatchMaker pipeline.
+//
+// The tx receiver used to try_send into a fixed 1000-deep channel and
+// silently drop the overflow — under a 3-5x offered overload the client
+// learned nothing and kept flooding, and nothing bounded the BYTES
+// buffered (1000 x 8 MiB frames is the frame cap, not a budget).  The
+// gate enforces an explicit byte + tx budget and tells the client:
+//
+//   * backlog within budget      -> admit into the channel;
+//   * backlog at budget          -> shed, reply "BUSY <retry_ms>" on the
+//     tx connection (clients back off per-user with jittered
+//     exponential retry — node/rate_pacer.hpp UserLoadModel);
+//   * a client that ignores BUSY (pause_after_sheds consecutive sheds
+//     with the backlog still at the high-water mark) -> PAUSE the tx
+//     receiver entirely: the reactor stops reading, the kernel socket
+//     buffers fill, and TCP flow control pushes back — the one
+//     backpressure no client can ignore.  The BatchMaker side resumes
+//     the receiver once it has drained the backlog to the low-water
+//     mark (budget / low_water_div).
+//
+// Threading: admit() runs on the reactor thread (the tx receiver's
+// on_frame callback — it must never block; the gate is a few counter
+// updates under an uncontended mutex); on_consumed() runs on the
+// BatchMaker thread, once per transaction drained.  The pause callback
+// (NetworkReceiver::set_read_paused) posts to the event loop and is
+// safe from either thread; it is invoked OUTSIDE the gate lock.
+#pragma once
+
+#include <algorithm>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+
+#include "common/log.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+class IngressGate {
+ public:
+  struct Config {
+    size_t tx_budget = 20'000;          // txs buffered ahead of sealing
+    size_t byte_budget = 16u << 20;     // bytes buffered (16 MiB)
+    size_t low_water_div = 2;           // resume at budget / div
+    size_t pause_after_sheds = 256;     // consecutive BUSYs before pause
+    uint64_t max_batch_delay_ms = 100;  // scales the retry-after hint
+  };
+  using PauseFn = std::function<void(bool paused)>;
+
+  IngressGate(Config cfg, PauseFn pause)
+      : cfg_(cfg), pause_(std::move(pause)) {}
+
+  // Reactor thread: admit one client tx of `tx_bytes` into the pipeline
+  // (true), or shed it (false; *retry_ms carries the BUSY hint).
+  bool admit(size_t tx_bytes, uint32_t* retry_ms) {
+    bool pause_now = false;
+    bool admitted;
+    size_t txs;
+    size_t bytes;
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      admitted = txs_ < cfg_.tx_budget && bytes_ + tx_bytes <= cfg_.byte_budget;
+      if (admitted) {
+        txs_++;
+        bytes_ += tx_bytes;
+        consecutive_sheds_ = 0;
+      } else {
+        sheds_++;
+        consecutive_sheds_++;
+        if (retry_ms != nullptr) *retry_ms = retry_hint_locked_();
+        if (!paused_ && consecutive_sheds_ >= cfg_.pause_after_sheds) {
+          paused_ = true;
+          pause_crossings_++;
+          pause_now = true;
+        }
+      }
+      txs = txs_;
+      bytes = bytes_;
+    }
+    if (pause_now) {
+      LOG_WARN("mempool::ingress")
+          << "Ingress paused: " << txs << " txs / " << bytes
+          << " B queued after " << cfg_.pause_after_sheds
+          << " consecutive busy sheds (crossing " << pause_crossings()
+          << "); resuming at " << cfg_.tx_budget / cfg_.low_water_div
+          << " txs";
+      if (pause_) pause_(true);
+    }
+    return admitted;
+  }
+
+  // BatchMaker thread: one tx drained from the channel.
+  void on_consumed(size_t tx_bytes) {
+    bool resume_now = false;
+    size_t txs;
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      txs_ = txs_ > 0 ? txs_ - 1 : 0;
+      bytes_ = bytes_ > tx_bytes ? bytes_ - tx_bytes : 0;
+      if (paused_ && txs_ <= cfg_.tx_budget / cfg_.low_water_div &&
+          bytes_ <= cfg_.byte_budget / cfg_.low_water_div) {
+        paused_ = false;
+        consecutive_sheds_ = 0;
+        resume_now = true;
+      }
+      txs = txs_;
+    }
+    if (resume_now) {
+      LOG_INFO("mempool::ingress")
+          << "Ingress resumed at " << txs << " queued txs (low-water mark)";
+      if (pause_) pause_(false);
+    }
+  }
+
+  // -- telemetry (any thread) ----------------------------------------------
+
+  size_t queued_txs() const {
+    std::lock_guard<std::mutex> lk(m_);
+    return txs_;
+  }
+  size_t queued_bytes() const {
+    std::lock_guard<std::mutex> lk(m_);
+    return bytes_;
+  }
+  uint64_t sheds() const {
+    std::lock_guard<std::mutex> lk(m_);
+    return sheds_;
+  }
+  uint64_t pause_crossings() const {
+    std::lock_guard<std::mutex> lk(m_);
+    return pause_crossings_;
+  }
+  bool paused() const {
+    std::lock_guard<std::mutex> lk(m_);
+    return paused_;
+  }
+
+ private:
+  // Retry-after heuristic: one max_batch_delay is the sealing cadence
+  // both sides already reason in; persistent shedding (a client that
+  // keeps arriving hot) doubles the hint per pause_after_sheds/4 run of
+  // consecutive sheds, capped so a blip never parks a client for more
+  // than ~2 s.
+  uint32_t retry_hint_locked_() const {
+    uint64_t base = std::max<uint64_t>(50, 2 * cfg_.max_batch_delay_ms);
+    size_t quarter = std::max<size_t>(1, cfg_.pause_after_sheds / 4);
+    uint64_t doublings = std::min<uint64_t>(consecutive_sheds_ / quarter, 5);
+    return uint32_t(std::min<uint64_t>(base << doublings, 2'000));
+  }
+
+  const Config cfg_;      // SHARED_OK(immutable after construction)
+  const PauseFn pause_;   // SHARED_OK(immutable after construction;
+                          // posts to the event loop, called unlocked)
+  mutable std::mutex m_;
+  size_t txs_ = 0;                  // GUARDED_BY(m_)
+  size_t bytes_ = 0;                // GUARDED_BY(m_)
+  size_t consecutive_sheds_ = 0;    // GUARDED_BY(m_)
+  uint64_t sheds_ = 0;              // GUARDED_BY(m_)
+  uint64_t pause_crossings_ = 0;    // GUARDED_BY(m_)
+  bool paused_ = false;             // GUARDED_BY(m_)
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

@@ -18,7 +18,12 @@ std::unique_ptr<Mempool> Mempool::spawn(
 
   auto mp = std::unique_ptr<Mempool>(new Mempool());
 
-  auto tx_batch_maker = make_channel<Transaction>();
+  // graftsurge: the tx channel is sized to the ingress budget so the
+  // GATE, not the channel, is the admission authority (the +64 slack
+  // absorbs the reactor-vs-consumer accounting race; the gate's budget
+  // is what clients experience).
+  auto tx_batch_maker =
+      make_channel<Transaction>(parameters.ingress_tx_budget + 64);
   auto tx_quorum_waiter = make_channel<QuorumWaiterMessage>();
   auto tx_processor = make_channel<Bytes>();       // our own acked batches
   auto tx_helper =
@@ -42,17 +47,41 @@ std::unique_ptr<Mempool> Mempool::spawn(
                           parameters.sync_retry_delay,
                           parameters.sync_retry_nodes, rx_consensus));
 
-  // Client transaction ingress (:front). No ACKs.
+  // Client transaction ingress (:front), behind the graftsurge bounded
+  // admission gate: within budget txs are admitted; at budget the
+  // client gets an explicit "BUSY <retry_ms>" reply (it backs off
+  // per-user); a client that ignores BUSY gets the receiver PAUSED —
+  // kernel-buffer TCP backpressure — until the BatchMaker drains the
+  // backlog to the low-water mark.  The pause callback posts to the
+  // event loop, so calling it from either thread is safe; the receiver
+  // member outlives every actor thread (stop() joins them first).
+  IngressGate::Config gate_cfg;
+  gate_cfg.tx_budget = parameters.ingress_tx_budget;
+  gate_cfg.byte_budget = parameters.ingress_byte_budget;
+  gate_cfg.max_batch_delay_ms = parameters.max_batch_delay;
+  NetworkReceiver* tx_rx = &mp->tx_receiver_;
+  mp->ingress_gate_ = std::make_shared<IngressGate>(
+      gate_cfg, [tx_rx](bool paused) { tx_rx->set_read_paused(paused); });
+  auto gate = mp->ingress_gate_;
   auto tx_address = committee.transactions_address(name);
   if (!tx_address) throw std::runtime_error("our key is not in the committee");
   if (!mp->tx_receiver_.spawn(
           *tx_address,
-          [tx_batch_maker](ConnectionWriter&, Bytes msg) {
-            // Reactor-thread handler: try_send only (see peer handler).
-            // Load-shedding client transactions under a 1000-deep backlog
-            // replaces the TCP backpressure the per-connection-thread
-            // design applied.
+          [tx_batch_maker, gate](ConnectionWriter& writer, Bytes msg) {
+            // Reactor-thread handler: gate check + try_send only (see
+            // peer handler) — never a blocking channel op.
+            size_t tx_bytes = msg.size();
+            uint32_t retry_ms = 0;
+            if (!gate->admit(tx_bytes, &retry_ms)) {
+              writer.send("BUSY " + std::to_string(retry_ms));
+              return true;
+            }
             if (!tx_batch_maker->try_send(std::move(msg))) {
+              // The slack between gate budget and channel capacity makes
+              // this unreachable in practice; unwind the accounting and
+              // tell the client anyway rather than silently dropping.
+              gate->on_consumed(tx_bytes);
+              writer.send("BUSY " + std::to_string(retry_ms ? retry_ms : 100));
               LOG_DEBUG("mempool::mempool")
                   << "batch maker overloaded; shedding transaction";
             }
@@ -68,7 +97,7 @@ std::unique_ptr<Mempool> Mempool::spawn(
       BatchMaker::spawn(parameters.batch_size, parameters.max_batch_delay,
                         tx_batch_maker, tx_quorum_waiter,
                         committee.broadcast_addresses(name),
-                        mp->stop_flag_));
+                        mp->stop_flag_, mp->ingress_gate_));
 
   mp->threads_.push_back(QuorumWaiter::spawn(committee, committee.stake(name),
                                              tx_quorum_waiter, tx_processor,

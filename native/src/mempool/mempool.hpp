@@ -12,6 +12,7 @@
 
 #include "common/channel.hpp"
 #include "mempool/config.hpp"
+#include "mempool/ingress.hpp"
 #include "mempool/messages.hpp"
 #include "network/receiver.hpp"
 #include "store/store.hpp"
@@ -36,12 +37,15 @@ class Mempool {
 
   NetworkReceiver& tx_receiver() { return tx_receiver_; }
   NetworkReceiver& peer_receiver() { return peer_receiver_; }
+  // graftsurge: the bounded-ingress admission gate (telemetry access).
+  const IngressGate& ingress_gate() const { return *ingress_gate_; }
 
  private:
   Mempool() = default;
 
   NetworkReceiver tx_receiver_;
   NetworkReceiver peer_receiver_;
+  std::shared_ptr<IngressGate> ingress_gate_;
   std::shared_ptr<std::atomic<bool>> stop_flag_ =
       std::make_shared<std::atomic<bool>>(false);
   std::vector<std::function<void()>> closers_;

@@ -227,14 +227,27 @@ void EventLoop::destroy(uint64_t id, bool run_closed_cb) {
   }
 }
 
+void EventLoop::apply_interest_(uint64_t id, Conn* c) {
+  epoll_event ev{};
+  ev.events = (c->read_paused ? 0u : uint32_t(EPOLLIN)) |
+              (c->want_write ? uint32_t(EPOLLOUT) : 0u);
+  ev.data.u64 = id;
+  epoll_ctl(epfd_, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
 void EventLoop::update_interest(uint64_t id, Conn* c) {
   bool want = !c->out.empty();
   if (want == c->want_write) return;
   c->want_write = want;
-  epoll_event ev{};
-  ev.events = EPOLLIN | (want ? EPOLLOUT : 0u);
-  ev.data.u64 = id;
-  epoll_ctl(epfd_, EPOLL_CTL_MOD, c->fd, &ev);
+  apply_interest_(id, c);
+}
+
+void EventLoop::set_read_paused(uint64_t conn_id, bool paused) {
+  auto it = conns_.find(conn_id);
+  if (it == conns_.end()) return;
+  if (it->second.read_paused == paused) return;
+  it->second.read_paused = paused;
+  apply_interest_(conn_id, &it->second);
 }
 
 void EventLoop::flush(uint64_t id, Conn* c) {
@@ -334,8 +347,13 @@ void EventLoop::handle_readable(uint64_t id, Conn* c) {
       // false); stop touching freed state if so.
       auto it = conns_.find(id);
       if (it == conns_.end() || &it->second != c) return;
+      // A pause set from inside the callback (ingress watermark) stops
+      // this read pass too: parse no further buffered frames and stop
+      // recv'ing — the partial remainder waits for the resume.
+      if (c->read_paused) break;
     }
     if (pos) c->in.erase(c->in.begin(), c->in.begin() + pos);
+    if (c->read_paused) break;
     if (size_t(n) < sizeof(buf)) break;  // drained the socket
   }
 }

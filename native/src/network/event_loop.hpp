@@ -72,6 +72,13 @@ class EventLoop {
   // `max_queue` (> 0) frames are already backlogged on the connection.
   bool send(uint64_t conn_id, std::shared_ptr<const Bytes> payload,
             size_t max_queue = 0);
+  // Suspend/resume EPOLLIN on a connection (graftsurge ingress
+  // watermarks): while paused the kernel receive buffer fills and TCP
+  // flow control pushes back on the peer — the reactor stops reading,
+  // writes still flush.  A pause set from inside the connection's own
+  // on_frame callback also stops the current read loop after that
+  // callback returns (at most the already-buffered chunk is parsed).
+  void set_read_paused(uint64_t conn_id, bool paused);
   // Close an id (connection or listener); runs no ClosedCb (explicit
   // close means the owner already knows).
   void close(uint64_t id);
@@ -89,6 +96,7 @@ class EventLoop {
     FrameCb on_frame;
     ClosedCb on_closed;
     bool want_write = false;
+    bool read_paused = false;
   };
   struct Listener_ {
     int fd = -1;
@@ -113,6 +121,7 @@ class EventLoop {
   void handle_readable(uint64_t id, Conn* c);
   void flush(uint64_t id, Conn* c);
   void update_interest(uint64_t id, Conn* c);
+  void apply_interest_(uint64_t id, Conn* c);
   void destroy(uint64_t id, bool run_closed_cb);
   void cancel_timer(uint64_t seq);
   int next_timeout_ms() const;

@@ -48,10 +48,25 @@ bool NetworkReceiver::spawn(const Address& address, MessageHandler handler,
           // on_closed (peer EOF / error)
           [state](uint64_t cid) { state->conns.erase(cid); });
       state->conns.insert(id);
+      // A connection accepted while the receiver is paused (ingress
+      // watermark) starts paused: the backlog that triggered the pause
+      // is shared, so a fresh socket must not bypass it.
+      if (state->paused) loop->set_read_paused(id, true);
     });
   });
   spawned_ = true;
   return true;
+}
+
+void NetworkReceiver::set_read_paused(bool paused) {
+  if (!spawned_) return;
+  EventLoop* loop = &EventLoop::instance();
+  auto state = state_;
+  loop->post([loop, state, paused] {
+    if (state->stopped || state->paused == paused) return;
+    state->paused = paused;
+    for (uint64_t id : state->conns) loop->set_read_paused(id, paused);
+  });
 }
 
 void NetworkReceiver::stop() {

@@ -62,12 +62,19 @@ class NetworkReceiver {
   uint16_t port() const { return port_; }
   void stop();
 
+  // graftsurge ingress watermarks: suspend/resume reading on every
+  // current AND future connection of this receiver (the listener keeps
+  // accepting — a paused receiver is slow, not dead; accepted sockets
+  // simply start paused).  Thread-safe (posts to the loop); idempotent.
+  void set_read_paused(bool paused);
+
  private:
   // Loop-thread-only connection registry; shared so late callbacks after
   // stop() hit a flagged state instead of a dangling receiver.
   struct State {
     std::unordered_set<uint64_t> conns;
     bool stopped = false;
+    bool paused = false;
   };
 
   uint16_t port_ = 0;

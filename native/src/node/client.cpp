@@ -1,11 +1,22 @@
 // Benchmark client: open-loop transaction load generator
-// (node/src/client.rs:15-168 in the reference). Sends `rate` tx/s in
-// PRECISION bursts per second over one framed TCP connection to a node's
-// transactions address. Sample txs ([0u8][u64 BE counter][padding]) are
-// logged for end-to-end latency measurement; filler txs are
-// [1u8][u64 BE r][padding].
+// (node/src/client.rs:15-168 in the reference), generalized by graftsurge
+// into a multi-user open-loop generator.  Default (--users 1) is the
+// legacy constant-rate stream: `rate` tx/s in PRECISION bursts per second
+// over one framed TCP connection to a node's transactions address.  With
+// --users N it simulates N independent users, each with heavy-tailed
+// (lognormal or Pareto, seeded) inter-arrival times and an optional
+// diurnal ramp, the AGGREGATE mean still honoring --rate (see
+// node/rate_pacer.hpp UserLoadModel).  The node's bounded ingress can
+// reply "BUSY <retry_ms>" on this connection; a reader thread parses it
+// and the generator backs off — per user with jittered exponential
+// retry in model mode, a whole-stream pause in legacy mode.
+// Sample txs ([0u8][u64 BE counter][padding]) are logged for end-to-end
+// latency measurement; filler txs are [1u8][u64 BE r][padding].
 //   client ADDR --size BYTES --rate TXS [--timeout MS] [--nodes A1 A2 ...]
+//          [--users N] [--seed S] [--dist lognormal|pareto] [--sigma X]
+//          [--alpha X] [--diurnal AMP] [--diurnal-period SEC]
 #include <algorithm>
+#include <atomic>
 #include <chrono>
 #include <iostream>
 #include <random>
@@ -22,6 +33,26 @@ using namespace hotstuff;
 namespace {
 constexpr uint64_t kPrecision = 20;  // sample precision: bursts per second
 constexpr uint64_t kBurstDurationMs = 1000 / kPrecision;
+// BUSY replies are per-shed; log the first and every Nth so a surge
+// leaves evidence without drowning the log.
+constexpr uint64_t kBusyLogEvery = 50;
+
+// "BUSY <retry_ms>" -> retry_ms, or -1 when the frame is something else.
+int64_t parse_busy(const Bytes& frame) {
+  static const std::string kTag = "BUSY ";
+  if (frame.size() < kTag.size() + 1) return -1;
+  if (!std::equal(kTag.begin(), kTag.end(), frame.begin())) return -1;
+  int64_t ms = 0;
+  for (size_t i = kTag.size(); i < frame.size(); i++) {
+    if (frame[i] < '0' || frame[i] > '9') return -1;
+    ms = ms * 10 + (frame[i] - '0');
+    // Clamp but KEEP validating: a corrupt frame with a long digit
+    // prefix and junk after it must be rejected, not read as a 60 s
+    // backoff order.
+    if (ms > 60'000) ms = 60'000;
+  }
+  return ms;
+}
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -29,6 +60,13 @@ int main(int argc, char** argv) {
   size_t size = 512;
   uint64_t rate = 1000;
   uint64_t timeout_ms = 0;
+  size_t users = 1;
+  uint64_t seed = std::random_device{}();
+  ArrivalDist dist = ArrivalDist::kLognormal;
+  double sigma = 1.5;
+  double alpha = 2.5;
+  double diurnal_amp = 0.0;
+  double diurnal_period_s = 600.0;
   std::vector<std::string> nodes;
   for (int i = 1; i < argc; i++) {
     std::string arg = argv[i];
@@ -42,7 +80,21 @@ int main(int argc, char** argv) {
     if (arg == "--size") size = std::stoul(next());
     else if (arg == "--rate") rate = std::stoull(next());
     else if (arg == "--timeout") timeout_ms = std::stoull(next());
-    else if (arg == "--nodes") {
+    else if (arg == "--users") users = std::stoul(next());
+    else if (arg == "--seed") seed = std::stoull(next());
+    else if (arg == "--sigma") sigma = std::stod(next());
+    else if (arg == "--alpha") alpha = std::stod(next());
+    else if (arg == "--diurnal") diurnal_amp = std::stod(next());
+    else if (arg == "--diurnal-period") diurnal_period_s = std::stod(next());
+    else if (arg == "--dist") {
+      std::string d = next();
+      if (d == "pareto") dist = ArrivalDist::kPareto;
+      else if (d == "lognormal") dist = ArrivalDist::kLognormal;
+      else {
+        std::cerr << "unknown --dist " << d << "\n";
+        return 2;
+      }
+    } else if (arg == "--nodes") {
       while (i + 1 < argc && argv[i + 1][0] != '-') nodes.push_back(argv[++i]);
     } else if (arg[0] != '-') target_str = arg;
   }
@@ -51,7 +103,9 @@ int main(int argc, char** argv) {
   auto target = Address::parse(target_str);
   if (!target) {
     std::cerr << "client ADDR --size BYTES --rate TXS [--timeout MS] "
-                 "[--nodes ...]\n";
+                 "[--users N] [--seed S] [--dist lognormal|pareto] "
+                 "[--sigma X] [--alpha X] [--diurnal AMP] "
+                 "[--diurnal-period SEC] [--nodes ...]\n";
     return 2;
   }
   if (size < 9) {
@@ -62,12 +116,22 @@ int main(int argc, char** argv) {
     LOG_ERROR("client") << "rate must be at least 1 tx/s";
     return 1;
   }
+  if (users < 1) users = 1;
 
   LOG_INFO("client") << "Node address: " << target->str();
   // NOTE: These log entries are used to compute performance
   // (hotstuff_tpu/harness/logs.py client regexes).
   LOG_INFO("client") << "Transactions size: " << size << " B";
   LOG_INFO("client") << "Transactions rate: " << rate << " tx/s";
+  if (users > 1) {
+    LOG_INFO("client") << "Simulating " << users << " users ("
+                       << (dist == ArrivalDist::kPareto ? "pareto alpha="
+                                                        : "lognormal sigma=")
+                       << (dist == ArrivalDist::kPareto ? alpha : sigma)
+                       << ", seed " << seed << ", diurnal "
+                       << diurnal_amp * 100 << "% over " << diurnal_period_s
+                       << " s)";
+  }
 
   // Wait for all nodes to be online, then for synchronization
   // (client.rs:152-167).
@@ -82,20 +146,58 @@ int main(int argc, char** argv) {
   LOG_INFO("client") << "Waiting for all nodes to be synchronized...";
   std::this_thread::sleep_for(std::chrono::milliseconds(2 * timeout_ms));
 
-  auto sock = Socket::connect(*target);
-  if (!sock) {
+  auto sock_opt = Socket::connect(*target);
+  if (!sock_opt) {
     LOG_WARN("client") << "failed to connect to " << target->str();
     return 1;
   }
+  // Shared ownership: the detached BUSY reader below may still be
+  // blocked in read_frame when main returns on a send failure; the
+  // shared_ptr keeps the fd alive until both sides are done.
+  auto sock = std::make_shared<Socket>(std::move(*sock_opt));
 
-  // One tick every 1/kPrecision s; the pacer carries the rate/kPrecision
-  // remainder across ticks so the offered load matches --rate exactly at
-  // EVERY rate >= 1 (truncation used to under-deliver [kPrecision,
-  // 2*kPrecision) by up to 2x, and the harness divides the total rate by
-  // committee size, so per-client rates land in that band at scale).
-  // Sub-kPrecision rates emit empty ticks in between 1-tx bursts.
+  // BUSY reader: the node's bounded ingress replies "BUSY <retry_ms>"
+  // when it sheds (mempool/ingress.hpp).  A dedicated thread drains the
+  // connection — the send loop never blocks on reads — and publishes
+  // the freshest hint for the generator to consume at its next tick.
+  // static: the detached reader must never touch a dead stack frame if
+  // main returns on a send failure while it is still parsing a reply.
+  static std::atomic<int64_t> busy_hint_ms{-1};   // -1 = none pending
+  static std::atomic<uint64_t> busy_total{0};
+  std::thread busy_reader([sock] {
+    Bytes frame;
+    while (sock->read_frame(&frame)) {
+      int64_t ms = parse_busy(frame);
+      if (ms < 0) continue;  // unknown reply kind: ignore
+      busy_hint_ms.store(ms, std::memory_order_release);
+      uint64_t n = busy_total.fetch_add(1, std::memory_order_relaxed) + 1;
+      if (n == 1 || n % kBusyLogEvery == 0) {
+        // NOTE: The log parser mines these lines for overload notes.
+        LOG_INFO("client") << "Node busy (retry-after " << ms
+                           << " ms); backing off (" << n << " total)";
+      }
+    }
+  });
+  busy_reader.detach();  // exits when the socket closes with the process
+
+  UserLoadModel::Options opt;
+  opt.rate = rate;
+  opt.users = users;
+  opt.seed = seed;
+  opt.dist = dist;
+  opt.sigma = sigma;
+  opt.alpha = alpha;
+  opt.diurnal_amp = diurnal_amp;
+  opt.diurnal_period_s = diurnal_period_s;
+  UserLoadModel model(opt);
+
+  // Legacy single-user pacing: one tick every 1/kPrecision s; the pacer
+  // carries the rate/kPrecision remainder across ticks so the offered
+  // load matches --rate exactly at EVERY rate >= 1 (truncation used to
+  // under-deliver; see rate_pacer.hpp).  Sub-kPrecision rates emit
+  // empty ticks in between 1-tx bursts.
   RatePacer pacer{rate, kPrecision};
-  std::mt19937_64 rng(std::random_device{}());
+  std::mt19937_64 rng(seed);
   uint64_t r = rng();
   uint64_t counter = 0;
   Bytes tx(size, 0);
@@ -104,12 +206,28 @@ int main(int argc, char** argv) {
   LOG_INFO("client") << "Start sending transactions";
 
   auto interval = std::chrono::milliseconds(kBurstDurationMs);
-  auto next_tick = std::chrono::steady_clock::now() + interval;
+  auto start = std::chrono::steady_clock::now();
+  auto next_tick = start + interval;
+  auto legacy_busy_until = start;
   while (true) {
     std::this_thread::sleep_until(next_tick);
     next_tick += interval;
-    const uint64_t burst = pacer.next_burst();
-    if (burst == 0) continue;  // sub-kPrecision rate: skip this tick
+    auto now = std::chrono::steady_clock::now();
+    double now_s = std::chrono::duration<double>(now - start).count();
+    int64_t hint = busy_hint_ms.exchange(-1, std::memory_order_acquire);
+    uint64_t burst;
+    if (users > 1) {
+      if (hint >= 0) model.busy(now_s, double(hint) / 1e3);
+      burst = model.arrivals(now_s);
+    } else {
+      if (hint >= 0) {
+        legacy_busy_until =
+            now + std::chrono::milliseconds(std::max<int64_t>(hint, 20));
+      }
+      if (now < legacy_busy_until) continue;  // whole-stream pause
+      burst = pacer.next_burst();
+    }
+    if (burst == 0) continue;  // no arrivals due on this tick
     auto burst_start = std::chrono::steady_clock::now();
     for (uint64_t x = 0; x < burst; x++) {
       uint64_t id;

@@ -1,13 +1,33 @@
-// Exact open-loop pacing for the benchmark client.  The old scheme sent
+// Open-loop client load models (graftsurge).
+//
+// RatePacer: exact constant-rate pacing.  The old scheme sent
 // floor(rate / precision) transactions per tick, which under-delivers
 // every rate that truncates — worst in [precision, 2*precision), where
 // e.g. --rate 39 at precision 20 sent 20 tx/s, half the run label
 // (round-5 ADVICE.md).  The pacer carries the remainder across ticks so
 // the offered load over any whole second equals `rate` exactly, for
 // every rate >= 1 (sub-precision rates emit empty ticks in between).
+//
+// UserLoadModel: the multi-user open-loop generator behind `client
+// --users N`.  Thousands of simulated users per client process, each
+// with heavy-tailed (lognormal or Pareto, seeded) inter-arrival times —
+// real traffic is bursty: a p99 burst is many times the mean, which a
+// constant-rate stream never exercises — plus an optional diurnal ramp,
+// with the AGGREGATE mean rate still equal to `--rate` (every
+// inter-arrival multiplier is sampled mean-1, and the diurnal profile
+// averages to 1 over its period).  On a node BUSY reply the model backs
+// off PER USER with jittered exponential retry: arrivals due inside the
+// busy window are deferred, not dropped — an open-loop load the node
+// can actually shed.  All time is caller-supplied seconds, so tests and
+// the bench probe drive it on a virtual clock.
 #pragma once
 
+#include <algorithm>
+#include <cmath>
 #include <cstdint>
+#include <queue>
+#include <random>
+#include <vector>
 
 namespace hotstuff {
 
@@ -24,6 +44,130 @@ struct RatePacer {
     acc -= burst * precision;
     return burst;
   }
+};
+
+enum class ArrivalDist { kLognormal, kPareto };
+
+class UserLoadModel {
+ public:
+  struct Options {
+    uint64_t rate = 1000;   // aggregate mean tx/s across all users
+    size_t users = 1000;
+    uint64_t seed = 1;      // generator is deterministic in the seed
+    ArrivalDist dist = ArrivalDist::kLognormal;
+    double sigma = 1.5;     // lognormal shape: CV = sqrt(e^sigma^2 - 1)
+    double alpha = 2.5;     // pareto shape (> 1 for a finite mean)
+    double diurnal_amp = 0.0;       // 0 = flat; 0.5 = rate swings +-50%
+    double diurnal_period_s = 600;  // compressed "day" for bench windows
+    double busy_base_s = 0.05;      // backoff base when BUSY has no hint
+  };
+
+  explicit UserLoadModel(const Options& opt) : opt_(opt), rng_(opt.seed) {
+    size_t users = std::max<size_t>(1, opt_.users);
+    mean_gap_s_ = double(users) / std::max<uint64_t>(1, opt_.rate);
+    users_.resize(users);
+    std::uniform_real_distribution<double> phase(0.0, mean_gap_s_);
+    for (size_t u = 0; u < users; u++) {
+      // Random start phase: the aggregate is at its mean rate from t=0
+      // instead of every user firing at once.
+      heap_.push({phase(rng_), u});
+    }
+  }
+
+  // Diurnal multiplier at time t (mean exactly 1 over a period).
+  double profile(double t) const {
+    if (opt_.diurnal_amp <= 0.0) return 1.0;
+    constexpr double kTau = 6.283185307179586;
+    return 1.0 + opt_.diurnal_amp *
+                     std::sin(kTau * t / opt_.diurnal_period_s);
+  }
+
+  // Number of transactions to send at `now` (all user arrivals due up
+  // to now).  Call with a monotonically non-decreasing clock.
+  uint64_t arrivals(double now) {
+    uint64_t due = 0;
+    while (!heap_.empty() && heap_.top().t <= now) {
+      Arrival a = heap_.top();
+      heap_.pop();
+      User& u = users_[a.user];
+      if (a.t < busy_until_) {
+        // The node said BUSY: this user's arrival defers with jittered
+        // exponential backoff — deferred, never dropped (open loop).
+        u.attempt = std::min<uint32_t>(u.attempt + 1, 6);
+        double base = std::max(busy_hint_s_, opt_.busy_base_s);
+        double jitter = jitter_(rng_);
+        heap_.push({busy_until_ + base * double(1u << u.attempt) * jitter,
+                    a.user});
+        deferred_++;
+        continue;
+      }
+      u.attempt = 0;
+      due++;
+      sent_++;
+      heap_.push({a.t + next_gap_(a.t), a.user});
+    }
+    return due;
+  }
+
+  // A node BUSY reply observed at `now` with a retry-after hint.
+  void busy(double now, double hint_s) {
+    busy_hint_s_ = std::max(0.0, hint_s);
+    busy_until_ =
+        std::max(busy_until_, now + std::max(busy_hint_s_, opt_.busy_base_s));
+    busy_events_++;
+  }
+
+  uint64_t sent() const { return sent_; }
+  uint64_t deferred() const { return deferred_; }
+  uint64_t busy_events() const { return busy_events_; }
+
+  // Test hook: one inter-arrival gap sample at time t, drawn from the
+  // same rng stream the generator uses (distribution sanity checks).
+  double sample_gap_for_test(double t) { return next_gap_(t); }
+
+ private:
+  struct Arrival {
+    double t;
+    size_t user;
+    bool operator>(const Arrival& o) const { return t > o.t; }
+  };
+  struct User {
+    uint32_t attempt = 0;
+  };
+
+  // One inter-arrival gap for a user at time t: the user's mean gap
+  // (users / rate) times a mean-1 heavy-tailed multiplier, compressed
+  // by the diurnal profile.
+  double next_gap_(double t) {
+    double x;
+    if (opt_.dist == ArrivalDist::kPareto) {
+      // X = xm * U^(-1/alpha) with xm = (alpha-1)/alpha has mean 1.
+      double a = std::max(1.05, opt_.alpha);
+      double u = std::max(1e-12, uniform_(rng_));
+      x = (a - 1.0) / a * std::pow(u, -1.0 / a);
+    } else {
+      // X = exp(sigma Z - sigma^2/2) has mean 1.
+      double z = normal_(rng_);
+      x = std::exp(opt_.sigma * z - 0.5 * opt_.sigma * opt_.sigma);
+    }
+    double gap = mean_gap_s_ * x / profile(t);
+    return std::max(gap, 1e-9);
+  }
+
+  Options opt_;
+  double mean_gap_s_ = 1.0;
+  std::mt19937_64 rng_;
+  std::normal_distribution<double> normal_{0.0, 1.0};
+  std::uniform_real_distribution<double> uniform_{0.0, 1.0};
+  std::uniform_real_distribution<double> jitter_{0.5, 1.5};
+  std::vector<User> users_;
+  std::priority_queue<Arrival, std::vector<Arrival>, std::greater<Arrival>>
+      heap_;
+  double busy_until_ = -1.0;
+  double busy_hint_s_ = 0.0;
+  uint64_t sent_ = 0;
+  uint64_t deferred_ = 0;
+  uint64_t busy_events_ = 0;
 };
 
 }  // namespace hotstuff

@@ -1,0 +1,148 @@
+// Client load-model tests (graftsurge): the heavy-tailed multi-user
+// open-loop generator (node/rate_pacer.hpp UserLoadModel) driven on a
+// virtual clock — seeded determinism, aggregate rate honoring --rate,
+// heavy-tailed inter-arrival shape, per-user BUSY backoff, the diurnal
+// profile's mean-1 invariant — plus the legacy RatePacer exactness.
+#include <cmath>
+#include <vector>
+
+#include "node/rate_pacer.hpp"
+#include "test_util.hpp"
+
+using namespace hotstuff;
+using namespace hotstuff::test;
+
+namespace {
+
+// Step a model through `seconds` of virtual time in `tick_s` ticks,
+// returning total arrivals.
+uint64_t drive(UserLoadModel* m, double from_s, double to_s,
+               double tick_s = 0.05) {
+  uint64_t total = 0;
+  for (double t = from_s + tick_s; t <= to_s + 1e-9; t += tick_s) {
+    total += m->arrivals(t);
+  }
+  return total;
+}
+
+UserLoadModel::Options base_opts() {
+  UserLoadModel::Options opt;
+  opt.rate = 2000;
+  opt.users = 400;
+  opt.seed = 42;
+  opt.sigma = 1.5;
+  return opt;
+}
+
+}  // namespace
+
+TEST(rate_pacer_is_exact_at_truncating_rates) {
+  RatePacer pacer{39, 20};
+  uint64_t total = 0;
+  for (int i = 0; i < 20; i++) total += pacer.next_burst();
+  CHECK(total == 39);
+}
+
+TEST(load_model_is_deterministic_in_the_seed) {
+  UserLoadModel a(base_opts());
+  UserLoadModel b(base_opts());
+  for (double t = 0.05; t <= 5.0; t += 0.05) {
+    CHECK(a.arrivals(t) == b.arrivals(t));
+  }
+  UserLoadModel::Options other = base_opts();
+  other.seed = 43;
+  UserLoadModel c(base_opts());
+  UserLoadModel d(other);
+  drive(&c, 0.0, 5.0);
+  drive(&d, 0.0, 5.0);
+  CHECK(c.sent() != d.sent());  // a different world, not a constant
+}
+
+TEST(load_model_aggregate_honors_rate_on_virtual_clock) {
+  // 400 heavy-tailed users at aggregate 2000 tx/s over 30 virtual
+  // seconds: the mean-1 multiplier construction must keep the total
+  // within a few percent of rate * seconds despite per-user burstiness.
+  UserLoadModel m(base_opts());
+  uint64_t total = drive(&m, 0.0, 30.0);
+  CHECK(total > 54'000);   // -10%
+  CHECK(total < 66'000);   // +10%
+}
+
+TEST(load_model_pareto_aggregate_honors_rate) {
+  UserLoadModel::Options opt = base_opts();
+  opt.dist = ArrivalDist::kPareto;
+  opt.alpha = 2.5;
+  UserLoadModel m(opt);
+  uint64_t total = drive(&m, 0.0, 30.0);
+  CHECK(total > 54'000);
+  CHECK(total < 66'000);
+}
+
+TEST(load_model_gaps_are_heavy_tailed) {
+  // Sample the inter-arrival multiplier stream directly: the lognormal
+  // shape at sigma=1.5 has CV ~ 2.9 — far above the CV=1 of the
+  // exponential arrivals a Poisson (let alone constant-rate) client
+  // would produce.  Mean must still track 1/user-rate.
+  UserLoadModel::Options opt;
+  opt.rate = 100;
+  opt.users = 1;
+  opt.seed = 7;
+  opt.sigma = 1.5;
+  UserLoadModel m(opt);
+  const int n = 20'000;
+  double sum = 0.0;
+  double sum2 = 0.0;
+  for (int i = 0; i < n; i++) {
+    double g = m.sample_gap_for_test(0.0);
+    sum += g;
+    sum2 += g * g;
+  }
+  double mean = sum / n;
+  double var = sum2 / n - mean * mean;
+  double cv = std::sqrt(var) / mean;
+  CHECK(mean > 0.0085);  // user mean gap 10 ms +-15%
+  CHECK(mean < 0.0115);
+  CHECK(cv > 1.2);       // heavy tail (true CV ~ 2.9)
+}
+
+TEST(load_model_busy_backoff_defers_then_recovers) {
+  UserLoadModel::Options opt;
+  opt.rate = 1000;
+  opt.users = 20;
+  opt.seed = 3;
+  UserLoadModel m(opt);
+  uint64_t before = drive(&m, 0.0, 1.0, 0.01);
+  CHECK(before > 0);
+  m.busy(1.0, 0.5);
+  // Inside the busy window every due arrival defers (jittered
+  // exponential per-user retry) — nothing is sent, nothing is dropped.
+  uint64_t during = drive(&m, 1.0, 1.5, 0.01);
+  CHECK(during == 0);
+  CHECK(m.deferred() > 0);
+  CHECK(m.busy_events() == 1);
+  // Users come back after their backoff; the open loop recovers.
+  uint64_t after = drive(&m, 1.5, 6.0, 0.01);
+  CHECK(after > 0);
+}
+
+TEST(load_model_diurnal_profile_means_one) {
+  UserLoadModel::Options opt = base_opts();
+  opt.diurnal_amp = 0.5;
+  opt.diurnal_period_s = 100.0;
+  UserLoadModel m(opt);
+  double acc = 0.0;
+  const int steps = 1000;
+  for (int i = 0; i < steps; i++) {
+    acc += m.profile(100.0 * i / steps);
+  }
+  CHECK(std::fabs(acc / steps - 1.0) < 0.01);  // mean 1 over a period
+  CHECK(m.profile(25.0) > 1.4);                // peak ~ 1 + amp
+  CHECK(m.profile(75.0) < 0.6);                // trough ~ 1 - amp
+  // The ramp bends the aggregate but not its mean: 2 whole periods of
+  // diurnal load still deliver ~rate * seconds.
+  uint64_t total = drive(&m, 0.0, 200.0, 0.05);
+  CHECK(total > 360'000);  // 2000 tx/s * 200 s -10%
+  CHECK(total < 440'000);
+}
+
+int main() { return run_all(); }

@@ -271,6 +271,100 @@ TEST(mempool_pipeline_end_to_end) {
   for (auto& t : threads) t.join();
 }
 
+TEST(ingress_gate_budget_watermarks_and_retry_hints) {
+  // Unit drive of the graftsurge admission gate: budget admits, the
+  // overflow sheds with a retry hint, persistent shedding crosses the
+  // pause watermark exactly once, and the consumer side resumes at the
+  // low-water mark.
+  IngressGate::Config cfg;
+  cfg.tx_budget = 10;
+  cfg.byte_budget = 10'000;
+  cfg.pause_after_sheds = 3;
+  cfg.low_water_div = 2;
+  cfg.max_batch_delay_ms = 100;
+  std::vector<bool> pauses;
+  IngressGate gate(cfg, [&pauses](bool p) { pauses.push_back(p); });
+
+  uint32_t retry = 0;
+  for (int i = 0; i < 10; i++) CHECK(gate.admit(100, &retry));
+  CHECK(gate.queued_txs() == 10);
+  CHECK(gate.queued_bytes() == 1000);
+  // 11th: over the tx budget -> BUSY with a hint, no pause yet.
+  CHECK(!gate.admit(100, &retry));
+  CHECK(retry >= 50);
+  CHECK(gate.sheds() == 1);
+  CHECK(pauses.empty());
+  // Two more consecutive sheds cross the pause watermark exactly once.
+  CHECK(!gate.admit(100, &retry));
+  CHECK(!gate.admit(100, &retry));
+  CHECK(gate.paused());
+  CHECK(gate.pause_crossings() == 1);
+  CHECK(pauses.size() == 1 && pauses[0] == true);
+  CHECK(!gate.admit(100, &retry));  // still shedding, still one crossing
+  CHECK(gate.pause_crossings() == 1);
+  // Draining to the low-water mark (10/2 = 5 txs) resumes.
+  for (int i = 0; i < 4; i++) gate.on_consumed(100);
+  CHECK(gate.paused());  // 6 queued: still above low water
+  gate.on_consumed(100);
+  CHECK(!gate.paused());
+  CHECK(pauses.size() == 2 && pauses[1] == false);
+  // Admission works again after the resume.
+  CHECK(gate.admit(100, &retry));
+}
+
+TEST(ingress_gate_byte_budget_sheds_too) {
+  IngressGate::Config cfg;
+  cfg.tx_budget = 1000;
+  cfg.byte_budget = 250;
+  IngressGate gate(cfg, nullptr);
+  uint32_t retry = 0;
+  CHECK(gate.admit(100, &retry));
+  CHECK(gate.admit(100, &retry));
+  CHECK(!gate.admit(100, &retry));  // 300 > 250
+  CHECK(gate.sheds() == 1);
+  gate.on_consumed(100);
+  CHECK(gate.admit(100, &retry));
+}
+
+TEST(mempool_bounded_ingress_replies_busy) {
+  // End-to-end through the real pipeline: with no ACKing peers the
+  // QuorumWaiter wedges on its first sealed batch, the BatchMaker
+  // backs up behind it, the tx channel fills to the (tiny) ingress
+  // budget — and the client's own connection receives an explicit
+  // "BUSY <retry_ms>" frame instead of a silent drop.
+  auto committee = mempool_committee(7800);
+  auto myself = keys()[0].name;
+
+  Store store = Store::open("");
+  Parameters params;
+  params.batch_size = 20;        // one tx seals a batch
+  params.max_batch_delay = 60'000;
+  params.ingress_tx_budget = 16;
+  auto rx_consensus = make_channel<ConsensusMempoolMessage>();
+  auto tx_consensus = make_channel<Digest>();
+  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
+                           tx_consensus);
+
+  auto sock = Socket::connect(*committee.transactions_address(myself));
+  CHECK(sock.has_value());
+  sock->set_recv_timeout(30'000);
+  Bytes tx(32, 9);
+  // The QuorumWaiter holds batch 1; the tx_quorum_waiter channel holds
+  // the next 1000; the BatchMaker's in-flight tx is one more; past
+  // that the gate's 16-tx budget fills and sheds begin.
+  const size_t kSends = 1'100;
+  for (size_t i = 0; i < kSends; i++) CHECK(sock->write_frame(tx));
+  Bytes reply;
+  CHECK(sock->read_frame(&reply));
+  std::string text(reply.begin(), reply.end());
+  CHECK(text.rfind("BUSY ", 0) == 0);
+  uint64_t hint = std::stoull(text.substr(5));
+  CHECK(hint >= 50);
+  CHECK(hint <= 2'000);
+  CHECK(mp->ingress_gate().sheds() > 0);
+  mp->stop();
+}
+
 TEST(peer_batch_digest_survives_consensus_backlog) {
   // A stored+ACKed peer batch must remain proposable even when consensus
   // has a deep backlog: the inlined peer-batch path try_sends the digest

@@ -71,6 +71,15 @@ if __name__ == "__main__":
                     "threads:hotstuff_tpu/sidecar/service.py",
                     "threads:hotstuff_tpu/sidecar/sched/scheduler.py",
                     "threads:hotstuff_tpu/sidecar/sched/classes.py",
+                    # graftsurge: the admission controller and the load
+                    # model stay inside the THREADS scan (both are
+                    # called from multiple threads), and every surge
+                    # module inside the new BOUNDED-INGRESS scan.
+                    "threads:hotstuff_tpu/sidecar/sched/surge.py",
+                    "ingress:hotstuff_tpu/sidecar/sched/surge.py",
+                    "ingress:hotstuff_tpu/sidecar/sched/scheduler.py",
+                    "ingress:hotstuff_tpu/sidecar/sched/classes.py",
+                    "ingress:hotstuff_tpu/harness/loadgen.py",
                     "threads:hotstuff_tpu/obs/sampler.py",
                     "threads:hotstuff_tpu/chaos/runner.py",
                     "threads:hotstuff_tpu/harness/faults.py",
@@ -83,6 +92,7 @@ if __name__ == "__main__":
                     "cxxsync:native/src/crypto/sidecar_client.hpp",
                     "cxxsync:native/src/crypto/sidecar_client.cpp",
                     "cxxsync:native/src/consensus/mempool_driver.hpp",
-                    "cxxsync:native/src/consensus/core.cpp"):
+                    "cxxsync:native/src/consensus/core.cpp",
+                    "cxxsync:native/src/mempool/ingress.hpp"):
             argv += ["--must-cover", pin]
     sys.exit(main(argv))

@@ -21,7 +21,7 @@ case "$MODE" in
 esac
 TESTS=("$@")
 if [ ${#TESTS[@]} -eq 0 ]; then
-  TESTS=(serde crypto store network mempool consensus)
+  TESTS=(serde crypto store network mempool consensus client)
 fi
 
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"

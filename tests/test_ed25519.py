@@ -26,6 +26,11 @@ def make_sigs(n, msg_len=32, seed=0):
 
 def test_ref_impl_against_cryptography_lib():
     """Anchor the pure-python reference to an independent implementation."""
+    pytest.importorskip(
+        "cryptography",
+        reason="third-party `cryptography` (OpenSSL) not installed on "
+               "this image; the cross-check needs an independent "
+               "implementation to anchor against")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )

@@ -41,6 +41,11 @@ def test_ecdsa_roundtrip_and_tamper():
 def test_ecdsa_cross_check_openssl():
     """Our signatures must verify under OpenSSL's secp256k1 and vice
     versa (DER interchange)."""
+    pytest.importorskip(
+        "cryptography",
+        reason="third-party `cryptography` (OpenSSL binding) not "
+               "installed on this image; the DER interchange check "
+               "needs it as the independent side")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import ec
     from cryptography.hazmat.primitives.asymmetric.utils import (

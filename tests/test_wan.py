@@ -254,6 +254,66 @@ def test_wanproxy_forwards_and_pays_latency():
         stop_srv()
 
 
+def test_token_bucket_charges_rate_not_chunks():
+    """The ROADMAP item-5 follow-up: rate caps must be accurate at any
+    rate.  The bucket sleeps only for the DEFICIT — idle time between
+    chunks earns byte credit at the link rate — where the old per-chunk
+    charge slept ``len * 8 / rate`` regardless of elapsed time."""
+    from hotstuff_tpu.chaos.netem import _TokenBucket
+
+    now = [0.0]
+    bucket = _TokenBucket(0.8, clock=lambda: now[0])  # 100 KB/s
+    # First chunk rides the burst allowance (8 KiB floor).
+    assert bucket.delay(8192) == 0.0
+    # An immediate second chunk pays its full serialization time.
+    d = bucket.delay(65536)
+    assert d == pytest.approx(65536 / 100_000, rel=0.01)
+    # Idle time earns the credit back: after 2 s the debt (and more) is
+    # repaid, so a burst-sized chunk is free again — the old model would
+    # have charged it ~0.66 s regardless.
+    now[0] = 2.0
+    assert bucket.delay(8192) == 0.0
+    # Sustained sending converges on exactly the cap: 10 chunks of
+    # 10 KB with the clock advancing by each returned delay.
+    bucket2 = _TokenBucket(0.8, clock=lambda: now[0])
+    sent = 0
+    t_start = now[0]
+    for _ in range(10):
+        d = bucket2.delay(10_000)
+        now[0] += d
+        sent += 10_000
+    elapsed = now[0] - t_start
+    # 100 KB at 100 KB/s minus the 8 KiB burst: ~0.92 s.
+    assert elapsed == pytest.approx((sent - 8192) / 100_000, rel=0.05)
+    # Uncapped rate never delays.
+    assert _TokenBucket(0.0, clock=lambda: now[0]).delay(1 << 20) == 0.0
+
+
+def test_wanproxy_rate_cap_accurate_below_one_mbit():
+    """Regression with a real socket pair: a 0.8 Mbit (100 KB/s) cap
+    must deliver ~100 KB/s — the per-chunk model over-shaped low caps
+    (every chunk paid serialization + latency with no credit for the
+    gaps in between)."""
+    port, stop_srv = _echo_server()
+    proxy = WanProxy(("127.0.0.1", port),
+                     shape=LinkShape(rate_mbit=0.8))
+    try:
+        proxy.start()
+        assert proxy.wait_ready(5.0)
+        payload = b"\x07" * 40_000
+        t0 = time.monotonic()
+        assert _roundtrip(proxy.port, payload) == payload
+        elapsed = time.monotonic() - t0
+        # Forward direction spends (40000 - burst)/100000 ~ 0.32 s; the
+        # echoed bytes pay the reverse bucket too -> ~0.64 s total.
+        # Bound generously for CI scheduling noise, but tight enough
+        # that the old double-charging (or no shaping) would fail.
+        assert 0.35 <= elapsed <= 2.5, f"rate cap off ({elapsed:.3f}s)"
+    finally:
+        proxy.stop()
+        stop_srv()
+
+
 def test_wanproxy_partition_heal_and_loss():
     port, stop_srv = _echo_server()
 

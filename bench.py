@@ -55,14 +55,24 @@ tunneled chip; a pod run reuses the same probe).  Per size:
 (HOTSTUFF_TPU_MESH_RLC_BUDGET seconds, default 240, bounds the stage).
 
 MSM window-chunk sweep (`"msm_window_chunk"` field): RLC throughput at
-n=256 with ops/ed25519._MSM_WINDOW_CHUNK forced to 4, 8 and 16 via one
-subprocess per value (the constant binds at import; running the sweep
-in subprocesses BEFORE the parent binds the device also gives each
-child the single tunneled chip to itself).  Per chunk:
+n=256 with the Straus window chunk re-pinned to 4, 8 and 16 IN-PROCESS
+(ops/ed25519.set_msm_window_chunk clears the jit caches per value — no
+more subprocess per value).  Per chunk:
   {"chunkC": {"rlc_sigs_per_s": float}}   — or {"skipped"/"error": ...}.
 PR 2 chose the default (8) by conv-group arithmetic; this field gives a
 real v5e run the measurement to settle it (HOTSTUFF_TPU_MSM_SWEEP_BUDGET
-seconds, default 180, bounds the sweep via per-child timeouts).
+seconds, default 180, bounds the sweep).
+
+graftkern roofline (`"roofline"` field): measured sigs/sec/chip for the
+LAX vs PALLAS kernel routes (ops/kern — HOTSTUFF_TPU_KERN) through
+verify_batch_rlc at n in {64, 256, 1024}, next to an arithmetic int-op
+roofline estimate per chip (roofline_estimate: per-sig op model +
+HOTSTUFF_TPU_CHIP_INT_OPS), so kernel speedups are attributable as a
+fraction of the same ceiling on every run.  Emitted on BOTH the live
+and degraded lines; off-TPU pallas entries carry "interpreted": true
+(the Pallas interpreter is not kernel performance and must never read
+as it).  HOTSTUFF_TPU_ROOFLINE_BUDGET seconds (default 300) bounds the
+stage; sizes/routes that miss it report {"skipped": true}.
 
 Scheduler telemetry (`"sched"` field): the verifysched STATS counters of
 a tiny in-process host-mode engine exercise (one latency QC + one bulk
@@ -176,29 +186,15 @@ TRIALS = 4        # best-of: the tunneled TPU and the shared host CPU both
 CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "results", "headline_cache.json")
 
-# The sources whose edits can change what this bench measures: a cached
-# best is only comparable to a live run built from the same kernel.
-_KERNEL_SOURCES = (
-    "bench.py",
-    "hotstuff_tpu/ops/ed25519.py",
-    "hotstuff_tpu/ops/field25519.py",
-    "hotstuff_tpu/ops/scalar25519.py",
-    "hotstuff_tpu/crypto/eddsa.py",
-)
-
-
 def kernel_fingerprint() -> str:
-    """Hash of the kernel sources; namespaces the headline cache so a
-    stale best can only ever answer for the code that produced it."""
-    import hashlib
+    """Hash of the kernel sources (the shared utils/xla_cache scheme —
+    ops + crypto + the graftkern Pallas modules — plus bench.py itself);
+    namespaces the headline cache so a stale best can only ever answer
+    for the code that produced it.  The compile-cache manifest uses the
+    same scheme, so one kernel edit invalidates both records together."""
+    from hotstuff_tpu.utils.xla_cache import kernel_fingerprint as _kf
 
-    root = os.path.dirname(os.path.abspath(__file__))
-    h = hashlib.sha256()
-    for rel in _KERNEL_SOURCES:
-        with open(os.path.join(root, rel), "rb") as f:
-            h.update(f.read())
-        h.update(b"\x00")
-    return h.hexdigest()[:16]
+    return _kf(extra=("bench.py",))
 
 
 def load_cache():
@@ -356,66 +352,179 @@ def _make_ref_sigs(n: int, seed: int = 11):
     return msgs, pks, sigs
 
 
-def msm_chunk_probe(n: int = 256, repeats: int = 2):
-    """Child-process half of the msm_window_chunk sweep: measure RLC
-    throughput at quorum size n under THIS process's
-    ops/ed25519._MSM_WINDOW_CHUNK (bound from the env at import), and
-    print one JSON line.  Run via `python -c "import bench;
-    bench.msm_chunk_probe()"` with HOTSTUFF_TPU_MSM_WINDOW_CHUNK set."""
+def _rlc_best_sigs_per_s(msgs, pks, sigs, n: int, repeats: int) -> float:
+    """Warm/compile + correctness guard, then best-of-``repeats``
+    verify_batch_rlc throughput at quorum size n — the one timing
+    discipline the msm_window_chunk and roofline headlines share (a
+    future change to it lands in both)."""
     from hotstuff_tpu.crypto import eddsa
-    from hotstuff_tpu.ops import ed25519 as E
-    from hotstuff_tpu.utils.xla_cache import configure_xla_cache
 
-    configure_xla_cache()
-    msgs, pks, sigs = _make_ref_sigs(n)
-    if not eddsa.verify_batch_rlc(msgs, pks, sigs).all():  # warm + correct
+    m, p, s = msgs[:n], pks[:n], sigs[:n]
+    # Explicit raise, not assert: python -O must not strip the warmup
+    # call or the correctness guard.
+    if not eddsa.verify_batch_rlc(m, p, s).all():
         raise RuntimeError(f"RLC verify failed at n={n}")
     best = 0.0
     for _ in range(repeats):
         t0 = time.perf_counter()
-        mask = eddsa.verify_batch_rlc(msgs, pks, sigs)
+        mask = eddsa.verify_batch_rlc(m, p, s)
         dt = time.perf_counter() - t0
         if not mask.all():
             raise RuntimeError(f"RLC verify failed at n={n}")
         best = max(best, n / dt)
-    print(json.dumps({"chunk": E._MSM_WINDOW_CHUNK,
-                      "rlc_sigs_per_s": round(best, 1)}), flush=True)
+    return best
 
 
 def msm_chunk_sweep(chunks=(4, 8, 16), n: int = 256,
                     budget_s: float = 240.0) -> dict:
-    """Parent half: one subprocess per chunk value (the constant binds at
-    ops/ed25519 import, so re-binding needs a fresh interpreter — which
-    also gives each value its own jit cache and a reliable timeout).
-    Chunks that miss the budget report {"skipped": true}; a crashed or
-    hung child reports {"error": ...} — the sweep never takes the
-    headline down with it."""
-    import subprocess
-    import sys
+    """RLC throughput at quorum size n under each MSM window-chunk
+    value, IN-PROCESS: ops/ed25519.set_msm_window_chunk re-pins the
+    constant and clears the jit caches, so the sweep no longer re-execs
+    a subprocess per value (the old shape; the constant used to bind at
+    import).  Results are bit-identical across chunk values — only the
+    conv-group/scan-depth trade moves — so the sweep is pure timing.
+    Chunks that miss the budget report {"skipped": true}; a failed
+    measurement reports {"error": ...} and the default chunk is always
+    restored — the sweep never takes the headline down with it.
 
-    root = os.path.dirname(os.path.abspath(__file__))
+    The sweep PINS the lax kernel route for its duration: the chunk
+    knob only exists on the lax chunked-scan path (the pallas window
+    accumulator grids single windows — ed25519.msm_window_sums
+    documents the knob as inapplicable there), so sweeping under
+    HOTSTUFF_TPU_KERN=pallas would measure one identical program three
+    times and read as "chunk doesn't matter"."""
+    from hotstuff_tpu.ops import ed25519 as E
+    from hotstuff_tpu.ops import kern
+
     t0 = time.perf_counter()
+    default_chunk = E.msm_window_chunk()
+    ambient_mode = kern.mode()
+    msgs, pks, sigs = _make_ref_sigs(n)
     out = {}
-    for chunk in chunks:
-        left = budget_s - (time.perf_counter() - t0)
-        if left <= 0:
-            out[f"chunk{chunk}"] = {"skipped": True}
-            continue
-        env = dict(os.environ, HOTSTUFF_TPU_MSM_WINDOW_CHUNK=str(chunk))
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 f"import bench; bench.msm_chunk_probe({n})"],
-                cwd=root, env=env, capture_output=True, text=True,
-                timeout=min(left, 180.0), check=True)
-            line = json.loads(proc.stdout.strip().splitlines()[-1])
-            out[f"chunk{chunk}"] = {
-                "rlc_sigs_per_s": line["rlc_sigs_per_s"]}
-        except Exception as e:  # noqa: BLE001 — per-chunk isolation
-            detail = ""
-            if isinstance(e, subprocess.CalledProcessError):
-                detail = (e.stderr or "")[-120:]
-            out[f"chunk{chunk}"] = {"error": f"{e!r:.120}{detail}"}
+    try:
+        kern.set_mode("lax")
+        for chunk in chunks:
+            left = budget_s - (time.perf_counter() - t0)
+            if left <= 0:
+                out[f"chunk{chunk}"] = {"skipped": True}
+                continue
+            try:
+                E.set_msm_window_chunk(chunk)
+                best = _rlc_best_sigs_per_s(msgs, pks, sigs, n, repeats=2)
+                out[f"chunk{chunk}"] = {"rlc_sigs_per_s": round(best, 1)}
+            except Exception as e:  # noqa: BLE001 — per-chunk isolation
+                out[f"chunk{chunk}"] = {"error": f"{e!r:.200}"}
+    finally:
+        E.set_msm_window_chunk(default_chunk)
+        kern.set_mode(ambient_mode)
+    return out
+
+
+def roofline_estimate() -> dict:
+    """Arithmetic int-op roofline for one chip — the yardstick the
+    ``roofline`` headline measures the lax and pallas paths against.
+
+    Per-signature integer-op model of the RLC verify path (the
+    quorum-certificate steady state), from the op counts the ops/
+    modules document:
+
+      * one field mul = 32x63 MAC pairs (conv) + the wrap-38 fold +
+        4 parallel carry steps over 32 limbs (~4 ops each);
+      * decompression: ~265 muls per point (the pow_p58 chain dominates)
+        x 2 points (A, R) per signature;
+      * MSM: per-point 16-entry table build (14 point adds x 8 muls +
+        16 to_cached muls = 128 muls/point) + 64 windows of amortized
+        ~1 tree add/point (8 muls + amortized to_cached ~0.5) x
+        2 points/sig; scalar mod-L products are noise next to these.
+
+    The per-chip int-op rate defaults to a v5e-class VPU estimate
+    (8 x 128 lanes x 2 int ops/cycle x ~0.94 GHz ~= 1.9e12); override
+    with HOTSTUFF_TPU_CHIP_INT_OPS (and name the chip via
+    HOTSTUFF_TPU_CHIP) when benching other silicon.  An estimate with
+    stated knobs, not a measurement — its job is making measured
+    sigs/sec/chip numbers attributable as a fraction of the ceiling."""
+    ops_per_mul = 32 * 63 * 2 + 63 + 4 * 32 * 4          # ~4.6e3
+    muls_decompress = 2 * 265                            # A and R
+    muls_table = 2 * (14 * 8 + 16)
+    muls_windows = 2 * 64 * (8 + 4)  # tree add + amortized cached/horner
+    muls_per_sig = muls_decompress + muls_table + muls_windows
+    int_ops_per_sig = muls_per_sig * ops_per_mul
+    chip = os.environ.get("HOTSTUFF_TPU_CHIP", "v5e")
+    try:
+        chip_int_ops = float(
+            os.environ.get("HOTSTUFF_TPU_CHIP_INT_OPS", "1.9e12"))
+    except ValueError:
+        chip_int_ops = 1.9e12
+    return {
+        "model": "rlc-straus int-op estimate",
+        "field_muls_per_sig": muls_per_sig,
+        "int_ops_per_sig": int_ops_per_sig,
+        "chip": chip,
+        "chip_int_ops_per_s": chip_int_ops,
+        "roofline_sigs_per_s_chip": round(chip_int_ops / int_ops_per_sig,
+                                          1),
+    }
+
+
+def roofline_headline(sizes=(64, 256, 1024), repeats: int = 2,
+                      budget_s: float | None = None) -> dict:
+    """The headline ``roofline`` field: measured sigs/sec/chip for the
+    LAX vs PALLAS kernel routes at quorum sizes n, next to the
+    arithmetic roofline estimate — so a graftkern speedup (or
+    regression) is attributable against the same ceiling on every run.
+
+    Measures verify_batch_rlc (the QC hot path) per route via
+    ops/kern.set_mode, which clears the jit caches between routes so
+    each measurement compiles its own programs; the ambient mode is
+    restored afterwards.  Off-TPU the pallas route runs the kernel
+    INTERPRETER — orders of magnitude slower and flagged per-entry as
+    ``interpreted`` so a degraded line can never pass interpreter
+    numbers off as kernel performance.  Budget-capped like every
+    headline stage (HOTSTUFF_TPU_ROOFLINE_BUDGET, default 300 s):
+    sizes/routes that miss the budget report {"skipped": true}; a
+    failed route reports {"error": ...}.  Emitted on BOTH the live and
+    degraded JSON lines."""
+    from hotstuff_tpu.ops import kern
+
+    if budget_s is None:
+        budget_s = float(
+            os.environ.get("HOTSTUFF_TPU_ROOFLINE_BUDGET", "300"))
+    est = roofline_estimate()
+    out = {"est": est, "chips": 1, "kern_default": kern.mode()}
+    if budget_s <= 0:
+        out["skipped"] = True
+        return out
+    t0 = time.perf_counter()
+    msgs, pks, sigs = _make_ref_sigs(max(sizes), seed=29)
+    ambient = kern.mode()
+    interpreted = kern.interpret_default()
+    roof = est["roofline_sigs_per_s_chip"]
+    try:
+        for n in sizes:
+            stats = {}
+            for route in ("lax", "pallas"):
+                if time.perf_counter() - t0 > budget_s:
+                    stats[route] = {"skipped": True}
+                    continue
+                try:
+                    kern.set_mode(route)
+                    best = _rlc_best_sigs_per_s(msgs, pks, sigs, n,
+                                                repeats)
+                    entry = {"sigs_per_s_chip": round(best, 1),
+                             "pct_of_roofline": round(100.0 * best / roof,
+                                                      2)}
+                    if route == "pallas" and interpreted:
+                        entry["interpreted"] = True
+                    stats[route] = entry
+                except Exception as e:  # noqa: BLE001 — route isolation
+                    stats[route] = {"error": f"{e!r:.200}"}
+            lax_v = stats.get("lax", {}).get("sigs_per_s_chip")
+            pal_v = stats.get("pallas", {}).get("sigs_per_s_chip")
+            if lax_v and pal_v:
+                stats["pallas_speedup"] = round(pal_v / lax_v, 3)
+            out[f"n{n}"] = stats
+    finally:
+        kern.set_mode(ambient)
     return out
 
 
@@ -1086,8 +1195,12 @@ def run_degraded(reason: str):
     # The degraded stage itself must fit the REMAINING outer budget with
     # slack for the emit: the whole point of capping the probe window is
     # that this path still lands its line inside the driver's timeout.
+    # Cap raised 480 -> 900 with the roofline stage (a pallas-interpret
+    # measurement is compile-bound, ~2-4 min for one size on CPU); the
+    # budget_left guard, not the cap, is what keeps the emit inside the
+    # driver's window.
     left = max(30.0, budget_left_s() - 60.0)
-    watchdog = threading.Timer(min(480.0, left), _bail)
+    watchdog = threading.Timer(min(900.0, left), _bail)
     watchdog.daemon = True
     watchdog.start()
     try:
@@ -1120,6 +1233,22 @@ def run_degraded(reason: str):
                 max(0.0, budget_left_s() - 90.0)))
         except Exception as e:  # noqa: BLE001 — headline isolation
             mesh_rlc = {"error": f"{e!r:.120}"}
+        # graftkern roofline on the CPU backend: the estimate is always
+        # present; measured entries are CPU-backend (and the pallas
+        # route interpreter-flagged) — comparable to each other, never
+        # to TPU numbers, which the degraded flag already says.  One
+        # size: a pallas-interpret measurement is compile-bound
+        # (~2-4 min) and the larger sizes belong to a live device run
+        # (the budget check is per-route, so an in-flight measurement
+        # is never preempted — the size list is what bounds this
+        # stage under the degraded watchdog).
+        try:
+            roofline = roofline_headline(
+                sizes=(64,), repeats=1,
+                budget_s=min(240.0, max(0.0, budget_left_s() - 180.0)))
+        except Exception as e:  # noqa: BLE001 — headline isolation
+            roofline = {"est": roofline_estimate(),
+                        "error": f"{e!r:.120}"}
         try:
             sched = sched_headline_probe()
         except Exception as e:  # noqa: BLE001 — telemetry is best-effort
@@ -1144,8 +1273,8 @@ def run_degraded(reason: str):
         # Report the backend that actually ran (an already-initialized
         # device backend wins over the cpu config flip above).
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
-             note=reason, rlc=rlc, mesh_rlc=mesh_rlc, sched=sched,
-             chaos=chaos, trace=trace, surge=surge)
+             note=reason, rlc=rlc, mesh_rlc=mesh_rlc, roofline=roofline,
+             sched=sched, chaos=chaos, trace=trace, surge=surge)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -1313,18 +1442,39 @@ def main(argv=None):
     if not ok:
         run_degraded(probe_reason)
 
-    # MSM window-chunk sweep BEFORE this process binds the device: each
-    # chunk child needs the (single, tunneled) chip to itself, so the
-    # sweep must run while the only device users so far were the probe
-    # subprocesses, which have exited.  Each child carries its own
-    # subprocess timeout, so the stage is bounded by its budget without
-    # a watchdog; failures degrade to per-chunk error entries, never
-    # take the headline down.
+    # Persistent XLA compilation cache BEFORE anything compiles in this
+    # process (the in-process msm sweep below is the first compiler; the
+    # old subprocess children configured the cache themselves).
+    from hotstuff_tpu.utils.xla_cache import configure_xla_cache
+
+    configure_xla_cache()
+
+    # MSM window-chunk sweep, IN-PROCESS (set_msm_window_chunk re-pins
+    # the constant and clears the jit caches — no more subprocess per
+    # value; this process now binds the device here, which is fine: the
+    # probe subprocesses have exited and every later stage runs in this
+    # same process anyway).  Budget-guarded per chunk; failures degrade
+    # to per-chunk error entries.  The budget only checks BETWEEN
+    # chunks, and the subprocess-per-value timeout that used to bound a
+    # wedged compile is gone — so the stage runs under its own watchdog:
+    # a stalled tunneled compile emits the best cached measurement (or
+    # an error line) instead of eating the whole artifact (the rc=124
+    # failure mode the module header documents).
+    def _msm_abort():
+        emit_cached_or_fail("msm chunk sweep wedged (stage watchdog)")
+
+    msm_budget = float(
+        os.environ.get("HOTSTUFF_TPU_MSM_SWEEP_BUDGET", "180"))
+    msm_watchdog = threading.Timer(
+        min(msm_budget + 120.0,
+            max(60.0, budget_left_s() - _DEADLINE_SLACK)), _msm_abort)
+    msm_watchdog.daemon = True
+    msm_watchdog.start()
     try:
-        msm = msm_chunk_sweep(budget_s=float(
-            os.environ.get("HOTSTUFF_TPU_MSM_SWEEP_BUDGET", "180")))
+        msm = msm_chunk_sweep(budget_s=msm_budget)
     except Exception as e:  # noqa: BLE001
         msm = {"error": f"{e!r:.200}"}
+    msm_watchdog.cancel()
 
     # mesh_rlc headline: a forced-host CPU-mesh subprocess (no device
     # contention with the stages below), budgeted so the main headline
@@ -1341,13 +1491,6 @@ def main(argv=None):
         min(900.0, max(60.0, budget_left_s() - _DEADLINE_SLACK)), _abort)
     watchdog.daemon = True
     watchdog.start()
-
-    # Persistent XLA compilation cache (same dir the sidecar uses): the
-    # driver runs this script in a cold process, and the chunked-verify
-    # program costs 30-60 s to compile through the tunnel.
-    from hotstuff_tpu.utils.xla_cache import configure_xla_cache
-
-    configure_xla_cache()
 
     from hotstuff_tpu.ops import field25519
 
@@ -1382,7 +1525,10 @@ def main(argv=None):
     # checks between sizes; a single stalled compile needs the timer.)
     def _rlc_abort():
         emit_final(tpu, cpu, rlc={"error": "rlc stage watchdog (420s)"},
-                   msm_window_chunk=msm, mesh_rlc=mesh_rlc)
+                   msm_window_chunk=msm, mesh_rlc=mesh_rlc,
+                   roofline={"est": roofline_estimate(),
+                             "skipped": True,
+                             "note": "rlc stage watchdog fired first"})
         os._exit(0)
 
     rlc_watchdog = threading.Timer(420.0, _rlc_abort)
@@ -1394,6 +1540,32 @@ def main(argv=None):
     except Exception as e:  # noqa: BLE001 — headline must not die on rlc
         rlc = {"error": f"{e!r:.200}"}
     rlc_watchdog.cancel()
+    # graftkern roofline: lax vs pallas sigs/sec/chip against the
+    # arithmetic ceiling, derated against what is left of the outer
+    # budget.  A Mosaic failure on new silicon degrades to a per-route
+    # error entry; a Mosaic compile that WEDGES needs the timer (the
+    # budget only checks between routes) — on fire, the already-measured
+    # fields still ship instead of dying with the stage.
+    def _roofline_abort():
+        emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
+                   mesh_rlc=mesh_rlc,
+                   roofline={"est": roofline_estimate(),
+                             "error": "roofline stage watchdog"})
+        os._exit(0)
+
+    roofline_budget = min(
+        float(os.environ.get("HOTSTUFF_TPU_ROOFLINE_BUDGET", "300")),
+        max(0.0, budget_left_s() - _DEADLINE_SLACK))
+    roofline_watchdog = threading.Timer(
+        min(max(60.0, roofline_budget + 180.0),
+            max(60.0, budget_left_s() - 60.0)), _roofline_abort)
+    roofline_watchdog.daemon = True
+    roofline_watchdog.start()
+    try:
+        roofline = roofline_headline(budget_s=roofline_budget)
+    except Exception as e:  # noqa: BLE001 — headline isolation
+        roofline = {"error": f"{e!r:.200}"}
+    roofline_watchdog.cancel()
     try:
         sched = sched_headline_probe()
     except Exception as e:  # noqa: BLE001 — telemetry is best-effort
@@ -1411,8 +1583,8 @@ def main(argv=None):
     except Exception as e:  # noqa: BLE001 — surge probe is best-effort
         surge = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
-               mesh_rlc=mesh_rlc, sched=sched, chaos=chaos, trace=trace,
-               surge=surge)
+               mesh_rlc=mesh_rlc, roofline=roofline, sched=sched,
+               chaos=chaos, trace=trace, surge=surge)
 
 
 if __name__ == "__main__":

@@ -54,6 +54,12 @@ from .common import Finding, apply_suppressions, parse_source, \
 # with --must-cover).
 DEFAULT_TARGETS = (
     "hotstuff_tpu/ops",
+    # graftkern: the ops/ scan is non-recursive (os.listdir), so the
+    # Pallas kernel subpackage must be its own target — every kernel
+    # body is jit-reachable device code where a stray host sync or an
+    # implicit dtype is the exact silent-degradation class this scan
+    # exists for (lint_gate pins each module with --must-cover).
+    "hotstuff_tpu/ops/kern",
     "hotstuff_tpu/parallel",
     "hotstuff_tpu/sidecar/service.py",
     "hotstuff_tpu/sidecar/sched",

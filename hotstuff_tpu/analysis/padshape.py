@@ -37,6 +37,15 @@ Rules:
                   on the engine thread mid-traffic.  next_pow2 alone is
                   NOT sufficient there: the power-of-two discipline must
                   be applied per shard, which only the helpers encode.
+  pallas-interpret-in-prod
+                  An ``interpret=True`` LITERAL on a pallas_call outside
+                  the graftkern backend probe (ops/kern/backend.py's
+                  interpret_default): production kernels must select
+                  interpreter mode OFF THE BACKEND at trace time
+                  (``interpret=interpret_default()``), or a TPU
+                  deployment silently runs the Pallas interpreter —
+                  orders of magnitude slower, invisible to CPU unit
+                  tests (which run interpreted either way).
 """
 
 from __future__ import annotations
@@ -49,11 +58,15 @@ from .common import (Finding, _eval_int, apply_suppressions,
                      module_int_constants, parse_source, read_source)
 from .hotpath import _attr_chain
 
-# The modules whose functions launch padded device programs.
+# The modules whose functions launch padded device programs.  The
+# graftkern dir rides the scan so the pallas-interpret-in-prod rule
+# sees every kernel module (directories scan non-recursively, like the
+# hotpath checker's).
 DEFAULT_TARGETS = (
     "hotstuff_tpu/crypto/eddsa.py",
     "hotstuff_tpu/parallel/sharded_verify.py",
     "hotstuff_tpu/sidecar/sched/shapes.py",
+    "hotstuff_tpu/ops/kern",
 )
 
 # The MESH-path modules: launch sizing there must go through the
@@ -202,6 +215,53 @@ def _check_shard_alignment(path: str, source: str) -> list:
     return findings
 
 
+# The one function allowed to pin interpret mode with a literal: the
+# backend probe itself — qualified by BOTH module and name, so a shim
+# merely NAMED interpret_default in some other kernel module cannot
+# claim the exemption (ops/kern/backend.interpret_default reads the
+# backend; interpret_probe carries a worked suppression).
+_INTERPRET_EXEMPT = {("hotstuff_tpu/ops/kern/backend.py",
+                      "interpret_default")}
+
+
+def _check_pallas_interpret(path: str, source: str) -> list:
+    """The pallas-interpret-in-prod rule over one module: flag
+    ``interpret=True`` literals on ``pallas_call`` invocations whose
+    enclosing function is not the backend probe."""
+    findings = []
+    tree = parse_source(source, path)
+    norm = path.replace(os.sep, "/")
+
+    def visit(node, fname):
+        for child in ast.iter_child_nodes(node):
+            child_fname = fname
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_fname = child.name
+            if isinstance(child, ast.Call):
+                name = _terminal_name(child)
+                if name == "pallas_call" and \
+                        (norm, fname) not in _INTERPRET_EXEMPT:
+                    for kw in child.keywords:
+                        if kw.arg == "interpret" and \
+                                isinstance(kw.value, ast.Constant) and \
+                                kw.value.value is True:
+                            findings.append(Finding(
+                                path, kw.value.lineno,
+                                "pallas-interpret-in-prod",
+                                f"{fname or '<module>'}() pins "
+                                "interpret=True on a pallas_call: a TPU "
+                                "deployment would silently run the "
+                                "Pallas interpreter; select off the "
+                                "backend via ops/kern/backend."
+                                "interpret_default() (or suppress with "
+                                "a rationale for a forced-interpreter "
+                                "probe)"))
+            visit(child, child_fname)
+
+    visit(tree, None)
+    return findings
+
+
 def _line_of(source: str, pattern: str) -> int:
     m = re.search(pattern, source, re.MULTILINE)
     return source[:m.start()].count("\n") + 1 if m else 1
@@ -307,11 +367,13 @@ def _check_warmup_constants(root: str) -> list:
 
 def check_sources(sources: dict) -> list:
     """Lint a {path: python source} mapping (unit-test entry point):
-    launch-bucketing + (for mesh-path modules) shard alignment — the
-    warmup constant cross-check needs the real tree (see check)."""
+    launch-bucketing + pallas-interpret literals + (for mesh-path
+    modules) shard alignment — the warmup constant cross-check needs
+    the real tree (see check)."""
     findings = []
     for path, src in sources.items():
         findings += _check_launch_bucketing(path, src)
+        findings += _check_pallas_interpret(path, src)
         if path in MESH_TARGETS:
             findings += _check_shard_alignment(path, src)
     return sorted(apply_suppressions(findings, sources),
@@ -322,6 +384,15 @@ def check(root: str, targets=DEFAULT_TARGETS) -> list:
     sources = {}
     for rel in targets:
         path = os.path.join(root, rel)
+        if os.path.isdir(path):
+            for f in sorted(os.listdir(path)):
+                if f.endswith(".py"):
+                    try:
+                        sources[f"{rel}/{f}"] = read_source(
+                            os.path.join(path, f))
+                    except OSError:
+                        continue
+            continue
         try:
             sources[rel] = read_source(path)
         except OSError:

@@ -137,6 +137,14 @@ class LocalBench:
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        # graftkern: the sidecar child gets the repo-local persistent
+        # compile cache by default, so warm boots deserialize programs
+        # instead of recompiling (the same dir bench.py and the warmup
+        # manifest use).  An exported HOTSTUFF_TPU_XLA_CACHE always wins
+        # — including an EMPTY value, which disables the cache.
+        if "HOTSTUFF_TPU_XLA_CACHE" not in env:
+            env["HOTSTUFF_TPU_XLA_CACHE"] = os.path.join(
+                pkg_root, "results", "compile_cache", "xla")
         proc = subprocess.Popen(
             ["/bin/sh", "-c", cmd], preexec_fn=os.setsid, env=env)
         self._procs.append((name, proc))

@@ -527,6 +527,16 @@ class LogParser:
                     f"{pipe.get('overlap_ratio', 0.0):.0%} of "
                     f"{pipe['pack_ms']:g} ms packing hidden behind "
                     "device execution")
+            comp = stats.get("compile", {})
+            if isinstance(comp, dict) and \
+                    (comp.get("hits") or comp.get("misses")):
+                boot = "warm boot" if comp.get("warm_boot") else "cold boot"
+                lines.append(
+                    f"Sidecar compile cache: {comp.get('hits', 0)} "
+                    f"hit(s), {comp.get('misses', 0)} miss(es) — {boot}, "
+                    f"warmup {comp.get('warmup_wall_s', 0):g} s"
+                    + (f" (kernel {comp['kernel']})"
+                       if comp.get("kernel") else ""))
             full = stats.get("queue_full", {})
             if any(full.values()):
                 lines.append("Sidecar queue-full sheds: " + ", ".join(

@@ -615,12 +615,39 @@ verify_prepared_jit = jax.jit(verify_prepared)
 # form on a SIMD machine.  The per-point tables cost 2n*16 points of
 # memory (~128 KB at n=512), which is noise next to the conv workspace.
 
+from . import kern as _kern  # noqa: E402  (graftkern Pallas route)
 from . import scalar25519 as S  # noqa: E402  (device scalar arithmetic)
 
 _MSM_WINDOW_CHUNK = int(_os.environ.get("HOTSTUFF_TPU_MSM_WINDOW_CHUNK",
                                         "8"))
 if 64 % _MSM_WINDOW_CHUNK != 0:
     raise ValueError("HOTSTUFF_TPU_MSM_WINDOW_CHUNK must divide 64")
+
+
+def msm_window_chunk() -> int:
+    """The Straus window-chunk size — env-pinned once at import
+    (HOTSTUFF_TPU_MSM_WINDOW_CHUNK, default 8), re-pinnable in-process
+    via :func:`set_msm_window_chunk`.  Read at trace time by
+    msm_window_sums, so the v5e sweep (bench.py msm_chunk_sweep) can
+    measure every value from ONE process instead of re-exec'ing a
+    subprocess per value."""
+    return _MSM_WINDOW_CHUNK
+
+
+def set_msm_window_chunk(chunk: int) -> None:
+    """Re-pin the window-chunk size in-process.  Clears the global jit
+    caches: every compiled MSM program baked the chunk it was traced
+    with, so a stale trace would keep the old scan shape.  The chunk
+    only trades conv group count against scan depth — results are
+    bit-identical across values (asserted in tests/test_kern.py)."""
+    global _MSM_WINDOW_CHUNK
+    if not isinstance(chunk, int) or chunk < 1 or 64 % chunk != 0:
+        raise ValueError(
+            f"msm window chunk must be a positive divisor of 64, "
+            f"got {chunk!r}")
+    if chunk != _MSM_WINDOW_CHUNK:
+        _MSM_WINDOW_CHUNK = chunk
+        jax.clear_caches()
 
 
 def msm_table(points: jnp.ndarray) -> jnp.ndarray:
@@ -666,7 +693,22 @@ def msm_window_sums(points: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
             [points, identity_ext((b_pad - b,))], axis=0)
         digits = jnp.pad(digits, [(0, b_pad - b), (0, 0)])
     table = msm_table(points)                        # (B, 16, 4, 32)
-    chunk = _MSM_WINDOW_CHUNK
+    if _kern.use_pallas():
+        # graftkern route: selection + tree fused per window, window
+        # sums bit-identical to the chunked scan below (the chunk knob
+        # does not apply — the kernel grids over single windows).
+        return _kern.msm_window_accum(table, digits)
+    return _window_sums_lax(table, digits)
+
+
+def _window_sums_lax(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    """The lax reference window accumulator (and the
+    HOTSTUFF_TPU_KERN=lax route): per-window table selection + masked
+    tree reduction, windows processed in chunks of msm_window_chunk()
+    inside one lax.scan.  ``table`` (B, 16, 4, 32) from msm_table,
+    ``digits`` (B, 64) with B already a power of two."""
+    b_pad = digits.shape[0]
+    chunk = msm_window_chunk()
     # (64, B) MSB-first -> (64/chunk, chunk, B)
     dig = jnp.moveaxis(digits, -1, 0).reshape(64 // chunk, chunk, b_pad)
 

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import kern as _kern
+
 NLIMBS = 32
 LIMB_BITS = 8
 LIMB_MASK = (1 << LIMB_BITS) - 1
@@ -142,6 +144,19 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """a * b mod p (weak).
+
+    Routed: ``HOTSTUFF_TPU_KERN=pallas`` dispatches the graftkern fused
+    kernel (ops/kern/field_mul — conv + wrap-38 fold + carries in one
+    VMEM-resident pass), bit-identical to the lax reference below; the
+    route is read at trace time (ops/kern.set_mode clears the caches).
+    """
+    if _kern.use_pallas():
+        return _kern.field_mul(a, b)
+    return _mul_lax(a, b)
+
+
+def _mul_lax(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """The lax reference multiply (and the HOTSTUFF_TPU_KERN=lax route).
 
     The schoolbook product is a depthwise (per-signature-kernel) 1-D
     convolution: out[b] = a[b] conv b[b], exactly

@@ -1,0 +1,98 @@
+"""graftkern: hand-laid Pallas kernels for the ed25519 verify hot path.
+
+The lax-op modules (ops/field25519, ops/ed25519, ops/scalar25519) leave
+XLA to schedule the limb arithmetic however it likes; this layer fuses
+the three dominant primitives into Pallas kernels tuned to the VPU's
+(8, 128) tile shape, behind the EXISTING public op signatures — the
+scheduler / engine / sharding stack above is untouched, and the sharded
+entries in parallel/sharded_verify.py route per-shard window sums
+through the same kernels because they call the same ops:
+
+  field_mul        the 32-limb byte convolution + wrap-38 parallel carry
+                   of field25519.mul as ONE kernel, carry-save limbs in
+                   a 128-lane vector, rows batched over sublanes
+                   (ops/kern/field_mul.py).
+  msm_window_accum the Straus inner loop — per-window 16-entry table
+                   gather (one-hot masked sum) + the masked point-add
+                   tree that dominates ed25519.msm_window_sums — fused
+                   so window sums never round-trip through HBM between
+                   limb ops (ops/kern/msm_accum.py).
+  scalar_mont_mul  the mod-L Montgomery multiply (REDC at R = 2^256)
+                   of scalar25519.mont_mul (ops/kern/scalar_mont.py).
+
+Selection: ``HOTSTUFF_TPU_KERN=lax|pallas`` (read ONCE, at first use;
+``set_mode`` re-pins it in-process and clears the jit caches so routed
+programs re-trace).  The lax implementations stay in-tree as the
+bit-identical reference and fallback — every kernel is property-tested
+bit-identical against them (tests/test_kern.py), and the default stays
+``lax`` until a real-device measurement re-pins it (bench.py's
+``roofline`` headline is that measurement).
+
+CPU story: each kernel selects ``interpret=`` off the backend at trace
+time (ops/kern/backend.interpret_default) — on anything but a TPU the
+kernels run through the Pallas interpreter, so tier-1 stays
+CPU-runnable and the property sweeps exercise the exact kernel bodies a
+TPU would compile.  Every pallas_call is wrapped in its own ``jax.jit``
+so the per-call-site trace cost is paid once per shape, not once per
+call site (~0.4 s/site -> ~4 ms/site measured; the verify program has
+hundreds of mul sites).
+"""
+
+from __future__ import annotations
+
+import os
+
+_VALID_MODES = ("lax", "pallas")
+_mode: str | None = None
+
+
+def mode() -> str:
+    """The kernel route, read ONCE from HOTSTUFF_TPU_KERN at first use
+    (lazy, like the backend probe: importing this package must stay
+    side-effect-free)."""
+    global _mode
+    if _mode is None:
+        raw = os.environ.get("HOTSTUFF_TPU_KERN", "lax").strip().lower()
+        m = raw or "lax"
+        if m not in _VALID_MODES:
+            raise ValueError(
+                f"HOTSTUFF_TPU_KERN must be one of {_VALID_MODES}, "
+                f"got {raw!r}")
+        _mode = m
+    return _mode
+
+
+def use_pallas() -> bool:
+    """True when the routed ops (field25519.mul, ed25519.msm_window_sums,
+    scalar25519.mont_mul) should dispatch the Pallas kernels.  Read at
+    TRACE time by the routers, so a cached jit keeps the route it was
+    traced with — which is why set_mode clears the caches."""
+    return mode() == "pallas"
+
+
+def set_mode(m: str) -> None:
+    """Re-pin the kernel route in-process (bench.py's roofline headline
+    measures both routes from one process).  Clears the global jit
+    caches: every routed program read use_pallas() at trace time, so a
+    stale trace would keep dispatching the old route."""
+    global _mode
+    if m not in _VALID_MODES:
+        raise ValueError(f"kern mode must be one of {_VALID_MODES}, "
+                         f"got {m!r}")
+    if m != mode():
+        import jax
+
+        _mode = m
+        jax.clear_caches()
+
+
+from .backend import interpret_default, interpret_probe  # noqa: E402
+from .field_mul import field_mul  # noqa: E402
+from .msm_accum import msm_window_accum  # noqa: E402
+from .scalar_mont import scalar_mont_mul  # noqa: E402
+
+__all__ = [
+    "mode", "set_mode", "use_pallas",
+    "interpret_default", "interpret_probe",
+    "field_mul", "msm_window_accum", "scalar_mont_mul",
+]

@@ -1,0 +1,53 @@
+"""graftkern kernel 1: fused GF(2^255-19) multiply.
+
+One Pallas kernel fuses what the lax path spreads over a
+conv_general_dilated launch plus five elementwise passes: the 32-limb
+byte convolution, the wrap-38 fold, and the four parallel carry steps —
+all on a carry-save accumulator that lives in the (rows, 128) padded
+layout the whole time (fieldops module notes), so intermediate
+coefficients never leave VMEM.  Batched over the row dimension: the
+grid walks row blocks of up to fieldops.BLOCK_ROWS (multiples of the
+8-sublane tile), one block per grid step.
+
+Bit-identity: the kernel body is fieldops.f_mul, a transliteration of
+field25519.mul with identical carry structure — pure int32, exact, so
+outputs match the lax reference limb for limb (tests/test_kern.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import fieldops as FK
+from .backend import interpret_default
+
+
+def _field_mul_kernel(a_ref, b_ref, o_ref):
+    o_ref[:] = FK.f_mul(a_ref[:], b_ref[:])
+
+
+# jit-wrapped so the pallas trace is paid once per SHAPE, not once per
+# call site — the verify program reaches this from hundreds of mul
+# sites (see the kern package docstring for the measured difference).
+@jax.jit
+def _mul_rows(a_pad: jnp.ndarray, b_pad: jnp.ndarray) -> jnp.ndarray:
+    rows = a_pad.shape[0]
+    block, _ = FK.row_block(rows)
+    return pl.pallas_call(
+        _field_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, FK.NLANES), jnp.int32),
+        grid=(rows // block,),
+        in_specs=[pl.BlockSpec((block, FK.NLANES), lambda i: (i, 0))] * 2,
+        out_specs=pl.BlockSpec((block, FK.NLANES), lambda i: (i, 0)),
+        interpret=interpret_default(),
+    )(a_pad, b_pad)
+
+
+def field_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a * b mod p for weak (..., 32) int32 limb arrays — the Pallas
+    route of field25519.mul (same signature, bit-identical result).
+    Batch flattening / lane padding / row-block plumbing is the shared
+    fieldops.launch_rows wrapper."""
+    return FK.launch_rows(_mul_rows, a, b)

@@ -1,0 +1,102 @@
+"""graftkern kernel 2: the Straus MSM window accumulator.
+
+The inner loop that dominates ed25519.msm_window_sums — per-window
+16-entry table selection plus the masked binary-tree point-add fold
+over the batch — fused into one kernel so a window's selected points,
+cached forms and every tree level's intermediate limbs stay in VMEM:
+the lax path round-trips each of those through XLA-scheduled buffers
+between the gather and every point_add's eight conv launches.
+
+Shape: ONE kernel invocation holds the whole per-point table and loops
+the 64 MSB-first nibble windows with an in-kernel ``lax.fori_loop`` —
+the loop body (selection + tree) traces once, and the table is read
+into VMEM once for all 64 windows instead of once per window (the
+grid-per-window form re-fetched it 64x AND unrolled the tree 64x into
+the program, which priced the interpreter out of the CPU test lane).
+Selection is a ONE-HOT MASKED SUM (exact for int32 limbs, and the
+vector-friendly form — no gather unit dependency); identity table
+entries make padding and digit-0 rows vanish without a separate mask,
+the same trick as the lax path.
+
+VMEM envelope: the table is B * 8 KB (8 MB at the B = 1024 launch cap)
+— inside the ~16 MB budget with the output and tree temporaries, and
+per-shard batches on the mesh path are far smaller.
+
+Bit-identity: the tree replays ed25519._tree_sum's exact order
+(point_add(pts[:m], to_cached(pts[m:])), halving) with the fieldops
+transliterations of add_t/to_cached_t, so window sums match the lax
+reference limb for limb.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import fieldops as FK
+from .backend import interpret_default
+
+_WINDOWS = 64
+_TABLE = 16
+
+
+def _msm_kernel(tab_ref, dig_ref, o_ref):
+    b = tab_ref.shape[0]
+    tab = tab_ref[:]                                       # (B, 16, 4, 32)
+    digs = dig_ref[:]                                      # (B, 64)
+    entry_iota = jax.lax.broadcasted_iota(jnp.int32, (b, _TABLE), 1)
+
+    def window(j, carry):
+        dig = jax.lax.dynamic_slice(digs, (0, j), (b, 1))[:, 0]
+        onehot = (dig[:, None] == entry_iota).astype(jnp.int32)
+        coords = []
+        for c in range(4):
+            sel = jnp.sum(tab[:, :, c, :] * onehot[:, :, None], axis=1)
+            coords.append(
+                jnp.pad(sel, [(0, 0), (0, FK.NLANES - FK.NLIMBS)]))
+        pts = tuple(coords)
+        m = b
+        while m > 1:                                       # _tree_sum order
+            m //= 2
+            first = tuple(c[:m] for c in pts)
+            second = tuple(c[m:] for c in pts)
+            pts = FK.add_cached(first, FK.to_cached(second))
+        for c in range(4):
+            o_ref[j, c, :] = pts[c][0, :FK.NLIMBS]
+        return carry
+
+    jax.lax.fori_loop(0, _WINDOWS, window, 0)
+
+
+# jit-wrapped: one pallas trace per (B,) shape (kern package docstring).
+@jax.jit
+def _accum(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    return pl.pallas_call(
+        _msm_kernel,
+        out_shape=jax.ShapeDtypeStruct((_WINDOWS, 4, FK.NLIMBS),
+                                       jnp.int32),
+        interpret=interpret_default(),
+    )(table, digits)
+
+
+def msm_window_accum(table: jnp.ndarray,
+                     digits: jnp.ndarray) -> jnp.ndarray:
+    """Per-window Straus sums from a prebuilt table — the Pallas route
+    of the selection + tree half of ed25519.msm_window_sums.
+
+    Args:
+      table:  (B, 16, 4, 32) int32 ext tables (ed25519.msm_table; entry
+              0 is the identity, so padding/excluded rows select it).
+      digits: (B, 64) int32 MSB-first 4-bit windows.  B must be a power
+              of two (msm_window_sums pads before calling).
+    Returns:
+      (64, 4, 32) int32 MSB-first window sums, bit-identical to the lax
+      chunked-scan path.
+    """
+    b = table.shape[0]
+    if b < 1 or b & (b - 1):
+        raise ValueError(
+            f"msm_window_accum batch must be a power of two, got {b}")
+    return _accum(jnp.asarray(table, jnp.int32),
+                  jnp.asarray(digits, jnp.int32))

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import field25519 as F
+from . import kern as _kern
 from ..utils.intmath import L
 
 NLIMBS = 32
@@ -156,6 +157,19 @@ def mont_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     input may range up to 2^256 - 1 if the other stays < L — the
     ``reduce512_mod_l`` high-half path uses that headroom).  Returns
     canonical bytes < L.
+
+    Routed: ``HOTSTUFF_TPU_KERN=pallas`` dispatches the graftkern fused
+    REDC kernel (ops/kern/scalar_mont), bit-identical to the lax
+    reference below; ``mul_mod_l``/``reduce512_mod_l`` compose this
+    primitive, so the route covers them too.
+    """
+    if _kern.use_pallas():
+        return _kern.scalar_mont_mul(a, b)
+    return _mont_mul_lax(a, b)
+
+
+def _mont_mul_lax(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """The lax reference REDC (and the HOTSTUFF_TPU_KERN=lax route).
 
     REDC with byte-aligned R: T = a*b; m = (T mod R) * L' mod R;
     U = T + m*L is divisible by R, so U >> 256 is limb slicing after one

@@ -197,7 +197,12 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
 # The RLC check (crypto/eddsa.verify_batch_rlc) splits mesh-natively:
 # window sums of an MSM over disjoint point shards simply point-add
 # together, and the fixed-base scalar sum is a limb-wise integer sum that
-# commutes with an ICI psum.  So each chip runs the shard-local half
+# commutes with an ICI psum.  The per-shard window sums route through
+# the SAME graftkern Pallas kernels as the single-chip path when
+# HOTSTUFF_TPU_KERN=pallas — the shard body calls ops/ed25519
+# (rlc_partials -> msm_window_sums / scalar25519.mont_mul), and the
+# kernel route lives behind those signatures, so mesh launches pick it
+# up with zero changes here.  So each chip runs the shard-local half
 # (ops/ed25519.rlc_partials — decompression, mod-L scalar products,
 # per-point tables, masked tree reduction to 64 window sums), the mesh
 # exchanges 64 points + 32 limbs + 1 counter per chip (an all_gather and

@@ -179,6 +179,11 @@ class VerifyEngine:
         # may launch on device — others verify on host so a surprise TC
         # size can never wedge this thread mid-traffic.
         self._bls_multi_warmed: set[int] = set()
+        # graftkern compile accounting: serve() attaches a CompileTracker
+        # (utils/xla_cache) on device-mode boots so the warmup's manifest
+        # hit/miss counts and wall time ride the OP_STATS ``compile``
+        # section; host-mode engines compile nothing and keep None.
+        self.compile_tracker = None
         # (msg, pk, sig) -> bool verdict; see _cache_verdict.
         self._verdicts: dict = {}
         self._mesh = None
@@ -227,6 +232,8 @@ class VerifyEngine:
         snap["shapes"] = self._shapes.snapshot()
         snap["queue_caps"] = self._sched.queue_caps()
         snap["verdict_cache_entries"] = len(self._verdicts)
+        if self.compile_tracker is not None:
+            snap["compile"] = self.compile_tracker.snapshot()
         return snap
 
     def cached_verdicts(self, request):
@@ -906,12 +913,21 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
     # silently stalls every client for the whole compile — the round-2
     # 0-TPS failure mode.)
     if not use_host:
-        _enable_compilation_cache()
+        cache_dir = _enable_compilation_cache()
+        # graftkern compile accounting: every warmup shape below runs
+        # under the tracker, so OP_STATS ``compile`` reports manifest
+        # hits/misses + warmup wall time and a second boot against a
+        # populated cache proves itself (misses == 0, lower wall).
+        from ..utils.xla_cache import CompileTracker
+
+        tracker = CompileTracker(cache_dir=cache_dir)
+        engine.compile_tracker = tracker
         _warmup(engine, warm_max)
         if warm_bls:
-            _warmup_bls()
+            tracker.warm("bls:pairing", _warmup_bls)
         if warm_bls_multi:
-            _warmup_bls_multi(engine, warm_bls_multi)
+            tracker.warm(f"bls_multi:{warm_bls_multi}",
+                         lambda: _warmup_bls_multi(engine, warm_bls_multi))
         if warm_bulk:
             # Covers both the single-device chunked scan and the mesh path:
             # verify_batch_sharded buckets per-shard sizes to powers of two,
@@ -930,6 +946,12 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
             # RLC_MIN_LAUNCH+ unique records down the sharded MSM path
             # with its bisection fallback already compiled.
             _warmup_rlc_sharded(engine, warm_max)
+        tracker.finish()
+        log.info(
+            "warmup compile cache: %d hit(s), %d miss(es) in %.1fs "
+            "(kernel %s%s)", tracker.hits, tracker.misses,
+            tracker.wall_s(), tracker.kernel,
+            "" if cache_dir else "; XLA disk cache OFF")
     chaos_state = None
     if chaos:
         chaos_state = ChaosState()
@@ -951,10 +973,12 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
 
 def _enable_compilation_cache():
     """Persist XLA compilations across sidecar restarts; the BLS pairing
-    program alone is minutes of compile, paid once per cache dir."""
+    program alone is minutes of compile, paid once per cache dir.
+    Returns the cache dir (None when disabled) for the CompileTracker's
+    OP_STATS ``compile`` section."""
     from ..utils.xla_cache import configure_xla_cache
 
-    configure_xla_cache()
+    return configure_xla_cache()
 
 
 def _warmup_bls(n_pks: int = 3):
@@ -994,6 +1018,16 @@ def _warmup_bls_multi(engine, n_votes: int):
              n_votes, monotonic() - t0)
 
 
+def _warmed(engine, key: str, thunk):
+    """Run one warmup shape, through the engine's CompileTracker when
+    one is attached (device boots) so the manifest hit/miss accounting
+    sees every shape; bare otherwise (tests, host mode)."""
+    tracker = getattr(engine, "compile_tracker", None)
+    if tracker is not None:
+        return tracker.warm(key, thunk)
+    return thunk()
+
+
 def _warm_shapes(engine, start: int, stop: int, label: str):
     """Compile padded batch shapes start, 2*start, ... stop through the
     engine's own verify path so the exact jitted callables are cached,
@@ -1007,9 +1041,13 @@ def _warm_shapes(engine, start: int, stop: int, label: str):
     n = start
     while n <= stop:
         t0 = monotonic()
-        mask = engine._verify([msg] * n, [pk] * n, [sig] * n)
-        if not all(mask):
-            log.error("%s verify returned false at N=%d", label, n)
+
+        def _one(n=n):
+            mask = engine._verify([msg] * n, [pk] * n, [sig] * n)
+            if not all(mask):
+                log.error("%s verify returned false at N=%d", label, n)
+
+        _warmed(engine, f"{label.replace(' ', '_')}:{n}", _one)
         if n <= MAX_SUBBATCH:
             engine._shapes.mark_bucket(n)
         else:
@@ -1069,16 +1107,21 @@ def _warmup_rlc_sharded(engine, warm_max: int = MAX_SUBBATCH):
     while per <= top:
         n = n_dev * per
         t0 = monotonic()
-        # One prep serves both programs: neither pack entry mutates the
-        # host dict (padding copies before device_put).
-        prep = eddsa.prepare_batch([msg] * n, [pk] * n, [sig] * n)
-        mask = shv.verify_batch_sharded_pack(engine._mesh, prep)()()
-        if not all(mask):
-            log.error("sharded warmup verify returned false at N=%d", n)
-        mask = shv.verify_rlc_sharded_pack(engine._mesh, prep)()()
-        if not all(mask):
-            log.error("RLC sharded warmup verify returned false at N=%d",
-                      n)
+
+        def _one(n=n):
+            # One prep serves both programs: neither pack entry mutates
+            # the host dict (padding copies before device_put).
+            prep = eddsa.prepare_batch([msg] * n, [pk] * n, [sig] * n)
+            mask = shv.verify_batch_sharded_pack(engine._mesh, prep)()()
+            if not all(mask):
+                log.error("sharded warmup verify returned false at N=%d",
+                          n)
+            mask = shv.verify_rlc_sharded_pack(engine._mesh, prep)()()
+            if not all(mask):
+                log.error("RLC sharded warmup verify returned false "
+                          "at N=%d", n)
+
+        _warmed(engine, f"rlc_sharded:{n_dev}x{per}", _one)
         engine._shapes.mark_bucket(n)
         engine._shapes.mark_rlc_sharded(n)
         log.info("RLC sharded warmup N=%d (per-shard bucket %d) done "
@@ -1109,9 +1152,13 @@ def _warmup_rlc(engine, warm_max: int = MAX_SUBBATCH):
     n = 8  # == crypto/eddsa._MIN_BUCKET, the smallest padded shape
     while n <= min(warm_max, MAX_SUBBATCH):
         t0 = monotonic()
-        mask = eddsa.verify_batch_rlc([msg] * n, [pk] * n, [sig] * n)
-        if not all(mask):
-            log.error("RLC warmup verify returned false at N=%d", n)
+
+        def _one(n=n):
+            mask = eddsa.verify_batch_rlc([msg] * n, [pk] * n, [sig] * n)
+            if not all(mask):
+                log.error("RLC warmup verify returned false at N=%d", n)
+
+        _warmed(engine, f"rlc:{n}", _one)
         engine._shapes.mark_rlc(n)
         log.info("RLC warmup N=%d done in %.1fs", n, monotonic() - t0)
         n *= 2

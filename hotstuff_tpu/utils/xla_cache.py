@@ -1,25 +1,252 @@
-"""Persistent XLA compilation cache shared by every TPU-touching entrypoint
-(sidecar, bench): cold processes reuse compiled programs instead of paying
-30-60 s per shape through the tunneled device."""
+"""Persistent compiled-program cache shared by every TPU-touching
+entrypoint (sidecar, bench): cold processes reuse compiled programs
+instead of paying 30-60 s per shape through the tunneled device.
+
+Two layers:
+
+* The XLA compilation cache (:func:`configure_xla_cache`): jax persists
+  compiled executables to a shared on-disk dir, so a warm boot's
+  "compile" is a fast deserialization.
+* The warmed-shape manifest (:class:`CompileManifest`,
+  ``results/compile_cache/manifest.json``): records which (shape key,
+  kernel-source hash) pairs a warmup has already compiled — keyed on
+  the SAME kernel-source hash scheme bench.py uses for its headline
+  cache (:func:`kernel_fingerprint`), so a kernel edit invalidates the
+  record exactly when it invalidates the programs.  The sidecar's
+  warmup walks its shapes through :class:`CompileTracker`, which counts
+  manifest hits/misses and per-shape wall time into the OP_STATS
+  ``compile`` section; ``scripts/warmup_report.py`` turns the recorded
+  runs into the cold-vs-warm boot comparison.
+"""
 
 from __future__ import annotations
 
+import glob
+import hashlib
+import json
 import logging
 import os
+import time
 
 log = logging.getLogger("xla-cache")
 
+MANIFEST_SCHEMA = "hotstuff-tpu-compile-manifest-v1"
+_MAX_RUNS = 50
+
+# The sources whose edits can change what a compiled verify program
+# does: a manifest entry (and a cached bench headline) is only
+# comparable to a boot built from the same kernel.  The kern glob keeps
+# new Pallas modules inside the hash automatically.
+KERNEL_SOURCES = (
+    "hotstuff_tpu/ops/ed25519.py",
+    "hotstuff_tpu/ops/field25519.py",
+    "hotstuff_tpu/ops/scalar25519.py",
+    "hotstuff_tpu/crypto/eddsa.py",
+)
+KERNEL_SOURCE_GLOBS = ("hotstuff_tpu/ops/kern/*.py",)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def kernel_fingerprint(extra=()) -> str:
+    """Hash of the kernel sources (plus any caller-specific ``extra``
+    repo-relative files — bench.py adds itself); namespaces the manifest
+    and the bench headline cache so a stale record can only ever answer
+    for the code that produced it."""
+    root = repo_root()
+    rels = list(KERNEL_SOURCES)
+    for pattern in KERNEL_SOURCE_GLOBS:
+        rels += sorted(
+            os.path.relpath(p, root)
+            for p in glob.glob(os.path.join(root, pattern)))
+    rels += list(extra)
+    h = hashlib.sha256()
+    for rel in rels:
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<missing>")
+        h.update(b"\x00")
+    return h.hexdigest()[:16]
+
 
 def configure_xla_cache() -> str | None:
-    """Point jax at the shared on-disk compilation cache; returns the dir,
-    or None if this jax build has no such option."""
+    """Point jax at the shared on-disk compilation cache; returns the
+    dir, or None if disabled (HOTSTUFF_TPU_XLA_CACHE set empty) or this
+    jax build has no such option."""
     import jax
 
-    cache_dir = os.environ.get("HOTSTUFF_TPU_XLA_CACHE",
-                               os.path.expanduser("~/.cache/hotstuff_tpu"))
+    raw = os.environ.get("HOTSTUFF_TPU_XLA_CACHE")
+    if raw is not None and not raw.strip():
+        log.info("XLA compilation cache disabled "
+                 "(HOTSTUFF_TPU_XLA_CACHE empty)")
+        return None
+    cache_dir = raw or os.path.expanduser("~/.cache/hotstuff_tpu")
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     except Exception:  # older jax without the option: lazy compiles only
         log.warning("jax compilation cache unavailable")
         return None
     return cache_dir
+
+
+def default_manifest_path() -> str:
+    return os.environ.get(
+        "HOTSTUFF_TPU_COMPILE_MANIFEST",
+        os.path.join(repo_root(), "results", "compile_cache",
+                     "manifest.json"))
+
+
+class CompileManifest:
+    """The warmed-shape manifest: which (kernel hash, shape key) pairs
+    have been compiled, plus a bounded history of warmup runs.  Load is
+    tolerant (a corrupt or missing file starts empty); save is atomic
+    (tmp + replace) so a killed sidecar can never leave a torn file."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path or default_manifest_path()
+        self.data = self._load()
+
+    def _load(self) -> dict:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+            if isinstance(data, dict) and \
+                    data.get("schema") == MANIFEST_SCHEMA and \
+                    isinstance(data.get("kernels"), dict) and \
+                    isinstance(data.get("runs"), list):
+                return data
+        except (OSError, ValueError):
+            pass
+        return {"schema": MANIFEST_SCHEMA, "kernels": {}, "runs": []}
+
+    def seen(self, kernel: str, key: str,
+             cache_dir: str | None = None) -> bool:
+        """True when this (kernel, key) pair was warmed before AND — if
+        ``cache_dir`` is given — it was warmed against that same XLA
+        cache dir, which still exists on disk.  The dir checks keep the
+        warm-boot claim honest: a manifest alone cannot prove the
+        compiled programs survived (a wiped or different cache dir
+        means this boot recompiles everything regardless of what the
+        manifest remembers)."""
+        entry = self.data["kernels"].get(kernel, {}) \
+            .get("shapes", {}).get(key)
+        if entry is None:
+            return False
+        if cache_dir is None:
+            return True
+        return entry.get("cache_dir") == cache_dir and \
+            os.path.isdir(cache_dir)
+
+    def record(self, kernel: str, key: str, wall_s: float,
+               now: float | None = None,
+               cache_dir: str | None = None) -> None:
+        shapes = self.data["kernels"].setdefault(
+            kernel, {"shapes": {}})["shapes"]
+        entry = shapes.setdefault(key, {
+            "first_warmed_at": now if now is not None else time.time()})
+        entry["last_wall_s"] = round(wall_s, 3)
+        entry["cache_dir"] = cache_dir
+
+    def record_run(self, kernel: str, hits: int, misses: int,
+                   wall_s: float, now: float | None = None) -> None:
+        self.data["runs"].append({
+            "t": now if now is not None else time.time(),
+            "kernel": kernel,
+            "hits": hits,
+            "misses": misses,
+            "wall_s": round(wall_s, 3),
+        })
+        del self.data["runs"][:-_MAX_RUNS]
+
+    def save(self) -> None:
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self.data, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, self.path)
+        except OSError as e:  # manifest is an optimization, never fatal
+            log.warning("compile manifest save failed: %r", e)
+
+
+class CompileTracker:
+    """Warmup-time compile accounting against the persistent manifest.
+
+    The sidecar wraps every warmup shape in :meth:`warm`: a shape whose
+    (kernel hash, key) pair the manifest already holds is a cache HIT —
+    the XLA disk cache deserializes instead of compiling — anything
+    else is a MISS that this boot pays for and records.  A second boot
+    against a populated cache therefore reports ``misses == 0`` with a
+    measurably lower warmup wall time, which is exactly what the
+    OP_STATS ``compile`` section (:meth:`snapshot`) and
+    ``scripts/warmup_report.py`` surface.  ``clock`` is injectable for
+    tests."""
+
+    def __init__(self, cache_dir: str | None = None,
+                 manifest_path: str | None = None,
+                 clock=None, kernel: str | None = None):
+        self.cache_dir = cache_dir
+        self._clock = clock or time.monotonic
+        self.kernel = kernel or kernel_fingerprint()
+        self.manifest = CompileManifest(manifest_path)
+        self.hits = 0
+        self.misses = 0
+        self.shapes: dict[str, dict] = {}
+        self._t0 = self._clock()
+        self._wall_s: float | None = None
+
+    def warm(self, key: str, thunk):
+        """Run one warmup shape under hit/miss + wall-time accounting;
+        returns the thunk's result.  A hit requires the manifest entry
+        AND the matching, still-present XLA cache dir (a boot with the
+        cache disabled or re-pointed counts every shape as a miss —
+        it IS recompiling; CompileManifest.seen documents the residual:
+        a dir whose files were purged but recreated can still read as
+        warm)."""
+        hit = self.manifest.seen(self.kernel, key,
+                                 cache_dir=self.cache_dir
+                                 if self.cache_dir is not None else "")
+        t0 = self._clock()
+        out = thunk()
+        dt = self._clock() - t0
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+        self.shapes[key] = {"s": round(dt, 3), "hit": hit}
+        self.manifest.record(self.kernel, key, dt,
+                             cache_dir=self.cache_dir)
+        return out
+
+    def wall_s(self) -> float:
+        if self._wall_s is not None:
+            return self._wall_s
+        return self._clock() - self._t0
+
+    def finish(self) -> None:
+        """Close out the warmup: stamp the run into the manifest and
+        persist it (idempotent)."""
+        if self._wall_s is None:
+            self._wall_s = self._clock() - self._t0
+            self.manifest.record_run(self.kernel, self.hits, self.misses,
+                                     self._wall_s)
+            self.manifest.save()
+
+    def snapshot(self) -> dict:
+        """The OP_STATS ``compile`` section (JSON-safe)."""
+        return {
+            "kernel": self.kernel,
+            "cache_dir": self.cache_dir,
+            "manifest": self.manifest.path,
+            "hits": self.hits,
+            "misses": self.misses,
+            "warm_boot": self.misses == 0 and (self.hits > 0),
+            "warmup_wall_s": round(self.wall_s(), 3),
+            "shapes": {k: v["s"] for k, v in sorted(self.shapes.items())},
+        }

@@ -33,6 +33,23 @@ if __name__ == "__main__":
         # fails if any of them ever moves out of its checker's target
         # set (or is deleted without this pin being updated consciously).
         for pin in ("hotpath:hotstuff_tpu/ops/scalar25519.py",
+                    # graftkern: every Pallas kernel module stays inside
+                    # BOTH the hot-path taint scan and the padshape scan
+                    # (which carries the pallas-interpret-in-prod rule)
+                    # — a kernel module that moves out of either loses
+                    # the silent-degradation net this layer rides on.
+                    "hotpath:hotstuff_tpu/ops/kern/__init__.py",
+                    "hotpath:hotstuff_tpu/ops/kern/backend.py",
+                    "hotpath:hotstuff_tpu/ops/kern/fieldops.py",
+                    "hotpath:hotstuff_tpu/ops/kern/field_mul.py",
+                    "hotpath:hotstuff_tpu/ops/kern/msm_accum.py",
+                    "hotpath:hotstuff_tpu/ops/kern/scalar_mont.py",
+                    "padshape:hotstuff_tpu/ops/kern/__init__.py",
+                    "padshape:hotstuff_tpu/ops/kern/backend.py",
+                    "padshape:hotstuff_tpu/ops/kern/fieldops.py",
+                    "padshape:hotstuff_tpu/ops/kern/field_mul.py",
+                    "padshape:hotstuff_tpu/ops/kern/msm_accum.py",
+                    "padshape:hotstuff_tpu/ops/kern/scalar_mont.py",
                     "hotpath:hotstuff_tpu/parallel/shard_shapes.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/__init__.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/classes.py",

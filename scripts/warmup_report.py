@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Cold-vs-warm sidecar boot report from the graftkern compile manifest.
+
+Every device-mode sidecar boot records its warmup into
+``results/compile_cache/manifest.json`` (utils/xla_cache.CompileTracker:
+per-run manifest hits/misses + wall time, keyed on the kernel-source
+hash).  This script prints the recorded runs and the headline the cache
+exists for: the warmup wall time of the latest COLD boot (misses > 0)
+next to the latest WARM boot (misses == 0) of the same kernel.
+
+    scripts/warmup_report.py [--manifest PATH] [--stats PATH] [--json]
+
+``--stats`` additionally folds in the ``compile`` section of a
+harness-fetched OP_STATS snapshot (logs/sidecar-stats.json) — the same
+numbers the LogParser surfaces as the "Sidecar compile cache" CONFIG
+note.  Exit status: 0 with a report, 1 when the manifest is missing or
+holds no runs (nothing to report is a finding, not a crash).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _fmt_t(t: float) -> str:
+    try:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(t))
+    except (OverflowError, OSError, ValueError):
+        return "?"
+
+
+def report(manifest: dict, stats: dict | None = None) -> dict:
+    """The machine-readable report (also what --json prints): recorded
+    runs, plus the cold-vs-warm comparison for the newest kernel that
+    has both boot classes on record."""
+    runs = [r for r in manifest.get("runs", []) if isinstance(r, dict)]
+    out: dict = {"runs": runs, "comparison": None}
+    # Newest-first by record order; compare within the newest kernel
+    # hash that has both a cold and a warm run (a kernel edit resets
+    # the story — cross-kernel comparisons would be apples to oranges).
+    for run in reversed(runs):
+        kernel = run.get("kernel")
+        same = [r for r in runs if r.get("kernel") == kernel]
+        cold = [r for r in same if r.get("misses", 0) > 0]
+        warm = [r for r in same if r.get("misses", 0) == 0
+                and r.get("hits", 0) > 0]
+        if cold and warm:
+            c, w = cold[-1], warm[-1]
+            saved = c["wall_s"] - w["wall_s"]
+            out["comparison"] = {
+                "kernel": kernel,
+                "cold_wall_s": c["wall_s"],
+                "warm_wall_s": w["wall_s"],
+                "saved_s": round(saved, 3),
+                "saved_pct": round(100.0 * saved / c["wall_s"], 1)
+                if c["wall_s"] else 0.0,
+            }
+            break
+    if stats is not None:
+        out["stats_compile"] = stats.get("compile")
+    return out
+
+
+def main(argv=None) -> int:
+    from hotstuff_tpu.utils.xla_cache import default_manifest_path
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--manifest", default=default_manifest_path(),
+                    help="compile manifest path (default: "
+                         "results/compile_cache/manifest.json)")
+    ap.add_argument("--stats", default=None, metavar="PATH",
+                    help="also report the compile section of this "
+                         "OP_STATS snapshot (logs/sidecar-stats.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable report instead")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.manifest, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"warmup_report: no usable manifest at {args.manifest} "
+              f"({e.__class__.__name__}) — run a device-mode sidecar "
+              "boot first", file=sys.stderr)
+        return 1
+    stats = None
+    if args.stats:
+        try:
+            with open(args.stats, encoding="utf-8") as f:
+                stats = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warmup_report: --stats unreadable ({e!r:.80})",
+                  file=sys.stderr)
+            stats = {}
+
+    doc = report(manifest, stats)
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0 if doc["runs"] else 1
+    if not doc["runs"]:
+        print("warmup_report: manifest holds no recorded warmup runs",
+              file=sys.stderr)
+        return 1
+    print(f"warmup runs ({args.manifest}):")
+    for r in doc["runs"]:
+        boot = "warm" if r.get("misses", 0) == 0 and r.get("hits", 0) \
+            else "cold"
+        print(f"  {_fmt_t(r.get('t', 0))}  kernel {r.get('kernel', '?')}  "
+              f"{boot:4s}  {r.get('hits', 0):3d} hit(s) "
+              f"{r.get('misses', 0):3d} miss(es)  "
+              f"wall {r.get('wall_s', 0):g} s")
+    cmp_ = doc["comparison"]
+    if cmp_:
+        print(f"cold boot {cmp_['cold_wall_s']:g} s -> warm boot "
+              f"{cmp_['warm_wall_s']:g} s "
+              f"({cmp_['saved_pct']:g}% faster, kernel {cmp_['kernel']})")
+    else:
+        print("no cold+warm pair recorded for any one kernel yet "
+              "(boot the sidecar twice against the same cache)")
+    sc = doc.get("stats_compile")
+    if sc:
+        boot = "warm boot" if sc.get("warm_boot") else "cold boot"
+        print(f"last OP_STATS compile section: {sc.get('hits', 0)} "
+              f"hit(s), {sc.get('misses', 0)} miss(es) — {boot}, "
+              f"warmup {sc.get('warmup_wall_s', 0):g} s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -457,6 +457,71 @@ def test_shard_misaligned_quiet_on_factories_and_non_mesh_modules():
     assert padshape.check_sources({"mod.py": elsewhere}) == []
 
 
+# ---------------------------------------------------------------------------
+# pallas-interpret-in-prod (graftkern interpreter-pin discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_pallas_interpret_fires_on_literal_true():
+    findings = padshape.check_sources({
+        "hotstuff_tpu/ops/kern/fake.py": textwrap.dedent("""
+            def my_kernel_entry(x):
+                return pl.pallas_call(
+                    body,
+                    out_shape=shape,
+                    interpret=True,
+                )(x)
+            """)})
+    assert rules(findings) == {"pallas-interpret-in-prod"}
+    assert "my_kernel_entry" in findings[0].message
+
+
+def test_pallas_interpret_quiet_on_backend_probe_and_helper_call():
+    # interpret selected off the backend probe: clean.
+    clean = textwrap.dedent("""
+        def entry(x):
+            return pl.pallas_call(
+                body, out_shape=shape,
+                interpret=interpret_default(),
+            )(x)
+        """)
+    assert padshape.check_sources(
+        {"hotstuff_tpu/ops/kern/fake.py": clean}) == []
+    # The backend-probe helper itself may pin the literal.
+    probe = textwrap.dedent("""
+        def interpret_default():
+            return pl.pallas_call(k, out_shape=s, interpret=True)(x)
+        """)
+    assert padshape.check_sources(
+        {"hotstuff_tpu/ops/kern/backend.py": probe}) == []
+    # ... but ONLY in backend.py: a shim merely NAMED interpret_default
+    # in another kernel module cannot claim the exemption.
+    findings = padshape.check_sources(
+        {"hotstuff_tpu/ops/kern/msm_accum.py": probe})
+    assert rules(findings) == {"pallas-interpret-in-prod"}
+
+
+def test_pallas_interpret_suppression_comment():
+    src = textwrap.dedent("""
+        def probe(x):
+            return pl.pallas_call(
+                body, out_shape=shape,
+                # graftlint: disable=pallas-interpret-in-prod
+                interpret=True,
+            )(x)
+        """)
+    assert padshape.check_sources(
+        {"hotstuff_tpu/ops/kern/fake.py": src}) == []
+
+
+def test_pallas_interpret_quiet_on_real_kern_tree():
+    # The real kern package carries exactly one forced literal — the
+    # interpreter probe — behind its worked suppression.
+    findings = [f for f in padshape.check(REPO)
+                if f.rule == "pallas-interpret-in-prod"]
+    assert findings == []
+
+
 def test_padded_bucket_fires_on_warmup_floor_drift(tmp_path):
     for rel in (padshape.EDDSA, padshape.SERVICE):
         dst = tmp_path / rel
@@ -496,6 +561,12 @@ def test_must_cover_gate():
         "hotpath:hotstuff_tpu/sidecar/sched/shapes.py",
         "hotpath:hotstuff_tpu/sidecar/sched/stats.py",
         "hotpath:hotstuff_tpu/sidecar/sched/classes.py",
+        # graftkern pins: the Pallas kernel modules sit inside BOTH the
+        # hotpath and padshape scans
+        "hotpath:hotstuff_tpu/ops/kern/field_mul.py",
+        "hotpath:hotstuff_tpu/ops/kern/msm_accum.py",
+        "padshape:hotstuff_tpu/ops/kern/backend.py",
+        "padshape:hotstuff_tpu/ops/kern/scalar_mont.py",
         # graftchaos pins (the sockets checker's targets)
         "sockets:hotstuff_tpu/chaos/plan.py",
         "sockets:hotstuff_tpu/chaos/runner.py",

@@ -90,9 +90,13 @@ def test_parser_folds_sidecar_stats_into_notes():
                  "shard_buckets": {"2": 30, "4": 10}},
         "pipeline": {"pack_ms": 120.5, "pack_hidden_ms": 90.4,
                      "overlap_ratio": 0.75},
+        "compile": {"kernel": "abcd1234", "hits": 11, "misses": 0,
+                    "warm_boot": True, "warmup_wall_s": 3.5},
     })
     out = parser.result()
     assert "Sidecar launches: 42 (latency 40, bulk 2)" in out
+    assert ("Sidecar compile cache: 11 hit(s), 0 miss(es) — warm boot, "
+            "warmup 3.5 s (kernel abcd1234)") in out
     assert "rlc_sharded=30" in out and "rlc_bisect=2" in out
     assert "latency p50 0.4 ms / p99 2.1 ms" in out
     assert "Sidecar pad fill: 128 sigs (waste 300)" in out

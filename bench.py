@@ -117,13 +117,16 @@ bulk-before-latency, baseline SLO PASS).  Emitted on BOTH the live and
 degraded lines.
 
 grafttrace (`"trace"` field): the cross-layer tracing pipeline proven
-end to end — two synthetic replica logs with a known clock skew run
+end to end — synthetic replica logs with a known clock skew run
 through the real node-TRACE parser, the RTT-midpoint offset estimator,
 per-block stitching (one deliberately partial trace), the critical-path
-p50/p99 breakdown, and a Chrome-trace JSON round trip (the exact
-pipeline a live run's logs/trace.json artifact and "Commit critical
-path" parser note come from).  Keys: blocks, complete, segments
-({name: {n, p50_ms, p99_ms}}), chrome_events, offset_applied_ms,
+p50/p99 breakdown, the graftscope protocol-v5 ctx join (one block with
+a full sidecar chain, one verify-traced block without — join_rate 0.5,
+verify:device sub-segment present), and a Chrome-trace JSON round trip
+(the exact pipeline a live run's logs/trace.json artifact and "Commit
+critical path" parser note come from).  Keys: blocks, complete,
+segments ({name: {n, p50_ms, p99_ms}}), join ({committed, with_verify,
+joined, rate}), join_rate, chrome_events, offset_applied_ms,
 roundtrip_ok.
 
 Degraded mode (`"degraded": true`): the device probe is capped at
@@ -662,15 +665,19 @@ def mesh_rlc_headline(n_devices: int = 8,
 
 def trace_headline_probe() -> dict:
     """The headline's ``trace`` field: prove the grafttrace pipeline end
-    to end without booting a committee.  Two synthetic replica logs
-    with a KNOWN clock skew run through the REAL node-TRACE parser
+    to end without booting a committee.  Synthetic replica logs with a
+    KNOWN clock skew run through the REAL node-TRACE parser
     (obs/trace.py — the exact regex that mines live node logs), the
     RTT-midpoint offset estimator, per-block stitching (one block's
     trace is deliberately partial: a dropped span must degrade the
     sample count, not the breakdown), the critical-path percentiles,
-    and a Chrome-trace JSON serialization round trip.  Keys: blocks,
-    complete, segments ({name: {n, p50_ms, p99_ms}}), chrome_events,
-    offset_applied_ms, roundtrip_ok."""
+    the graftscope ctx join (block aaa= carries a full sidecar chain,
+    block ccc= verifies but has none — join_rate must come out 0.5 and
+    the device sub-segment must appear), and a Chrome-trace JSON
+    serialization round trip.  Keys: blocks, complete, segments
+    ({name: {n, p50_ms, p99_ms}}), join ({committed, with_verify,
+    joined, rate}), join_rate, chrome_events, offset_applied_ms,
+    roundtrip_ok."""
     import json as _json
 
     from hotstuff_tpu.obs import trace as obstrace
@@ -680,7 +687,10 @@ def trace_headline_probe() -> dict:
                 f"TRACE stage={stage} block={block} round={rnd}")
 
     # Replica 0: the reference clock.  Block bbb='s trace is partial
-    # (no verify stages — the cached-certificate path).
+    # (no verify stages — the cached-certificate path); block ccc=
+    # verifies but its sidecar chain is deliberately MISSING (every
+    # replica answered from the verdict-cache fast path), so the join
+    # rate must degrade, not the trace.
     log_a = "\n".join([
         line(1.000, "proposal", "aaa=", 2),
         line(1.010, "verify_submit", "aaa=", 2),
@@ -688,6 +698,10 @@ def trace_headline_probe() -> dict:
         line(1.050, "commit", "aaa=", 2),
         line(1.100, "proposal", "bbb=", 3),
         line(1.180, "commit", "bbb=", 3),
+        line(1.200, "proposal", "ccc=", 4),
+        line(1.210, "verify_submit", "ccc=", 4),
+        line(1.230, "verify_reply", "ccc=", 4),
+        line(1.260, "commit", "ccc=", 4),
     ])
     # Replica 1: same events observed later, stamped by a clock running
     # a known skew AHEAD — alignment must bring them back onto (not
@@ -707,22 +721,41 @@ def trace_headline_probe() -> dict:
     spans += obstrace.apply_offset(spans_b, offset)
     traces = obstrace.stitch_blocks(spans)
     summary = obstrace.critical_path(traces)
+    # Sidecar chain for block aaa= only: per-request spans tagged ctx,
+    # the launch-level device span tagged ctxs — the protocol-v5 schema
+    # the live sidecar emits.
     sidecar_spans = [
+        {"stage": "admit", "t": 1785751201.005, "dur_ms": 0.0, "rid": 1,
+         "cls": "latency", "ctx": "aaa="},
         {"stage": "queue", "t": 1785751201.01, "dur_ms": 1.5, "rid": 1,
-         "cls": "latency"},
-        {"stage": "device", "t": 1785751201.02, "dur_ms": 18.0, "rid": 1},
+         "cls": "latency", "ctx": "aaa="},
+        {"stage": "device", "t": 1785751201.02, "dur_ms": 18.0, "rid": 1,
+         "ctxs": ["aaa="]},
+        {"stage": "reply", "t": 1785751201.04, "dur_ms": 0.0, "rid": 1,
+         "cls": "latency", "ctx": "aaa="},
     ]
-    chrome = obstrace.chrome_trace(traces, sidecar_spans)
+    join, joined = obstrace.join_blocks(
+        traces, obstrace.chain_spans(sidecar_spans))
+    if joined:
+        summary["segments"][obstrace.DEVICE_SEGMENT] = \
+            obstrace.device_subsegment(joined)
+    chrome = obstrace.chrome_trace(traces, sidecar_spans, joined=joined)
     decoded = _json.loads(_json.dumps(chrome))
     events = decoded.get("traceEvents", [])
     roundtrip_ok = (
         len(events) == len(chrome["traceEvents"])
         and all(e.get("ph") in ("X", "M") for e in events)
-        and all(isinstance(e.get("ts", 0), (int, float)) for e in events))
+        and all(isinstance(e.get("ts", 0), (int, float)) for e in events)
+        # the joined chain must land nested in the block's row
+        and any(e.get("name") == "sidecar:device"
+                and e.get("args", {}).get("block") == "aaa="
+                for e in events))
     return {
         "blocks": summary["blocks"],
         "complete": summary["complete"],
         "segments": summary["segments"],
+        "join": join,
+        "join_rate": join["rate"],
         "chrome_events": len(events),
         "offset_applied_ms": round(offset * 1e3, 3),
         "roundtrip_ok": roundtrip_ok,

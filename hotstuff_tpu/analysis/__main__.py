@@ -17,12 +17,12 @@ import os
 import sys
 
 CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
-            "obsspan", "threads", "cxxsync", "ingress")
+            "obsspan", "obsgrammar", "threads", "cxxsync", "ingress")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import cxxsync, hotpath, ingress, obsspan, padshape, sanitize, \
-        sockets, threads, timing, wirecheck
+    from . import cxxsync, hotpath, ingress, obsgrammar, obsspan, \
+        padshape, sanitize, sockets, threads, timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -39,6 +39,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += sockets.check(root)
     if "obsspan" in checkers:
         findings += obsspan.check(root)
+    if "obsgrammar" in checkers:
+        findings += obsgrammar.check(root)
     if "threads" in checkers:
         findings += threads.check(root)
     if "cxxsync" in checkers:
@@ -69,8 +71,8 @@ def check_coverage(root: str, must_cover) -> list:
     accepts any checker.  scripts/lint_gate.py pins the RLC scalar
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
-    from . import cxxsync, hotpath, ingress, obsspan, padshape, sockets, \
-        threads, timing
+    from . import cxxsync, hotpath, ingress, obsgrammar, obsspan, \
+        padshape, sockets, threads, timing
     from .common import Finding
 
     target_sets = {
@@ -79,6 +81,7 @@ def check_coverage(root: str, must_cover) -> list:
         "timing": tuple(timing.DEFAULT_TARGETS),
         "padshape": tuple(padshape.DEFAULT_TARGETS),
         "obsspan": tuple(obsspan.DEFAULT_TARGETS),
+        "obsgrammar": tuple(obsgrammar.DEFAULT_TARGETS),
         "threads": tuple(threads.DEFAULT_TARGETS),
         "cxxsync": tuple(cxxsync.DEFAULT_TARGETS),
         "ingress": tuple(ingress.DEFAULT_TARGETS),

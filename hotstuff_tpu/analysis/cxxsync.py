@@ -77,6 +77,10 @@ DEFAULT_TARGETS = (
     # graftsurge: the bounded-ingress gate is reactor-thread +
     # batch-maker-thread shared state behind one mutex.
     "native/src/mempool/ingress.hpp",
+    # graftscope: the node METRICS sampler — hot-path atomic counter +
+    # sampler-thread state behind one mutex.
+    "native/src/common/metrics.hpp",
+    "native/src/common/metrics.cpp",
 )
 
 # The atomic rule scans the whole native tree (any .cpp/.hpp under here).

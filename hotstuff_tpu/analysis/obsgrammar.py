@@ -1,0 +1,163 @@
+"""graftlint obsgrammar checker: the Python<->C++ log-line grammar pins.
+
+graftscope's node-side observability rests on two FROZEN log grammars
+emitted by the C++ node and mined by Python regexes:
+
+  * ``TRACE stage=<s> block=<digest> round=<r>`` — consensus/core.cpp
+    ``trace_stage`` -> ``obs/trace.py _NODE_TRACE_RE``;
+  * ``METRICS commits=<n> commit_rate=<f> ingress_tx=<n>
+    ingress_bytes=<n> busy=<n> breaker=<state>`` — common/metrics.cpp
+    ``emit_sample`` -> ``obs/sampler.py _NODE_METRICS_RE``.
+
+Nothing type-checks the pair: a C++ edit that renames or reorders a
+key ships a node whose telemetry silently stops parsing — the join
+rate drops to zero, the replica series vanishes, and every downstream
+perf note degrades without a single test failing.  This checker holds
+the two sides together mechanically, wirecheck-style (AST-free regex
+over the C++, string constants over the Python):
+
+Rules:
+  trace-grammar-mismatch    the ordered ``key=`` token list mined from
+                            the Python TRACE regex no longer matches
+                            the string literals of the C++ emit site
+                            (or either side's anchor is missing)
+  metrics-grammar-mismatch  same, for the METRICS line
+
+The comparison is ORDERED and prefix-anchored: the Python miners are
+``re.findall`` over ``<LEADER> key1=.. key2=..``, so a reordered or
+renamed key on either side is a real break even when the key SET is
+unchanged.  New keys may be appended on the C++ side only together
+with the Python regex (append-only grammar, the log.hpp contract).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from .common import Finding, read_source
+
+TRACE_PY = "hotstuff_tpu/obs/trace.py"
+METRICS_PY = "hotstuff_tpu/obs/sampler.py"
+TRACE_CPP = "native/src/consensus/core.cpp"
+METRICS_CPP = "native/src/common/metrics.cpp"
+
+
+def _line_of(source: str, pattern: str) -> int:
+    m = re.search(pattern, source, re.MULTILINE)
+    return source[:m.start()].count("\n") + 1 if m else 1
+
+
+def py_grammar_tokens(source: str, const_name: str):
+    """``_NODE_*_RE = (r"..." r"...")`` -> ``(leader, [keys], line)`` or
+    None.  The miner regexes are implicitly-concatenated raw-string
+    constants; the payload is everything after the log-prefix ``\\] ``
+    group, whose first word is the leader (TRACE/METRICS) and whose
+    ``key=`` tokens are the grammar."""
+    m = re.search(
+        rf"^{re.escape(const_name)}\s*=\s*\(((?:\s*r?\"[^\"]*\")+)\)",
+        source, re.MULTILINE)
+    if not m:
+        return None
+    pattern = "".join(re.findall(r"r?\"([^\"]*)\"", m.group(1)))
+    payload = pattern.split(r"\] ", 1)
+    if len(payload) != 2:
+        return None
+    payload = payload[1]
+    leader = re.match(r"(\w+) ", payload)
+    keys = re.findall(r"(\w+)=", payload)
+    if leader is None or not keys:
+        return None
+    return leader.group(1), keys, _line_of(source, re.escape(const_name))
+
+
+def cpp_emit_tokens(source: str, leader: str):
+    """String literals of the ``<< "LEADER key=" << ... << " key="``
+    stream chain that emits the line -> ``(leader, [keys], line)`` or
+    None.  The chain is anchored on the literal starting with the
+    leader word and followed through consecutive ``<<`` operands;
+    literals contribute their ``key=`` tokens in order."""
+    anchor = re.search(rf"\"{leader} (\w+)=", source)
+    if not anchor:
+        return None
+    # From the anchor to the statement's terminating semicolon: every
+    # string literal in the << chain carries zero or more "key=" tokens.
+    stmt_end = source.find(";", anchor.start())
+    stmt = source[anchor.start():stmt_end if stmt_end != -1 else None]
+    keys = []
+    for lit in re.findall(r"\"([^\"]*)\"", stmt):
+        keys.extend(re.findall(r"(\w+)=", lit))
+    if not keys:
+        return None
+    return leader, keys, source[:anchor.start()].count("\n") + 1
+
+
+def _check_pair(findings, rule, py_rel, py_src, const_name,
+                cpp_rel, cpp_src, leader):
+    def miss(path, what):
+        findings.append(Finding(
+            path, 1, rule, f"{what} not found — the grammar cross-check "
+            "cannot anchor; fix the source or update obsgrammar.py"))
+
+    py = py_grammar_tokens(py_src, const_name) if py_src else None
+    cpp = cpp_emit_tokens(cpp_src, leader) if cpp_src else None
+    if py_src is None:
+        miss(py_rel, "source file")
+    elif py is None:
+        miss(py_rel, f"miner regex {const_name}")
+    if cpp_src is None:
+        miss(cpp_rel, "source file")
+    elif cpp is None:
+        miss(cpp_rel, f"'{leader} <key>=' emit site")
+    if py is None or cpp is None:
+        return
+    py_leader, py_keys, py_line = py
+    _, cpp_keys, cpp_line = cpp
+    if py_leader != leader:
+        findings.append(Finding(
+            py_rel, py_line, rule,
+            f"{const_name} mines leader {py_leader!r} but the frozen "
+            f"grammar is {leader!r}"))
+        return
+    if py_keys != cpp_keys:
+        findings.append(Finding(
+            cpp_rel, cpp_line, rule,
+            f"C++ emits '{leader} " + " ".join(f"{k}=.." for k in cpp_keys)
+            + f"' but {py_rel} {const_name} mines keys {py_keys} — the "
+            "telemetry line will silently stop parsing (the grammar is "
+            "frozen append-only; change BOTH sides together)"))
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: source} mapping (the unit-test entry point).
+    Expects the four grammar files under their repo-relative names;
+    absent files simply skip their pair (fixtures test one grammar at a
+    time)."""
+    findings: list[Finding] = []
+    norm = {p.replace(os.sep, "/"): s for p, s in sources.items()}
+    if TRACE_PY in norm or TRACE_CPP in norm:
+        _check_pair(findings, "trace-grammar-mismatch",
+                    TRACE_PY, norm.get(TRACE_PY), "_NODE_TRACE_RE",
+                    TRACE_CPP, norm.get(TRACE_CPP), "TRACE")
+    if METRICS_PY in norm or METRICS_CPP in norm:
+        _check_pair(findings, "metrics-grammar-mismatch",
+                    METRICS_PY, norm.get(METRICS_PY), "_NODE_METRICS_RE",
+                    METRICS_CPP, norm.get(METRICS_CPP), "METRICS")
+    return sorted(findings, key=lambda f: (f.path, f.line))
+
+
+# The four files this checker pins (must-cover target set).
+DEFAULT_TARGETS = (TRACE_PY, METRICS_PY, TRACE_CPP, METRICS_CPP)
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    sources = {}
+    for rel in targets:
+        path = os.path.join(root, rel)
+        try:
+            sources[rel] = read_source(path)
+        except OSError:
+            sources[rel] = None
+    # A missing file is reported by _check_pair, so keep the None
+    # entries rather than dropping them.
+    return check_sources({p: s for p, s in sources.items()})

@@ -57,6 +57,9 @@ _LEN_PAIRS = (
     ("BLS_SIG_LEN", "kBlsSigLen"),
     ("BLS_SK_LEN", "kBlsSkLen"),
     ("DIGEST_LEN", "kDigestLen"),
+    # protocol v5 (graftscope): the block-digest context tag riding
+    # between the verify header and its records.
+    ("CTX_LEN", "kCtxLen"),
 )
 
 PROTOCOL = "hotstuff_tpu/sidecar/protocol.py"

@@ -94,9 +94,11 @@ class LogParser:
         self.notes = []
         # grafttrace: the critical-path summary (note_trace) and the
         # sampled metrics time series (note_metrics) land here for
-        # bench.py's machine-readable round trip.
+        # bench.py's machine-readable round trip.  graftscope adds the
+        # per-replica node series accounting (hosts + divergence).
         self.trace = None
         self.metrics = None
+        self.node_metrics = None
         if self.malformed_lines:
             self.notes.append(
                 f"Parser: skipped {self.malformed_lines} torn/malformed "
@@ -583,10 +585,10 @@ class LogParser:
             return
         try:
             segs = summary.get("segments") or {}
-            from ..obs.trace import SEGMENTS, TOTAL_SEGMENT
+            from ..obs.trace import DEVICE_SEGMENT, SEGMENTS, TOTAL_SEGMENT
 
             parts = []
-            for name in SEGMENTS + (TOTAL_SEGMENT,):
+            for name in SEGMENTS + (DEVICE_SEGMENT, TOTAL_SEGMENT):
                 entry = segs.get(name)
                 if entry and entry.get("n"):
                     parts.append(f"{name} p50 {entry['p50_ms']:g} ms / "
@@ -594,10 +596,18 @@ class LogParser:
             if not parts:
                 return
             self.trace = summary
+            # graftscope join accounting: device time nested inside
+            # verify is only as good as the fraction of blocks it
+            # covers — say the rate next to the percentiles.
+            join = summary.get("join") or {}
+            join_part = ""
+            if isinstance(join.get("rate"), (int, float)):
+                join_part = (f", sidecar join {join['rate']:.0%} of "
+                             f"{join.get('with_verify', 0)} verify-traced")
             self.notes.append(
                 f"Commit critical path ({summary.get('blocks', 0)} "
-                f"block(s), {summary.get('complete', 0)} fully traced): "
-                + "; ".join(parts))
+                f"block(s), {summary.get('complete', 0)} fully traced"
+                f"{join_part}): " + "; ".join(parts))
             sc = summary.get("sidecar") or {}
             sc_parts = [f"{stage} p50 {e['p50_ms']:g} ms / "
                         f"p99 {e['p99_ms']:g} ms"
@@ -612,19 +622,32 @@ class LogParser:
             return
 
     def note_metrics(self, samples, malformed: int = 0):
-        """Fold the sampled OP_STATS time series (obs/sampler.py JSONL)
+        """Fold the sampled metrics time series (obs/sampler.py JSONL)
         into the summary: the in-window sample count as a CONFIG note,
         and — under a chaos plan — the per-event recovery curve, so an
         SLO verdict cites "telemetry resumed N ms after the fault"
-        rather than a single post-fault commit scalar."""
+        rather than a single post-fault commit scalar.
+
+        graftscope: the series may mix sidecar OP_STATS samples with the
+        C++ node's per-replica METRICS records; everything that reasons
+        about the SIDECAR (its sample count, recovery curves, the
+        baseline SLO judge) sees only the sidecar sub-series — a node
+        tick must never read as sidecar telemetry resuming — while the
+        node records feed the replica commit-rate notes."""
         if not samples:
             return
+        from ..obs import split_samples
+
+        sidecar, node = split_samples(samples)
         try:
             self.metrics = samples
-            ok = [s for s in samples if s.get("ok")]
-            window = max(s["t"] for s in samples) - \
-                min(s["t"] for s in samples)
-            note = (f"Sidecar metrics: {len(samples)} sample(s) "
+            self._note_node_metrics(node)
+            if not sidecar:
+                return
+            ok = [s for s in sidecar if s.get("ok")]
+            window = max(s["t"] for s in sidecar) - \
+                min(s["t"] for s in sidecar)
+            note = (f"Sidecar metrics: {len(sidecar)} sample(s) "
                     f"({len(ok)} ok) over {window:g} s")
             if malformed:
                 note += f", {malformed} torn line(s) skipped"
@@ -638,7 +661,7 @@ class LogParser:
                 wall = e.get("wall")
                 if not isinstance(wall, (int, float)):
                     continue
-                curve = recovery_curve(samples, wall)
+                curve = recovery_curve(sidecar, wall)
                 e["telemetry"] = curve
                 label = f"Chaos {event_label(e)}"
                 if curve["resumed"]:
@@ -653,7 +676,36 @@ class LogParser:
                         "event)")
         except (TypeError, ValueError, AttributeError, KeyError):
             return
-        self._judge_metrics_recovery(samples)
+        self._judge_metrics_recovery(sidecar)
+
+    # Straggler threshold: a replica sampling below this fraction of the
+    # committee's median commit rate diverges (graftscope; evidence, not
+    # failure — strict mode is unaffected).
+    COMMIT_RATE_DIVERGENCE = 0.7
+
+    def _note_node_metrics(self, node_samples):
+        """Per-replica METRICS notes: series count plus the commit-rate
+        divergence (straggler) evidence.  Best-effort like every
+        telemetry note."""
+        if not node_samples:
+            return
+        try:
+            from ..obs import commit_rate_divergence
+
+            hosts = sorted({s["node"] for s in node_samples})
+            self.notes.append(
+                f"Node metrics: {len(node_samples)} sample(s) across "
+                f"{len(hosts)} replica(s)")
+            div = commit_rate_divergence(
+                node_samples, threshold=self.COMMIT_RATE_DIVERGENCE)
+            self.node_metrics = {"hosts": hosts, "divergence": div}
+            for s in div["stragglers"]:
+                self.notes.append(
+                    f"Replica commit-rate divergence: {s['host']} at "
+                    f"{s['ratio']:.0%} of committee median "
+                    f"({s['rate']:g} vs {div['median']:g} commits/s)")
+        except (TypeError, ValueError, AttributeError, KeyError):
+            return
 
     def _judge_metrics_recovery(self, samples):
         """Metrics-driven recovery-to-baseline verdicts (graftsurge /
@@ -860,14 +912,19 @@ class LogParser:
         # grafttrace: merge the run's spans (node TRACE lines + sidecar
         # JSONL + clock offsets) into the Perfetto-loadable trace.json
         # artifact and the commit critical-path notes, and fold the
-        # sampled metrics time series in.  All best-effort: a run that
-        # traced nothing parses exactly as before.
+        # sampled metrics time series in.  graftscope first folds the
+        # C++ node's METRICS lines into metrics.jsonl (idempotent), so
+        # the per-replica series rides the same artifact.  All
+        # best-effort: a run that traced nothing parses exactly as
+        # before.
         try:
-            from ..obs import read_samples, write_run_trace
+            from ..obs import merge_node_series, read_samples, \
+                write_run_trace
 
             summary = write_run_trace(directory)
             if summary is not None:
                 parser.note_trace(summary)
+            merge_node_series(directory)
             samples, torn = read_samples(join(directory, "metrics.jsonl"))
             parser.note_metrics(samples, malformed=torn)
         except (OSError, ValueError, TypeError, KeyError):

@@ -169,18 +169,25 @@ class Ploter:
         """grafttrace: the sampled OP_STATS time series as throughput /
         queue-wait curves (``logs/metrics.jsonl``), with failed ticks —
         a chaos-killed sidecar's telemetry blackout — marked, so a
-        recovery transition is visible as a curve, not a scalar."""
-        from ..obs import read_samples
+        recovery transition is visible as a curve, not a scalar.
+
+        graftscope: when the series carries the C++ node's per-replica
+        METRICS records, a second panel overlays every replica's sampled
+        commit rate (the straggler-detection curves), with the same
+        blackout markers — a replica diverging from the committee is a
+        visibly lagging line, not just a parser note."""
+        from ..obs import read_samples, split_samples
 
         path = metrics_path or PathMaker.metrics_file()
         samples, _ = read_samples(path)
         if len(samples) < 2:
             raise PlotError(f"fewer than two metrics samples at {path}")
+        sidecar, node = split_samples(samples)
         t0 = min(s["t"] for s in samples)
         xs_ok, sig_rate, wait_p99 = [], [], []
         xs_bad = []
         prev = None
-        for s in sorted(samples, key=lambda s: s["t"]):
+        for s in sorted(sidecar, key=lambda s: s["t"]):
             if not s.get("ok"):
                 xs_bad.append(s["t"] - t0)
                 prev = None  # a blackout breaks the rate delta chain
@@ -193,24 +200,48 @@ class Ploter:
                 wait = (stats.get("queue_wait") or {}).get("latency") or {}
                 wait_p99.append(wait.get("p99_ms", 0))
             prev = (s["t"], sigs)
+        by_replica = {}
+        for s in sorted(node, key=lambda s: s["t"]):
+            rate = (s.get("metrics") or {}).get("commit_rate")
+            if isinstance(rate, (int, float)):
+                xs, ys = by_replica.setdefault(s["node"], ([], []))
+                xs.append(s["t"] - t0)
+                ys.append(rate)
         self.plt.clf()
-        fig, ax = self.plt.subplots(figsize=(6.4, 4.8))
+        nrows = 1 + (1 if by_replica else 0)
+        fig, axes = self.plt.subplots(
+            nrows, 1, squeeze=False, sharex=True,
+            figsize=(6.4, 4.8 if nrows == 1 else 7.2))
+        ax = axes[0][0]
         ax.plot(xs_ok, sig_rate, marker="o", markersize=3,
                 label="verify throughput (sigs/s)")
-        ax.set_xlabel("Run time (s)")
         ax.set_ylabel("Sigs/s launched")
         ax2 = ax.twinx()
         ax2.plot(xs_ok, wait_p99, color="tab:orange", marker="s",
                  markersize=3, label="latency queue-wait p99 (ms)")
         ax2.set_ylabel("Queue wait p99 (ms)")
-        for i, x in enumerate(xs_bad):
-            ax.axvline(x, color="red", alpha=0.4, linestyle="--",
-                       label="failed sample (sidecar down)"
-                       if i == 0 else None)
+        # Blackout markers BEFORE the legends are assembled, so the
+        # "failed sample" entry actually appears on chaos runs.
+        for r in range(nrows):
+            for i, x in enumerate(xs_bad):
+                axes[r][0].axvline(
+                    x, color="red", alpha=0.4, linestyle="--",
+                    label="failed sample (sidecar down)"
+                    if i == 0 and r == 0 else None)
         lines, labels = ax.get_legend_handles_labels()
         l2, lb2 = ax2.get_legend_handles_labels()
         ax.legend(lines + l2, labels + lb2, loc="best", fontsize="small")
         ax.grid(True, alpha=0.3)
+        if by_replica:
+            axr = axes[1][0]
+            markers = cycle(["o", "v", "s", "d", "^"])
+            for host, (xs, ys) in sorted(by_replica.items()):
+                axr.plot(xs, ys, marker=next(markers), markersize=2,
+                         linewidth=1, label=host)
+            axr.set_ylabel("Commit rate (blocks/s)")
+            axr.legend(loc="best", fontsize="x-small")
+            axr.grid(True, alpha=0.3)
+        axes[-1][0].set_xlabel("Run time (s)")
         for ext in ("pdf", "png"):
             fig.savefig(PathMaker.plot_file("metrics", ext),
                         bbox_inches="tight")

@@ -26,18 +26,37 @@ package makes every claim attributable to a *place in the pipeline*:
     samples to ``logs/metrics.jsonl`` so throughput/queue-wait over
     time can be plotted, chaos SLO verdicts can cite the recovery
     curve, and a chaos-killed sidecar's telemetry survives as the last
-    good sample.
+    good sample.  graftscope adds the C++ node's 1 Hz ``METRICS`` line
+    reader: per-replica commit-rate/ingress/breaker series merged into
+    the same artifact, plus straggler detection over them.
+
+graftscope closes the attribution loop between the two halves: the
+protocol-v5 context tag carries each block's digest through the verify
+RPC, the sidecar tags its stage spans with it, and ``trace`` joins the
+chains back onto the blocks — ``logs/trace.json`` nests device time
+inside each block's verify segment, with ``join_rate`` saying what
+fraction of verify-traced committed blocks carried a chain.
 """
 
 from __future__ import annotations
 
-from .sampler import MetricsSampler, read_samples, recovery_curve
+from .sampler import (
+    MetricsSampler,
+    commit_rate_divergence,
+    merge_node_series,
+    parse_node_metrics,
+    read_samples,
+    recovery_curve,
+    split_samples,
+)
 from .spans import SpanError, Tracer, parse_spans
 from .trace import (
     build_run_trace,
+    chain_spans,
     chrome_trace,
     clock_offset,
     critical_path,
+    join_blocks,
     parse_node_trace,
     stitch_blocks,
     write_run_trace,
@@ -48,13 +67,19 @@ __all__ = [
     "SpanError",
     "Tracer",
     "build_run_trace",
+    "chain_spans",
     "chrome_trace",
     "clock_offset",
+    "commit_rate_divergence",
     "critical_path",
+    "join_blocks",
+    "merge_node_series",
+    "parse_node_metrics",
     "parse_node_trace",
     "parse_spans",
     "read_samples",
     "recovery_curve",
+    "split_samples",
     "stitch_blocks",
     "write_run_trace",
 ]

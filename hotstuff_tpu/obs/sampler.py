@@ -18,6 +18,21 @@ back to it when the sidecar died before the final fetch.
 Every tick dials a FRESH connection: a sampler pinned to one socket
 would die with the first kill and miss the restart it exists to show.
 
+graftscope adds the NODE side of the series: the C++ node emits 1 Hz
+machine-parseable ``METRICS`` lines into its own log (common/metrics.cpp,
+behind the ``trace`` parameter), and :func:`merge_node_series` mines
+``node-*.log`` post-run and appends per-replica records next to the
+sidecar samples::
+
+    {"t": <wall s>, "ok": true, "node": "node-0.log",
+     "metrics": {"commits": N, "commit_rate": f, "ingress_tx": N,
+                 "ingress_bytes": N, "busy": N, "breaker": "closed"}}
+
+``split_samples`` keeps the two sub-series apart for consumers that
+reason about the sidecar only (recovery curves, SLO judges), and
+``commit_rate_divergence`` turns the per-replica curves into straggler
+evidence for the LogParser.
+
 Clocks are injected (``clock``/``wall``/``wait``) — the virtual-clock
 tests drive ticks manually, and graftlint's span checker keeps inline
 ``time.time()`` out of this package.
@@ -124,6 +139,140 @@ def read_samples(path: str):
         text,
         lambda rec: isinstance(rec.get("t"), (int, float))
         and "ok" in rec)
+
+
+# -- graftscope: the C++ node's METRICS series -------------------------------
+
+# The FROZEN node METRICS line grammar (common/metrics.cpp emit_sample;
+# graftlint's obsgrammar checker cross-checks the two sides): the log
+# prefix is the node's standard grammar, the payload is append-only
+# key=value.  Torn fragments simply don't match — tolerance for free,
+# the parse_node_trace convention.
+_NODE_METRICS_RE = (r"\[(\S+Z) \w+ [^\]]+\] METRICS "
+                    r"commits=(\d+) commit_rate=([0-9.]+) "
+                    r"ingress_tx=(\d+) ingress_bytes=(\d+) "
+                    r"busy=(\d+) breaker=(\w+)")
+
+
+def parse_node_metrics(log: str, host: str = "node") -> list:
+    """One node log -> metrics.jsonl-shaped records (see module doc)."""
+    import re
+
+    from .trace import _to_posix
+
+    records = []
+    for ts, commits, rate, itx, ibytes, busy, breaker in \
+            re.findall(_NODE_METRICS_RE, log):
+        try:
+            t = _to_posix(ts)
+            metrics = {"commits": int(commits),
+                       "commit_rate": float(rate),
+                       "ingress_tx": int(itx),
+                       "ingress_bytes": int(ibytes),
+                       "busy": int(busy),
+                       "breaker": breaker}
+        except ValueError:
+            continue
+        records.append({"t": t, "ok": True, "node": host,
+                        "metrics": metrics})
+    return records
+
+
+def collect_node_series(directory: str) -> list:
+    """Mine every ``node-*.log`` in a logs directory -> node records,
+    sorted by wall stamp."""
+    import os
+    from glob import glob
+
+    records = []
+    for path in sorted(glob(os.path.join(directory, "node-*.log"))):
+        try:
+            with open(path, errors="replace") as f:
+                log = f.read()
+        except OSError:
+            continue
+        records.extend(parse_node_metrics(log, host=os.path.basename(path)))
+    records.sort(key=lambda r: r["t"])
+    return records
+
+
+def merge_node_series(directory: str, path: str | None = None) -> int:
+    """Append the mined node series into ``<directory>/metrics.jsonl``
+    (creating it when only the node side traced) so the one artifact
+    carries per-replica series next to the sidecar's.  Idempotent: if
+    the file already holds node records (a re-parse of the same logs
+    dir), nothing is appended.  Returns the record count appended —
+    best-effort, 0 on any failure (telemetry never raises)."""
+    import os
+
+    target = path or os.path.join(directory, "metrics.jsonl")
+    try:
+        existing, _ = read_samples(target)
+        if any("node" in s for s in existing):
+            return 0
+        records = collect_node_series(directory)
+        if not records:
+            return 0
+        with open(target, "a", encoding="utf-8") as f:
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return len(records)
+    except (OSError, TypeError, ValueError):
+        return 0
+
+
+def split_samples(samples):
+    """One mixed metrics.jsonl series -> ``(sidecar, node)`` sub-series.
+    Consumers that reason about the sidecar (recovery curves, baseline
+    SLO judges, the throughput plot) must not see node records — a
+    replica's ok=true tick would otherwise read as sidecar telemetry
+    resuming."""
+    sidecar = [s for s in samples if "node" not in s]
+    node = [s for s in samples if "node" in s]
+    return sidecar, node
+
+
+def replica_commit_rates(node_samples) -> dict:
+    """Node records -> ``{host: mean sampled commit rate}`` over the run
+    window (the straggler-detection input)."""
+    by_host: dict = {}
+    for s in node_samples:
+        metrics = s.get("metrics") or {}
+        rate = metrics.get("commit_rate")
+        if isinstance(rate, (int, float)):
+            by_host.setdefault(s["node"], []).append(float(rate))
+    return {host: sum(v) / len(v) for host, v in by_host.items() if v}
+
+
+def commit_rate_divergence(node_samples, threshold: float = 0.7) -> dict:
+    """Straggler detection over the sampled per-replica commit rates::
+
+        {"median": <committee median mean-rate>,
+         "rates": {host: mean_rate},
+         "stragglers": [{"host", "rate", "ratio"}]}   # ratio < threshold
+
+    A replica whose mean sampled commit rate falls below ``threshold``
+    of the committee median diverges — it commits, but late enough that
+    its view of the chain lags the committee (the LogParser surfaces
+    this as a note; strict mode is unaffected, divergence is evidence,
+    not failure)."""
+    from statistics import median
+
+    rates = replica_commit_rates(node_samples)
+    if len(rates) < 2:
+        return {"median": None, "rates": rates, "stragglers": []}
+    med = median(rates.values())
+    stragglers = []
+    if med > 0:
+        for host, rate in sorted(rates.items()):
+            ratio = rate / med
+            if ratio < threshold:
+                stragglers.append({"host": host,
+                                   "rate": round(rate, 3),
+                                   "ratio": round(ratio, 3)})
+    return {"median": round(med, 3), "rates":
+            {h: round(r, 3) for h, r in rates.items()},
+            "stragglers": stragglers}
 
 
 def recovery_curve(samples, event_wall: float) -> dict:

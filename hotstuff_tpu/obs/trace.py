@@ -41,6 +41,10 @@ from .spans import parse_spans
 NODE_STAGES = ("proposal", "verify_submit", "verify_reply", "commit")
 SEGMENTS = tuple(f"{a}->{b}" for a, b in zip(NODE_STAGES, NODE_STAGES[1:]))
 TOTAL_SEGMENT = "proposal->commit"
+# graftscope: the named device sub-segment of verify — the sidecar's
+# ctx-joined device span durations, reported next to the node segments
+# so "where did verify time go" has a device answer.
+DEVICE_SEGMENT = "verify:device"
 
 # The frozen node log grammar (common/log.hpp) around the TRACE payload
 # emitted by consensus/core.cpp: timestamp, level, module, then
@@ -193,6 +197,81 @@ def critical_path(traces: dict) -> dict:
             "segments": segments}
 
 
+# -- graftscope: per-block node<->sidecar joins ------------------------------
+
+
+def chain_spans(sidecar_spans) -> dict:
+    """ctx-tagged sidecar spans -> ``{block_digest_b64: [spans]}``.
+
+    The sidecar tags per-request spans (admit/queue/reply) with ``ctx``
+    and per-launch spans (pack/dispatch/device) with a ``ctxs`` list —
+    both carry the protocol-v5 context tag as the SAME base64 string the
+    C++ node logs in ``block=`` (common/bytes.hpp base64_encode), so the
+    join is plain string equality.  A launch coalescing several blocks'
+    requests contributes its spans to every one of their chains."""
+    chains: dict = {}
+    for s in sidecar_spans:
+        tags = []
+        ctx = s.get("ctx")
+        if isinstance(ctx, str):
+            tags.append(ctx)
+        ctxs = s.get("ctxs")
+        if isinstance(ctxs, (list, tuple)):
+            tags.extend(c for c in ctxs if isinstance(c, str))
+        for c in tags:
+            chains.setdefault(c, []).append(s)
+    return chains
+
+
+def join_blocks(traces: dict, chains: dict):
+    """Per-block traces + ctx chains -> ``(join, joined)``.
+
+    ``join`` is the machine-readable accounting::
+
+        {"committed": N,     # blocks with a commit stage
+         "with_verify": M,   # of those, blocks whose verify segment
+                             # (verify_submit AND verify_reply) traced
+         "joined": J,        # of those, blocks whose digest has a
+                             # sidecar chain with a device span
+         "rate": J / M}      # None when no block traced a verify
+
+    ``joined`` maps ``(block, round) -> chain spans`` for the blocks
+    that joined — what the Chrome exporter nests inside the block's
+    verify segment.  A block whose chain is missing (fast-path cache
+    answer on every replica, a torn span file) degrades the rate, never
+    the trace."""
+    committed = sum(1 for st in traces.values() if "commit" in st)
+    with_verify = 0
+    joined: dict = {}
+    for key, stages in traces.items():
+        if "commit" not in stages:
+            continue
+        if "verify_submit" not in stages or "verify_reply" not in stages:
+            continue
+        with_verify += 1
+        chain = chains.get(key[0])
+        if chain and any(s.get("stage") == "device" for s in chain):
+            joined[key] = chain
+    rate = round(len(joined) / with_verify, 4) if with_verify else None
+    return ({"committed": committed, "with_verify": with_verify,
+             "joined": len(joined), "rate": rate}, joined)
+
+
+def device_subsegment(joined: dict) -> dict:
+    """Joined chains -> the ``verify:device`` sub-segment percentiles
+    (per-block device milliseconds: the sum of the chain's device span
+    durations — one block's QC verify can split across launches)."""
+    vals = []
+    for chain in joined.values():
+        ms = sum(float(s.get("dur_ms") or 0.0) for s in chain
+                 if s.get("stage") == "device")
+        vals.append(ms)
+    vals.sort()
+    return {"n": len(vals),
+            "p50_ms": round(_percentile(vals, 0.50), 3),
+            "p99_ms": round(_percentile(vals, 0.99), 3)}
+
+
 def sidecar_breakdown(spans) -> dict:
     """Sidecar JSONL spans -> per-stage duration percentiles (same
     shape as the critical-path segments, keyed by span stage)."""
@@ -216,10 +295,18 @@ _PID_CONSENSUS = 1
 _PID_SIDECAR = 2
 
 
-def chrome_trace(traces: dict, sidecar_spans=()) -> dict:
+def chrome_trace(traces: dict, sidecar_spans=(), joined=None) -> dict:
     """Per-block traces + sidecar spans -> a Chrome trace-event JSON
     object (Perfetto-loadable: complete events, microsecond stamps
-    normalized to the earliest span, process-name metadata)."""
+    normalized to the earliest span, process-name metadata).
+
+    ``joined`` (graftscope, from :func:`join_blocks`) nests each joined
+    block's sidecar stage chain INSIDE that block's row on the consensus
+    process: the chain's spans are re-emitted at ``pid`` consensus /
+    ``tid`` round (cat ``sidecar``, block in args), so opening a block
+    in Perfetto shows device time as a sub-segment of its verify
+    segment.  The flat sidecar-process timeline is kept too — it still
+    carries the un-joined spans (bulk traffic, zero-tag requests)."""
     events = []
     t0_candidates = [min(stages.values()) for stages in traces.values()
                      if stages]
@@ -241,6 +328,18 @@ def chrome_trace(traces: dict, sidecar_spans=()) -> dict:
                     "pid": _PID_CONSENSUS, "tid": rnd,
                     "args": {"block": block, "round": rnd},
                 })
+    for (block, rnd), chain in sorted((joined or {}).items(),
+                                      key=lambda kv: kv[0][1]):
+        for s in chain:
+            events.append({
+                "name": f"sidecar:{s['stage']}", "ph": "X",
+                "cat": "sidecar",
+                "ts": us(s["t"]),
+                "dur": max(0.0, float(s.get("dur_ms") or 0.0) * 1e3),
+                "pid": _PID_CONSENSUS, "tid": rnd,
+                "args": {"block": block, "round": rnd,
+                         "rid": s.get("rid")},
+            })
     for s in sidecar_spans:
         args = {k: v for k, v in s.items()
                 if k not in ("stage", "t", "dur_ms")}
@@ -300,7 +399,14 @@ def build_run_trace(directory: str):
     summary = critical_path(traces)
     summary["sidecar"] = sidecar_breakdown(sc_spans)
     summary["malformed_spans"] = malformed
-    chrome = chrome_trace(traces, sc_spans)
+    # graftscope: join the ctx-tagged sidecar chains onto their blocks —
+    # device time becomes the verify:device sub-segment and join_rate
+    # says what fraction of verify-traced committed blocks carried one.
+    join, joined = join_blocks(traces, chain_spans(sc_spans))
+    summary["join"] = join
+    if joined:
+        summary["segments"][DEVICE_SEGMENT] = device_subsegment(joined)
+    chrome = chrome_trace(traces, sc_spans, joined=joined)
     summary["chrome_events"] = len(chrome["traceEvents"])
     return summary, chrome
 

@@ -52,7 +52,8 @@ class SidecarClient:
         self._await(rid)
         return True
 
-    def verify_batch(self, msgs, pks, sigs, *, bulk: bool = False) -> list:
+    def verify_batch(self, msgs, pks, sigs, *, bulk: bool = False,
+                     ctx: bytes | None = None) -> list:
         """Returns per-signature validity list of bools.
 
         ``bulk=True`` tags the request bulk-class on the wire
@@ -60,13 +61,18 @@ class SidecarClient:
         instead of ahead of them.  Mempool batch verification and
         offchain sweeps should pass it; QC/TC verification must not.
 
+        ``ctx`` (protocol v5, graftscope) attaches the 32-byte block
+        digest this verify serves, so the sidecar's stage spans join the
+        block's node-side trace in logs/trace.json.
+
         Raises :class:`SidecarOverloaded` when the sidecar sheds the
         request (its class queue was full)."""
         if not msgs:
             return []
         op = proto.OP_VERIFY_BULK if bulk else proto.OP_VERIFY_BATCH
         rid = self._send(
-            lambda r: proto.encode_request(r, msgs, pks, sigs, opcode=op))
+            lambda r: proto.encode_request(r, msgs, pks, sigs, opcode=op,
+                                           ctx=ctx))
         body = self._await(rid)
         if len(body) != len(msgs):
             raise SidecarOverloaded(

@@ -18,6 +18,8 @@ Request payload:
     u32 request id  echoed in the reply (lets a client pipeline requests)
     u32 count N     number of signature records (0 for PING)
     u16 msg_len M   byte length of each message (digests: 32)
+    [32 bytes context tag — protocol v5, OPTIONAL: the block digest this
+     verify serves; all-zero = none; discriminated by frame length]
     N * (M bytes msg | 32 bytes pubkey | 64 bytes signature)
 
 Reply payload:
@@ -81,12 +83,31 @@ OP_BUSY = 10
 
 # Version of this wire protocol, bumped when the opcode set or any frame
 # layout changes (v2: OP_VERIFY_BULK + OP_STATS; v3: OP_CHAOS; v4:
-# OP_BUSY retry-after replies).  Mirrored by the C++ client's
-# kProtocolVersion; graftlint's wire cross-checker pins the pair.
-# Replies an unknown-opcode ValueError on older peers rather than
-# desyncing, so the constant is documentation + lint anchor, not a
-# handshake.
-PROTOCOL_VERSION = 4
+# OP_BUSY retry-after replies; v5: the graftscope context tag below).
+# Mirrored by the C++ client's kProtocolVersion; graftlint's wire
+# cross-checker pins the pair.  Replies an unknown-opcode ValueError on
+# older peers rather than desyncing, so the constant is documentation +
+# lint anchor, not a handshake.
+PROTOCOL_VERSION = 5
+
+# Protocol v5 (graftscope): OP_VERIFY_BATCH / OP_VERIFY_BULK requests may
+# carry a 32-byte CONTEXT TAG between the fixed header and the records —
+# the block digest whose certificate this verify serves.  The sidecar
+# tags its admit/queue/pack/dispatch/device/reply spans with it, which
+# is what lets obs/trace.py nest the sidecar stage chain (device time
+# included) inside that block's verify segment in logs/trace.json.
+#
+# The tag is OPTIONAL and self-describing by frame length: a verify
+# payload is either header + N records (legacy, ctx None) or header +
+# 32 tag bytes + N records — unambiguous because a record is msg_len +
+# 96 >= 96 bytes, so 32 extra bytes can never alias a record count.
+# Writers emit the tag only when they HAVE a block context (the C++
+# client's no-context frames stay byte-identical to v4, so a node
+# upgraded before its sidecar keeps verifying), an ALL-ZERO tag is
+# tolerated and decodes as ctx None, and legacy tag-less frames stay
+# valid forever.
+CTX_LEN = 32
+ZERO_CTX = b"\x00" * CTX_LEN
 
 # Backpressure contract: v2/v3 shed replies were an EMPTY body (count 0)
 # for a request that carried records — unambiguous, because a real
@@ -118,6 +139,9 @@ class VerifyRequest:
     msgs: list
     pks: list
     sigs: list
+    # graftscope (protocol v5): the 32-byte block-digest context tag, or
+    # None when the frame carried none (legacy frame or all-zero tag).
+    ctx: bytes | None = None
 
 
 @dataclass
@@ -158,12 +182,19 @@ class ChaosRequest:
 
 
 def encode_request(request_id: int, msgs, pks, sigs,
-                   opcode: int = OP_VERIFY_BATCH) -> bytes:
+                   opcode: int = OP_VERIFY_BATCH,
+                   ctx: bytes | None = None) -> bytes:
+    """``ctx`` (protocol v5) attaches the 32-byte block-digest context
+    tag after the header; None emits the legacy tag-less frame (an
+    all-zero ctx is legal and decodes back as None)."""
     n = len(msgs)
     assert len(pks) == n and len(sigs) == n
     assert opcode in (OP_VERIFY_BATCH, OP_VERIFY_BULK)
     msg_len = len(msgs[0]) if n else 0
     parts = [_HDR.pack(opcode, request_id, n, msg_len)]
+    if ctx is not None:
+        assert len(ctx) == CTX_LEN
+        parts.append(ctx)
     for m, p, s in zip(msgs, pks, sigs):
         assert len(m) == msg_len and len(p) == ED_PK_LEN \
             and len(s) == ED_SIG_LEN
@@ -339,9 +370,17 @@ def decode_request(payload: bytes):
         return opcode, BlsMultiRequest(request_id, msgs, pks, sigs)
     rec = msg_len + ED_PK_LEN + ED_SIG_LEN
     off = _HDR.size
-    if len(payload) != off + n * rec:
+    # Protocol v5 context tag: frame length discriminates (a record is
+    # msg_len + 96 >= 96 bytes, so the 32 tag bytes never alias one).
+    ctx = None
+    if len(payload) == off + CTX_LEN + n * rec:
+        tag = payload[off:off + CTX_LEN]
+        ctx = None if tag == ZERO_CTX else tag
+        off += CTX_LEN
+    elif len(payload) != off + n * rec:
         raise ValueError(
-            f"bad frame: expected {off + n * rec} bytes, got {len(payload)}")
+            f"bad frame: expected {off + n * rec} "
+            f"(or +{CTX_LEN} tagged) bytes, got {len(payload)}")
     msgs, pks, sigs = [], [], []
     for _ in range(n):
         msgs.append(payload[off:off + msg_len])
@@ -350,7 +389,7 @@ def decode_request(payload: bytes):
         off += ED_PK_LEN
         sigs.append(payload[off:off + ED_SIG_LEN])
         off += ED_SIG_LEN
-    return opcode, VerifyRequest(request_id, msgs, pks, sigs)
+    return opcode, VerifyRequest(request_id, msgs, pks, sigs, ctx=ctx)
 
 
 def encode_reply(opcode: int, request_id: int, mask) -> bytes:

@@ -59,6 +59,31 @@ MAX_COALESCED = 16 * MAX_SUBBATCH
 _Pending = vsched.Pending
 
 
+from base64 import b64encode as _b64encode
+
+
+def _ctx_tag(request):
+    """Protocol v5 block-digest context tag -> the base64 string the C++
+    node logs in its TRACE lines (common/bytes.hpp base64_encode:
+    standard alphabet, padded — python's b64encode matches), so
+    obs/trace.py joins on string equality.  None when untagged.
+
+    Callers must gate on ``tracer.enabled`` (the trace_stage cost
+    discipline): the un-traced hot path never pays the encode."""
+    ctx = getattr(request, "ctx", None)
+    if not ctx:
+        return None
+    return _b64encode(ctx).decode("ascii")
+
+
+def _ctx_tags(batch):
+    """Distinct context tags across one coalesced launch (sorted for a
+    stable span schema); empty when no request carried one."""
+    tags = {_ctx_tag(p.request) for p in batch}
+    tags.discard(None)
+    return sorted(tags)
+
+
 class ChaosState:
     """Protocol v3 fault-injection hook (OP_CHAOS, behind ``--chaos``).
 
@@ -215,9 +240,15 @@ class VerifyEngine:
         (the handler sends the explicit empty-mask backpressure reply);
         never blocks the calling connection thread."""
         ok = self._sched.offer(request, reply_fn, cls=cls, is_bls=is_bls)
-        self._tracer.event("admit", rid=request.request_id, cls=cls,
-                           ok=ok, n=len(getattr(request, "msgs", ()) or ())
-                           or 1)
+        if self._tracer.enabled:
+            tags = {}
+            ctx = _ctx_tag(request)
+            if ctx:
+                tags["ctx"] = ctx
+            self._tracer.event("admit", rid=request.request_id, cls=cls,
+                               ok=ok,
+                               n=len(getattr(request, "msgs", ()) or ())
+                               or 1, **tags)
         return ok
 
     def retry_after_ms(self, cls: str) -> int:
@@ -390,15 +421,23 @@ class VerifyEngine:
             return
         now = monotonic()
         for p in launch.items:
+            tags = {}
+            ctx = _ctx_tag(p.request)
+            if ctx:
+                tags["ctx"] = ctx
             self._tracer.event("queue", dur_ms=(now - p.enqueued_at) * 1e3,
-                               rid=p.request.request_id, cls=p.cls)
+                               rid=p.request.request_id, cls=p.cls, **tags)
 
     def _trace_replies(self, batch):
         if not self._tracer.enabled:
             return
         for p in batch:
+            tags = {}
+            ctx = _ctx_tag(p.request)
+            if ctx:
+                tags["ctx"] = ctx
             self._tracer.event("reply", rid=p.request.request_id,
-                               cls=p.cls)
+                               cls=p.cls, **tags)
 
     def _dispatch_one(self, packing, inflight):
         """Move the oldest staged pack onto the device (engine thread)."""
@@ -411,7 +450,12 @@ class VerifyEngine:
                 p.reply_fn([False] * len(p.request.msgs))
             self._trace_replies(batch)
             return
-        self._tracer.event("dispatch", reqs=len(batch))
+        if self._tracer.enabled:
+            tags = {}
+            ctxs = _ctx_tags(batch)
+            if ctxs:
+                tags["ctxs"] = ctxs
+            self._tracer.event("dispatch", reqs=len(batch), **tags)
         inflight.append((batch, fetch, monotonic()))
         self._inflight_n = len(inflight)
 
@@ -428,10 +472,15 @@ class VerifyEngine:
             return
         # The device stage spans dispatch -> fetch completion: it
         # includes the tunnel round trip, exactly what the engine pays.
-        self._tracer.event("device",
-                           dur_ms=(monotonic() - dispatched_at) * 1e3,
-                           reqs=len(batch),
-                           sigs=sum(len(p.request.msgs) for p in batch))
+        if self._tracer.enabled:
+            tags = {}
+            ctxs = _ctx_tags(batch)
+            if ctxs:
+                tags["ctxs"] = ctxs
+            self._tracer.event(
+                "device", dur_ms=(monotonic() - dispatched_at) * 1e3,
+                reqs=len(batch),
+                sigs=sum(len(p.request.msgs) for p in batch), **tags)
         off = 0
         for p in batch:
             n = len(p.request.msgs)
@@ -534,9 +583,14 @@ class VerifyEngine:
                                                    m_sigs[i:i + step])
                            for i in range(0, len(m_msgs), step)]
         stats.note_pack(monotonic() - t0, hidden)
-        self._tracer.event("pack", dur_ms=(monotonic() - t0) * 1e3,
-                           reqs=len(batch), uniq=len(uniq_records),
-                           path=path, hidden=hidden)
+        if self._tracer.enabled:
+            pack_tags = {}
+            pack_ctxs = _ctx_tags(batch)
+            if pack_ctxs:
+                pack_tags["ctxs"] = pack_ctxs
+            self._tracer.event("pack", dur_ms=(monotonic() - t0) * 1e3,
+                               reqs=len(batch), uniq=len(uniq_records),
+                               path=path, hidden=hidden, **pack_tags)
 
         def dispatch():
             fetchers = [d() for d in dispatchers]

@@ -1,0 +1,112 @@
+#include "common/metrics.hpp"
+
+#include <chrono>
+#include <cstdio>
+
+#include "common/log.hpp"
+#include "crypto/sidecar_client.hpp"
+#include "mempool/ingress.hpp"
+
+namespace hotstuff {
+
+NodeMetrics& NodeMetrics::instance() {
+  static NodeMetrics g;
+  return g;
+}
+
+void NodeMetrics::note_commit() {
+  if (!log_trace_enabled()) return;
+  commits_.fetch_add(1, std::memory_order_relaxed);
+}
+
+void NodeMetrics::set_ingress_gate(
+    std::weak_ptr<const mempool::IngressGate> gate) {
+  std::lock_guard<std::mutex> lk(m_);
+  gate_ = std::move(gate);
+}
+
+namespace {
+const char* breaker_name(TpuVerifier* tpu) {
+  if (tpu == nullptr) return "none";
+  switch (tpu->breaker_state()) {
+    case TpuVerifier::BreakerState::kOpen:
+      return "open";
+    case TpuVerifier::BreakerState::kHalfOpen:
+      return "half_open";
+    case TpuVerifier::BreakerState::kClosed:
+    default:
+      return "closed";
+  }
+}
+}  // namespace
+
+void NodeMetrics::emit_sample(double dt_s) {
+  uint64_t commits = commits_.load(std::memory_order_relaxed);
+  uint64_t delta = commits - last_commits_;
+  last_commits_ = commits;
+  double rate = dt_s > 0 ? double(delta) / dt_s : 0.0;
+  // Fixed one-decimal rate: the python miner's grammar expects a plain
+  // [0-9.]+ token, never scientific notation.
+  char rate_buf[32];
+  std::snprintf(rate_buf, sizeof(rate_buf), "%.1f", rate);
+  uint64_t ingress_tx = 0;
+  uint64_t ingress_bytes = 0;
+  uint64_t busy = 0;
+  {
+    std::shared_ptr<const mempool::IngressGate> gate;
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      gate = gate_.lock();
+    }
+    if (gate) {
+      ingress_tx = gate->queued_txs();
+      ingress_bytes = gate->queued_bytes();
+      busy = gate->sheds();
+    }
+  }
+  // FROZEN grammar (obs/sampler.py _NODE_METRICS_RE; graftlint
+  // obsgrammar cross-checks): append-only.
+  LOG_INFO("node::metrics")
+      << "METRICS commits=" << commits << " commit_rate=" << rate_buf
+      << " ingress_tx=" << ingress_tx << " ingress_bytes=" << ingress_bytes
+      << " busy=" << busy << " breaker=" << breaker_name(
+          TpuVerifier::instance());
+}
+
+void NodeMetrics::start(uint64_t interval_ms) {
+  std::lock_guard<std::mutex> lk(m_);
+  if (running_) return;
+  running_ = true;
+  stopping_ = false;
+  last_commits_ = commits_.load(std::memory_order_relaxed);
+  thread_ = std::thread([this, interval_ms] {
+    set_thread_name("node-metrics");
+    auto last = std::chrono::steady_clock::now();
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_.wait_for(lk, std::chrono::milliseconds(interval_ms),
+                     [this] { return stopping_; });
+        if (stopping_) return;
+      }
+      auto now = std::chrono::steady_clock::now();
+      emit_sample(std::chrono::duration<double>(now - last).count());
+      last = now;
+    }
+  });
+}
+
+void NodeMetrics::stop() {
+  std::thread t;
+  {
+    std::lock_guard<std::mutex> lk(m_);
+    if (!running_) return;
+    stopping_ = true;
+    running_ = false;
+    t = std::move(thread_);
+  }
+  cv_.notify_all();
+  if (t.joinable()) t.join();
+}
+
+}  // namespace hotstuff

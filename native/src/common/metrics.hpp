@@ -1,0 +1,83 @@
+// graftscope node-side metrics: 1 Hz machine-parseable METRICS lines.
+//
+// The sidecar has had a live OP_STATS time series since grafttrace; the
+// C++ node had nothing — a straggling replica, a paused ingress, or a
+// breaker stuck open was invisible until the post-run log mining.  This
+// sampler emits one line per second into the node's own log, in the
+// frozen log grammar, so hotstuff_tpu/obs/sampler.py can read the node
+// side NEXT TO the sidecar series in logs/metrics.jsonl:
+//
+//   [<ts>Z INFO node::metrics] METRICS commits=<u64> commit_rate=<f.1>
+//       ingress_tx=<u64> ingress_bytes=<u64> busy=<u64>
+//       breaker=<closed|open|half_open|none>
+//
+// The line grammar is FROZEN (mined by obs/sampler.py; graftlint's
+// obsgrammar checker cross-checks the two sides) — extend by appending
+// key=value fields only.
+//
+// Cost discipline (the trace_stage contract): everything here is behind
+// the parameters-file `trace` flag.  The one hot-path instrumentation
+// site, note_commit(), pays exactly one relaxed atomic load when
+// tracing is off (log_trace_enabled()) and one relaxed fetch_add when
+// on; gauges (ingress fill, breaker state) are read by the 1 Hz sampler
+// thread only, never on a hot path.
+//
+// Process scope: one singleton per process, like TpuVerifier — the
+// harness runs one node per process.  In-process multi-node tests
+// (test_e2e) share the counter; the sampler is only started by
+// Node::create under the trace flag, which those tests leave off.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+namespace hotstuff {
+
+namespace mempool {
+class IngressGate;
+}  // namespace mempool
+
+class NodeMetrics {
+ public:
+  static NodeMetrics& instance();
+
+  // Consensus core thread, once per committed block.  One relaxed load
+  // when tracing is off; one relaxed add when on (same discipline as
+  // trace_stage in consensus/core.cpp).
+  void note_commit();
+  uint64_t commits() const {
+    return commits_.load(std::memory_order_relaxed);
+  }
+
+  // Mempool boot registers its ingress gate so the sampler can report
+  // fill + BUSY sheds; weak so the gate's lifetime stays the mempool's.
+  void set_ingress_gate(std::weak_ptr<const mempool::IngressGate> gate);
+
+  // Start/stop the 1 Hz sampler thread (Node::create under the `trace`
+  // parameter; idempotent — a second start is a no-op).
+  void start(uint64_t interval_ms = 1000);
+  void stop();
+
+  // One METRICS line from the current counters (the sampler's tick body,
+  // exposed for tests); `dt_s` scales the commit-rate delta.
+  void emit_sample(double dt_s);
+
+ private:
+  NodeMetrics() = default;
+
+  std::atomic<uint64_t> commits_{0};
+
+  std::mutex m_;
+  std::condition_variable cv_;  // SHARED_OK(waited on under m_)
+  std::weak_ptr<const mempool::IngressGate> gate_;  // GUARDED_BY(m_)
+  bool running_ = false;                            // GUARDED_BY(m_)
+  bool stopping_ = false;                           // GUARDED_BY(m_)
+  std::thread thread_;                              // GUARDED_BY(m_)
+  uint64_t last_commits_ = 0;  // OWNED_BY(sampler thread)
+};
+
+}  // namespace hotstuff

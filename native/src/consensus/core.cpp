@@ -8,6 +8,7 @@
 #include <thread>
 
 #include "common/log.hpp"
+#include "common/metrics.hpp"
 #include "crypto/sidecar_client.hpp"
 
 namespace hotstuff {
@@ -173,6 +174,7 @@ class CoreImpl {
 
     for (const Block& b : to_commit) {
       trace_stage("commit", b);
+      NodeMetrics::instance().note_commit();
       if (!b.payload.empty()) {
         LOG_INFO("consensus::core") << "Committed B" << b.round;
         // NOTE: These log entries are used to compute performance
@@ -538,11 +540,19 @@ class CoreImpl {
       items.insert(items.end(), ti.begin(), ti.end());
     }
     Block copy = block;
+    // graftscope: the block digest rides the verify RPC as the protocol
+    // v5 context tag, so the sidecar's admit/queue/pack/dispatch/device/
+    // reply spans for this batch join this block's verify segment in the
+    // merged trace (the frame is built before this call returns, so the
+    // stack digest is safe to pass by pointer).
+    Digest ctx = block.digest();
     Signature::verify_batch_multi_async(
-        std::move(items), [ch, copy](std::optional<bool> ok) mutable {
+        std::move(items),
+        [ch, copy](std::optional<bool> ok) mutable {
           CoreEvent e = CoreEvent::verdict_of(std::move(copy), ok);
           ch->try_send(std::move(e));
-        });
+        },
+        &ctx);
     return true;
   }
 

@@ -310,7 +310,7 @@ bool Signature::async_available() {
 
 void Signature::verify_batch_multi_async(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-    AsyncCallback cb) {
+    AsyncCallback cb, const Digest* ctx) {
   TpuVerifier* tpu = TpuVerifier::instance();
   if (!tpu) {
     cb(std::nullopt);
@@ -319,7 +319,8 @@ void Signature::verify_batch_multi_async(
   if (current_scheme() == Scheme::kBls) {
     // No host pairing exists in C++: transport failure is a definitive
     // reject (same policy as the synchronous path above), so map nullopt
-    // to false rather than asking the caller to retry.
+    // to false rather than asking the caller to retry.  (The BLS opcodes
+    // predate the v5 context tag; ctx is Ed25519-path-only for now.)
     tpu->bls_verify_multi_async(items, [cb = std::move(cb)](
                                            std::optional<bool> ok) {
       cb(ok.value_or(false));
@@ -339,7 +340,8 @@ void Signature::verify_batch_multi_async(
           }
         }
         cb(true);
-      });
+      },
+      /*bulk=*/false, ctx);
 }
 
 KeyPair generate_keypair() {

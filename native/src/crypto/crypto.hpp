@@ -185,10 +185,15 @@ struct Signature {
   // suspend a proposal on a pending device verify instead of eating the
   // device round-trip on its own thread (SURVEY.md §7; the reference's
   // QC::verify is synchronous, consensus/src/messages.rs:180-198).
+  //
+  // `ctx` (graftscope, protocol v5): digest of the block whose
+  // certificates this batch verifies — rides the verify RPC as the
+  // context tag so the sidecar's stage spans join the block's trace.
+  // nullptr sends the legacy tag-less frame (v4-compatible).
   using AsyncCallback = std::function<void(std::optional<bool>)>;
   static void verify_batch_multi_async(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-      AsyncCallback cb);
+      AsyncCallback cb, const Digest* ctx = nullptr);
 };
 
 struct KeyPair {

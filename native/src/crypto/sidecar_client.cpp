@@ -35,7 +35,12 @@ constexpr uint8_t kOpChaos = 9;  // NOLINT (wire constant, unused here)
 // (host fallback now); the in-flight AIMD already paces resubmission,
 // so the hint is logged, not slept on.
 constexpr uint8_t kOpBusy = 10;
-constexpr uint8_t kProtocolVersion = 4;  // NOLINT (lint anchor; no handshake)
+// Protocol v5 (graftscope): verify requests carry a 32-byte block-digest
+// context tag between the header and the records (all-zero = none), so
+// the sidecar's stage spans can be joined to the block's node-side
+// trace.  Frame length discriminates tagged from legacy frames.
+constexpr size_t kCtxLen = 32;
+constexpr uint8_t kProtocolVersion = 5;  // NOLINT (lint anchor; no handshake)
 constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
@@ -439,7 +444,7 @@ void TpuVerifier::submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
 
 void TpuVerifier::verify_batch_multi_async(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-    MaskCallback cb, bool bulk) {
+    MaskCallback cb, bool bulk, const Digest* ctx) {
   // Class tag rides the opcode: consensus QC/TC verifies stay latency
   // class (the sidecar launches them ahead of any bulk backlog); bulk
   // callers (offchain sweeps, mempool-style batches) must say so.
@@ -451,6 +456,17 @@ void TpuVerifier::verify_batch_multi_async(
     rid = inner_->next_id++;
   }
   write_header(&w, opcode, rid, static_cast<uint32_t>(items.size()));
+  // Protocol v5 context tag, written ONLY when a block context exists:
+  // the tag rides between header and records and the sidecar
+  // discriminates by frame length, so an untagged frame is byte-for-
+  // byte the legacy v4 form — a node upgraded before its sidecar keeps
+  // verifying (no-ctx callers emit frames a v4 decoder still accepts,
+  // and tagged frames only flow once tracing-relevant traffic exists).
+  // An all-zero tag is also legal on the wire and decodes as "none".
+  if (ctx != nullptr) {
+    static_assert(sizeof(ctx->data) == kCtxLen, "ctx tag is a digest");
+    w.fixed(ctx->data);
+  }
   for (const auto& [digest, pk, sig] : items) {
     if (sig.data.size() != 64) {  // not an Ed25519 sig
       cb(std::nullopt);
@@ -515,14 +531,14 @@ void TpuVerifier::verify_batch_multi_async(
 
 std::optional<std::vector<bool>> TpuVerifier::verify_batch_multi(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-    bool bulk) {
+    bool bulk, const Digest* ctx) {
   Oneshot<std::optional<std::vector<bool>>> done;
   verify_batch_multi_async(
       items,
       [done](std::optional<std::vector<bool>> mask) {
         done.set(std::move(mask));
       },
-      bulk);
+      bulk, ctx);
   return done.wait();  // bounded: every submitted callback fires by deadline
 }
 

@@ -84,9 +84,17 @@ class TpuVerifier {
   // false = latency class (consensus QC/TC verification — launched ahead
   // of any bulk backlog), true = bulk class (mempool/offchain batches —
   // coalesced behind latency work).  Consensus paths must NOT pass true.
+  //
+  // `ctx` (protocol v5, graftscope) is the 32-byte block-digest context
+  // tag: the consensus core passes the digest of the block whose
+  // certificates this batch verifies, and the sidecar tags its stage
+  // spans with it so obs/trace.py can nest device time inside that
+  // block's verify segment.  nullptr emits the legacy tag-less frame —
+  // byte-identical to v4, so a node upgraded before its sidecar keeps
+  // its no-context verifies working.
   std::optional<std::vector<bool>> verify_batch_multi(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-      bool bulk = false);
+      bool bulk = false, const Digest* ctx = nullptr);
 
   // Asynchronous form: the callback is invoked EXACTLY once — with the
   // validity mask on a reply, or nullopt on transport failure/timeout —
@@ -96,7 +104,7 @@ class TpuVerifier {
       std::function<void(std::optional<std::vector<bool>>)>;
   void verify_batch_multi_async(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-      MaskCallback cb, bool bulk = false);
+      MaskCallback cb, bool bulk = false, const Digest* ctx = nullptr);
 
   // scheme=bls operations (pairing lives only in the sidecar; signing is
   // its host G2 scalar mult). These use a longer deadline than Ed25519

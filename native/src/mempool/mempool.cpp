@@ -1,6 +1,7 @@
 #include "mempool/mempool.hpp"
 
 #include "common/log.hpp"
+#include "common/metrics.hpp"
 #include "mempool/batch_maker.hpp"
 #include "mempool/helper.hpp"
 #include "mempool/processor.hpp"
@@ -62,6 +63,9 @@ std::unique_ptr<Mempool> Mempool::spawn(
   NetworkReceiver* tx_rx = &mp->tx_receiver_;
   mp->ingress_gate_ = std::make_shared<IngressGate>(
       gate_cfg, [tx_rx](bool paused) { tx_rx->set_read_paused(paused); });
+  // graftscope: the node METRICS sampler reports ingress fill + BUSY
+  // sheds from this gate (weak ref — the gate's lifetime stays ours).
+  NodeMetrics::instance().set_ingress_gate(mp->ingress_gate_);
   auto gate = mp->ingress_gate_;
   auto tx_address = committee.transactions_address(name);
   if (!tx_address) throw std::runtime_error("our key is not in the committee");

@@ -1,6 +1,7 @@
 #include "node/node.hpp"
 
 #include "common/log.hpp"
+#include "common/metrics.hpp"
 #include "crypto/sidecar_client.hpp"
 
 namespace hotstuff {
@@ -24,9 +25,14 @@ std::unique_ptr<Node> Node::create(const std::string& committee_file,
   // grafttrace: span lines are opt-in per deployment; the harness turns
   // them on for benched runs so commit latency is attributable per
   // stage (obs/trace.py stitches them into per-block critical paths).
+  // graftscope rides the same flag: the 1 Hz METRICS sampler (commit
+  // rate, ingress fill, BUSY sheds, breaker state) starts with tracing
+  // so a benched run's node side lands next to the sidecar series in
+  // logs/metrics.jsonl.
   if (parameters.trace) {
     log_set_trace(true);
     LOG_INFO("node::node") << "Consensus tracing enabled (TRACE spans)";
+    NodeMetrics::instance().start();
   }
 
   // Device dispatch for QC batch verification (process-wide; the crypto
@@ -99,7 +105,9 @@ void Node::stop() {
   // mempool; the store and signature service wind down with their last
   // handles. The reference gets the equivalent ordering from tokio runtime
   // drop; here it is explicit so `node` exits cleanly on SIGTERM and the
-  // in-process e2e test tears down without leaking threads.
+  // in-process e2e test tears down without leaking threads.  The METRICS
+  // sampler goes first — its gauges read the mempool's ingress gate.
+  NodeMetrics::instance().stop();
   if (consensus_) consensus_->stop();
   if (mempool_) mempool_->stop();
 }

@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""graftscope bench-trajectory regression ledger.
+
+Every driver round commits a ``BENCH_*.json`` artifact, but nothing ever
+READ them as a sequence — a perf regression between rounds (a headline
+that quietly halved, a sub-field that vanished) was invisible until a
+human diffed the files.  This script parses every committed artifact
+into one trajectory, ``results/trend.json``, and judges the latest live
+numbers against the best on record:
+
+  * per-headline-field best/latest (fields are the flattened numeric
+    leaves of the emitted JSON line: ``value``,
+    ``rlc.n256.rlc_sigs_per_s``, ``roofline.n1024.pallas...``, ...);
+  * degraded runs flagged (``"degraded": true`` lines, non-zero driver
+    rc, rounds that emitted nothing) and EXCLUDED from "best" and from
+    the regression comparison — a CPU-fallback number regressing
+    against a TPU best is backend noise, not a regression;
+  * schema-tolerant across rounds: artifacts are driver wrappers with a
+    ``parsed`` line (BENCH_r01..), bare headline objects
+    (BENCH_surge_degraded), or wedged rounds with no line at all
+    (BENCH_r04/r05 rc=124) — all land in the ledger.
+
+``--check`` exits non-zero when the latest live headline ``value``
+regressed more than ``--threshold`` (default 0.2 = 20%) below the best
+live value on record.  CI runs it warn-only today (no live device
+number has landed since round 2); the moment the first real device
+headline lands, this ledger is what will defend it.
+
+Usage:
+    python scripts/bench_trend.py                # write results/trend.json
+    python scripts/bench_trend.py --check        # + exit 1 on regression
+    python scripts/bench_trend.py --check --threshold 0.1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from glob import glob
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA = "bench-trend-v1"
+# The one field --check judges: the headline sigs/sec number every
+# round emits.
+HEADLINE_FIELD = "value"
+
+
+def flatten_numeric(obj, prefix: str = "") -> dict:
+    """JSON object -> {dotted.path: number} over its numeric leaves
+    (bools excluded: ``"degraded": true`` is a flag, not a measurement).
+    Lists are indexed; strings/None are skipped."""
+    out: dict = {}
+    if isinstance(obj, bool) or obj is None:
+        return out
+    if isinstance(obj, (int, float)):
+        if prefix:
+            out[prefix] = obj
+        return out
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten_numeric(v, key))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            out.update(flatten_numeric(v, f"{prefix}[{i}]"))
+    return out
+
+
+def parse_artifact(path: str) -> dict:
+    """One BENCH_*.json -> a run record (never raises; unreadable files
+    become flagged degraded runs with an error note)."""
+    name = os.path.basename(path)
+    run = {"file": name, "n": None, "rc": None, "degraded": True,
+           "error": None, "fields": {}}
+    m = re.search(r"_r(\d+)", name)
+    if m:
+        run["n"] = int(m.group(1))
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        run["error"] = f"unreadable: {e}"
+        return run
+    if not isinstance(doc, dict):
+        run["error"] = "artifact is not a JSON object"
+        return run
+    # Driver-wrapper shape: {"n", "rc", "tail", "parsed": {...}|null}.
+    # Bare-headline shape: {"metric", "value", ...}.
+    parsed = doc.get("parsed") if "parsed" in doc else doc
+    if isinstance(doc.get("n"), int):
+        run["n"] = doc["n"]
+    if isinstance(doc.get("rc"), int):
+        run["rc"] = doc["rc"]
+    if not isinstance(parsed, dict) or "value" not in parsed:
+        run["error"] = "no parsed headline line (wedged round)"
+        return run
+    run["fields"] = flatten_numeric(parsed)
+    err = parsed.get("error") or parsed.get("note")
+    if isinstance(err, str):
+        run["error"] = err[:200]
+    # A live run: the driver exited 0 (or the artifact has no rc), the
+    # line is not self-flagged degraded, and it carried no error.
+    run["degraded"] = bool(parsed.get("degraded")) \
+        or (run["rc"] not in (None, 0)) \
+        or bool(parsed.get("error")) \
+        or parsed.get("value") in (0, None)
+    return run
+
+
+def build_trend(paths) -> dict:
+    runs = [parse_artifact(p) for p in paths]
+    # Round order: numbered rounds first (ascending), then the named
+    # artifacts (degraded committed lines) in name order.
+    runs.sort(key=lambda r: (r["n"] is None, r["n"] or 0, r["file"]))
+    fields: dict = {}
+    for run in runs:
+        for path, val in run["fields"].items():
+            entry = fields.setdefault(path, {
+                "best": None, "best_run": None,
+                "latest": None, "latest_run": None,
+                "latest_live": None, "latest_live_run": None,
+                "latest_degraded": None})
+            entry["latest"] = val
+            entry["latest_run"] = run["file"]
+            entry["latest_degraded"] = run["degraded"]
+            if not run["degraded"]:
+                entry["latest_live"] = val
+                entry["latest_live_run"] = run["file"]
+                if entry["best"] is None or val > entry["best"]:
+                    entry["best"] = val
+                    entry["best_run"] = run["file"]
+    return {
+        "schema": SCHEMA,
+        "runs": [{k: v for k, v in r.items() if k != "fields"}
+                 | {"value": r["fields"].get(HEADLINE_FIELD)}
+                 for r in runs],
+        "fields": fields,
+    }
+
+
+def judge(trend: dict, threshold: float) -> dict:
+    """Regression verdict on the headline field: latest live value vs
+    best live value on record.  Not judgeable (no live run, or only
+    one) => ok with a reason — the gate must not fail on a repo whose
+    only committed lines are degraded."""
+    entry = trend["fields"].get(HEADLINE_FIELD) or {}
+    best, latest = entry.get("best"), entry.get("latest_live")
+    if best is None or latest is None:
+        return {"ok": True, "judged": False, "threshold": threshold,
+                "reason": "no live headline run on record"}
+    if entry.get("best_run") == entry.get("latest_live_run"):
+        return {"ok": True, "judged": False, "threshold": threshold,
+                "reason": "latest live run IS the best on record"}
+    floor = best * (1.0 - threshold)
+    ok = latest >= floor
+    return {"ok": ok, "judged": True, "threshold": threshold,
+            "best": best, "best_run": entry["best_run"],
+            "latest": latest, "latest_run": entry["latest_live_run"],
+            "floor": round(floor, 3),
+            "reason": None if ok else (
+                f"latest live headline {latest:g} fell "
+                f"{(1 - latest / best):.0%} below best {best:g} "
+                f"({entry['best_run']}) — past the {threshold:.0%} "
+                "threshold")}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO,
+                    help="repo root holding the BENCH_*.json artifacts")
+    ap.add_argument("--glob", default="BENCH_*.json",
+                    help="artifact pattern relative to --root")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="trajectory output (default "
+                         "<root>/results/trend.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the latest live headline regressed "
+                         "past --threshold below the best on record")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="allowed relative regression (default 0.2)")
+    args = ap.parse_args(argv)
+    if not 0.0 <= args.threshold < 1.0:
+        print("bench_trend: --threshold must be in [0, 1)",
+              file=sys.stderr)
+        return 2
+    paths = sorted(glob(os.path.join(args.root, args.glob)))
+    if not paths:
+        print(f"bench_trend: no artifacts match {args.glob} under "
+              f"{args.root}", file=sys.stderr)
+        return 2
+    trend = build_trend(paths)
+    verdict = judge(trend, args.threshold)
+    trend["check"] = verdict
+    out = args.out or os.path.join(args.root, "results", "trend.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    tmp = out + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(trend, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, out)
+
+    live = [r for r in trend["runs"] if not r["degraded"]]
+    print(f"bench_trend: {len(trend['runs'])} run(s) "
+          f"({len(live)} live, {len(trend['runs']) - len(live)} "
+          f"degraded/wedged), {len(trend['fields'])} field(s) -> {out}")
+    for r in trend["runs"]:
+        tag = "live" if not r["degraded"] else "DEGRADED"
+        val = f"{r['value']:g}" if isinstance(
+            r["value"], (int, float)) else "-"
+        note = f" [{r['error']}]" if r["error"] else ""
+        print(f"  {r['file']}: value={val} ({tag}){note}")
+    if verdict["judged"]:
+        word = "ok" if verdict["ok"] else "REGRESSION"
+        print(f"bench_trend: headline {word}: latest live "
+              f"{verdict['latest']:g} vs best {verdict['best']:g} "
+              f"(floor {verdict['floor']:g})")
+    else:
+        print(f"bench_trend: headline not judged: {verdict['reason']}")
+    if args.check and not verdict["ok"]:
+        print(f"bench_trend: CHECK FAILED: {verdict['reason']}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,14 @@ if __name__ == "__main__":
                     "obsspan:hotstuff_tpu/sidecar/service.py",
                     "timing:hotstuff_tpu/obs/trace.py",
                     "timing:hotstuff_tpu/obs/sampler.py",
+                    # graftscope: both halves of each frozen node-log
+                    # grammar (TRACE + METRICS) stay inside the
+                    # obsgrammar cross-check — a side moving out of the
+                    # scan is how a one-sided grammar edit ships.
+                    "obsgrammar:hotstuff_tpu/obs/trace.py",
+                    "obsgrammar:hotstuff_tpu/obs/sampler.py",
+                    "obsgrammar:native/src/consensus/core.cpp",
+                    "obsgrammar:native/src/common/metrics.cpp",
                     # graftsync: every threaded Python module stays
                     # inside the THREADS scan, and every annotated
                     # native file inside the CXXSYNC scan — a module
@@ -110,6 +118,8 @@ if __name__ == "__main__":
                     "cxxsync:native/src/crypto/sidecar_client.cpp",
                     "cxxsync:native/src/consensus/mempool_driver.hpp",
                     "cxxsync:native/src/consensus/core.cpp",
-                    "cxxsync:native/src/mempool/ingress.hpp"):
+                    "cxxsync:native/src/mempool/ingress.hpp",
+                    "cxxsync:native/src/common/metrics.hpp",
+                    "cxxsync:native/src/common/metrics.cpp"):
             argv += ["--must-cover", pin]
     sys.exit(main(argv))

@@ -952,6 +952,103 @@ def test_obs_modules_pinned_to_span_and_timing_scans():
 
 
 # ---------------------------------------------------------------------------
+# graftscope: obsgrammar rules (Python<->C++ log-line grammar pins)
+# ---------------------------------------------------------------------------
+
+from hotstuff_tpu.analysis import obsgrammar
+
+_GOOD_METRICS_PY = '''
+_NODE_METRICS_RE = (r"\\[(\\S+Z) \\w+ [^\\]]+\\] METRICS "
+                    r"commits=(\\d+) commit_rate=([0-9.]+) "
+                    r"ingress_tx=(\\d+) ingress_bytes=(\\d+) "
+                    r"busy=(\\d+) breaker=(\\w+)")
+'''
+
+_GOOD_METRICS_CPP = '''
+void NodeMetrics::emit_sample(double dt_s) {
+  LOG_INFO("node::metrics")
+      << "METRICS commits=" << commits << " commit_rate=" << rate_buf
+      << " ingress_tx=" << ingress_tx << " ingress_bytes=" << ingress_bytes
+      << " busy=" << busy << " breaker=" << breaker_name(tpu);
+}
+'''
+
+
+def test_obsgrammar_clean_fixture_pair():
+    assert obsgrammar.check_sources({
+        "hotstuff_tpu/obs/sampler.py": _GOOD_METRICS_PY,
+        "native/src/common/metrics.cpp": _GOOD_METRICS_CPP}) == []
+
+
+def test_obsgrammar_renamed_cpp_key_fires():
+    bad = _GOOD_METRICS_CPP.replace('" busy="', '" busyx="')
+    findings = obsgrammar.check_sources({
+        "hotstuff_tpu/obs/sampler.py": _GOOD_METRICS_PY,
+        "native/src/common/metrics.cpp": bad})
+    assert [f.rule for f in findings] == ["metrics-grammar-mismatch"]
+    assert "busyx" in findings[0].message
+
+
+def test_obsgrammar_reordered_keys_fire_despite_same_set():
+    bad = _GOOD_METRICS_CPP.replace(
+        '" ingress_tx=" << ingress_tx << " ingress_bytes=" << ingress_bytes',
+        '" ingress_bytes=" << ingress_bytes << " ingress_tx=" << ingress_tx')
+    findings = obsgrammar.check_sources({
+        "hotstuff_tpu/obs/sampler.py": _GOOD_METRICS_PY,
+        "native/src/common/metrics.cpp": bad})
+    assert [f.rule for f in findings] == ["metrics-grammar-mismatch"]
+
+
+def test_obsgrammar_missing_anchor_is_a_finding():
+    # A python side whose regex vanished cannot be silently ignored.
+    findings = obsgrammar.check_sources({
+        "hotstuff_tpu/obs/sampler.py": "X = 1\n",
+        "native/src/common/metrics.cpp": _GOOD_METRICS_CPP})
+    assert findings and all(f.rule == "metrics-grammar-mismatch"
+                            for f in findings)
+    # Same for an emit site that disappeared from the C++.
+    findings = obsgrammar.check_sources({
+        "hotstuff_tpu/obs/sampler.py": _GOOD_METRICS_PY,
+        "native/src/common/metrics.cpp": "int x;\n"})
+    assert findings and "emit site" in findings[0].message
+
+
+def test_obsgrammar_trace_pair_fixture():
+    py = ('_NODE_TRACE_RE = (r"\\[(\\S+Z) \\w+ [^\\]]+\\] TRACE "\n'
+          '                  r"stage=(\\w+) block=(\\S+) round=(\\d+)")\n')
+    cpp = ('void trace_stage(const char* stage, const Block& block) {\n'
+           '  LOG_INFO("consensus::core")\n'
+           '      << "TRACE stage=" << stage << " block=" << d\n'
+           '      << " round=" << block.round;\n'
+           '}\n')
+    assert obsgrammar.check_sources({
+        "hotstuff_tpu/obs/trace.py": py,
+        "native/src/consensus/core.cpp": cpp}) == []
+    findings = obsgrammar.check_sources({
+        "hotstuff_tpu/obs/trace.py": py,
+        "native/src/consensus/core.cpp":
+            cpp.replace('" round="', '" rnd="')})
+    assert [f.rule for f in findings] == ["trace-grammar-mismatch"]
+
+
+def test_obsgrammar_quiet_on_real_tree():
+    assert obsgrammar.check(REPO) == []
+
+
+def test_obsgrammar_pins_cover_both_grammar_sides():
+    from hotstuff_tpu.analysis.__main__ import check_coverage
+
+    assert check_coverage(REPO, [
+        "obsgrammar:hotstuff_tpu/obs/trace.py",
+        "obsgrammar:hotstuff_tpu/obs/sampler.py",
+        "obsgrammar:native/src/consensus/core.cpp",
+        "obsgrammar:native/src/common/metrics.cpp",
+    ]) == []
+    out = check_coverage(REPO, ["obsgrammar:hotstuff_tpu/harness/logs.py"])
+    assert [f.rule for f in out] == ["must-cover"]
+
+
+# ---------------------------------------------------------------------------
 # graftsync: threads rules (cross-thread sharing discipline)
 # ---------------------------------------------------------------------------
 

@@ -524,17 +524,25 @@ def test_fetch_sidecar_stats_falls_back_to_last_sample(tmp_path,
 
 def test_trace_headline_probe_schema(bench_mod):
     """The headline `trace` field: known skew recovered, partial trace
-    tolerated, Chrome round trip intact (the field rides the degraded
-    line too, so this schema is what a no-device run publishes)."""
+    tolerated, the graftscope ctx join accounted (one joined block, one
+    verify-traced block with no chain -> join_rate 0.5), Chrome round
+    trip intact (the field rides the degraded line too, so this schema
+    is what a no-device run publishes)."""
     out = bench_mod.trace_headline_probe()
     assert out["roundtrip_ok"] is True
-    assert out["blocks"] == 2 and out["complete"] == 1
+    assert out["blocks"] == 3 and out["complete"] == 2
     assert out["offset_applied_ms"] == pytest.approx(125.0)
     segs = out["segments"]
     # replica 1's skewed observations aligned BEHIND replica 0's, so
     # the earliest-wins totals are replica 0's own
-    assert segs["proposal->commit"]["n"] == 2
-    assert segs["proposal->commit"]["p50_ms"] == pytest.approx(50.0)
+    assert segs["proposal->commit"]["n"] == 3
+    assert segs["proposal->commit"]["p50_ms"] == pytest.approx(60.0)
     assert segs["verify_submit->verify_reply"]["p50_ms"] == \
-        pytest.approx(24.0)
+        pytest.approx(20.0)
+    # graftscope: device time nested as the verify:device sub-segment,
+    # join accounting on the line
+    assert segs["verify:device"]["p50_ms"] == pytest.approx(18.0)
+    assert out["join"] == {"committed": 3, "with_verify": 2,
+                           "joined": 1, "rate": 0.5}
+    assert out["join_rate"] == 0.5
     assert out["chrome_events"] > 0

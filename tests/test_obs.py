@@ -4,6 +4,12 @@ Chrome trace JSON schema round trip, the live metrics sampler on a
 virtual clock across a sidecar kill/restart, and the directory-level
 trace build the harness + LogParser drive.
 
+graftscope additions: the protocol-v5 context-tag round trip (legacy
+zero-tag frames included), the per-block node<->sidecar span join
+(partial chains degrade join_rate, never the trace), the C++ node's
+METRICS line reader + per-replica divergence, and the bench-trajectory
+regression ledger.
+
 All CPU-only and fast (no jax, no device, no sleeps beyond thread
 joins) — the suite runs in tier-1.
 """
@@ -17,18 +23,26 @@ from hotstuff_tpu.obs import (
     MetricsSampler,
     Tracer,
     build_run_trace,
+    chain_spans,
     chrome_trace,
     clock_offset,
+    commit_rate_divergence,
     critical_path,
+    join_blocks,
+    merge_node_series,
+    parse_node_metrics,
     parse_node_trace,
     parse_spans,
     read_samples,
     recovery_curve,
+    split_samples,
     stitch_blocks,
     write_run_trace,
 )
 from hotstuff_tpu.obs.trace import (
+    DEVICE_SEGMENT,
     apply_offset,
+    device_subsegment,
     estimate_offset,
     probe_host_offset,
     sidecar_breakdown,
@@ -441,6 +455,408 @@ def test_verify_engine_emits_stage_spans(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# graftscope: protocol v5 context tag round trip
+# ---------------------------------------------------------------------------
+
+
+def _make_records(n=2):
+    msgs = [bytes([i]) * 32 for i in range(n)]
+    pks = [bytes([0x10 + i]) * 32 for i in range(n)]
+    sigs = [bytes([0x20 + i]) * 64 for i in range(n)]
+    return msgs, pks, sigs
+
+
+def test_protocol_v5_ctx_round_trip():
+    from hotstuff_tpu.sidecar import protocol as proto
+
+    msgs, pks, sigs = _make_records()
+    ctx = bytes(range(32))
+    frame = proto.encode_request(7, msgs, pks, sigs, ctx=ctx)
+    opcode, req = proto.decode_request(frame[4:])
+    assert opcode == proto.OP_VERIFY_BATCH
+    assert req.ctx == ctx
+    assert req.msgs == msgs and req.pks == pks and req.sigs == sigs
+    # Bulk class carries the tag identically.
+    frame = proto.encode_request(8, msgs, pks, sigs,
+                                 opcode=proto.OP_VERIFY_BULK, ctx=ctx)
+    opcode, req = proto.decode_request(frame[4:])
+    assert opcode == proto.OP_VERIFY_BULK and req.ctx == ctx
+
+
+def test_protocol_v5_legacy_and_zero_tag_frames():
+    """Legacy tag-less frames AND all-zero tags (the C++ client's 'no
+    context' form) both decode as ctx None — a version-skewed peer can
+    never desync on the tag."""
+    from hotstuff_tpu.sidecar import protocol as proto
+
+    msgs, pks, sigs = _make_records()
+    legacy = proto.encode_request(1, msgs, pks, sigs)  # no ctx at all
+    _, req = proto.decode_request(legacy[4:])
+    assert req.ctx is None
+    zero = proto.encode_request(2, msgs, pks, sigs, ctx=proto.ZERO_CTX)
+    assert len(zero) == len(legacy) + proto.CTX_LEN
+    _, req = proto.decode_request(zero[4:])
+    assert req.ctx is None
+    # A frame whose length matches neither form still raises.
+    bad = legacy[4:] + b"\x01" * 7
+    with pytest.raises(ValueError):
+        proto.decode_request(bad)
+
+
+def test_verify_engine_spans_carry_ctx(tmp_path):
+    """An engine-path verify tagged with a block digest must leave the
+    ctx on its per-request spans (admit/queue/reply) and the b64 tag in
+    the per-launch ctxs lists (pack/dispatch/device) — the exact schema
+    obs/trace.py joins on."""
+    from base64 import b64encode
+
+    from hotstuff_tpu.crypto import ref_ed25519 as ref
+    from hotstuff_tpu.sidecar import protocol as proto
+    from hotstuff_tpu.sidecar.service import VerifyEngine
+
+    sk = bytes(range(32))
+    _, pk = ref.generate_keypair(sk)
+    msg = b"\x06" * 32
+    sig = ref.sign(sk, msg)
+    ctx = bytes(range(32))
+    ctx_b64 = b64encode(ctx).decode()
+
+    path = str(tmp_path / "spans.jsonl")
+    engine = VerifyEngine(use_host=True, tracer=Tracer(path))
+    try:
+        done = []
+        cond = threading.Condition()
+
+        def reply(mask):
+            with cond:
+                done.append(mask)
+                cond.notify()
+
+        assert engine.submit(
+            proto.VerifyRequest(9, [msg], [pk], [sig], ctx=ctx), reply)
+        with cond:
+            assert cond.wait_for(lambda: done, timeout=60.0)
+        assert done[0] == [True]
+    finally:
+        engine.stop()
+        engine._tracer.close()
+    spans, malformed = parse_spans((tmp_path / "spans.jsonl").read_text())
+    assert malformed == 0
+    by_stage = {s["stage"]: s for s in spans}
+    for stage in ("admit", "queue", "reply"):
+        assert by_stage[stage]["ctx"] == ctx_b64, by_stage[stage]
+    for stage in ("pack", "dispatch", "device"):
+        assert by_stage[stage]["ctxs"] == [ctx_b64], by_stage[stage]
+    # The chain machinery joins them all onto the one tag.
+    chains = chain_spans(spans)
+    assert set(s["stage"] for s in chains[ctx_b64]) == \
+        {"admit", "queue", "pack", "dispatch", "device", "reply"}
+
+
+# ---------------------------------------------------------------------------
+# graftscope: per-block node<->sidecar joins
+# ---------------------------------------------------------------------------
+
+
+def _chain(block, t0, rid=1):
+    return [
+        {"stage": "admit", "t": t0, "dur_ms": 0.0, "rid": rid,
+         "cls": "latency", "ctx": block},
+        {"stage": "queue", "t": t0 + 0.001, "dur_ms": 1.0, "rid": rid,
+         "cls": "latency", "ctx": block},
+        {"stage": "pack", "t": t0 + 0.002, "dur_ms": 2.0, "reqs": 1,
+         "ctxs": [block]},
+        {"stage": "device", "t": t0 + 0.005, "dur_ms": 12.0, "reqs": 1,
+         "ctxs": [block]},
+        {"stage": "reply", "t": t0 + 0.02, "dur_ms": 0.0, "rid": rid,
+         "cls": "latency", "ctx": block},
+    ]
+
+
+def test_join_blocks_full_and_missing_chain():
+    """The satellite case: one committed block's sidecar chain is
+    missing — its trace stays (partial), the join rate degrades to 0.5,
+    and the device sub-segment reports only the joined block."""
+    traces = stitch_blocks(_full_block("a=", 2, 100.0)
+                           + _full_block("c=", 4, 102.0))
+    spans = _chain("a=", 100.012)
+    join, joined = join_blocks(traces, chain_spans(spans))
+    assert join == {"committed": 2, "with_verify": 2, "joined": 1,
+                    "rate": 0.5}
+    assert list(joined) == [("a=", 2)]
+    dev = device_subsegment(joined)
+    assert dev["n"] == 1 and dev["p50_ms"] == pytest.approx(12.0)
+
+
+def test_join_blocks_requires_verify_segment():
+    # A block that committed off the cached-certificate path (no verify
+    # stages) is out of the join denominator entirely.
+    partial = [s for s in _full_block("b=", 3, 101.0)
+               if s["stage"] in ("proposal", "commit")]
+    traces = stitch_blocks(partial)
+    join, joined = join_blocks(traces, chain_spans(_chain("b=", 101.0)))
+    assert join == {"committed": 1, "with_verify": 0, "joined": 0,
+                    "rate": None}
+    assert not joined
+
+
+def test_join_shared_launch_spans_both_blocks():
+    # One coalesced launch carrying two blocks' requests: its pack/
+    # device spans list both ctxs and land in BOTH chains.
+    traces = stitch_blocks(_full_block("a=", 2, 100.0)
+                           + _full_block("b=", 3, 100.5))
+    shared = {"stage": "device", "t": 100.02, "dur_ms": 9.0,
+              "ctxs": ["a=", "b="]}
+    join, joined = join_blocks(traces, chain_spans([shared]))
+    assert join["joined"] == 2 and join["rate"] == 1.0
+    assert all(shared in chain for chain in joined.values())
+
+
+def test_build_run_trace_with_ctx_join(tmp_path):
+    """Directory-level: ctx-tagged sidecar spans join onto the mined
+    node trace — summary grows join + verify:device, and the Chrome
+    artifact nests the chain in the block's consensus row."""
+    log = "\n".join([_trace_line(1, "proposal"),
+                     _trace_line(1, "verify_submit", ms="010"),
+                     _trace_line(1, "verify_reply", ms="030"),
+                     _trace_line(1, "commit", ms="050"),
+                     _trace_line(2, "proposal", block="xxx=", rnd=3),
+                     _trace_line(2, "verify_submit", block="xxx=",
+                                 rnd=3, ms="010"),
+                     _trace_line(2, "verify_reply", block="xxx=",
+                                 rnd=3, ms="030"),
+                     _trace_line(2, "commit", block="xxx=", rnd=3,
+                                 ms="050")])
+    (tmp_path / "node-0.log").write_text(log + "\n")
+    t0 = 1785751201.0  # block aaa='s chain only; xxx= stays unjoined
+    (tmp_path / "sidecar-spans.jsonl").write_text(
+        "\n".join(json.dumps(s) for s in _chain("aaa=", t0)) + "\n")
+    summary, chrome = build_run_trace(str(tmp_path))
+    assert summary["join"] == {"committed": 2, "with_verify": 2,
+                               "joined": 1, "rate": 0.5}
+    assert summary["segments"][DEVICE_SEGMENT]["n"] == 1
+    assert summary["segments"][DEVICE_SEGMENT]["p50_ms"] == \
+        pytest.approx(12.0)
+    nested = [e for e in chrome["traceEvents"]
+              if e.get("name", "").startswith("sidecar:")]
+    assert nested and all(e["args"]["block"] == "aaa=" and e["pid"] == 1
+                          for e in nested)
+    # The flat sidecar-process timeline is still there for the chain.
+    flat = [e for e in chrome["traceEvents"]
+            if e.get("cat") == "sidecar" and e.get("pid") == 2]
+    assert flat
+
+
+# ---------------------------------------------------------------------------
+# graftscope: node METRICS series + divergence
+# ---------------------------------------------------------------------------
+
+
+def _metrics_line(sec, commits, rate, busy=0, breaker="closed",
+                  itx=5, ibytes=2048):
+    return (f"[2026-08-03T12:00:{sec:02d}.000Z INFO node::metrics] "
+            f"METRICS commits={commits} commit_rate={rate} "
+            f"ingress_tx={itx} ingress_bytes={ibytes} busy={busy} "
+            f"breaker={breaker}")
+
+
+def test_parse_node_metrics_and_torn_lines():
+    log = "\n".join([
+        "[2026-08-03T12:00:01.000Z INFO node::node] Node abc= booted",
+        _metrics_line(1, 10, "5.0"),
+        _metrics_line(2, 15, "5.0", busy=3, breaker="open"),
+        # torn mid-write: missing keys simply don't match
+        "[2026-08-03T12:00:03.000Z INFO node::metrics] METRICS commi",
+        "garbage line",
+        _metrics_line(4, 20, "2.5"),
+    ])
+    recs = parse_node_metrics(log, host="node-0.log")
+    assert len(recs) == 3
+    assert all(r["node"] == "node-0.log" and r["ok"] for r in recs)
+    assert recs[0]["metrics"] == {
+        "commits": 10, "commit_rate": 5.0, "ingress_tx": 5,
+        "ingress_bytes": 2048, "busy": 0, "breaker": "closed"}
+    assert recs[1]["metrics"]["busy"] == 3
+    assert recs[1]["metrics"]["breaker"] == "open"
+    assert recs[2]["t"] - recs[0]["t"] == pytest.approx(3.0)
+
+
+def test_merge_node_series_idempotent(tmp_path):
+    (tmp_path / "node-0.log").write_text(_metrics_line(1, 10, "5.0")
+                                         + "\n")
+    (tmp_path / "node-1.log").write_text(_metrics_line(1, 9, "4.5")
+                                         + "\n")
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"t": 1.0, "ok": True, "stats": {}}) + "\n")
+    assert merge_node_series(str(tmp_path)) == 2
+    samples, malformed = read_samples(str(tmp_path / "metrics.jsonl"))
+    assert malformed == 0
+    sidecar, node = split_samples(samples)
+    assert len(sidecar) == 1 and len(node) == 2
+    # Re-merging the same directory must not duplicate the series.
+    assert merge_node_series(str(tmp_path)) == 0
+    samples, _ = read_samples(str(tmp_path / "metrics.jsonl"))
+    assert len(samples) == 3
+
+
+def test_commit_rate_divergence_flags_straggler():
+    def rec(host, rate):
+        return {"t": 1.0, "ok": True, "node": host,
+                "metrics": {"commit_rate": rate}}
+
+    samples = [rec("node-0.log", 10.0), rec("node-1.log", 10.5),
+               rec("node-2.log", 9.8), rec("node-3.log", 3.0)]
+    div = commit_rate_divergence(samples, threshold=0.7)
+    assert div["median"] == pytest.approx(9.9)
+    assert [s["host"] for s in div["stragglers"]] == ["node-3.log"]
+    assert div["stragglers"][0]["ratio"] < 0.7
+    # A healthy committee flags nothing; one replica is unjudgeable.
+    assert commit_rate_divergence(samples[:3])["stragglers"] == []
+    assert commit_rate_divergence(samples[:1])["median"] is None
+
+
+def test_log_parser_notes_divergence_and_splits_series():
+    from test_harness import GOLDEN_CLIENT, GOLDEN_NODE
+
+    from hotstuff_tpu.harness import LogParser
+
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    samples = [
+        {"t": 1.0, "ok": True, "stats": {"launches": 1}},
+        {"t": 2.0, "ok": True, "stats": {"launches": 2}},
+    ]
+    for host, rate in (("node-0.log", 10.0), ("node-1.log", 9.5),
+                       ("node-2.log", 1.0)):
+        samples.append({"t": 1.5, "ok": True, "node": host,
+                        "metrics": {"commit_rate": rate}})
+    parser.note_metrics(samples)
+    # The sidecar note counts only sidecar samples.
+    assert any("Sidecar metrics: 2 sample(s)" in n for n in parser.notes)
+    assert any("Node metrics: 3 sample(s) across 3 replica(s)" in n
+               for n in parser.notes)
+    straggler = [n for n in parser.notes
+                 if "Replica commit-rate divergence" in n]
+    assert len(straggler) == 1 and "node-2.log" in straggler[0]
+    assert parser.node_metrics["divergence"]["stragglers"]
+
+
+def test_note_trace_includes_join_rate():
+    from test_harness import GOLDEN_CLIENT, GOLDEN_NODE
+
+    from hotstuff_tpu.harness import LogParser
+
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_trace({
+        "blocks": 4, "complete": 4,
+        "join": {"committed": 4, "with_verify": 4, "joined": 3,
+                 "rate": 0.75},
+        "segments": {
+            "proposal->commit": {"n": 4, "p50_ms": 50.0, "p99_ms": 80.0},
+            DEVICE_SEGMENT: {"n": 3, "p50_ms": 12.0, "p99_ms": 18.0},
+        }})
+    note = next(n for n in parser.notes if "Commit critical path" in n)
+    assert "sidecar join 75% of 4 verify-traced" in note
+    assert "verify:device p50 12 ms / p99 18 ms" in note
+
+
+# ---------------------------------------------------------------------------
+# graftscope: bench-trajectory regression ledger
+# ---------------------------------------------------------------------------
+
+
+def _bench_trend():
+    import importlib.util
+    import os
+
+    from conftest import REPO
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_trend", os.path.join(REPO, "scripts", "bench_trend.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_artifacts(tmp_path, *runs):
+    for name, doc in runs:
+        (tmp_path / name).write_text(json.dumps(doc))
+
+
+def test_bench_trend_best_latest_and_degraded_flags(tmp_path):
+    bt = _bench_trend()
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r01.json", {"n": 1, "rc": 0,
+                            "parsed": {"metric": "m", "value": 100.0,
+                                       "rlc": {"n64": {"speedup": 2.0}}}}),
+        ("BENCH_r02.json", {"n": 2, "rc": 0,
+                            "parsed": {"metric": "m", "value": 95.0,
+                                       "rlc": {"n64": {"speedup": 2.5}}}}),
+        # wedged round: no line at all
+        ("BENCH_r03.json", {"n": 3, "rc": 124, "parsed": None}),
+        # bare-headline degraded artifact (the surge_degraded shape)
+        ("BENCH_zz_degraded.json", {"metric": "m", "value": 5.0,
+                                    "degraded": True}),
+    )
+    trend = bt.build_trend(sorted(str(p) for p in
+                                  tmp_path.glob("BENCH_*.json")))
+    runs = {r["file"]: r for r in trend["runs"]}
+    assert not runs["BENCH_r01.json"]["degraded"]
+    assert not runs["BENCH_r02.json"]["degraded"]
+    assert runs["BENCH_r03.json"]["degraded"]
+    assert runs["BENCH_zz_degraded.json"]["degraded"]
+    v = trend["fields"]["value"]
+    assert v["best"] == 100.0 and v["best_run"] == "BENCH_r01.json"
+    assert v["latest_live"] == 95.0
+    # Degraded values stay visible as "latest" but never become best.
+    assert v["latest"] == 5.0 and v["latest_degraded"] is True
+    assert trend["fields"]["rlc.n64.speedup"]["best"] == 2.5
+    # 5% drop inside the default 20% threshold: ok.
+    assert bt.judge(trend, 0.2)["ok"] is True
+    # A 1% threshold turns the same history into a regression.
+    verdict = bt.judge(trend, 0.01)
+    assert verdict["ok"] is False and "below best" in verdict["reason"]
+
+
+def test_bench_trend_unjudgeable_histories_pass(tmp_path):
+    bt = _bench_trend()
+    # Only degraded runs: nothing to judge, never a failure.
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r01.json", {"n": 1, "rc": 3,
+                            "parsed": {"value": 0, "error": "wedged"}}))
+    trend = bt.build_trend([str(tmp_path / "BENCH_r01.json")])
+    verdict = bt.judge(trend, 0.2)
+    assert verdict["ok"] is True and verdict["judged"] is False
+    # One live run that IS the best: also unjudged, ok.
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r02.json", {"n": 2, "rc": 0, "parsed": {"value": 50.0}}))
+    trend = bt.build_trend(sorted(str(p) for p in
+                                  tmp_path.glob("BENCH_*.json")))
+    verdict = bt.judge(trend, 0.2)
+    assert verdict["ok"] is True and verdict["judged"] is False
+
+
+def test_bench_trend_cli_writes_ledger_and_exits_on_regression(tmp_path):
+    bt = _bench_trend()
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r01.json", {"n": 1, "rc": 0, "parsed": {"value": 100.0}}),
+        ("BENCH_r02.json", {"n": 2, "rc": 0, "parsed": {"value": 10.0}}))
+    out = tmp_path / "results" / "trend.json"
+    assert bt.main(["--root", str(tmp_path), "--out", str(out)]) == 0
+    ledger = json.loads(out.read_text())
+    assert ledger["schema"] == "bench-trend-v1"
+    assert ledger["check"]["ok"] is False  # recorded even without --check
+    # --check makes the 90% drop fatal.
+    assert bt.main(["--root", str(tmp_path), "--out", str(out),
+                    "--check"]) == 1
+    # No artifacts at all: usage error, not a crash.
+    assert bt.main(["--root", str(tmp_path / "empty")]) == 2
+
+
+# ---------------------------------------------------------------------------
 # End-to-end grafttrace (slow lane; needs the native build)
 # ---------------------------------------------------------------------------
 
@@ -451,7 +867,10 @@ def test_grafttrace_e2e_local_bench(tmp_path, monkeypatch):
     scripted sidecar kill/restart) must produce logs/trace.json
     (Perfetto-loadable), logs/metrics.jsonl with >= 2 in-window samples
     showing the kill/restart transition, and a 'Commit critical path'
-    note with per-stage percentiles."""
+    note with per-stage percentiles.  graftscope: the same run must
+    join >= 90% of its verify-traced committed blocks onto their
+    sidecar chains (device time nested inside verify), and the node
+    METRICS series must land per-replica next to the sidecar's."""
     import os
 
     from conftest import NODE_BIN, REPO
@@ -480,14 +899,30 @@ def test_grafttrace_e2e_local_bench(tmp_path, monkeypatch):
     with open("logs/trace.json") as f:
         chrome = json.load(f)
     assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+    # graftscope acceptance: >= 90% of verify-traced committed blocks
+    # carry a joined sidecar chain, and device time rides inside the
+    # verify segment of the summary + the Chrome artifact.
+    join = parser.trace["join"]
+    assert join["with_verify"] > 0, parser.trace
+    assert join["rate"] >= 0.9, join
+    assert parser.trace["segments"][DEVICE_SEGMENT]["n"] > 0
+    assert any(e.get("name") == "sidecar:device"
+               and e.get("args", {}).get("block")
+               for e in chrome["traceEvents"])
     # >= 2 in-window samples, with the kill/restart visible as a
-    # failed->ok transition in the series
+    # failed->ok transition in the series (sidecar sub-series: the node
+    # records merged next to them must not mask the gap)
     samples, _ = read_samples("logs/metrics.jsonl")
-    assert len(samples) >= 2, samples
+    sidecar_series, node_series = split_samples(samples)
+    assert len(sidecar_series) >= 2, samples
     assert any("Sidecar metrics:" in n for n in parser.notes)
-    oks = [s["ok"] for s in samples]
+    oks = [s["ok"] for s in sidecar_series]
     assert False in oks and True in oks[oks.index(False):], \
         "sidecar kill/restart not visible in the sampled series"
+    # per-replica node METRICS landed in the same artifact
+    assert node_series, "no node METRICS records merged"
+    assert len({s["node"] for s in node_series}) >= 2
+    assert any("Node metrics:" in n for n in parser.notes)
     # sidecar spans were written and merged
     assert os.path.exists("logs/sidecar-spans.jsonl")
     # the per-event telemetry curve rode into the chaos summary

@@ -54,6 +54,19 @@ tunneled chip; a pod run reuses the same probe).  Per size:
    "speedup": float}         — or {"skipped"/"error": ...}
 (HOTSTUFF_TPU_MESH_RLC_BUDGET seconds, default 240, bounds the stage).
 
+Committee-scale headline (`"committee_scale"` field, graftscale —
+ROADMAP item 4): QC-shaped verify batches of 2f+1 votes for committee
+sizes N in {100, 300, 1000}, measured through the engine-path mesh
+entries — per-signature-sharded vs RLC-sharded vs the whole-backlog
+chunked scan — in the same forced-host 8-device CPU-mesh subprocess as
+mesh_rlc, reported as sigs/sec/CHIP.  Per committee:
+  {"NX": {"quorum": int, "per_sig_sharded_sigs_per_s_chip": float,
+   "rlc_sharded_sigs_per_s_chip": float, "scan_sigs_per_s_chip": float,
+   "rlc_speedup": float}}    — or {"skipped"/"error": ...}
+(HOTSTUFF_TPU_COMMITTEE_BUDGET seconds, default 240, bounds the stage;
+the field rides BOTH the live and degraded JSON lines under the same
+budget-derate/emit-or-die watchdog discipline as mesh_rlc/roofline).
+
 MSM window-chunk sweep (`"msm_window_chunk"` field): RLC throughput at
 n=256 with the Straus window chunk re-pinned to 4, 8 and 16 IN-PROCESS
 (ops/ed25519.set_msm_window_chunk clears the jit caches per value — no
@@ -595,22 +608,21 @@ def mesh_rlc_probe(n_devices: int = 8, sizes=(64, 256, 1024),
         emit_progress(out)
 
 
-def mesh_rlc_headline(n_devices: int = 8,
-                      budget_s: float | None = None) -> dict:
-    """Parent half of the ``mesh_rlc`` headline field: run
-    :func:`mesh_rlc_probe` in a subprocess pinned to an n-device
-    forced-host CPU mesh (this rig has ONE tunneled chip, so the mesh
-    routing win is measured on the virtual mesh — identical program
-    structure, honest relative numbers; a real pod run reuses the same
-    probe).  Failures degrade to an ``error`` entry, never take the
-    headline down."""
+def _forced_host_mesh_headline(field: str, probe_call: str,
+                               n_devices: int, budget_s: float) -> dict:
+    """Shared parent of the forced-host CPU-mesh probe headlines
+    (``mesh_rlc``, ``committee_scale``): run the named probe in a
+    subprocess pinned to an n-device virtual mesh (this rig has ONE
+    tunneled chip, so mesh-routing wins are measured on the virtual
+    mesh — identical program structure, honest relative numbers; a
+    real pod run reuses the same probes), parse the LAST parseable
+    progress line, and salvage a partial measurement when the child
+    times out mid-compile.  Failures degrade to an ``error`` entry,
+    never take the headline down."""
     import re
     import subprocess
     import sys
 
-    if budget_s is None:
-        budget_s = float(
-            os.environ.get("HOTSTUFF_TPU_MESH_RLC_BUDGET", "240"))
     if budget_s <= 0:
         return {"skipped": True}
     root = os.path.dirname(os.path.abspath(__file__))
@@ -625,8 +637,7 @@ def mesh_rlc_headline(n_devices: int = 8,
     # child must flip the platform via jax.config before any
     # backend-initializing call (same dance as dryrun_multichip).
     code = ("import jax; jax.config.update('jax_platforms', 'cpu')\n"
-            f"import bench; bench.mesh_rlc_probe({n_devices}, "
-            f"budget_s={budget_s})\n")
+            f"import bench; bench.{probe_call}\n")
     def _last_line(stdout):
         if isinstance(stdout, bytes):
             stdout = stdout.decode("utf-8", "replace")
@@ -641,7 +652,7 @@ def mesh_rlc_headline(n_devices: int = 8,
         line = _last_line(proc.stdout)
         if line is None:
             return {"error": "probe child printed nothing"}
-        return line["mesh_rlc"]
+        return line[field]
     except subprocess.TimeoutExpired as e:
         # The child emits one line per completed size: salvage whatever
         # it finished before the timeout (first-boot XLA compiles can
@@ -650,7 +661,7 @@ def mesh_rlc_headline(n_devices: int = 8,
         try:
             line = _last_line(e.stdout)
             if line is not None:
-                out = line["mesh_rlc"]
+                out = line[field]
                 out["timeout"] = True
                 return out
         except (ValueError, KeyError, TypeError):
@@ -661,6 +672,130 @@ def mesh_rlc_headline(n_devices: int = 8,
         if isinstance(e, subprocess.CalledProcessError):
             detail = (e.stderr or "")[-200:]
         return {"error": f"{e!r:.120}{detail}"}
+
+
+def mesh_rlc_headline(n_devices: int = 8,
+                      budget_s: float | None = None) -> dict:
+    """Parent half of the ``mesh_rlc`` headline field: run
+    :func:`mesh_rlc_probe` on the forced-host CPU mesh (see
+    :func:`_forced_host_mesh_headline` for the subprocess contract)."""
+    if budget_s is None:
+        budget_s = float(
+            os.environ.get("HOTSTUFF_TPU_MESH_RLC_BUDGET", "240"))
+    return _forced_host_mesh_headline(
+        "mesh_rlc", f"mesh_rlc_probe({n_devices}, budget_s={budget_s})",
+        n_devices, budget_s)
+
+
+def committee_scale_probe(n_devices: int = 8,
+                          committees=(100, 300, 1000),
+                          repeats: int = 2,
+                          budget_s: float = 240.0) -> dict:
+    """Child half of the ``committee_scale`` headline (graftscale):
+    sweep QC-shaped verify batches — 2f+1 votes for committee sizes
+    N — through the ENGINE-path mesh entries, per route:
+
+      * ``per_sig_sharded``  — verify_batch_sharded_pack, the scalar
+        ladder data-parallel across every device;
+      * ``rlc_sharded``      — verify_rlc_sharded_pack, ONE Straus MSM
+        whose window sums shard over the mesh (the path the scheduler
+        routes a warmed giant-committee QC batch down);
+      * ``scan``             — verify_sharded_chunked_pack, the
+        whole-backlog chunked mesh scan draining the batch in ONE
+        dispatch (the graftscale bulk route).
+
+    Each measurement pays the full pack -> dispatch -> fetch stages the
+    sidecar engine drives (host preparation included), reported as
+    sigs/sec/CHIP so committee sizes compare on one axis.  Prints one
+    JSON progress line per completed committee (the parent salvages a
+    partial sweep) and returns the dict (the in-process schema test).
+    Committee sizes that miss ``budget_s`` report {"skipped": true}."""
+    from hotstuff_tpu.crypto import eddsa
+    from hotstuff_tpu.parallel import sharded_verify as shv
+    from hotstuff_tpu.parallel.mesh import make_mesh
+    from hotstuff_tpu.sidecar.sched.shapes import quorum_sigs
+    from hotstuff_tpu.utils.xla_cache import configure_xla_cache
+
+    configure_xla_cache()
+    t0 = time.perf_counter()
+    mesh = make_mesh(n_devices)
+    nmax = quorum_sigs(max(committees))
+    msgs, pks, sigs = _make_ref_sigs(nmax, seed=19)
+    # The scan column must measure the MULTI-chunk whole-backlog
+    # structure the engine's scan route dispatches (a rows=None default
+    # would collapse every quorum to a degenerate one-chunk scan): pick
+    # the chunk rows so the batch drains as SCAN_CHUNKS chunks, the
+    # same g-chunks-of-warmed-rows program shape _warmup_mesh_scan
+    # compiles.
+    SCAN_CHUNKS = 4
+
+    def scan_rows_for(n):
+        from hotstuff_tpu.parallel.shard_shapes import shard_bucket
+
+        return shard_bucket(-(-n // SCAN_CHUNKS), n_devices)
+
+    def emit_progress(out):
+        print(json.dumps({"committee_scale": out,
+                          "n_devices": n_devices}), flush=True)
+
+    out = {}
+    for committee in committees:
+        n = quorum_sigs(committee)
+        if time.perf_counter() - t0 > budget_s:
+            out[f"N{committee}"] = {"quorum": n, "skipped": True}
+            emit_progress(out)
+            continue
+        stats = {"quorum": n}
+        for name, pack in (
+                ("per_sig_sharded",
+                 lambda p: shv.verify_batch_sharded_pack(mesh, p)),
+                ("rlc_sharded",
+                 lambda p: shv.verify_rlc_sharded_pack(mesh, p)),
+                ("scan",
+                 lambda p, r=scan_rows_for(n):
+                 shv.verify_sharded_chunked_pack(mesh, p, rows=r))):
+            # Warm/compile + correctness guard outside the timed region
+            # (explicit raise: python -O must not strip either).
+            prep = eddsa.prepare_batch(msgs[:n], pks[:n], sigs[:n])
+            if not pack(prep)()().all():
+                raise RuntimeError(
+                    f"{name} verify failed at quorum {n}")
+            best = 0.0
+            for _ in range(repeats):
+                t = time.perf_counter()
+                prep = eddsa.prepare_batch(msgs[:n], pks[:n], sigs[:n])
+                mask = pack(prep)()()
+                dt = time.perf_counter() - t
+                if not mask.all():
+                    raise RuntimeError(
+                        f"{name} verify failed at quorum {n}")
+                best = max(best, n / dt)
+            stats[f"{name}_sigs_per_s_chip"] = round(best / n_devices, 1)
+        stats["rlc_speedup"] = round(
+            stats["rlc_sharded_sigs_per_s_chip"]
+            / stats["per_sig_sharded_sigs_per_s_chip"], 3)
+        out[f"N{committee}"] = stats
+        emit_progress(out)
+    if not out:
+        emit_progress(out)
+    return out
+
+
+def committee_scale_headline(n_devices: int = 8,
+                             budget_s: float | None = None) -> dict:
+    """Parent half of the ``committee_scale`` headline field
+    (graftscale, ROADMAP item 4): run :func:`committee_scale_probe`
+    for N in {100, 300, 1000} on the forced-host CPU mesh (see
+    :func:`_forced_host_mesh_headline` for the subprocess contract;
+    HOTSTUFF_TPU_COMMITTEE_BUDGET seconds, default 240, bounds the
+    stage)."""
+    if budget_s is None:
+        budget_s = float(
+            os.environ.get("HOTSTUFF_TPU_COMMITTEE_BUDGET", "240"))
+    return _forced_host_mesh_headline(
+        "committee_scale",
+        f"committee_scale_probe({n_devices}, budget_s={budget_s})",
+        n_devices, budget_s)
 
 
 def trace_headline_probe() -> dict:
@@ -1229,11 +1364,12 @@ def run_degraded(reason: str):
     # slack for the emit: the whole point of capping the probe window is
     # that this path still lands its line inside the driver's timeout.
     # Cap raised 480 -> 900 with the roofline stage (a pallas-interpret
-    # measurement is compile-bound, ~2-4 min for one size on CPU); the
-    # budget_left guard, not the cap, is what keeps the emit inside the
-    # driver's window.
+    # measurement is compile-bound, ~2-4 min for one size on CPU), then
+    # 900 -> 1200 with the committee_scale stage (another bounded
+    # forced-host-mesh subprocess); the budget_left guard, not the cap,
+    # is what keeps the emit inside the driver's window.
     left = max(30.0, budget_left_s() - 60.0)
-    watchdog = threading.Timer(min(900.0, left), _bail)
+    watchdog = threading.Timer(min(1200.0, left), _bail)
     watchdog.daemon = True
     watchdog.start()
     try:
@@ -1266,6 +1402,18 @@ def run_degraded(reason: str):
                 max(0.0, budget_left_s() - 90.0)))
         except Exception as e:  # noqa: BLE001 — headline isolation
             mesh_rlc = {"error": f"{e!r:.120}"}
+        # graftscale committee_scale on the same forced-host mesh: the
+        # giant-committee sweep rides the degraded line too (same
+        # bounded-subprocess emit-or-die discipline as mesh_rlc) — a
+        # degraded environment still proves the N in {100, 300, 1000}
+        # routing story, just on CPU-backend numbers.
+        try:
+            committee_scale = committee_scale_headline(budget_s=min(
+                float(os.environ.get("HOTSTUFF_TPU_COMMITTEE_BUDGET",
+                                     "240")),
+                max(0.0, budget_left_s() - 90.0)))
+        except Exception as e:  # noqa: BLE001 — headline isolation
+            committee_scale = {"error": f"{e!r:.120}"}
         # graftkern roofline on the CPU backend: the estimate is always
         # present; measured entries are CPU-backend (and the pallas
         # route interpreter-flagged) — comparable to each other, never
@@ -1306,7 +1454,8 @@ def run_degraded(reason: str):
         # Report the backend that actually ran (an already-initialized
         # device backend wins over the cpu config flip above).
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
-             note=reason, rlc=rlc, mesh_rlc=mesh_rlc, roofline=roofline,
+             note=reason, rlc=rlc, mesh_rlc=mesh_rlc,
+             committee_scale=committee_scale, roofline=roofline,
              sched=sched, chaos=chaos, trace=trace, surge=surge)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
@@ -1516,6 +1665,13 @@ def main(argv=None):
         float(os.environ.get("HOTSTUFF_TPU_MESH_RLC_BUDGET", "240")),
         max(0.0, budget_left_s() - 900.0)))
 
+    # committee_scale headline (graftscale): the giant-committee sweep
+    # on the same forced-host mesh — also a bounded subprocess, also
+    # budgeted against what the main measurement must keep.
+    committee_scale = committee_scale_headline(budget_s=min(
+        float(os.environ.get("HOTSTUFF_TPU_COMMITTEE_BUDGET", "240")),
+        max(0.0, budget_left_s() - 900.0)))
+
     def _abort():
         emit_cached_or_fail(
             "watchdog: TPU unresponsive for 900s after a healthy probe")
@@ -1559,6 +1715,7 @@ def main(argv=None):
     def _rlc_abort():
         emit_final(tpu, cpu, rlc={"error": "rlc stage watchdog (420s)"},
                    msm_window_chunk=msm, mesh_rlc=mesh_rlc,
+                   committee_scale=committee_scale,
                    roofline={"est": roofline_estimate(),
                              "skipped": True,
                              "note": "rlc stage watchdog fired first"})
@@ -1581,7 +1738,7 @@ def main(argv=None):
     # fields still ship instead of dying with the stage.
     def _roofline_abort():
         emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
-                   mesh_rlc=mesh_rlc,
+                   mesh_rlc=mesh_rlc, committee_scale=committee_scale,
                    roofline={"est": roofline_estimate(),
                              "error": "roofline stage watchdog"})
         os._exit(0)
@@ -1616,7 +1773,8 @@ def main(argv=None):
     except Exception as e:  # noqa: BLE001 — surge probe is best-effort
         surge = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
-               mesh_rlc=mesh_rlc, roofline=roofline, sched=sched,
+               mesh_rlc=mesh_rlc, committee_scale=committee_scale,
+               roofline=roofline, sched=sched,
                chaos=chaos, trace=trace, surge=surge)
 
 

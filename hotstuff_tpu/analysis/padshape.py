@@ -82,10 +82,12 @@ EDDSA = "hotstuff_tpu/crypto/eddsa.py"
 SERVICE = "hotstuff_tpu/sidecar/service.py"
 
 # Helpers that implement THE bucketing rules: crypto/eddsa.next_pow2 and
-# its module-private wrapper, plus the mesh shard-alignment pair
-# (parallel/shard_shapes).  A launch-bearing function must route its
+# its module-private wrapper, plus the mesh shard-alignment helpers
+# (parallel/shard_shapes — mesh_chunk_count is the graftscale
+# whole-backlog scan's chunk arithmetic, the same single-home rule for
+# the (g, rows) scan shapes).  A launch-bearing function must route its
 # size through one of these.
-_SHARD_HELPERS = {"shard_bucket", "shard_aligned_rows"}
+_SHARD_HELPERS = {"shard_bucket", "shard_aligned_rows", "mesh_chunk_count"}
 _BUCKET_HELPERS = {"next_pow2", "_bucket"} | _SHARD_HELPERS
 
 # An n_devices-ish operand: arithmetic against one of these names is the

@@ -522,6 +522,22 @@ class LogParser:
                     f"Sidecar mesh launches: "
                     f"{mesh['sharded_launches']:,}"
                     + (f" (per-shard buckets {hist})" if hist else ""))
+            # graftscale: bulk backlogs drained as ONE chunked
+            # whole-backlog mesh scan, with the per-launch_cap ladder
+            # dispatches the old path would have paid.
+            scan = stats.get("scan", {})
+            if scan.get("launches"):
+                hist = ", ".join(
+                    f"{k}x{v:,}" for k, v in
+                    sorted(scan.get("chunk_hist", {}).items(),
+                           key=lambda kv: int(kv[0])))
+                lines.append(
+                    f"Sidecar whole-backlog scans: "
+                    f"{scan['launches']:,} "
+                    f"({scan.get('sigs', 0):,} sigs"
+                    + (f", chunks {hist}" if hist else "")
+                    + f"), {scan.get('slices_avoided', 0):,} "
+                    "slice(s) avoided")
             pipe = stats.get("pipeline", {})
             if pipe.get("pack_ms"):
                 lines.append(

@@ -423,7 +423,11 @@ def verify_packed_chunked(packed_g: jnp.ndarray) -> jnp.ndarray:
     times — so large backlogs go through this shape: group count stays at
     the sub-batch size, but G sub-batches share one dispatch.  This is the
     production launch shape for the sidecar's bulk path and the headline
-    bench (scripts/PROFILE.md "Throughput structure")."""
+    bench (scripts/PROFILE.md "Throughput structure").  The mesh twin is
+    parallel/sharded_verify.verify_sharded_chunked (graftscale): the
+    same scan structure per shard, with the validity counts psum-reduced
+    over ICI and the (g, rows) shape set coming from
+    parallel/shard_shapes.mesh_chunk_count."""
     def body(_, chunk):
         return None, verify_packed(chunk)
     _, masks = jax.lax.scan(body, None, packed_g)

@@ -49,3 +49,32 @@ def shard_aligned_rows(n: int, n_devices: int,
     by ``n_devices``, and the capacity pad-fill may use without growing
     any shard's compiled shape."""
     return n_devices * shard_bucket(n, n_devices, max_subbatch)
+
+
+def mesh_chunk_count(n: int, n_devices: int, rows: int) -> int:
+    """Chunk count g of a whole-backlog mesh scan
+    (parallel/sharded_verify.verify_sharded_chunked): each shard scans g
+    chunks of ``rows`` rows inside ONE program, so an ``n``-record
+    backlog pads to ``n_devices * g * rows`` total rows.
+
+    ``rows`` is the per-shard chunk row count the scan shapes were
+    compiled at (the warmup's top per-shard bucket — a power of two);
+    g is the power of two that covers ceil(n / n_devices) rows per
+    shard, so the compiled scan lengths stay a small closed set (the
+    registry's ``mesh_chunks``) exactly like the single-chip
+    ``chunks`` of ops/ed25519.verify_packed_chunked.
+
+    Because g, rows and the per-shard bucket are all powers of two (or
+    whole-chunk multiples), ``g * rows == shard_bucket(n)`` whenever
+    per-shard demand exceeds ``rows`` — the scan pads to the SAME
+    global capacity the aligned-rows rule promises, e.g. 3000 records
+    on 8 devices at rows=128 scan as g=4 chunks -> 512 rows/shard, the
+    8x512 shape ``shard_aligned_rows`` computes.
+    """
+    if n_devices < 1:
+        raise ValueError(f"need a positive device count, got {n_devices}")
+    if rows < 1 or rows & (rows - 1):
+        raise ValueError(f"scan chunk rows must be a power of two, "
+                         f"got {rows}")
+    per_shard = -(-max(n, 1) // n_devices)
+    return next_pow2(-(-per_shard // rows))

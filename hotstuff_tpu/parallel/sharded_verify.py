@@ -29,9 +29,11 @@ from ..crypto.eddsa import MAX_SUBBATCH, RLC_MIN_MSM, _rlc_coeffs, next_pow2
 from ..ops import ed25519 as E
 from ..ops import scalar25519  # noqa: F401  (re-export surface for tests)
 from .mesh import BATCH_AXIS
-from .shard_shapes import shard_aligned_rows, shard_bucket  # noqa: F401
-# (shard_bucket re-exported: the scheduler's shape registry and tests
-# read per-shard buckets from the same module that launches them)
+from .shard_shapes import (mesh_chunk_count,  # noqa: F401
+                           shard_aligned_rows, shard_bucket)
+# (shard_bucket / mesh_chunk_count re-exported: the scheduler's shape
+# registry and tests read per-shard buckets and scan chunk counts from
+# the same module that launches them)
 
 
 def _make_shard_body(max_subbatch: int):
@@ -183,6 +185,151 @@ def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
     m = shard_aligned_rows(n, n_dev, max_subbatch)
     out = _pack_sharded_arrays(mesh, prep, m)
     mask, bad_total = _cached_verifier(mesh, max_subbatch)(*out)
+    mask = np.asarray(mask)[:n]
+    if return_bad_total:
+        return mask, int(bad_total)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Whole-backlog chunked mesh scan (graftscale): ONE compiled program that
+# drains a bulk backlog across the mesh
+# ---------------------------------------------------------------------------
+#
+# The mesh analogue of ops/ed25519.verify_packed_chunked: each shard
+# scans g chunks of ``rows`` packed rows inside one program (the
+# tunneled device charges a fixed ~15-20 ms per dispatch, so a backlog
+# sliced into per-launch_cap ladder launches pays that cost per slice —
+# the scan pays it once for the whole backlog), with the per-shard
+# validity counts psum-reduced over ICI like the per-signature path.
+# The (g, rows) shape comes from THE shard-alignment rule
+# (shard_shapes.mesh_chunk_count over the warmup's top per-shard
+# bucket), so every launchable scan length is a shape the
+# ``--warm-rlc-sharded`` warmup compiled and the scheduler's registry
+# marked (ShapeRegistry.mesh_chunks) — an unwarmed scan length never
+# dispatches; the engine falls back to the sliced ladder path instead.
+
+
+def _make_chunk_scan_body(g: int, rows: int):
+    def _chunk_body(packed, present):
+        """packed: (g*rows, 128) uint8 rows of A || R || S || k per
+        shard; present: (g*rows,) int32 — 1 for a real, host-canonical
+        record; 0 for padding or host-rejected rows."""
+        def body(_, chunk):
+            return None, E.verify_packed(chunk)
+
+        _, masks = jax.lax.scan(body, None,
+                                packed.reshape(g, rows, 128))
+        mask = masks.reshape(g * rows) & (present > 0)
+        bad = jnp.sum((present > 0) & ~mask).astype(jnp.int32)
+        return mask, jax.lax.psum(bad, BATCH_AXIS)
+    return _chunk_body
+
+
+def make_chunk_scan_verifier(mesh: Mesh, g: int, rows: int,
+                             donate: bool = False):
+    """Returns a jitted fn over ((B, 128) packed rows, (B,) int32
+    present), B == n_devices * g * rows -> ((B,) bool mask, () int32
+    invalid count): each shard verifies its g chunks of ``rows`` rows as
+    a lax.scan inside ONE dispatch.  ``donate=True`` donates both input
+    buffers (production launches transfer each once, consume each
+    once)."""
+    batched = Pspec(BATCH_AXIS)
+    fn = shard_map(
+        _make_chunk_scan_body(g, rows),
+        mesh=mesh,
+        in_specs=(batched, batched),
+        out_specs=(batched, Pspec()),
+        **_SHARD_MAP_KW,
+    )
+    if donate:
+        return jax.jit(fn, donate_argnums=(0, 1))
+    return jax.jit(fn)
+
+
+@functools.cache
+def _cached_chunk_verifier(mesh: Mesh, g: int, rows: int):
+    return make_chunk_scan_verifier(mesh, g, rows)
+
+
+@functools.cache
+def _cached_chunk_verifier_donated(mesh: Mesh, g: int, rows: int):
+    # Same CPU-backend sharing as _cached_verifier_donated: one compile
+    # per scan shape on the test backend, donation on real devices.
+    if jax.default_backend() == "cpu":
+        return _cached_chunk_verifier(mesh, g, rows)
+    return make_chunk_scan_verifier(mesh, g, rows, donate=True)
+
+
+def _pack_chunk_arrays(mesh: Mesh, prep: dict, m: int):
+    """Shared pack step of the scan entries: pad packed rows + present
+    mask to ``m`` total rows and ship both to the mesh."""
+    n = prep["a"].shape[0]
+    packed = np.asarray(prep["packed"])
+    present = prep["host_ok"].astype(np.int32)
+    if m != n:
+        packed = np.pad(packed, [(0, m - n), (0, 0)])
+        present = np.pad(present, [(0, m - n)])
+    return _shard_put(mesh, packed), _shard_put(mesh, present)
+
+
+def verify_sharded_chunked_pack(mesh: Mesh, prep: dict, *,
+                                rows: int | None = None,
+                                max_subbatch: int = MAX_SUBBATCH):
+    """Pack stage of a whole-backlog chunked mesh scan; returns
+    ``dispatch() -> fetch() -> (N,) bool mask``, the same three-stage
+    contract as :func:`verify_batch_sharded_pack` (and the same mask —
+    per-signature verification, just batched into one program).
+
+    Pack (this thread): shard-aligned padding to ``n_devices * g *
+    rows`` total rows plus the h2d transfer of the packed rows and the
+    present mask.  ``rows`` is the per-shard chunk row count (the
+    registry's warmed ``scan_rows``; defaults to the per-shard bucket of
+    the batch itself, capped at ``max_subbatch``) and g comes from
+    shard_shapes.mesh_chunk_count — the one place the scan's chunk
+    arithmetic lives, so dispatch and warmup can never disagree about
+    which (g, rows) programs exist.
+    """
+    n = prep["a"].shape[0]
+    n_dev = mesh.devices.size
+    if rows is None:
+        rows = min(shard_bucket(n, n_dev, max_subbatch), max_subbatch)
+    g = mesh_chunk_count(n, n_dev, rows)
+    dev_rows, dev_present = _pack_chunk_arrays(mesh, prep,
+                                               n_dev * g * rows)
+
+    def dispatch():
+        mask_dev, _bad = _cached_chunk_verifier_donated(
+            mesh, g, rows)(dev_rows, dev_present)
+
+        def fetch():
+            return np.asarray(mask_dev)[:n]
+
+        return fetch
+
+    return dispatch
+
+
+def verify_sharded_chunked(mesh: Mesh, prep: dict, *,
+                           rows: int | None = None,
+                           return_bad_total: bool = False,
+                           max_subbatch: int = MAX_SUBBATCH):
+    """Run a host-prepared backlog (crypto/eddsa.prepare_batch) through
+    ONE chunked mesh scan -> (N,) bool mask, matching
+    verify_batch_sharded row for row.  Eager twin of
+    :func:`verify_sharded_chunked_pack` (same shared pack step) that
+    can also surface the psum'd invalid count — the sidecar engine
+    uses the staged form behind the scheduler's ``scan_sharded``
+    route."""
+    n = prep["a"].shape[0]
+    n_dev = mesh.devices.size
+    if rows is None:
+        rows = min(shard_bucket(n, n_dev, max_subbatch), max_subbatch)
+    g = mesh_chunk_count(n, n_dev, rows)
+    dev_rows, dev_present = _pack_chunk_arrays(mesh, prep,
+                                               n_dev * g * rows)
+    mask, bad_total = _cached_chunk_verifier(mesh, g, rows)(
+        dev_rows, dev_present)
     mask = np.asarray(mask)[:n]
     if return_bad_total:
         return mask, int(bad_total)

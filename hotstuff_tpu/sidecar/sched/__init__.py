@@ -17,8 +17,9 @@ from .classes import BULK, CLASSES, LATENCY, Launch, Pending, \
     class_of_opcode  # noqa: F401
 from .scheduler import BULK_QUEUE_CAP_SIGS, LATENCY_QUEUE_CAP_SIGS, \
     Scheduler, size_queue_caps  # noqa: F401
-from .shapes import PATH_HOST, PATH_LADDER_SHARDED, PATH_MESH, \
-    PATH_PER_SIG, PATH_RLC, PATH_RLC_SHARDED, RLC_MIN_LAUNCH, \
-    ShapeRegistry  # noqa: F401
+from .shapes import MESH_SCAN_CHUNKS, PATH_HOST, PATH_LADDER_SHARDED, \
+    PATH_MESH, PATH_PER_SIG, PATH_RLC, PATH_RLC_SHARDED, \
+    PATH_SCAN_SHARDED, RLC_MIN_LAUNCH, ShapeRegistry, \
+    quorum_sigs  # noqa: F401
 from .stats import SchedStats  # noqa: F401
 from .surge import AdmissionController  # noqa: F401

@@ -282,7 +282,13 @@ class Scheduler:
         """Pop a FIFO run of same-class Ed25519 requests up to the launch
         cap.  The head always ships (an oversized single request slices
         inside the engine dispatch); a later head that would overflow the
-        budget stays queued and leads the next launch (carry-over)."""
+        budget stays queued and leads the next launch (carry-over).
+
+        The cap is the registry's launch_cap: MAX_SUBBATCH until the
+        bulk shapes are warmed, then the single-chip MAX_COALESCED — or,
+        on a mesh, the whole-backlog scan capacity the gated enable_bulk
+        raised it to (graftscale): everything coalesced here then drains
+        as ONE chunked mesh scan instead of per-cap ladder slices."""
         cap = self.shapes.launch_cap
         items = [q._pop_locked()]
         total = len(items[0])

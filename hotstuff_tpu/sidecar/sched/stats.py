@@ -5,9 +5,11 @@ are launches actually (coalesce histogram), how much padded capacity is
 wasted (pad_waste vs bulk fill), which verify path ran (per_sig / rlc /
 rlc_bisect / host / rlc_sharded / ladder_sharded), how long requests sat
 queued per class (p50/p99), how often backpressure fired, how mesh
-launches distribute over per-shard buckets, and how much of the host
-pack work the double-buffered dispatch pipeline actually hid behind
-device execution (the ``pipeline`` overlap ratio).
+launches distribute over per-shard buckets, how many bulk backlogs
+drained as ONE whole-backlog chunked scan instead of per-launch_cap
+slices (the ``scan`` section), and how much of the host pack work the
+double-buffered dispatch pipeline actually hid behind device execution
+(the ``pipeline`` overlap ratio).
 
 Exposed over the wire as the ``OP_STATS`` reply (one JSON object — the
 snapshot() dict verbatim), which the harness fetches at teardown into
@@ -60,6 +62,15 @@ class SchedStats:
         # warmup marked, or a cold compile happened mid-traffic).
         self.mesh_launches = 0
         self.shard_bucket_hist: dict[int, int] = {}
+        # graftscale whole-backlog scans: backlogs drained as ONE
+        # chunked mesh program instead of per-launch_cap ladder slices.
+        # chunk_hist keys are the scan chunk counts g — like the shard
+        # buckets, every key must be a g the warmup marked
+        # (ShapeRegistry.mesh_chunks) or a cold compile happened.
+        self.scan_launches = 0
+        self.scan_sigs = 0
+        self.scan_chunk_hist: dict[int, int] = {}
+        self.scan_slices_avoided = 0
         # Double-buffered dispatch pipeline: total host pack time, and
         # the share of it that ran while a launch was already executing
         # on the device (hidden == free; the overlap ratio is the
@@ -114,15 +125,32 @@ class SchedStats:
         with self._lock:
             self.paths[path] = self.paths.get(path, 0) + 1
 
-    def note_mesh_launch(self, per_shard_bucket: int | None):
-        """One launch dispatched onto the mesh, keyed by the per-shard
-        padded bucket it landed on (None — a registry without a mesh
-        size — is counted but not bucketed)."""
+    def note_mesh_launch(self, buckets):
+        """One scheduler launch dispatched onto the mesh: counted ONCE,
+        with every per-slice shard bucket recorded in the histogram.
+        ``buckets`` is the list of per-shard padded buckets the launch's
+        ladder slices landed on (one entry for an unsliced launch; None
+        entries — a registry without a mesh size — are counted but not
+        bucketed).  The old shape called this per SLICE, so a sliced
+        backlog inflated ``sharded_launches`` past the scheduler's own
+        launch count and the two could never be compared."""
         with self._lock:
             self.mesh_launches += 1
-            if per_shard_bucket is not None:
-                self.shard_bucket_hist[per_shard_bucket] = \
-                    self.shard_bucket_hist.get(per_shard_bucket, 0) + 1
+            for b in buckets:
+                if b is not None:
+                    self.shard_bucket_hist[b] = \
+                        self.shard_bucket_hist.get(b, 0) + 1
+
+    def note_scan_launch(self, g: int, sigs: int, slices_avoided: int):
+        """One whole-backlog chunked mesh scan launch: g chunks drained
+        ``sigs`` signatures in ONE dispatch; ``slices_avoided`` is how
+        many extra per-launch_cap ladder dispatches the pre-graftscale
+        path would have paid for the same backlog."""
+        with self._lock:
+            self.scan_launches += 1
+            self.scan_sigs += sigs
+            self.scan_chunk_hist[g] = self.scan_chunk_hist.get(g, 0) + 1
+            self.scan_slices_avoided += max(0, slices_avoided)
 
     def note_pack(self, duration_s: float, hidden: bool):
         """One host-side pack stage: ``hidden`` says a launch was
@@ -169,6 +197,14 @@ class SchedStats:
                     "shard_buckets": {
                         str(k): v for k, v in
                         sorted(self.shard_bucket_hist.items())},
+                },
+                "scan": {
+                    "launches": self.scan_launches,
+                    "sigs": self.scan_sigs,
+                    "chunk_hist": {
+                        str(k): v for k, v in
+                        sorted(self.scan_chunk_hist.items())},
+                    "slices_avoided": self.scan_slices_avoided,
                 },
                 "pipeline": {
                     "pack_ms": round(self.pack_s * 1e3, 3),

@@ -185,7 +185,8 @@ class VerifyEngine:
         # rate drives bulk) with env overrides winning — see
         # sched/scheduler.size_queue_caps.
         self._shapes = vsched.ShapeRegistry(
-            use_host=use_host, n_devices=mesh_devices or 0)
+            use_host=use_host, n_devices=mesh_devices or 0,
+            committee=committee)
         lat_cap, bulk_cap = vsched.size_queue_caps(
             committee=committee, client_rate=client_rate)
         self._sched = vsched.Scheduler(shapes=self._shapes,
@@ -556,7 +557,7 @@ class VerifyEngine:
             dispatchers = [eddsa.verify_batch_rlc_pack(
                 m_msgs, m_pks, m_sigs, on_bisect=on_bisect)]
         elif path in (vsched.PATH_RLC_SHARDED, vsched.PATH_LADDER_SHARDED,
-                      vsched.PATH_MESH):
+                      vsched.PATH_SCAN_SHARDED, vsched.PATH_MESH):
             dispatchers = self._pack_sharded(path, m_msgs, m_pks, m_sigs,
                                              on_bisect)
         elif path == vsched.PATH_HOST:
@@ -613,27 +614,54 @@ class VerifyEngine:
 
     def _pack_sharded(self, path, msgs, pks, sigs, on_bisect):
         """Pack-stage dispatchers for the mesh routes: RLC launches go
-        whole (one MSM across the mesh); ladder launches slice at the
-        launch cap like the single-chip path.  Every launch's per-shard
-        bucket lands in the OP_STATS histogram — the warmed-shape
-        discipline made observable."""
+        whole (one MSM across the mesh); scan-routed backlogs go whole
+        too (ONE chunked whole-backlog program — graftscale); ladder
+        launches slice at the launch cap like the single-chip path.
+        Every launch's per-shard buckets (one per slice) land in the
+        OP_STATS histogram — counted once per LAUNCH, so the mesh
+        launch count stays comparable to the scheduler's own — and scan
+        launches land in the ``scan`` section with their chunk count."""
         from ..crypto.eddsa import prepare_batch
         from ..parallel import sharded_verify as shv
 
         stats = self._sched.stats
         if path == vsched.PATH_RLC_SHARDED:
-            stats.note_mesh_launch(self._shapes.shard_bucket_of(len(msgs)))
+            stats.note_mesh_launch(
+                [self._shapes.shard_bucket_of(len(msgs))])
             return [shv.verify_rlc_sharded_pack(
                 self._mesh, prepare_batch(msgs, pks, sigs),
                 on_bisect=on_bisect)]
-        step = self._shapes.launch_cap
-        out = []
+        if path == vsched.PATH_SCAN_SHARDED:
+            shape = self._shapes.scan_shape_of(len(msgs))
+            if shape is not None:
+                # The whole coalesced backlog in ONE dispatch.  The
+                # registry only answers this route for chunk counts the
+                # warmup marked (mesh_chunks), so an unwarmed scan
+                # shape can never compile mid-run; slices_avoided
+                # counts the per-MAX_SUBBATCH ladder dispatches the
+                # pre-graftscale mesh path would have paid (its launch
+                # cap never rose past MAX_SUBBATCH).
+                g, rows = shape
+                stats.note_scan_launch(
+                    g, len(msgs), -(-len(msgs) // MAX_SUBBATCH) - 1)
+                return [shv.verify_sharded_chunked_pack(
+                    self._mesh, prepare_batch(msgs, pks, sigs),
+                    rows=rows)]
+            # Defensive fallback (the registry only ever grows, so the
+            # shape cannot have vanished since route()): slice below.
+        # Slice at the WARMED ladder cap, not launch_cap: enable_bulk
+        # raises launch_cap to the scan capacity, and a slice that size
+        # would land on a per-shard bucket only the scan programs were
+        # compiled for (see ShapeRegistry.ladder_cap).
+        step = self._shapes.ladder_cap()
+        buckets, out = [], []
         for i in range(0, len(msgs), step):
             sl = slice(i, i + step)
             n = len(msgs[sl])
-            stats.note_mesh_launch(self._shapes.shard_bucket_of(n))
+            buckets.append(self._shapes.shard_bucket_of(n))
             out.append(shv.verify_batch_sharded_pack(
                 self._mesh, prepare_batch(msgs[sl], pks[sl], sigs[sl])))
+        stats.note_mesh_launch(buckets)
         return out
 
     # Verdict-cache capacity: ~224 B/record key; 64k entries ~ 15 MB.
@@ -983,10 +1011,11 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
             tracker.warm(f"bls_multi:{warm_bls_multi}",
                          lambda: _warmup_bls_multi(engine, warm_bls_multi))
         if warm_bulk:
-            # Covers both the single-device chunked scan and the mesh path:
-            # verify_batch_sharded buckets per-shard sizes to powers of two,
-            # so every launchable mesh batch maps onto a shape warmed here.
-            _warmup_bulk(engine)
+            # Single-chip: the chunked-scan shapes.  Mesh: the
+            # whole-backlog chunked mesh scan (graftscale) — the mesh
+            # registry gates enable_bulk on those scan shapes, so the
+            # cap only rises when the one-dispatch drain really exists.
+            _warmup_bulk(engine, warm_max)
             engine.enable_bulk()
         if warm_rlc and not (mesh_devices and mesh_devices > 1):
             # Single-chip only: the mesh path routes through
@@ -1110,11 +1139,75 @@ def _warm_shapes(engine, start: int, stop: int, label: str):
         n *= 2
 
 
-def _warmup_bulk(engine):
+def _warmup_bulk(engine, warm_max: int = MAX_SUBBATCH):
     """Compile the chunked-scan shapes (g = 2 .. 16 sub-batches) that bulk
     coalescing can hit once enable_bulk() raises the launch cap.  Cached
-    across restarts by the persistent compilation cache."""
+    across restarts by the persistent compilation cache.  On a mesh
+    engine the bulk drain is the whole-backlog chunked mesh scan
+    (graftscale), so that is what gets compiled — and what the
+    registry's gated enable_bulk requires."""
+    if engine._mesh is not None:
+        _warmup_mesh_scan(engine, warm_max)
+        return
     _warm_shapes(engine, 2 * MAX_SUBBATCH, MAX_COALESCED, "bulk warmup")
+
+
+def _warmup_mesh_scan(engine, warm_max: int = MAX_SUBBATCH,
+                      scan_chunks: int | None = None):
+    """Compile the whole-backlog chunked mesh scan
+    (parallel/sharded_verify.verify_sharded_chunked) at every chunk
+    count the engine may launch — g = 2, 4, ... MESH_SCAN_CHUNKS chunks
+    of the top warmed per-shard bucket — through the REAL staged entry,
+    and mark each (g, rows) in the registry (mark_mesh_chunks) so the
+    router starts choosing ``scan_sharded`` and the gated enable_bulk
+    may raise the launch cap to the scan capacity.  A backlog whose
+    chunk count is not marked here falls back to the sliced ladder —
+    an unwarmed scan shape never compiles mid-run.  ``scan_chunks``
+    lowers the warmed chunk-count ceiling (tests trade drain capacity
+    for compile wall; production keeps the default)."""
+    from ..crypto import eddsa, ref_ed25519 as ref
+    from ..parallel import sharded_verify as shv
+
+    n_dev = engine._shapes.n_devices
+    if n_dev < 2 or engine._mesh is None:
+        log.warning("mesh scan warmup ignored: no device mesh")
+        return
+    if engine._shapes.mesh_chunks:
+        # Already warmed (a --warm-bulk boot runs this before the
+        # --warm-rlc-sharded leg does): every rerun thunk would be a
+        # compile-cache hit but still pay a full n_dev*g*rows verify
+        # per chunk count — skip the duplicate boot wall.
+        return
+    if scan_chunks is None:
+        scan_chunks = vsched.MESH_SCAN_CHUNKS
+    sk = bytes(range(32))
+    _, pk = ref.generate_keypair(sk)
+    msg = b"\x03" * 32
+    sig = ref.sign(sk, msg)
+    # The committee floor applies here exactly as in the RLC warmup, so
+    # every caller (--warm-bulk's mesh leg, --warm-rlc-sharded's scan
+    # leg) derives the SAME chunk rows — mark_mesh_chunks enforces one
+    # rows value per registry.
+    cap = min(max(warm_max, engine._shapes.qc_sigs or 0), MAX_SUBBATCH)
+    rows = shv.shard_bucket(cap, n_dev)
+    g = 2
+    while g <= min(scan_chunks, vsched.MESH_SCAN_CHUNKS):
+        n = n_dev * g * rows
+        t0 = monotonic()
+
+        def _one(n=n, rows=rows):
+            prep = eddsa.prepare_batch([msg] * n, [pk] * n, [sig] * n)
+            mask = shv.verify_sharded_chunked_pack(
+                engine._mesh, prep, rows=rows)()()
+            if not all(mask):
+                log.error("mesh scan warmup verify returned false "
+                          "at N=%d", n)
+
+        _warmed(engine, f"mesh_scan:{n_dev}x{g}x{rows}", _one)
+        engine._shapes.mark_mesh_chunks(g, rows)
+        log.info("mesh scan warmup N=%d (%d chunks of %d rows/shard) "
+                 "done in %.1fs", n, g, rows, monotonic() - t0)
+        g *= 2
 
 
 def _warmup(engine, warm_max: int = MAX_SUBBATCH):
@@ -1129,7 +1222,8 @@ def _warmup(engine, warm_max: int = MAX_SUBBATCH):
     _warm_shapes(engine, 8, warm_max, "warmup")
 
 
-def _warmup_rlc_sharded(engine, warm_max: int = MAX_SUBBATCH):
+def _warmup_rlc_sharded(engine, warm_max: int = MAX_SUBBATCH,
+                        scan_chunks: int | None = None):
     """Compile the MESH verify programs at every per-shard bucket the
     engine may launch, and register the shapes so the scheduler's router
     starts choosing the ``rlc_sharded`` path.
@@ -1143,6 +1237,16 @@ def _warmup_rlc_sharded(engine, warm_max: int = MAX_SUBBATCH):
     bucket before the socket binds.  Bisection halves land on smaller
     buckets, which this loop has always already compiled (increasing
     order).
+
+    graftscale: the warmup ceiling is raised to the committee's quorum
+    size when one is served (``--committee N`` -> ShapeRegistry.qc_sigs
+    = 2N/3+1), so a giant-committee QC batch — ~667 signatures at
+    N=1000 — always lands on a warmed sharded-RLC bucket and never
+    takes the sliced ladder.  Afterwards the whole-backlog chunked
+    mesh scan shapes are compiled too (_warmup_mesh_scan) and the
+    launch cap rises through the gated enable_bulk, so mesh boots
+    (the harness's ``--mesh N --warm-rlc-sharded``) drain coalesced
+    bulk backlogs in ONE launch from the first block.
     """
     from ..crypto import eddsa, ref_ed25519 as ref
     from ..parallel import sharded_verify as shv
@@ -1156,7 +1260,9 @@ def _warmup_rlc_sharded(engine, warm_max: int = MAX_SUBBATCH):
     msg = b"\x02" * 32
     sig = ref.sign(sk, msg)
     per = shv.shard_bucket(1, n_dev)          # the smallest bucket
-    cap = min(warm_max, MAX_SUBBATCH)         # largest routed launch
+    # Largest routed launch: warm_max, floored at the served quorum so
+    # the committee's own QC shape is always covered.
+    cap = min(max(warm_max, engine._shapes.qc_sigs or 0), MAX_SUBBATCH)
     top = shv.shard_bucket(cap, n_dev)        # its per-shard bucket
     while per <= top:
         n = n_dev * per
@@ -1181,6 +1287,11 @@ def _warmup_rlc_sharded(engine, warm_max: int = MAX_SUBBATCH):
         log.info("RLC sharded warmup N=%d (per-shard bucket %d) done "
                  "in %.1fs", n, per, monotonic() - t0)
         per *= 2
+    # The whole-backlog scan leg: chunk counts over the top bucket just
+    # warmed, then the (gated) launch-cap raise — after this, mesh bulk
+    # stops slicing at the old MAX_SUBBATCH cap.
+    _warmup_mesh_scan(engine, cap, scan_chunks=scan_chunks)
+    engine.enable_bulk()
 
 
 def _warmup_rlc(engine, warm_max: int = MAX_SUBBATCH):

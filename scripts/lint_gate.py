@@ -51,6 +51,12 @@ if __name__ == "__main__":
                     "padshape:hotstuff_tpu/ops/kern/msm_accum.py",
                     "padshape:hotstuff_tpu/ops/kern/scalar_mont.py",
                     "hotpath:hotstuff_tpu/parallel/shard_shapes.py",
+                    # graftscale: the whole-backlog chunked mesh scan op
+                    # lives in sharded_verify — it must stay inside BOTH
+                    # the hot-path taint scan and the padshape scan
+                    # (which carries the shard-misaligned-launch rule
+                    # over its (g, rows) chunk arithmetic).
+                    "hotpath:hotstuff_tpu/parallel/sharded_verify.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/__init__.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/classes.py",
                     "hotpath:hotstuff_tpu/sidecar/sched/scheduler.py",

@@ -439,6 +439,38 @@ def test_shard_misaligned_quiet_on_helper_routed_launch():
     assert findings == []
 
 
+def test_shard_misaligned_fires_on_handrolled_scan_chunks():
+    """graftscale: a whole-backlog scan launch whose chunk count comes
+    from hand-rolled n_dev division instead of mesh_chunk_count is a
+    finding — the (g, rows) scan shapes are warmed exactly like the
+    buckets, so a free-hand g can land a never-compiled program."""
+    findings = padshape.check_sources({MESH_MOD: textwrap.dedent("""
+        def scan_backlog(mesh, rows_in, present, n_dev, rows):
+            g = next_pow2(-(-rows_in.shape[0] // n_dev) // rows)
+            return _cached_chunk_verifier(mesh, g, rows)(rows_in,
+                                                         present)
+        """)})
+    assert rules(findings) == {"shard-misaligned-launch"}
+    assert any("size math against n_dev" in f.message for f in findings)
+
+
+def test_shard_misaligned_quiet_on_mesh_chunk_count_routed_scan():
+    """mesh_chunk_count is one of THE shard helpers: a scan launch
+    routed through it is clean."""
+    findings = padshape.check_sources({MESH_MOD: textwrap.dedent("""
+        import numpy as np
+
+        def scan_backlog(mesh, prep, rows):
+            n = prep.shape[0]
+            n_dev = mesh.devices.size
+            g = mesh_chunk_count(n, n_dev, rows)
+            m = n_dev * g * rows
+            padded = np.pad(prep, m - n)
+            return _cached_chunk_verifier(mesh, g, rows)(padded)
+        """)})
+    assert findings == []
+
+
 def test_shard_misaligned_quiet_on_factories_and_non_mesh_modules():
     # The donated-cache factory REFERENCES _cached_verifier without
     # launching it; a non-mesh module may do n_dev math freely (the rule

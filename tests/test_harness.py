@@ -88,6 +88,9 @@ def test_parser_folds_sidecar_stats_into_notes():
         "queue_full": {"bulk": 3},
         "mesh": {"sharded_launches": 40,
                  "shard_buckets": {"2": 30, "4": 10}},
+        "scan": {"launches": 3, "sigs": 42_000,
+                 "chunk_hist": {"4": 1, "16": 2},
+                 "slices_avoided": 38},
         "pipeline": {"pack_ms": 120.5, "pack_hidden_ms": 90.4,
                      "overlap_ratio": 0.75},
         "compile": {"kernel": "abcd1234", "hits": 11, "misses": 0,
@@ -102,6 +105,8 @@ def test_parser_folds_sidecar_stats_into_notes():
     assert "Sidecar pad fill: 128 sigs (waste 300)" in out
     assert "Sidecar mesh launches: 40 (per-shard buckets 2x30, 4x10)" \
         in out
+    assert ("Sidecar whole-backlog scans: 3 (42,000 sigs, chunks 4x1, "
+            "16x2), 38 slice(s) avoided") in out
     assert "Sidecar pack overlap: 75% of 120.5 ms packing hidden" in out
     assert "Sidecar queue-full sheds: bulk=3" in out
     # labelled grammar intact
@@ -546,3 +551,38 @@ def test_trace_headline_probe_schema(bench_mod):
                            "joined": 1, "rate": 0.5}
     assert out["join_rate"] == 0.5
     assert out["chrome_events"] > 0
+
+
+def test_committee_scale_probe_schema(bench_mod):
+    """The headline `committee_scale` field (graftscale): QC-shaped
+    batches of 2f+1 votes per committee size through all three
+    engine-path mesh entries, keyed N<committee>, sigs/sec/chip per
+    route — the schema both the live and degraded lines publish.
+    Fixture-scale committees keep the CPU compiles tiny; the real
+    sweep (100/300/1000) runs in the bench's forced-host subprocess."""
+    out = bench_mod.committee_scale_probe(committees=(10, 22),
+                                          repeats=1, budget_s=600.0)
+    assert set(out) == {"N10", "N22"}
+    for key, committee in (("N10", 10), ("N22", 22)):
+        stats = out[key]
+        assert stats["quorum"] == 2 * committee // 3 + 1
+        for route in ("per_sig_sharded", "rlc_sharded", "scan"):
+            assert stats[f"{route}_sigs_per_s_chip"] > 0, (key, route)
+        assert stats["rlc_speedup"] > 0
+    # An exhausted budget marks remaining committees skipped instead of
+    # stalling the stage (the degraded-line discipline).
+    out = bench_mod.committee_scale_probe(committees=(10,), repeats=1,
+                                          budget_s=0.0)
+    assert out["N10"] == {"quorum": 7, "skipped": True}
+
+
+def test_sched_probe_carries_scan_section(bench_mod):
+    """The bench `sched` field round-trips the OP_STATS snapshot over
+    the real wire encoding — the graftscale ``scan`` section rides it
+    (zeros on the host-mode probe engine, but the schema is what a
+    mesh run's headline publishes)."""
+    out = bench_mod.sched_headline_probe()
+    assert out["scan"] == {"launches": 0, "sigs": 0, "chunk_hist": {},
+                           "slices_avoided": 0}
+    assert out["shapes"]["mesh_chunks"] == []
+    assert out["shapes"]["scan_rows"] == 0

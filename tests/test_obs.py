@@ -818,6 +818,44 @@ def test_bench_trend_best_latest_and_degraded_flags(tmp_path):
     assert verdict["ok"] is False and "below best" in verdict["reason"]
 
 
+def test_bench_trend_flattens_committee_scale(tmp_path):
+    """graftscale: the committee_scale headline's numeric leaves land in
+    the ledger like every other field — per-committee per-route
+    sigs/sec/chip tracked best/latest, degraded runs still excluded
+    from best."""
+    bt = _bench_trend()
+    cs = {"N100": {"quorum": 67, "per_sig_sharded_sigs_per_s_chip": 50.0,
+                   "rlc_sharded_sigs_per_s_chip": 120.0,
+                   "scan_sigs_per_s_chip": 60.0, "rlc_speedup": 2.4},
+          "N1000": {"quorum": 667, "skipped": True}}
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r01.json", {"n": 1, "rc": 0,
+                            "parsed": {"metric": "m", "value": 100.0,
+                                       "committee_scale": cs}}),
+        # A degraded line carrying larger CPU-backend numbers must not
+        # claim "best".
+        ("BENCH_zz_degraded.json", {
+            "metric": "m", "value": 5.0, "degraded": True,
+            "committee_scale": {
+                "N100": {"quorum": 67,
+                         "rlc_sharded_sigs_per_s_chip": 999.0}}}),
+    )
+    trend = bt.build_trend(sorted(str(p) for p in
+                                  tmp_path.glob("BENCH_*.json")))
+    f = trend["fields"]
+    assert f["committee_scale.N100.rlc_sharded_sigs_per_s_chip"]["best"] \
+        == 120.0
+    assert f["committee_scale.N100.rlc_sharded_sigs_per_s_chip"][
+        "latest"] == 999.0
+    assert f["committee_scale.N100.rlc_speedup"]["best"] == 2.4
+    assert f["committee_scale.N100.quorum"]["best"] == 67
+    # The skipped committee contributes only its quorum (bools and the
+    # skipped flag are not measurements).
+    assert "committee_scale.N1000.skipped" not in f
+    assert f["committee_scale.N1000.quorum"]["latest"] == 667
+
+
 def test_bench_trend_unjudgeable_histories_pass(tmp_path):
     bt = _bench_trend()
     # Only degraded runs: nothing to judge, never a failure.

@@ -124,6 +124,74 @@ def test_shard_shapes_alignment_rule():
         shard_bucket(8, 0)
 
 
+def test_whole_backlog_scan_matches_sliced_path():
+    """graftscale: the whole-backlog chunked mesh scan
+    (verify_sharded_chunked) returns a mask bit-identical to the sliced
+    per-signature path (verify_batch_sharded == verify_batch) for the
+    same backlog — including device-detected invalid rows and
+    host-rejected encodings — through both the eager and the staged
+    pack -> dispatch -> fetch entries."""
+    from hotstuff_tpu.parallel.sharded_verify import (
+        verify_sharded_chunked, verify_sharded_chunked_pack)
+
+    rng = np.random.default_rng(53)
+    msgs, pks, sigs = [], [], []
+    for i in range(40):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msg = rng.bytes(32)
+        sig = ref.sign(sk, msg)
+        if i in (7, 33):
+            sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
+        msgs.append(msg); pks.append(pk); sigs.append(sig)
+    pks[21] = b"\xff" * 32  # host-rejected encoding (y >= p)
+    mesh = make_mesh(8)
+    want = eddsa.verify_batch(msgs, pks, sigs)
+    prep = eddsa.prepare_batch(msgs, pks, sigs)
+    # rows=2 -> per-shard demand ceil(40/8)=5 -> g=4 chunks of 2 rows.
+    mask, bad = verify_sharded_chunked(mesh, prep, rows=2,
+                                       return_bad_total=True)
+    assert mask.tolist() == want.tolist()
+    assert not mask[7] and not mask[33] and not mask[21]
+    assert bad == 2  # device-detected; the host rejection is excluded
+    # The staged production entry lands on the SAME (g, rows) program.
+    dispatch = verify_sharded_chunked_pack(
+        mesh, eddsa.prepare_batch(msgs, pks, sigs), rows=2)
+    assert dispatch()().tolist() == want.tolist()
+
+
+def test_mesh_chunk_count_arithmetic():
+    """The scan's (g, rows) rule: pow2 chunk counts covering per-shard
+    demand, agreeing with the aligned-rows capacity whenever demand
+    exceeds one chunk — incl. the 3000-on-8-devices case, which scans
+    as 4 chunks of 128 rows = the 8x512 shard-aligned shape (never a
+    375-row shard)."""
+    import pytest
+
+    from hotstuff_tpu.parallel.shard_shapes import (mesh_chunk_count,
+                                                    shard_aligned_rows)
+
+    assert mesh_chunk_count(3000, 8, 128) == 4
+    assert 8 * 4 * 128 == shard_aligned_rows(3000, 8) == 8 * 512
+    assert mesh_chunk_count(40, 8, 2) == 4      # ceil(5/2) -> pow2 4
+    assert mesh_chunk_count(16, 8, 4) == 1      # fits one chunk
+    assert mesh_chunk_count(16 * 1024, 8, 128) == 16
+    # Beyond-one-chunk demand always pads to the aligned-rows capacity
+    # (both grow in powers of two over the same floor).
+    for n in (300, 1500, 3000, 20_000):
+        for n_dev in (2, 8):
+            for rows in (4, 128):
+                g = mesh_chunk_count(n, n_dev, rows)
+                total = n_dev * g * rows
+                assert total >= n
+                if -(-n // n_dev) >= rows:
+                    assert total == shard_aligned_rows(n, n_dev)
+    with pytest.raises(ValueError):
+        mesh_chunk_count(100, 0, 4)
+    with pytest.raises(ValueError):
+        mesh_chunk_count(100, 8, 3)   # rows must be a power of two
+
+
 def test_sharded_pack_stages_match_eager():
     """The pack -> dispatch -> fetch split (the engine's double-buffered
     launch shape) returns the same masks as the eager entry points, for

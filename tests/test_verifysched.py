@@ -385,10 +385,18 @@ def test_engine_small_batches_stay_per_sig(rlc_engine):
 def mesh_engine():
     """Mesh engine with the per-signature AND sharded one-MSM warmups
     run through the real entry points (what `--mesh 8 --warm-rlc-sharded`
-    produces), capped at 32 records to bound compile time."""
-    engine = VerifyEngine(mesh_devices=8)
+    produces), capped to small shapes to bound compile time.
+
+    graftscale knobs exercised: the committee (40 -> quorum 27) floors
+    the RLC warmup cap ABOVE warm_max=16, so the quorum's per-shard
+    bucket (4) is warmed even though warm_max alone would stop at 2 —
+    the giant-committee threshold discipline at fixture scale; and the
+    warmup's scan leg (chunk counts 2 and 4 of the top bucket) raises
+    the launch cap through the gated enable_bulk to the scan capacity
+    8 dev x 4 chunks x 4 rows = 128 sigs."""
+    engine = VerifyEngine(mesh_devices=8, committee=40)
     service._warmup(engine, warm_max=32)
-    service._warmup_rlc_sharded(engine, warm_max=32)
+    service._warmup_rlc_sharded(engine, warm_max=16, scan_chunks=4)
     yield engine
     engine.stop()
 
@@ -484,6 +492,224 @@ def test_mesh_engine_small_batches_take_ladder_path(mesh_engine):
     assert got == [i != 4 for i in range(10)]
     snap = engine.stats_snapshot()
     assert snap["paths"].get("ladder_sharded", 0) == before + 1
+
+
+# ---------------------------------------------------------------------------
+# graftscale: whole-backlog chunked mesh scans + giant-committee routing
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_scan_leg_raises_launch_cap_and_covers_quorum(mesh_engine):
+    """--warm-rlc-sharded's graftscale legs, observed on the fixture:
+    the scan shapes are marked and the launch cap rose through the
+    gated enable_bulk to the scan capacity; the committee floor (40 ->
+    quorum 27) warmed the quorum's per-shard bucket even though
+    warm_max=16 alone would have stopped one bucket short."""
+    shapes = mesh_engine._shapes
+    assert shapes.committee == 40 and shapes.qc_sigs == 27
+    snap = mesh_engine.stats_snapshot()["shapes"]
+    assert snap["mesh_chunks"] == [2, 4]
+    assert snap["scan_rows"] == 4
+    # Raise-only enable_bulk: the fixture's scan capacity (128) sits
+    # BELOW the MAX_SUBBATCH default, so the cap stays put (production
+    # capacities — 16 chunks of 128 rows on 8 devices — raise it).
+    assert shapes.scan_capacity() == 8 * 4 * 4
+    assert snap["launch_cap"] == eddsa.MAX_SUBBATCH
+    assert snap["committee"] == 40
+    # The quorum's per-shard bucket (shard_bucket(27, 8) = 4) is RLC
+    # warmed, so the committee's own QC batches route one-MSM.
+    assert shapes.route(27) == vsched.PATH_RLC_SHARDED
+
+
+def test_engine_whole_backlog_scan_one_launch(mesh_engine):
+    """A coalesced bulk backlog bigger than every warmed ladder bucket
+    dispatches as ONE whole-backlog scan launch: the OP_STATS ``scan``
+    section shows it (and zero per-slice ladder launches), the chunk
+    count is warmup-marked, and the mask is bit-identical to
+    verify_batch — including device-detected invalid rows."""
+    engine = mesh_engine
+    before = engine.stats_snapshot()
+    msgs, pks, sigs = _sigs(100, tamper={3, 77}, seed=80)
+    got = _engine_mask(engine, msgs, pks, sigs)
+    want = eddsa.verify_batch(msgs, pks, sigs)
+    assert got == [bool(b) for b in want]
+    assert got == [i not in (3, 77) for i in range(100)]
+    snap = engine.stats_snapshot()
+    scan = snap["scan"]
+    assert scan["launches"] - before["scan"]["launches"] == 1
+    assert scan["sigs"] - before["scan"]["sigs"] == 100
+    # ceil(100/8)=13 rows/shard over 4-row chunks -> g=4, a warmed
+    # chunk count (launched-scan-shapes subset of warmed, the scan
+    # twin of the ladder buckets assertion below).
+    assert scan["chunk_hist"].get("4", 0) >= 1
+    launched_chunks = {int(g) for g in scan["chunk_hist"]}
+    assert launched_chunks <= set(snap["shapes"]["mesh_chunks"])
+    assert snap["paths"].get("scan_sharded", 0) \
+        - before["paths"].get("scan_sharded", 0) == 1
+    # Zero per-slice ladder launches for the backlog.
+    assert snap["mesh"]["sharded_launches"] \
+        == before["mesh"]["sharded_launches"]
+
+
+def test_scan_route_falls_back_to_slicing_when_unwarmed():
+    """An unwarmed chunk count must NOT take the scan route (it would
+    be a cold XLA compile on the engine thread): the router answers the
+    sliced ladder instead, and scan_shape_of says why (None)."""
+    reg = vsched.ShapeRegistry(n_devices=8)
+    reg.mark_bucket(8)                      # shard bucket 1 warmed
+    for g in (2, 4):
+        reg.mark_mesh_chunks(g, 4)
+    assert reg.scan_shape_of(100) == (4, 4)
+    assert reg.route(100) == vsched.PATH_SCAN_SHARDED
+    # 3000 records need g=128 chunks of 4 rows — never warmed.
+    assert reg.scan_shape_of(3000) is None
+    assert reg.route(3000) == vsched.PATH_LADDER_SHARDED
+    # A batch whose ladder bucket IS warmed keeps the ladder path.
+    assert reg.route(8) == vsched.PATH_LADDER_SHARDED
+    # No scan warmup at all: every size slices, as before graftscale.
+    cold = vsched.ShapeRegistry(n_devices=8)
+    assert cold.scan_shape_of(100) is None
+    assert cold.route(100) == vsched.PATH_LADDER_SHARDED
+
+
+def test_enable_bulk_gated_on_scan_shapes():
+    """On a mesh registry the launch cap only rises once the
+    whole-backlog scan shapes are warmed — to the warmed scan capacity,
+    raise-only (a small capacity never LOWERS the cap below its current
+    value); single-chip registries keep the old contract."""
+    reg = vsched.ShapeRegistry(n_devices=8)
+    reg.enable_bulk(16 * 1024)
+    assert reg.launch_cap == eddsa.MAX_SUBBATCH  # gated: nothing warmed
+    # Production-scale scan shapes (16 chunks of 128 rows on 8 devices
+    # = 16384 capacity): the cap rises to min(bound, capacity).
+    for g in (2, 4, 8, 16):
+        reg.mark_mesh_chunks(g, 128)
+    reg.enable_bulk(16 * 1024)
+    assert reg.launch_cap == 16 * 1024
+    # The caller's bound still wins when it is tighter.
+    big = vsched.ShapeRegistry(n_devices=8)
+    for g in (2, 4, 8, 16):
+        big.mark_mesh_chunks(g, 1024)
+    big.enable_bulk(2048)
+    assert big.launch_cap == 2048
+    # Single chip: ungated, as before.
+    single = vsched.ShapeRegistry()
+    single.enable_bulk(4096)
+    assert single.launch_cap == 4096
+    # Raise-only: a SMALL warmed scan capacity (8 devices x 4 chunks x
+    # 4 rows = 128, the test-fixture scale) must never LOWER the cap
+    # below the MAX_SUBBATCH default.
+    small = vsched.ShapeRegistry(n_devices=8)
+    for g in (2, 4):
+        small.mark_mesh_chunks(g, 4)
+    small.enable_bulk(16 * 1024)
+    assert small.launch_cap == eddsa.MAX_SUBBATCH
+    assert small.scan_capacity() == 8 * 4 * 4
+    # One rows value per registry: a second would mean two scan
+    # ladders the router cannot tell apart.
+    with pytest.raises(ValueError):
+        reg.mark_mesh_chunks(2, 8)
+
+
+def test_ladder_slices_stay_on_warmed_buckets(mesh_engine):
+    """The sliced-ladder fallback must slice at the WARMED ladder cap,
+    not the scan-raised launch_cap: an oversized request whose chunk
+    count is unwarmed (g=16 here) slices into launches whose per-shard
+    buckets the warmup compiled — never a cold mid-run shape — and the
+    whole sliced backlog records as ONE mesh launch with its per-slice
+    buckets."""
+    engine = mesh_engine
+    shapes = engine._shapes
+    # The registry arithmetic: the coalescer cap (MAX_SUBBATCH at
+    # fixture scale — raise-only enable_bulk) never leaks into ladder
+    # slicing, which stays at n_dev x top warmed bucket = 32.
+    assert shapes.launch_cap == eddsa.MAX_SUBBATCH
+    assert shapes.ladder_cap() == 8 * 4
+    assert shapes.route(300) == vsched.PATH_LADDER_SHARDED
+    before = engine.stats_snapshot()
+    msgs, pks, sigs = _sigs(300, tamper={7, 250}, seed=81)
+    got = _engine_mask(engine, msgs, pks, sigs)
+    assert got == [i not in (7, 250) for i in range(300)]
+    snap = engine.stats_snapshot()
+    assert snap["mesh"]["sharded_launches"] \
+        - before["mesh"]["sharded_launches"] == 1
+    assert snap["scan"]["launches"] == before["scan"]["launches"]
+    launched = {int(b) for b in snap["mesh"]["shard_buckets"]}
+    warmed = set(snap["shapes"]["shard_buckets"]) \
+        | set(snap["shapes"]["rlc_shard_buckets"])
+    assert launched and launched <= warmed, (launched, warmed)
+
+
+def test_giant_committee_threshold_routing():
+    """QC-shaped latency batches for N in {100, 300, 1000} route
+    through the sharded one-MSM path once their quorum bucket is
+    warmed (the committee-floored warmup guarantees it is), and stay
+    on the safe ladder when it is not."""
+    from hotstuff_tpu.parallel.shard_shapes import shard_bucket
+
+    assert vsched.quorum_sigs(1000) == 667
+    for committee in (100, 300, 1000):
+        q = vsched.quorum_sigs(committee)
+        reg = vsched.ShapeRegistry(n_devices=8, committee=committee)
+        assert reg.qc_sigs == q
+        assert q >= vsched.RLC_MIN_LAUNCH
+        assert shard_bucket(q, 8) <= eddsa.MAX_SUBBATCH, \
+            "quorum must fit the one-dispatch RLC envelope"
+        assert reg.route(q) == vsched.PATH_LADDER_SHARDED  # unwarmed
+        reg.mark_rlc_sharded(q)
+        assert reg.route(q) == vsched.PATH_RLC_SHARDED
+    # N=1000: ~667 signatures land on the 128-row per-shard bucket.
+    assert shard_bucket(667, 8) == 128
+
+
+def test_scan_and_mesh_launch_stats_accounting():
+    """note_mesh_launch counts ONE launch with per-slice buckets in the
+    histogram; note_scan_launch feeds the ``scan`` section including
+    the slices the old per-launch_cap path would have paid."""
+    stats = vsched.SchedStats()
+    stats.note_mesh_launch([4, 4, 8, None])
+    snap = stats.snapshot()
+    assert snap["mesh"]["sharded_launches"] == 1
+    assert snap["mesh"]["shard_buckets"] == {"4": 2, "8": 1}
+    stats.note_scan_launch(16, 16384, 15)
+    stats.note_scan_launch(4, 300, 0)
+    snap = stats.snapshot()
+    assert snap["scan"] == {"launches": 2, "sigs": 16684,
+                            "chunk_hist": {"4": 1, "16": 1},
+                            "slices_avoided": 15}
+
+
+@pytest.mark.slow
+def test_giant_quorum_engine_path_n1000():
+    """The N=1000 acceptance shape through the REAL engine: a
+    667-signature latency batch routes sharded-RLC with its mask
+    bit-identical to verify_batch, incl. a forced bisection.  Slow
+    lane: the quorum floor warms per-shard buckets up to 128 (each a
+    fresh XLA compile of both mesh programs on the CPU backend)."""
+    engine = VerifyEngine(mesh_devices=8, committee=1000)
+    service._warmup(engine, warm_max=8)
+    # scan_chunks=0 skips the scan leg: this test is about the RLC
+    # threshold, and the scan programs at rows=128 are another minute
+    # of CPU compile the assertion doesn't need.
+    service._warmup_rlc_sharded(engine, warm_max=8, scan_chunks=0)
+    try:
+        assert engine._shapes.qc_sigs == 667
+        assert engine._shapes.route(667) == vsched.PATH_RLC_SHARDED
+        msgs, pks, sigs = _sigs(667, tamper={13, 600}, seed=90)
+        got = _engine_mask(engine, msgs, pks, sigs)
+        want = eddsa.verify_batch(msgs, pks, sigs)
+        assert got == [bool(b) for b in want]
+        assert got == [i not in (13, 600) for i in range(667)]
+        snap = engine.stats_snapshot()
+        assert snap["paths"].get("rlc_sharded", 0) >= 1
+        assert snap["paths"].get("rlc_bisect", 0) >= 1
+        warmed = set(snap["shapes"]["rlc_shard_buckets"])
+        assert 128 in warmed, "quorum bucket must be warmed"
+        launched = {int(b) for b in snap["mesh"]["shard_buckets"]}
+        assert launched and launched <= warmed \
+            | set(snap["shapes"]["shard_buckets"])
+    finally:
+        engine.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,22 @@ acceptance-bar "ok" (>=3x overload, consensus p99 bounded, sheds
 bulk-before-latency, baseline SLO PASS).  Emitted on BOTH the live and
 degraded lines.
 
+graftguard (`"guard"` field): the supervised-verify-engine ladder proven
+end to end — a host-mode VerifyEngine under a real LaunchGuard with
+tight deadlines takes a scripted launch wedge (the chaos hook's `wedge`
+knob, the same OP_CHAOS path a `sidecar wedge` fault-plan event drives),
+answers the wedged latency batch with a mask bit-identical to
+verify_batch, sheds bulk to BUSY during the crash-only reboot, re-warms,
+passes the canary, and resumes device routing.  Keys: wedges, reboots,
+canary_passes, quarantined_records, poisoned_records,
+host_fallback_records, busy_during_reboot, busy_retry_after_ms,
+masks_bit_identical, rewarmed, reboot_wall_ms, recovered, and the
+acceptance bar "ok".  Emitted on BOTH the live and degraded lines.
+Kill-proof emit rides with it: every emitted line is written to
+results/last_line.json CACHE-FIRST, and SIGTERM/SIGALRM re-emit the best
+line already measured before dying — an rc=124 round still yields a
+parseable artifact.
+
 grafttrace (`"trace"` field): the cross-layer tracing pipeline proven
 end to end — synthetic replica logs with a known clock skew run
 through the real node-TRACE parser, the RTT-midpoint offset estimator,
@@ -250,11 +266,86 @@ def save_cache(value: float, vs_baseline: float, cpu: float):
     os.replace(tmp, CACHE_PATH)
 
 
+# Kill-proof emit (graftguard satellite; VERDICT's top-next "kill-proof
+# BENCH emit"): every emitted line is remembered in-process AND written
+# to disk CACHE-FIRST (before stdout), so a driver timeout that SIGKILLs
+# mid-print — or an rc=124 round that never reaches the final emit —
+# still leaves results/last_line.json as a parseable artifact, and the
+# SIGTERM/SIGALRM handlers re-emit the best line already measured
+# before dying (install_kill_handlers, called first thing in main()).
+_LINE_CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "results", "last_line.json")
+_LAST_LINE = None
+
+
 def emit(value: float, vs_baseline: float, **extra):
+    global _LAST_LINE
     line = {"metric": "ed25519-batch-verify", "value": round(value, 1),
             "unit": "sigs/sec", "vs_baseline": round(vs_baseline, 3)}
     line.update(extra)
+    _LAST_LINE = line
+    try:
+        tmp = _LINE_CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(line, f)
+        os.replace(tmp, _LINE_CACHE_PATH)
+    except OSError:
+        pass  # the disk copy is belt-and-braces, never fatal
     print(json.dumps(line), flush=True)
+
+
+def install_kill_handlers(exit=os._exit, signums=None):
+    """SIGTERM/SIGALRM -> re-emit the best headline line this process
+    already measured, then exit 0: the driver's bounded window closing
+    (its `timeout` sends SIGTERM before the rc=124 SIGKILL) must never
+    eat an artifact a wedged stage already earned.  Preference order:
+    the last line THIS run emitted (partial stages included), else the
+    best cached measurement for this exact kernel, else an explicit
+    error line — always exactly one parseable JSON line.  ``exit`` is
+    injectable for the regression test; returns the handler."""
+    import signal as _signal
+
+    def _handler(signum, frame):
+        name = _signal.Signals(signum).name
+        if _LAST_LINE is not None:
+            out = dict(_LAST_LINE)
+            out["killed"] = name
+        else:
+            cached = load_cache()
+            if cached:
+                out = {"metric": "ed25519-batch-verify",
+                       "value": cached["value"], "unit": "sigs/sec",
+                       "vs_baseline": cached["vs_baseline"],
+                       "source": "cached-measurement",
+                       "measured_at": cached.get("measured_at",
+                                                 "unknown"),
+                       "note": f"killed by {name} before any emit",
+                       "killed": name}
+            else:
+                out = {"metric": "ed25519-batch-verify", "value": 0,
+                       "unit": "sigs/sec", "vs_baseline": 0,
+                       "killed": name,
+                       "error": f"killed by {name} before any "
+                                "measurement"}
+        # ONE os.write of pre-encoded bytes, with a LEADING newline:
+        # the signal may have interrupted emit() mid-print, and
+        # appending to that torn prefix would weld two lines into one
+        # unparseable last line.  The newline closes any partial line
+        # first, so the handler's line is always whole — the driver
+        # takes the last parseable line, and the torn fragment simply
+        # fails parse.  (No buffered print here: os._exit would drop
+        # it, and print() re-enters the interrupted stream machinery.)
+        try:
+            os.write(1, ("\n" + json.dumps(out) + "\n").encode("utf-8"))
+        except OSError:
+            pass
+        exit(0)
+
+    if signums is None:
+        signums = (_signal.SIGTERM, _signal.SIGALRM)
+    for s in signums:
+        _signal.signal(s, _handler)
+    return _handler
 
 
 def emit_cached(cached, note: str, **extra):
@@ -1265,6 +1356,117 @@ def surge_headline_probe(offered_x: float = 4.0,
     }
 
 
+def guard_headline_probe() -> dict:
+    """The headline's ``guard`` field: prove the graftguard wedge ->
+    recover ladder end to end without a device.
+
+    A host-mode VerifyEngine runs under a REAL LaunchGuard whose
+    deadlines are tiny (tens of milliseconds — the virtual-clock
+    equivalent for a monitor that must actually preempt a hung thread),
+    and the chaos hook's ``wedge`` knob hangs the next launch exactly
+    as a ``sidecar wedge`` fault-plan event does over OP_CHAOS.  The
+    probe asserts the full ladder: the wedged latency batch is answered
+    with a mask BIT-IDENTICAL to ``verify_batch`` (one tampered
+    signature pins the comparison), bulk offered during the crash-only
+    reboot is shed to BUSY with a retry-after hint, the injected rewarm
+    runs, the canary passes, and device routing resumes with the guard
+    counters (wedges / reboots / quarantine / canary) accounting for
+    all of it.  The acceptance bar rides in ``ok``.  Emitted on BOTH
+    the live and degraded JSON lines."""
+    import threading
+
+    from hotstuff_tpu.crypto import eddsa
+    from hotstuff_tpu.sidecar import protocol as proto
+    from hotstuff_tpu.sidecar import sched as vsched
+    from hotstuff_tpu.sidecar.guard import LaunchDeadlines, LaunchGuard
+    from hotstuff_tpu.sidecar.service import ChaosState, VerifyEngine
+
+    msgs, pks, sigs = _make_ref_sigs(8, seed=31)
+    sigs = list(sigs)
+    sigs[3] = sigs[3][:1] + bytes([sigs[3][1] ^ 0xFF]) + sigs[3][2:]
+    chaos = ChaosState()
+    # warm launch deadlines at 0.2 s (the injected hang is infinite, so
+    # any deadline catches it fast); the compile-class budget — which
+    # the reboot canary always gets — stays generous so a contended
+    # host can never false-wedge the recovery the probe asserts on.
+    guard = LaunchGuard(deadlines=LaunchDeadlines(
+        warm_boot=True, compile_budget_s=5.0, warm_grace_s=0.2,
+        min_deadline_s=0.05))
+    rewarmed = []
+
+    def rewarm():
+        rewarmed.append(1)
+        time.sleep(0.2)  # an observable reboot window for the BUSY leg
+
+    engine = VerifyEngine(use_host=True, guard=guard, chaos=chaos,
+                          rewarm_fn=rewarm)
+    try:
+        done = {}
+        cond = threading.Condition()
+
+        def reply_to(rid):
+            def _reply(mask):
+                with cond:
+                    done[rid] = mask
+                    cond.notify_all()
+            return _reply
+
+        expect = [bool(b) for b in eddsa.verify_batch(msgs, pks, sigs)]
+        chaos.configure({"wedge": 1})
+        engine.submit(proto.VerifyRequest(1, msgs, pks, sigs),
+                      reply_to(1), cls=vsched.LATENCY)
+        with cond:
+            cond.wait_for(lambda: 1 in done, timeout=30.0)
+        # Bulk offered while the engine re-warms must shed to BUSY.
+        busy_shed = None
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 5.0:
+            if engine._rebooting:
+                busy_shed = not engine.submit(
+                    proto.VerifyRequest(2, msgs, pks, sigs),
+                    reply_to(2), cls=vsched.BULK)
+                break
+            time.sleep(0.002)
+        retry_ms = engine.retry_after_ms(vsched.BULK)
+        t0 = time.monotonic()
+        while (engine._rebooting or not engine._device_ok) \
+                and time.monotonic() - t0 < 20.0:
+            time.sleep(0.01)
+        engine.submit(proto.VerifyRequest(3, msgs, pks, sigs),
+                      reply_to(3), cls=vsched.LATENCY)
+        with cond:
+            cond.wait_for(lambda: 3 in done, timeout=30.0)
+        snap = engine.stats_snapshot().get("guard", {})
+        masks_ok = done.get(1) == expect and done.get(3) == expect
+        recovered = bool(snap.get("device_ok")) \
+            and not snap.get("rebooting")
+        ok = (masks_ok and busy_shed is True and bool(rewarmed)
+              and snap.get("wedges", 0) >= 1
+              and snap.get("reboots", 0) >= 1
+              and snap.get("canary_passes", 0) >= 1
+              and snap.get("suspect_records", 0) >= 1
+              and recovered)
+        return {
+            "wedges": snap.get("wedges", 0),
+            "reboots": snap.get("reboots", 0),
+            "canary_passes": snap.get("canary_passes", 0),
+            "quarantined_records": snap.get("suspect_records", 0),
+            "poisoned_records": snap.get("poisoned_records", 0),
+            "host_fallback_records": snap.get("host_fallback_records", 0),
+            "busy_during_reboot": busy_shed,
+            "busy_retry_after_ms": retry_ms,
+            "masks_bit_identical": masks_ok,
+            "rewarmed": bool(rewarmed),
+            "reboot_wall_ms": round(
+                snap.get("last_reboot_wall_s", 0.0) * 1e3, 1),
+            "recovered": recovered,
+            "ok": ok,
+        }
+    finally:
+        engine.stop()
+        guard.close()
+
+
 def probe_device(window: float | None = None,
                  max_attempts: int | None = None, run=None,
                  sleep=time.sleep, now=time.monotonic):
@@ -1447,6 +1649,10 @@ def run_degraded(reason: str):
             surge = surge_headline_probe()
         except Exception as e:  # noqa: BLE001 — surge probe is best-effort
             surge = {"error": f"{e!r:.120}"}
+        try:
+            guard = guard_headline_probe()
+        except Exception as e:  # noqa: BLE001 — guard probe is best-effort
+            guard = {"error": f"{e!r:.120}"}
         # The watchdog stays armed until the moment of the real emit: a
         # stall anywhere above (including the sched probe) must still
         # produce a parseable line, which is this path's whole contract.
@@ -1456,7 +1662,8 @@ def run_degraded(reason: str):
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
              note=reason, rlc=rlc, mesh_rlc=mesh_rlc,
              committee_scale=committee_scale, roofline=roofline,
-             sched=sched, chaos=chaos, trace=trace, surge=surge)
+             sched=sched, chaos=chaos, trace=trace, surge=surge,
+             guard=guard)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -1594,6 +1801,12 @@ def main(argv=None):
     # the no-argv channel).  parse_known_args: the driver may pass flags
     # this bench does not own.
     import argparse
+
+    # Kill-proof emit FIRST (graftguard satellite): from here on, the
+    # driver's window closing (SIGTERM ahead of the rc=124 SIGKILL) or
+    # a stage alarm re-emits the best line already measured instead of
+    # dying silently; every emit below also lands cache-first on disk.
+    install_kill_handlers()
 
     global _FAULT_PLAN, _WAN_SPEC, _SLO_SPEC
     ap = argparse.ArgumentParser(add_help=False)
@@ -1772,10 +1985,14 @@ def main(argv=None):
         surge = surge_headline_probe()
     except Exception as e:  # noqa: BLE001 — surge probe is best-effort
         surge = {"error": f"{e!r:.120}"}
+    try:
+        guard = guard_headline_probe()
+    except Exception as e:  # noqa: BLE001 — guard probe is best-effort
+        guard = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
                mesh_rlc=mesh_rlc, committee_scale=committee_scale,
                roofline=roofline, sched=sched,
-               chaos=chaos, trace=trace, surge=surge)
+               chaos=chaos, trace=trace, surge=surge, guard=guard)
 
 
 if __name__ == "__main__":

@@ -17,12 +17,13 @@ import os
 import sys
 
 CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
-            "obsspan", "obsgrammar", "threads", "cxxsync", "ingress")
+            "obsspan", "obsgrammar", "threads", "cxxsync", "ingress",
+            "guard")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
-    from . import cxxsync, hotpath, ingress, obsgrammar, obsspan, \
-        padshape, sanitize, sockets, threads, timing, wirecheck
+    from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
+        obsspan, padshape, sanitize, sockets, threads, timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -47,6 +48,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += cxxsync.check(root)
     if "ingress" in checkers:
         findings += ingress.check(root)
+    if "guard" in checkers:
+        findings += guardlint.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -71,8 +74,8 @@ def check_coverage(root: str, must_cover) -> list:
     accepts any checker.  scripts/lint_gate.py pins the RLC scalar
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
-    from . import cxxsync, hotpath, ingress, obsgrammar, obsspan, \
-        padshape, sockets, threads, timing
+    from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
+        obsspan, padshape, sockets, threads, timing
     from .common import Finding
 
     target_sets = {
@@ -85,6 +88,7 @@ def check_coverage(root: str, must_cover) -> list:
         "threads": tuple(threads.DEFAULT_TARGETS),
         "cxxsync": tuple(cxxsync.DEFAULT_TARGETS),
         "ingress": tuple(ingress.DEFAULT_TARGETS),
+        "guard": tuple(guardlint.DEFAULT_TARGETS),
     }
     findings = []
     for pin in must_cover:

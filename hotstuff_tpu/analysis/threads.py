@@ -66,6 +66,7 @@ from .common import Finding, apply_suppressions, parse_source, read_source
 # one so a module cannot silently leave the scan.
 DEFAULT_TARGETS = (
     "hotstuff_tpu/sidecar/service.py",
+    "hotstuff_tpu/sidecar/guard.py",
     "hotstuff_tpu/sidecar/sched",
     "hotstuff_tpu/obs/sampler.py",
     "hotstuff_tpu/chaos/runner.py",

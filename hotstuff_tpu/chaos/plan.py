@@ -17,11 +17,16 @@ Actions (per target)
               network-partition proxy: the process holds its sockets but
               answers nothing, exactly what a partitioned peer looks
               like to the committee)
-    sidecar:  ``kill``, ``restart``, and ``degrade`` — the protocol v3
+    sidecar:  ``kill``, ``restart``, ``degrade`` — the protocol v3
               ``OP_CHAOS`` hook (bounded reply delay, connection drops,
               forced queue-full sheds) for testing client-side handling
-              without process murder.  ``degrade`` params ride in the
-              event's ``params`` dict (see sidecar/service.ChaosState).
+              without process murder (``degrade`` params ride in the
+              event's ``params`` dict, see sidecar/service.ChaosState) —
+              and ``wedge`` (graftguard): the next ``n`` device launches
+              hang past their guard deadline, driving the in-sidecar
+              supervisor ladder (host-fallback replies, quarantine,
+              crash-only reboot, canary) end to end.  DSL:
+              ``"5 sidecar wedge"`` or ``"5 sidecar wedge n=2"``.
     link:     ``partition`` (the link black-holes: netem ``loss 100%``
               remotely, a dropped WanProxy locally) and ``heal``
               (restore the spec shape) — the netem partition-heal fault
@@ -50,7 +55,7 @@ import re
 from dataclasses import dataclass, field
 
 ACTIONS = ("kill", "restart", "pause", "resume", "degrade",
-           "partition", "heal", "surge")
+           "partition", "heal", "surge", "wedge")
 SIDECAR = "sidecar"
 
 _NODE_RE = re.compile(r"^node:(\d+)$")
@@ -96,7 +101,7 @@ def surge_window_s(params) -> float:
 # verify engine for EVERY replica at once — use degrade for that class
 # of fault instead, it is observable and bounded).
 _NODE_ACTIONS = {"kill", "restart", "pause", "resume"}
-_SIDECAR_ACTIONS = {"kill", "restart", "degrade"}
+_SIDECAR_ACTIONS = {"kill", "restart", "degrade", "wedge"}
 _LINK_ACTIONS = {"partition", "heal"}
 _CLIENT_ACTIONS = {"surge"}
 
@@ -250,9 +255,19 @@ def _validate(events) -> FaultPlan:
         if e.action not in allowed:
             raise PlanError(f"{e.label()}: {e.target} does not support "
                             f"{e.action} (allowed: {', '.join(sorted(allowed))})")
-        if e.params and e.action not in ("degrade", "surge"):
-            raise PlanError(f"{e.label()}: only degrade and surge take "
-                            "params")
+        if e.params and e.action not in ("degrade", "surge", "wedge"):
+            raise PlanError(f"{e.label()}: only degrade, surge, and "
+                            "wedge take params")
+        if e.action == "wedge":
+            bad = set(e.params) - {"n"}
+            if bad:
+                raise PlanError(f"{e.label()}: unknown wedge param(s) "
+                                f"{sorted(bad)} (have n)")
+            n = e.params.get("n", 1)
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                raise PlanError(
+                    f"{e.label()}: wedge n must be an int >= 1 "
+                    f"(got {n!r})")
         if e.action == "surge":
             bad = set(e.params) - {"x", "for"}
             if bad:
@@ -297,8 +312,9 @@ def _validate(events) -> FaultPlan:
             raise PlanError(f"{e.label()}: pause needs a live target")
         if e.action == "resume" and cur != "paused":
             raise PlanError(f"{e.label()}: resume must follow a pause")
-        if e.action == "degrade" and cur != "up":
-            raise PlanError(f"{e.label()}: degrade needs a live sidecar")
+        if e.action in ("degrade", "wedge") and cur != "up":
+            raise PlanError(
+                f"{e.label()}: {e.action} needs a live sidecar")
         if e.action == "partition" and cur != "up":
             raise PlanError(f"{e.label()}: link is already partitioned")
         if e.action == "heal" and cur != "partitioned":
@@ -306,7 +322,8 @@ def _validate(events) -> FaultPlan:
         state[e.target] = {"kill": "down", "restart": "up",
                            "pause": "paused", "resume": "up",
                            "degrade": "up", "partition": "partitioned",
-                           "heal": "up", "surge": "up"}[e.action]
+                           "heal": "up", "surge": "up",
+                           "wedge": "up"}[e.action]
     return FaultPlan(tuple(ordered))
 
 

@@ -35,6 +35,11 @@ DEFAULT_SLO_MS = {
     "sidecar-kill": 15_000.0,
     "sidecar-restart": 15_000.0,
     "sidecar-degrade": 10_000.0,
+    # graftguard: a scripted launch wedge rides the in-sidecar
+    # supervisor — host-fallback replies keep consensus committing
+    # immediately, so the budget covers one ladder execution plus the
+    # async crash-only reboot's BUSY window, not a breaker timeout.
+    "sidecar-wedge": 20_000.0,
     "link-partition": 30_000.0,
     "link-heal": 20_000.0,
     # graftsurge: a flash crowd ends at t + for; the system must be back

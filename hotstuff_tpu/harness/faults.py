@@ -159,6 +159,14 @@ class LocalFaultInjector:
                 "sidecar is running without --chaos; the plan's degrade "
                 "event cannot be expressed")
 
+    def _sidecar_wedge(self, n: int = 1):
+        """graftguard drill: the next ``n`` device launches hang past
+        their guard deadline (ChaosState's ``wedge`` knob over the same
+        OP_CHAOS RPC as degrade) — the in-sidecar supervisor must answer
+        the wedged batch from the host path, quarantine it, and
+        crash-only-reboot the engine; same --chaos refusal contract."""
+        self._sidecar_degrade(wedge=int(n))
+
     # -- graftsurge client surges -------------------------------------------
 
     @staticmethod
@@ -447,3 +455,8 @@ class RemoteFaultInjector:
         cmd = (f"cd {self._repo} && python3 -c {shlex.quote(snippet)} "
                f"{shlex.quote(json.dumps(params))}")
         self._run(host, cmd, "sidecar chaos RPC")
+
+    def _sidecar_wedge(self, n: int = 1):
+        """graftguard drill over the fleet: same OP_CHAOS RPC as
+        degrade, with the wedge knob (see LocalFaultInjector)."""
+        self._sidecar_degrade(wedge=int(n))

@@ -555,6 +555,28 @@ class LogParser:
                     f"warmup {comp.get('warmup_wall_s', 0):g} s"
                     + (f" (kernel {comp['kernel']})"
                        if comp.get("kernel") else ""))
+            # graftguard: wedged launches, crash-only reboots, and the
+            # quarantine lane — a run that survived a hung device leg
+            # must read as exactly that, never as a quiet healthy one.
+            g = stats.get("guard", {})
+            if isinstance(g, dict) and (g.get("wedges")
+                                        or g.get("reboots")
+                                        or g.get("poisoned_records")):
+                lines.append(
+                    f"Sidecar guard: {g.get('wedges', 0):,} wedge(s), "
+                    f"{g.get('reboots', 0):,} crash-only reboot(s) "
+                    f"(canary {g.get('canary_passes', 0)} pass(es) / "
+                    f"{g.get('canary_failures', 0)} fail(s), last reboot "
+                    f"{g.get('last_reboot_wall_s', 0):g} s); "
+                    f"{g.get('suspect_records', 0):,} quarantined / "
+                    f"{g.get('poisoned_records', 0):,} poisoned "
+                    f"record(s); {g.get('host_fallback_records', 0):,} "
+                    f"host-fallback verdict(s), "
+                    f"{g.get('busy_replies', 0):,} BUSY")
+                if not g.get("device_ok", True):
+                    lines.append(
+                        "Sidecar guard: device leg DOWN at teardown "
+                        "(host path serving; canary never passed)")
             full = stats.get("queue_full", {})
             if any(full.values()):
                 lines.append("Sidecar queue-full sheds: " + ", ".join(

@@ -36,6 +36,7 @@ import numpy as np
 
 from . import protocol as proto
 from . import sched as vsched
+from .guard import BusyReply, WedgedLaunch, bisect_poison
 
 log = logging.getLogger("sidecar")
 
@@ -100,6 +101,12 @@ class ChaosState:
       ``shed``      the next N verify requests get the explicit
                     queue-full backpressure reply — a saturated engine,
                     without needing to actually saturate it
+      ``wedge``     the next N device launches HANG past their guard
+                    deadline (graftguard): drives the full supervisor
+                    ladder — host-fallback replies, quarantine,
+                    crash-only reboot, canary — end to end through
+                    OP_CHAOS, the fault a real tunneled-compile wedge
+                    inflicts, minus the tunnel
       ``clear``     reset everything
 
     Chaos only touches verify/sign opcodes: PING stays honest so
@@ -124,16 +131,18 @@ class ChaosState:
         self.delay_ms = 0
         self.shed_left = 0
         self.drop_left = 0
+        self.wedge_left = 0
 
     def configure(self, spec: dict) -> dict:
         """Apply one OP_CHAOS spec; raises ValueError on unknown keys or
         non-integer values (the connection closes, same contract as any
         malformed frame)."""
-        unknown = set(spec) - {"delay_ms", "shed", "drop", "clear"}
+        unknown = set(spec) - {"delay_ms", "shed", "drop", "wedge",
+                               "clear"}
         if unknown:
             raise ValueError(f"unknown chaos key(s) {sorted(unknown)}")
         vals = {}
-        for key in ("delay_ms", "shed", "drop"):
+        for key in ("delay_ms", "shed", "drop", "wedge"):
             if key in spec:
                 v = spec[key]
                 if not isinstance(v, int) or isinstance(v, bool) or v < 0:
@@ -142,16 +151,30 @@ class ChaosState:
         with self._lock:
             if spec.get("clear"):
                 self.delay_ms = self.shed_left = self.drop_left = 0
+                self.wedge_left = 0
             if "delay_ms" in vals:
                 self.delay_ms = min(vals["delay_ms"], self.MAX_DELAY_MS)
             if "shed" in vals:
                 self.shed_left = vals["shed"]
             if "drop" in vals:
                 self.drop_left = vals["drop"]
+            if "wedge" in vals:
+                self.wedge_left = vals["wedge"]
             applied = {"delay_ms": self.delay_ms, "shed": self.shed_left,
-                       "drop": self.drop_left}
+                       "drop": self.drop_left, "wedge": self.wedge_left}
         log.warning("chaos hook configured: %s", applied)
         return applied
+
+    def take_wedge(self) -> bool:
+        """Consume one scripted launch wedge (graftguard's OP_CHAOS
+        drill); called by the engine at dispatch time, not per request
+        — a wedge is a DEVICE fault, so it applies to whatever launch
+        is next, exactly like the real thing."""
+        with self._lock:
+            if self.wedge_left > 0:
+                self.wedge_left -= 1
+                return True
+            return False
 
     def verify_action(self):
         """Consume the chaos decision for one verify/sign request ->
@@ -172,7 +195,7 @@ class VerifyEngine:
     def __init__(self, mesh_devices: int | None = None, use_host: bool = False,
                  committee: int | None = None,
                  client_rate: int | None = None,
-                 tracer=None):
+                 tracer=None, guard=None, chaos=None, rewarm_fn=None):
         # All launch-shape policy lives in the scheduler subsystem: the
         # shape registry records what the warmup compiled (until
         # enable_bulk, launches cap at MAX_SUBBATCH; _warmup covers every
@@ -212,6 +235,28 @@ class VerifyEngine:
         self.compile_tracker = None
         # (msg, pk, sig) -> bool verdict; see _cache_verdict.
         self._verdicts: dict = {}
+        self._verdicts_lock = threading.Lock()
+        # graftguard: the launch supervisor (sidecar/guard.py).  When
+        # attached (serve() always attaches one; direct embedders and
+        # legacy tests may run bare), every staged dispatch/fetch wait
+        # routes through _guarded under a per-shape deadline, a wedge
+        # executes the degradation ladder instead of hanging this
+        # thread, and the engine can crash-only reboot the device leg
+        # off the warm cache (rewarm_fn) while the host path serves.
+        self._guard = guard
+        self._chaos = chaos
+        self._rewarm_fn = rewarm_fn
+        self._reboot_lock = threading.Lock()
+        self._device_ok = True
+        self._rebooting = False
+        # THREAD-LOCAL rewarm marker: while the reboot thread runs
+        # rewarm_fn, ITS calls into _verify_submit must hit the DEVICE
+        # (that is what re-warming means) even though _device_ok is
+        # still False — but live traffic on the pack worker must keep
+        # host-routing for the whole window, so the flag cannot be
+        # engine-global (an engine-global bool would leak concurrent
+        # live launches onto the mid-rewarm device).
+        self._rewarm_tls = threading.local()
         self._mesh = None
         if mesh_devices and mesh_devices > 1:
             from ..parallel.mesh import make_mesh
@@ -240,6 +285,15 @@ class VerifyEngine:
         queue-full — nothing was retained and the CALLER must reply
         (the handler sends the explicit empty-mask backpressure reply);
         never blocks the calling connection thread."""
+        if self._rebooting and cls == vsched.BULK and not is_bls:
+            # Crash-only reboot in progress (graftguard): the device leg
+            # is re-warming and the host path is reserved for consensus
+            # latency — bulk gets an honest BUSY NOW (the handler's
+            # queue-full reply carries the retry-after hint), so the C++
+            # breaker reads a live, rebooting sidecar, never silence.
+            if self._guard is not None:
+                self._guard.stats.note_busy()
+            return False
         ok = self._sched.offer(request, reply_fn, cls=cls, is_bls=is_bls)
         if self._tracer.enabled:
             tags = {}
@@ -266,6 +320,11 @@ class VerifyEngine:
         snap["verdict_cache_entries"] = len(self._verdicts)
         if self.compile_tracker is not None:
             snap["compile"] = self.compile_tracker.snapshot()
+        if self._guard is not None:
+            g = self._guard.snapshot()
+            g["device_ok"] = self._device_ok
+            g["rebooting"] = self._rebooting
+            snap["guard"] = g
         return snap
 
     def cached_verdicts(self, request):
@@ -352,7 +411,8 @@ class VerifyEngine:
         from concurrent import futures as cfut
 
         packing = collections.deque()   # (batch, Future[dispatch_fn])
-        inflight = collections.deque()  # (batch, fetch_fn, dispatched_at)
+        inflight = collections.deque()  # (batch, fetch_fn,
+                                        #  dispatched_at, guard_key)
         while not self._stopped.is_set():
             # 1) A FINISHED pack moves onto the device whenever there is
             #    dispatch room.  Unfinished packs are waited out in step
@@ -440,11 +500,61 @@ class VerifyEngine:
             self._tracer.event("reply", rid=p.request.request_id,
                                cls=p.cls, **tags)
 
+    def _guard_key(self, batch) -> str:
+        """Launch-shape key for the guard's per-shape deadlines: the
+        power-of-two bucket of the DEDUPED record count — the shape the
+        launch actually executes (the pack stage dedups before
+        dispatch), so p99 history trained under the shared-sidecar
+        headline load (N replicas submitting the SAME QC: raw total >>
+        unique) can never tighten the deadline of a genuinely-large
+        unique batch that shares a raw total with it.  Sliced launches
+        stay self-consistent: the same key always runs the same slice
+        count.  The dedup costs one hash pass on the engine thread —
+        small next to the launch it sizes, and only the wedge-protected
+        path pays it."""
+        from ..crypto.eddsa import next_pow2
+
+        uniq = len({rec for p in batch
+                    for rec in zip(p.request.msgs, p.request.pks,
+                                   p.request.sigs)})
+        return f"launch:{next_pow2(max(8, uniq))}"
+
+    def _guarded(self, key: str, thunk):
+        """THE deadline helper: every engine-side wait on a staged
+        dispatch/fetch future routes through here (graftlint's
+        unsupervised-launch rule pins it).  With a guard attached the
+        thunk runs on a disposable launch thread under the shape's
+        deadline — a WedgedLaunch out of here means the monitor
+        declared an overrun and the worker was abandoned.  The chaos
+        hook's ``wedge`` knob swaps the thunk for a genuine hang, so
+        the scripted drill exercises the identical supervisor path."""
+        chaos = self._chaos
+        if chaos is not None and self._guard is not None and \
+                chaos.take_wedge():
+            log.warning("chaos: wedging launch %s", key)
+
+            def thunk():
+                # The injected fault IS an unbounded wait: a faithful
+                # stand-in for a hung tunneled device call.  It parks
+                # the disposable launch thread, never this one.
+                # graftlint: disable=unsupervised-launch
+                threading.Event().wait()
+        if self._guard is None:
+            return thunk()
+        return self._guard.call(key, thunk)
+
     def _dispatch_one(self, packing, inflight):
         """Move the oldest staged pack onto the device (engine thread)."""
         batch, fut = packing.popleft()
+        key = self._guard_key(batch)
         try:
-            fetch = fut.result()()  # wait for pack, then device dispatch
+            # wait for pack, then device dispatch — both can wedge on
+            # the tunnel (pack stages the h2d transfer), so both run
+            # under the one guarded deadline
+            fetch = self._guarded(key, lambda: fut.result()())
+        except WedgedLaunch:
+            self._wedge_ladder(batch, key, stage="dispatch")
+            return
         except Exception:
             log.exception("verify batch pack/dispatch failed")
             for p in batch:
@@ -457,14 +567,17 @@ class VerifyEngine:
             if ctxs:
                 tags["ctxs"] = ctxs
             self._tracer.event("dispatch", reqs=len(batch), **tags)
-        inflight.append((batch, fetch, monotonic()))
+        inflight.append((batch, fetch, monotonic(), key))
         self._inflight_n = len(inflight)
 
     def _drain_one(self, inflight):
-        batch, fetch, dispatched_at = inflight.popleft()
+        batch, fetch, dispatched_at, key = inflight.popleft()
         self._inflight_n = len(inflight)
         try:
-            mask = fetch()
+            mask = self._guarded(key, fetch)
+        except WedgedLaunch:
+            self._wedge_ladder(batch, key, stage="fetch")
+            return
         except Exception:
             log.exception("verify batch failed")
             for p in batch:
@@ -488,6 +601,227 @@ class VerifyEngine:
             p.reply_fn([bool(b) for b in mask[off:off + n]])
             off += n
         self._trace_replies(batch)
+
+    # -- graftguard: the wedge degradation ladder ---------------------------
+
+    def _wedge_ladder(self, batch, key: str, stage: str):
+        """A launch overran its deadline: execute the degradation ladder
+        instead of hanging (graftguard).
+
+        1. every latency-class request in the wedged batch is answered
+           from the HOST path — ``ref_ed25519.verify`` per record, the
+           reference ``verify_batch`` is property-tested bit-identical
+           to, so a wedge changes WHERE the verdict came from, never
+           what it is;
+        2. bulk-class requests get BusyReply (the handler encodes
+           OP_BUSY with the drain-derived retry-after) — throughput
+           work re-offers once the device leg is back;
+        3. the batch's records are quarantined (repeat offenders feed
+           the poison bisection after the reboot);
+        4. a crash-only engine reboot begins (async; the host path
+           serves meanwhile)."""
+        from ..crypto import ref_ed25519 as ref
+
+        guard = self._guard
+        log.error("guard: %s of launch %s WEDGED (deadline overrun); "
+                  "executing degradation ladder", stage, key)
+        records = {rec for p in batch if not p.is_bls
+                   for rec in zip(p.request.msgs, p.request.pks,
+                                  p.request.sigs)}
+        pending = guard.quarantine.note_wedged(records)
+        if pending:
+            log.error("guard: %d repeat-offender record(s) pending "
+                      "poison bisection", pending)
+
+        def answer():
+            for p in batch:
+                if p.cls == vsched.BULK:
+                    guard.stats.note_busy()
+                    p.reply_fn(
+                        BusyReply(self.retry_after_ms(vsched.BULK)))
+                    continue
+                mask = [bool(ref.verify(pk, m, s))
+                        for m, pk, s in zip(p.request.msgs,
+                                            p.request.pks,
+                                            p.request.sigs)]
+                guard.stats.note_host_fallback(len(mask))
+                p.reply_fn(mask)
+            self._trace_replies(batch)
+
+        # The host fallback runs OFF the engine thread: a wedged batch
+        # at the coalesced cap is tens of seconds of pure-python
+        # verification, and the queued consensus verifies behind it —
+        # about to be host-routed by the reboot flag — must drain
+        # concurrently, not wait out the very head-of-line stall the
+        # supervisor exists to kill.  One-shot body, reply_fn is
+        # thread-safe (outbox.put_nowait), no loop to stop.
+        # graftlint: disable=daemon-thread-without-stop-flag
+        threading.Thread(target=answer, daemon=True,
+                         name="guard-ladder").start()
+        self._begin_reboot()
+
+    def _begin_reboot(self):
+        """Start the crash-only engine reboot (idempotent: repeat wedges
+        while one is running fold into it).  Device routing flips OFF
+        first — from here until the canary passes, _pack routes every
+        launch down the host path and bulk admission replies BUSY."""
+        with self._reboot_lock:
+            if self._rebooting:
+                return
+            self._rebooting = True
+            self._device_ok = False
+        t = threading.Thread(target=self._reboot, daemon=True,
+                             name="guard-reboot")
+        t.start()
+
+    def _reboot(self):
+        """Crash-only reboot of the device leg: tear down the compiled-
+        program state, re-warm off the populated XLA cache/manifest
+        (rewarm_fn — a deserialization, not a recompile: PR 11 measured
+        38 s warm vs 149 s cold), and resume device routing only after
+        a canary launch passes under the guard's deadline.  Canary
+        failures retry up to the guard's max_reboots; past that the
+        engine stays on the host path — degraded, live, and visible in
+        OP_STATS rather than wedged."""
+        guard = self._guard
+        t0 = monotonic()
+        attempts = 0
+        while not self._stopped.is_set():
+            attempts += 1
+            try:
+                self._teardown_device()
+                t_warm = monotonic()
+                if self._rewarm_fn is not None:
+                    # The warmup legs must reach the DEVICE path even
+                    # though live routing is host-only right now —
+                    # without this, _warm_shapes' engine._verify calls
+                    # would "warm" the ladder shapes on the host and
+                    # compile nothing, leaving the first post-canary
+                    # launch to pay a re-trace under a tight warmed
+                    # deadline (a guaranteed re-wedge).  Thread-local:
+                    # only THIS thread's verifies force the device;
+                    # live traffic keeps host-routing meanwhile.
+                    # threading.local: this write is visible ONLY to
+                    # the reboot thread — unshared by construction, so
+                    # no lock can be needed (that isolation is the fix:
+                    # an engine-global flag here leaked live launches
+                    # onto the mid-rewarm device).
+                    # graftlint: disable=unlocked-shared-write
+                    self._rewarm_tls.active = True
+                    try:
+                        self._rewarm_fn()
+                    finally:
+                        # graftlint: disable=unlocked-shared-write
+                        self._rewarm_tls.active = False
+                guard.stats.note_rewarm(monotonic() - t_warm)
+                if self._canary():
+                    guard.stats.note_canary(True)
+                    break
+                guard.stats.note_canary(False)
+            except Exception:
+                log.exception("guard: reboot attempt %d failed", attempts)
+                guard.stats.note_canary(False)
+            if attempts >= guard.max_reboots:
+                log.error("guard: %d reboot attempt(s) failed the canary;"
+                          " staying on the host path", attempts)
+                with self._reboot_lock:
+                    self._rebooting = False
+                return
+        if self._stopped.is_set():
+            return  # engine teardown mid-reboot: nothing left to resume
+        # Poison bisection BEFORE resuming device routing: the repeat-
+        # offender records must be isolated while the host path still
+        # owns live traffic, or the first post-reboot launch could
+        # re-wedge on the same poison.
+        try:
+            self._bisect_quarantine()
+        except Exception:
+            log.exception("guard: poison bisection failed (pending "
+                          "records stay quarantined)")
+        with self._reboot_lock:
+            self._rebooting = False
+            self._device_ok = True
+        wall = monotonic() - t0
+        guard.stats.note_reboot(wall)
+        log.warning("guard: engine rebooted in %.1fs (canary passed "
+                    "after %d attempt(s)); device routing resumed",
+                    wall, attempts)
+
+    def _teardown_device(self):
+        """Crash-only teardown of the device-side state: drop the
+        in-process compiled-program caches so the re-warm rebuilds
+        every staged entry from the persistent XLA disk cache.  The
+        tunneled device client itself re-dials lazily on the next
+        dispatch; host-mode engines have nothing to tear down."""
+        if self._use_host:
+            return
+        try:
+            import jax
+
+            jax.clear_caches()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            log.exception("guard: jax cache teardown failed (continuing)")
+
+    def _canary(self) -> bool:
+        """One tiny known-good launch through the REAL staged verify
+        entry, under the guard's deadline: device routing resumes only
+        when this completes in time with an all-valid mask."""
+        from ..crypto import ref_ed25519 as ref
+
+        sk = bytes(range(32))
+        _, pk = ref.generate_keypair(sk)
+        msg = b"\x07" * 32
+        sig = ref.sign(sk, msg)
+        n = 8
+        try:
+            mask = self._guard.call(
+                "canary:8",
+                lambda: np.asarray(self._verify_submit(
+                    [msg] * n, [pk] * n, [sig] * n, force_device=True)()))
+        except WedgedLaunch:
+            log.error("guard: canary launch wedged")
+            return False
+        except Exception:
+            log.exception("guard: canary launch failed")
+            return False
+        return bool(np.asarray(mask).all())
+
+    def _bisect_quarantine(self):
+        """Poison-record bisection (the RLC bisection discipline applied
+        to wedges): probe subsets of the repeat-offender records
+        through guarded device launches until the minimal poison set is
+        isolated; confirmed poison records are host-verified forever
+        after (_pack's poison lane)."""
+        guard = self._guard
+        pending = guard.quarantine.pending()
+        if not pending:
+            return
+        log.warning("guard: bisecting %d repeat-offender record(s) for "
+                    "poison", len(pending))
+
+        def probe(subset):
+            msgs = [r[0] for r in subset]
+            pks = [r[1] for r in subset]
+            sigs = [r[2] for r in subset]
+            try:
+                self._guard.call(
+                    f"poison-probe:{len(subset)}",
+                    lambda: np.asarray(self._verify_submit(
+                        msgs, pks, sigs, force_device=True)()))
+                return True
+            except WedgedLaunch:
+                return False
+            except Exception:
+                # A clean failure means the launch COMPLETED (the device
+                # is not wedged); the record merely verifies False.
+                return True
+
+        poison = bisect_poison(pending, probe,
+                               max_probes=guard.max_bisect_probes)
+        n = guard.quarantine.resolve(poison)
+        if n:
+            log.error("guard: %d poison record(s) quarantined to the "
+                      "host path permanently", n)
 
     def _submit(self, batch):
         """Two-stage form of the launch path (pack + dispatch in one
@@ -531,25 +865,47 @@ class VerifyEngine:
             if c is None:
                 uniq.setdefault(records[i], []).append(i)
         uniq_records = list(uniq.keys())
-        m_msgs = [r[0] for r in uniq_records]
-        m_pks = [r[1] for r in uniq_records]
-        m_sigs = [r[2] for r in uniq_records]
+        # graftguard poison lane: records the bisection confirmed poison
+        # are split OUT of the device launch and verified on host right
+        # here (pure host work on the pack worker) — a cursed record is
+        # still answered and counted, but can never take the device leg
+        # down again, and its co-batched neighbors still ride the device.
+        guard = self._guard
+        poisoned = []
+        if guard is not None and guard.quarantine.has_poison():
+            device_records = [r for r in uniq_records
+                              if not guard.quarantine.is_poisoned(r)]
+            if len(device_records) != len(uniq_records):
+                poisoned = [r for r in uniq_records
+                            if guard.quarantine.is_poisoned(r)]
+                # Poison lane LAST so fetch order matches record order.
+                uniq_records = device_records + poisoned
+                guard.stats.note_poison_host(len(poisoned))
+        else:
+            device_records = uniq_records
+        m_msgs = [r[0] for r in device_records]
+        m_pks = [r[1] for r in device_records]
+        m_sigs = [r[2] for r in device_records]
         # Route via the warmed-shape registry: batches of RLC_MIN_LAUNCH+
         # unique records whose padded (per-shard, on a mesh) bucket the
         # RLC warmup compiled pay ONE Straus MSM — single-chip via
         # crypto/eddsa.verify_batch_rlc_pack, mesh via
         # parallel/sharded_verify.verify_rlc_sharded_pack — instead of
         # per-signature ladders; the bisection fallbacks keep the verdict
-        # mask bit-identical when the combined check fails.
+        # mask bit-identical when the combined check fails.  While a
+        # crash-only reboot is re-warming the device leg (graftguard),
+        # everything routes host — the path the ladder already answers
+        # wedged batches from.
         stats = self._sched.stats
-        path = self._shapes.route(len(uniq_records))
-        if uniq_records:
+        path = vsched.PATH_HOST if not self._device_ok \
+            else self._shapes.route(len(device_records))
+        if device_records:
             stats.note_path(path)
 
         def on_bisect():
             stats.note_path("rlc_bisect")
 
-        if not uniq_records:
+        if not device_records:
             dispatchers = []
         elif path == vsched.PATH_RLC:
             from ..crypto import eddsa
@@ -583,6 +939,14 @@ class VerifyEngine:
                                                    m_pks[i:i + step],
                                                    m_sigs[i:i + step])
                            for i in range(0, len(m_msgs), step)]
+        if poisoned:
+            # Poison lane: quarantined records verify on HOST, eagerly,
+            # here on the pack worker (same discipline as PATH_HOST).
+            from ..crypto import ref_ed25519 as ref
+
+            res = np.array([bool(ref.verify(pk, m, s))
+                            for m, pk, s in poisoned])
+            dispatchers.append(lambda res=res: (lambda: res))
         stats.note_pack(monotonic() - t0, hidden)
         if self._tracer.enabled:
             pack_tags = {}
@@ -673,20 +1037,19 @@ class VerifyEngine:
         # so a poisoned entry can only ever answer for the same forged
         # bytes, and the cap bounds an attacker to evicting, not growing.
         #
-        # graftsync evidence: the threads rule sees this method reachable
-        # from BOTH the engine thread (_run -> _execute_bls) and the pack
-        # worker (_pack), but the pack worker only ever REACHES it through
-        # the dispatch()/fetch() closures it returns, which execute on the
-        # engine thread (_dispatch_one/_drain_one) — the engine thread
-        # stays the only writer, connection threads and _pack only read
-        # (dict reads under the GIL; a concurrent evict at worst turns a
-        # hit into a miss).
-        if record not in self._verdicts:
-            while len(self._verdicts) >= self.VERDICT_CACHE_CAP:
-                # graftlint: disable=unlocked-shared-write
-                self._verdicts.pop(next(iter(self._verdicts)))
-        # graftlint: disable=unlocked-shared-write
-        self._verdicts[record] = ok
+        # graftguard changed the threading story that used to make this
+        # lock-free: dispatch/fetch closures now execute on the guard's
+        # DISPOSABLE launch threads, and an abandoned (wedged) launch
+        # may complete late, concurrent with a fresh launch's fetch —
+        # two writers.  The explicit lock makes the insert+evict pair
+        # atomic; readers (connection threads' fast path, _pack's
+        # cached-lookup) stay lockless — a dict read under the GIL can
+        # at worst turn a hit into a miss, exactly as before.
+        with self._verdicts_lock:
+            if record not in self._verdicts:
+                while len(self._verdicts) >= self.VERDICT_CACHE_CAP:
+                    self._verdicts.pop(next(iter(self._verdicts)))
+            self._verdicts[record] = ok
 
     def _execute_bls(self, item):
         """Run one BLS request on the engine thread.
@@ -783,11 +1146,18 @@ class VerifyEngine:
             ok = dbls.verify_aggregate_common(pks, req.msg, agg)
         reply([bool(ok)], cacheable=True)
 
-    def _verify_submit(self, msgs, pks, sigs):
-        """Dispatch one slice; returns fetch() -> (n,) bool mask."""
+    def _verify_submit(self, msgs, pks, sigs, force_device: bool = False):
+        """Dispatch one slice; returns fetch() -> (n,) bool mask.
+
+        While a graftguard reboot is re-warming the device leg
+        (``_device_ok`` False), everything verifies on host; the
+        canary and poison-bisection probes pass ``force_device`` to
+        exercise the device path they exist to validate."""
         if not msgs:
             return lambda: np.zeros((0,), bool)
-        if self._use_host:
+        if self._use_host or (not self._device_ok and not force_device
+                              and not getattr(self._rewarm_tls,
+                                              "active", False)):
             from ..crypto import ref_ed25519 as ref
 
             res = np.array([ref.verify(p, m, s)
@@ -935,7 +1305,13 @@ class _Handler(socketserver.BaseRequestHandler):
 
                 def reply(result, _rid=req.request_id, _op=opcode,
                           _send=send):
-                    if _op == proto.OP_BLS_SIGN:
+                    if isinstance(result, BusyReply):
+                        # graftguard wedge ladder: a bulk request whose
+                        # launch wedged gets the honest OP_BUSY with the
+                        # drain-derived retry-after, never a fake mask.
+                        frame = proto.encode_busy_reply(
+                            _rid, result.retry_after_ms)
+                    elif _op == proto.OP_BLS_SIGN:
                         frame = proto.encode_reply_raw(
                             _op, _rid, result if result else b"")
                     else:
@@ -985,15 +1361,20 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
 
         tracer = Tracer(trace_path)
         log.info("grafttrace span emission -> %s", trace_path)
-    engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host,
-                          committee=committee, client_rate=client_rate,
-                          tracer=tracer)
-    # Warm the jit cache BEFORE binding: until the socket exists, node
-    # crypto gets ECONNREFUSED and falls back to host verify instead of
-    # connecting into a server whose device thread is still compiling.
-    # (A bound-but-compiling socket accepts into the TCP backlog and
-    # silently stalls every client for the whole compile — the round-2
-    # 0-TPS failure mode.)
+    # graftguard: chaos state is built BEFORE the engine so the wedge
+    # knob can reach the dispatch path, and every boot gets a launch
+    # supervisor — per-shape deadlines off the compile manifest (device
+    # boots) or the defaults (host boots: supervision still catches a
+    # hung host stage, and the chaos drill needs it).
+    chaos_state = None
+    if chaos:
+        chaos_state = ChaosState()
+        log.warning("chaos hook ENABLED (--chaos): OP_CHAOS requests can "
+                    "degrade this sidecar")
+    from .guard import LaunchDeadlines, LaunchGuard
+
+    cache_dir = None
+    tracker = None
     if not use_host:
         cache_dir = _enable_compilation_cache()
         # graftkern compile accounting: every warmup shape below runs
@@ -1003,6 +1384,24 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         from ..utils.xla_cache import CompileTracker
 
         tracker = CompileTracker(cache_dir=cache_dir)
+        guard = LaunchGuard(deadlines=LaunchDeadlines.from_manifest(
+            tracker.manifest, tracker.kernel))
+    else:
+        # Host-crypto boots compile nothing, so the cold 180 s compile
+        # budget would be the wrong deadline class — the warm grace
+        # (30 s default: a MAX_SUBBATCH host slice is ~10 s of pure
+        # python) is what a hung host launch should be judged against.
+        guard = LaunchGuard(deadlines=LaunchDeadlines(warm_boot=True))
+    engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host,
+                          committee=committee, client_rate=client_rate,
+                          tracer=tracer, guard=guard, chaos=chaos_state)
+    # Warm the jit cache BEFORE binding: until the socket exists, node
+    # crypto gets ECONNREFUSED and falls back to host verify instead of
+    # connecting into a server whose device thread is still compiling.
+    # (A bound-but-compiling socket accepts into the TCP backlog and
+    # silently stalls every client for the whole compile — the round-2
+    # 0-TPS failure mode.)
+    if not use_host:
         engine.compile_tracker = tracker
         _warmup(engine, warm_max)
         if warm_bls:
@@ -1035,11 +1434,23 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
             "(kernel %s%s)", tracker.hits, tracker.misses,
             tracker.wall_s(), tracker.kernel,
             "" if cache_dir else "; XLA disk cache OFF")
-    chaos_state = None
-    if chaos:
-        chaos_state = ChaosState()
-        log.warning("chaos hook ENABLED (--chaos): OP_CHAOS requests can "
-                    "degrade this sidecar")
+
+        def _rewarm():
+            # graftguard crash-only reboot: re-run the SAME warmup legs
+            # this boot ran, against the now-populated XLA disk cache —
+            # a deserialization pass (38 s measured warm vs 149 s cold,
+            # PR 11), during which the host path owns live traffic.
+            # BLS warmups are skipped: the pairing programs are minutes
+            # of compile and BLS launches run outside the guard.
+            _warmup(engine, warm_max)
+            if warm_bulk:
+                _warmup_bulk(engine, warm_max)
+            if warm_rlc and not (mesh_devices and mesh_devices > 1):
+                _warmup_rlc(engine, warm_max)
+            if warm_rlc_sharded and mesh_devices and mesh_devices > 1:
+                _warmup_rlc_sharded(engine, warm_max)
+
+        engine._rewarm_fn = _rewarm
     server = SidecarServer((host, port), engine, chaos=chaos_state)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
     if ready_event is not None:
@@ -1048,6 +1459,7 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         server.serve_forever(poll_interval=0.2)
     finally:
         engine.stop()
+        guard.close()
         server.server_close()
         if tracer is not None:
             tracer.close()

@@ -142,6 +142,29 @@ class CompileManifest:
         return entry.get("cache_dir") == cache_dir and \
             os.path.isdir(cache_dir)
 
+    def shape_walls(self, kernel: str) -> dict:
+        """``{shape key: last_wall_s}`` for every shape warmed under
+        this kernel hash — what graftguard's LaunchDeadlines reads to
+        decide warm-boot deadlines (empty dict = cold boot: no record
+        of any compiled shape for this exact kernel)."""
+        shapes = self.data["kernels"].get(kernel, {}).get("shapes", {})
+        out = {}
+        for key, entry in shapes.items():
+            if isinstance(entry, dict) and \
+                    isinstance(entry.get("last_wall_s"), (int, float)):
+                out[key] = float(entry["last_wall_s"])
+        return out
+
+    def cold_wall_s(self) -> float | None:
+        """Wall time of the most expensive recorded COLD warmup run —
+        the max wall among runs that paid at least one miss (None when
+        no such run is on record).  graftguard's acceptance bar compares
+        the crash-only reboot's re-warm wall against half of this."""
+        walls = [r.get("wall_s") for r in self.data["runs"]
+                 if isinstance(r, dict) and r.get("misses")
+                 and isinstance(r.get("wall_s"), (int, float))]
+        return max(walls) if walls else None
+
     def record(self, kernel: str, key: str, wall_s: float,
                now: float | None = None,
                cache_dir: str | None = None) -> None:

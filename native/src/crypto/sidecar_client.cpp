@@ -327,6 +327,14 @@ void TpuVerifier::reader_loop_(std::shared_ptr<Inner> inner, uint64_t gen,
       if (inner->gen != gen) return;
       inner->last_rx = now;
       if (reply.size() >= 5) {
+        // graftguard: an OP_BUSY reply is a LIVE sidecar shedding
+        // honestly — its engine may be mid crash-only reboot, during
+        // which bulk gets BUSY and latency is host-answered, never
+        // silence.  That is liveness evidence: clear any accumulated
+        // transport-failure count so the breaker cannot open off a
+        // stale tally while the sidecar re-warms (the breaker exists
+        // for a sidecar that stops ANSWERING, not one that sheds).
+        if (reply[0] == kOpBusy) inner->consecutive_failures = 0;
         uint32_t rid = static_cast<uint32_t>(reply[1]) |
                        static_cast<uint32_t>(reply[2]) << 8 |
                        static_cast<uint32_t>(reply[3]) << 16 |

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Tier-2 graftguard gate: the structure-aware protocol fuzz corpus plus
+# the slow wedge-recovery lane (the full supervisor ladder through a
+# live SidecarServer: scripted wedge -> host-fallback masks -> BUSY for
+# bulk -> crash-only reboot -> canary -> poison bisection) inside a
+# bounded window.
+#
+#   scripts/guard_gate.sh [pytest-args ...]
+#
+# What fits the window and why (measured on this container, cold):
+#
+#   1. The fuzz corpus is cheap (~20 s): decode-level cases are pure
+#      byte pushing, and the live-handler cases each pay one socket
+#      round trip against a host-mode server with short timeouts.
+#   2. The wedge lanes are deadline-bound by construction: guard
+#      deadlines in the tests are tens of milliseconds, so a full
+#      wedge -> reboot -> bisect cycle costs well under a second; the
+#      slow e2e lane (live server + chaos plan + parser round trip)
+#      adds a few seconds of real traffic.
+#
+# GUARD_GATE_BUDGET_S overrides the window; the gate FAILS (rc 124) if
+# the budget is exceeded, so a supervisor-latency regression is a loud
+# CI signal, never a silently-lengthening job (same contract as
+# scripts/kern_gate.sh and scripts/tsan_gate.sh).
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+BUDGET="${GUARD_GATE_BUDGET_S:-600}"
+
+# pytest only puts the CALLER's cwd on sys.path: run from the repo root
+# so tests/conftest.py can import hotstuff_tpu from any invocation dir.
+cd "$ROOT"
+
+start=$(date +%s)
+rc=0
+timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu HOTSTUFF_TPU_SLOW_TESTS=1 \
+    python -m pytest "$ROOT/tests/test_fuzz.py" "$ROOT/tests/test_guard.py" \
+    -q -p no:cacheprovider "$@" || rc=$?
+if [ "$rc" -ne 0 ]; then
+  if [ "$rc" -eq 124 ]; then
+    echo "guard_gate: exceeded the ${BUDGET}s budget" >&2
+  else
+    echo "guard_gate: FAILED (rc=$rc)" >&2
+  fi
+  exit "$rc"
+fi
+end=$(date +%s)
+echo "guard_gate: clean in $((end - start))s (budget ${BUDGET}s)"

@@ -102,6 +102,15 @@ if __name__ == "__main__":
                     "threads:hotstuff_tpu/sidecar/service.py",
                     "threads:hotstuff_tpu/sidecar/sched/scheduler.py",
                     "threads:hotstuff_tpu/sidecar/sched/classes.py",
+                    # graftguard: the engine AND the supervisor must
+                    # stay inside the unsupervised-launch scan (an
+                    # engine wait moving out of it is how the next
+                    # wedged-launch hang ships), and guard.py — which
+                    # owns the monitor + disposable launch threads —
+                    # inside the THREADS scan.
+                    "guard:hotstuff_tpu/sidecar/service.py",
+                    "guard:hotstuff_tpu/sidecar/guard.py",
+                    "threads:hotstuff_tpu/sidecar/guard.py",
                     # graftsurge: the admission controller and the load
                     # model stay inside the THREADS scan (both are
                     # called from multiple threads), and every surge

@@ -1525,3 +1525,89 @@ def test_tsan_gate_runs_curated_test_clean():
     # the gate's own success line (the "all tests clean" line below it
     # is printed only by the no-cmake g++ fallback, not the ctest path)
     assert "tsan_gate: clean in" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# guard rules (graftguard: unsupervised-launch)
+# ---------------------------------------------------------------------------
+
+def _guard_findings(src, path="hotstuff_tpu/sidecar/service.py"):
+    from hotstuff_tpu.analysis import guardlint
+
+    return guardlint.check_sources({path: textwrap.dedent(src)})
+
+
+def test_unsupervised_launch_flags_bare_future_wait():
+    findings = _guard_findings("""
+        def _dispatch_one(self, packing, inflight):
+            batch, fut = packing.popleft()
+            fetch = fut.result()()
+    """)
+    assert [f.rule for f in findings] == ["unsupervised-launch"]
+    assert ".result()" in findings[0].message
+
+
+def test_unsupervised_launch_flags_unbounded_event_wait():
+    findings = _guard_findings("""
+        def _drain(self, ev):
+            ev.wait()
+    """)
+    assert [f.rule for f in findings] == ["unsupervised-launch"]
+
+
+def test_unsupervised_launch_clean_through_guard_helper():
+    assert _guard_findings("""
+        def _dispatch_one(self, packing, inflight):
+            batch, fut = packing.popleft()
+            fetch = self._guarded("k", lambda: fut.result()())
+
+        def _drain_one(self, inflight):
+            batch, fetch, t0, key = inflight.popleft()
+            mask = self._guarded(key, fetch)
+    """) == []
+
+
+def test_unsupervised_launch_clean_through_guard_call():
+    assert _guard_findings("""
+        def _canary(self):
+            return self._guard.call("canary:8", lambda: fut.result())
+    """) == []
+
+
+def test_unsupervised_launch_bounded_waits_are_legal():
+    assert _guard_findings("""
+        def _run(self, packing, ev):
+            packing[0][1].exception(timeout=0.25)
+            ev.wait(0.2)
+            fut.result(timeout=1.0)
+    """) == []
+
+
+def test_unsupervised_launch_suppression_needs_justification():
+    src = """
+        def call(self, call):
+            # bounded by construction: the monitor sets the event
+            # graftlint: disable=unsupervised-launch
+            call.done.wait()
+    """
+    assert _guard_findings(src) == []
+    # the same wait WITHOUT the suppression is a finding
+    bare = src.replace("# graftlint: disable=unsupervised-launch\n", "")
+    assert [f.rule for f in _guard_findings(bare)] == \
+        ["unsupervised-launch"]
+
+
+def test_unsupervised_launch_dot_call_on_non_guard_not_exempt():
+    # .call on something that is not a guard supervises nothing
+    findings = _guard_findings("""
+        def f(self, runner, fut):
+            runner.call("k", lambda: 1)
+            return fut.result()
+    """)
+    assert [f.rule for f in findings] == ["unsupervised-launch"]
+
+
+def test_guard_checker_real_tree_is_clean():
+    from hotstuff_tpu.analysis import guardlint
+
+    assert guardlint.check(REPO) == []

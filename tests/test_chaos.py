@@ -77,7 +77,8 @@ def test_parse_plan_file(tmp_path):
     ("5 sidecar degrade zap=1", "unknown degrade param"),
     ("5 sidecar degrade delay_ms=oops", "must be an int >= 0"),
     ("5 sidecar degrade shed=-3", "must be an int >= 0"),
-    ("5 node:0 kill extra=1", "only degrade and surge take params"),
+    ("5 node:0 kill extra=1", "only degrade, surge, and wedge take "
+                              "params"),
     ("nonsense", "want '<t> <target> <action>'"),
     ("", "empty fault plan"),
 ])

@@ -314,6 +314,8 @@ def bench_mod(tmp_path, monkeypatch):
 
     monkeypatch.setattr(bench, "CACHE_PATH",
                         str(tmp_path / "headline_cache.json"))
+    monkeypatch.setattr(bench, "_LINE_CACHE_PATH",
+                        str(tmp_path / "last_line.json"))
     return bench
 
 

@@ -87,6 +87,20 @@ and degraded lines; off-TPU pallas entries carry "interpreted": true
 as it).  HOTSTUFF_TPU_ROOFLINE_BUDGET seconds (default 300) bounds the
 stage; sizes/routes that miss it report {"skipped": true}.
 
+graftview (`"viewchange"` field): batched vs per-signature TC assembly
+latency at committee sizes N in {20, 100, 300} — the quorum's (2N/3+1)
+timeout votes over the SHARED (round, high_qc_round) digest verified as
+ONE eddsa.verify_batch launch (the QC-shaped batch the consensus core
+now dispatches at view-change time) vs one reference verify per sender
+(the old inline handle_timeout path, the N=100 fault-path wall).  Per
+committee: {"quorum", "batched_ms", "per_sig_ms", "batched_sigs_per_s",
+"per_sig_sigs_per_s", "speedup"} — or {"skipped"/"error": ...}; plus an
+"eject" sub-field proving a tampered candidate fails the batch and the
+per-signature fallback names exactly the signer set per-sig verification
+rejects (acceptance bar in "ok").  HOTSTUFF_TPU_VIEWCHANGE_BUDGET
+seconds (default 240) bounds the stage; emitted on BOTH the live and
+degraded lines under the usual emit-or-die stage watchdogs.
+
 Scheduler telemetry (`"sched"` field): the verifysched STATS counters of
 a tiny in-process host-mode engine exercise (one latency QC + one bulk
 batch through the real scheduler), round-tripped through the OP_STATS
@@ -1467,6 +1481,121 @@ def guard_headline_probe() -> dict:
         guard.close()
 
 
+def viewchange_headline(committees=(20, 100, 300), repeats: int = 2,
+                        budget_s: float | None = None) -> dict:
+    """The headline ``viewchange`` field (graftview): batched vs
+    per-signature TC assembly latency at committee sizes N.
+
+    Per committee, the quorum's (2N/3+1) timeout votes of one view
+    change — every vote signing the SHARED (round, high_qc_round)
+    digest, the QC-shaped batch the consensus core now dispatches as ONE
+    sidecar launch — are verified two ways: one signature at a time
+    through the pure-python reference verifier (the per-sender host path
+    the old handle_timeout ran inline, the N=100 fault-path wall), and
+    as one eddsa.verify_batch launch.  The probe also proves the EJECT
+    contract once per run: a tampered candidate fails the batch, and the
+    per-signature fallback identifies EXACTLY the signers per-signature
+    verification rejects (the accept/reject set equivalence the native
+    test pins, re-proven through the python engine).
+
+    Budget-capped like every stage (HOTSTUFF_TPU_VIEWCHANGE_BUDGET,
+    default 240 s): committees that miss the budget report
+    {"skipped": true}.  Emitted on BOTH the live and degraded lines.
+    """
+    from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
+    # The node's own quorum formula, single-homed (sched/shapes; the
+    # committee_scale headline uses the same helper).
+    from hotstuff_tpu.sidecar.sched.shapes import quorum_sigs
+
+    if budget_s is None:
+        budget_s = float(
+            os.environ.get("HOTSTUFF_TPU_VIEWCHANGE_BUDGET", "240"))
+    out = {"committees": list(committees)}
+    if budget_s <= 0:
+        out["skipped"] = True
+        return out
+    t0 = time.perf_counter()
+    rng = np.random.default_rng(37)
+    # One shared digest: all honest timeouts of a round carry the same
+    # (round, high_qc_round), which is what makes the batch QC-shaped.
+    shared = rng.bytes(32)
+    max_q = quorum_sigs(max(committees))
+    pks, sigs = [], []
+    for _ in range(max_q):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        pks.append(pk)
+        sigs.append(ref.sign(sk, shared))
+
+    for n in committees:
+        if time.perf_counter() - t0 > budget_s:
+            out[f"n{n}"] = {"skipped": True}
+            continue
+        q = quorum_sigs(n)
+        m, p, s = [shared] * q, pks[:q], sigs[:q]
+        try:
+            # Batched: warm/compile outside the timed region, then the
+            # one-launch path the core's TC batch rides.
+            if not eddsa.verify_batch(m, p, s).all():
+                raise RuntimeError(f"batched TC verify failed at q={q}")
+            batched_ms = None
+            for _ in range(repeats):
+                t = time.perf_counter()
+                mask = eddsa.verify_batch(m, p, s)
+                dt = (time.perf_counter() - t) * 1e3
+                if not mask.all():
+                    raise RuntimeError(f"batched TC verify failed at q={q}")
+                batched_ms = dt if batched_ms is None else min(batched_ms,
+                                                               dt)
+            # Per-signature: the old inline host path, one verify per
+            # arriving timeout (single repeat — pure-python point math).
+            t = time.perf_counter()
+            for mi, pi, si in zip(m, p, s):
+                if not ref.verify(pi, mi, si):
+                    raise RuntimeError(f"per-sig TC verify failed at q={q}")
+            per_sig_ms = (time.perf_counter() - t) * 1e3
+            out[f"n{n}"] = {
+                "quorum": q,
+                "batched_ms": round(batched_ms, 2),
+                "per_sig_ms": round(per_sig_ms, 2),
+                "batched_sigs_per_s": round(q / (batched_ms / 1e3), 1),
+                "per_sig_sigs_per_s": round(q / (per_sig_ms / 1e3), 1),
+                "speedup": round(per_sig_ms / batched_ms, 3),
+            }
+        except Exception as e:  # noqa: BLE001 — per-size isolation
+            out[f"n{n}"] = {"error": f"{e!r:.200}"}
+
+    # Eject-path equivalence at the smallest committee: one tampered
+    # candidate -> the batch rejects, and the per-sig fallback names
+    # exactly the same signer set the batch mask does.
+    try:
+        q = quorum_sigs(min(committees))
+        bad_i = q // 2
+        bad_sigs = list(sigs[:q])
+        bad_sigs[bad_i] = bad_sigs[bad_i][:1] + \
+            bytes([bad_sigs[bad_i][1] ^ 0xFF]) + bad_sigs[bad_i][2:]
+        mask = [bool(b) for b in
+                eddsa.verify_batch([shared] * q, pks[:q], bad_sigs)]
+        per_sig = [ref.verify(pk, shared, sg)
+                   for pk, sg in zip(pks[:q], bad_sigs)]
+        out["eject"] = {
+            "tampered_index": bad_i,
+            "batch_rejected": not all(mask),
+            "ejected": [i for i, ok in enumerate(mask) if not ok],
+            "match_per_sig": mask == per_sig,
+        }
+    except Exception as e:  # noqa: BLE001
+        out["eject"] = {"error": f"{e!r:.200}"}
+
+    measured = [v for k, v in out.items()
+                if k.startswith("n") and isinstance(v, dict)
+                and "speedup" in v]
+    out["ok"] = bool(measured) and \
+        out.get("eject", {}).get("match_per_sig") is True and \
+        out.get("eject", {}).get("batch_rejected") is True
+    return out
+
+
 def probe_device(window: float | None = None,
                  max_attempts: int | None = None, run=None,
                  sleep=time.sleep, now=time.monotonic):
@@ -1632,6 +1761,20 @@ def run_degraded(reason: str):
         except Exception as e:  # noqa: BLE001 — headline isolation
             roofline = {"est": roofline_estimate(),
                         "error": f"{e!r:.120}"}
+        # graftview viewchange on the CPU backend: batched vs per-sig TC
+        # assembly plus the eject-equivalence check — CPU-backend
+        # latencies (never comparable to device numbers, the degraded
+        # flag says so), but the eject contract and the field's schema
+        # are proven on every line.
+        try:
+            viewchange = viewchange_headline(
+                repeats=1,
+                budget_s=min(
+                    float(os.environ.get("HOTSTUFF_TPU_VIEWCHANGE_BUDGET",
+                                         "240")),
+                    max(0.0, budget_left_s() - 90.0)))
+        except Exception as e:  # noqa: BLE001 — headline isolation
+            viewchange = {"error": f"{e!r:.120}"}
         try:
             sched = sched_headline_probe()
         except Exception as e:  # noqa: BLE001 — telemetry is best-effort
@@ -1662,8 +1805,8 @@ def run_degraded(reason: str):
         emit(value, 0.0, degraded=True, backend=jax.default_backend(),
              note=reason, rlc=rlc, mesh_rlc=mesh_rlc,
              committee_scale=committee_scale, roofline=roofline,
-             sched=sched, chaos=chaos, trace=trace, surge=surge,
-             guard=guard)
+             viewchange=viewchange, sched=sched, chaos=chaos, trace=trace,
+             surge=surge, guard=guard)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -1969,6 +2112,30 @@ def main(argv=None):
     except Exception as e:  # noqa: BLE001 — headline isolation
         roofline = {"error": f"{e!r:.200}"}
     roofline_watchdog.cancel()
+    # graftview viewchange: batched vs per-sig TC assembly.  Compile-
+    # bound (fresh verify_batch buckets), so it gets the same stage-
+    # watchdog discipline as rlc/roofline — on fire, the already-measured
+    # fields ship with the stage marked instead of eating the line.
+    def _viewchange_abort():
+        emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
+                   mesh_rlc=mesh_rlc, committee_scale=committee_scale,
+                   roofline=roofline,
+                   viewchange={"error": "viewchange stage watchdog"})
+        os._exit(0)
+
+    viewchange_budget = min(
+        float(os.environ.get("HOTSTUFF_TPU_VIEWCHANGE_BUDGET", "240")),
+        max(0.0, budget_left_s() - _DEADLINE_SLACK))
+    viewchange_watchdog = threading.Timer(
+        min(max(60.0, viewchange_budget + 120.0),
+            max(60.0, budget_left_s() - 60.0)), _viewchange_abort)
+    viewchange_watchdog.daemon = True
+    viewchange_watchdog.start()
+    try:
+        viewchange = viewchange_headline(budget_s=viewchange_budget)
+    except Exception as e:  # noqa: BLE001 — headline isolation
+        viewchange = {"error": f"{e!r:.200}"}
+    viewchange_watchdog.cancel()
     try:
         sched = sched_headline_probe()
     except Exception as e:  # noqa: BLE001 — telemetry is best-effort
@@ -1991,7 +2158,7 @@ def main(argv=None):
         guard = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
                mesh_rlc=mesh_rlc, committee_scale=committee_scale,
-               roofline=roofline, sched=sched,
+               roofline=roofline, viewchange=viewchange, sched=sched,
                chaos=chaos, trace=trace, surge=surge, guard=guard)
 
 

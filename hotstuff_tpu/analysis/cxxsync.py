@@ -74,6 +74,11 @@ DEFAULT_TARGETS = (
     "native/src/consensus/mempool_driver.cpp",
     "native/src/consensus/core.hpp",
     "native/src/consensus/core.cpp",
+    # graftview: the optimistic timeout aggregator is core-thread-owned
+    # state (OWNED_BY-documented); scanning it pins that story — a
+    # mutex or atomic growing here must join the annotations.
+    "native/src/consensus/aggregator.hpp",
+    "native/src/consensus/aggregator.cpp",
     # graftsurge: the bounded-ingress gate is reactor-thread +
     # batch-maker-thread shared state behind one mutex.
     "native/src/mempool/ingress.hpp",

@@ -31,8 +31,9 @@ the sidecar's in-process fault hook (``OP_CHAOS``) in
 
 from .netem import LinkShape, WanError, WanProxy, WanSpec, \
     parse_wan  # noqa: F401
-from .plan import ACTIONS, FaultEvent, FaultPlan, PlanError, \
-    client_index, link_name, node_index, parse_plan  # noqa: F401
+from .plan import ACTIONS, LEADER_CASCADE, FaultEvent, FaultPlan, \
+    PlanError, cascade_k, client_index, link_name, node_index, \
+    parse_plan  # noqa: F401
 from .recovery import summarize_recovery  # noqa: F401
 from .runner import PlanRunner  # noqa: F401
 from .slo import DEFAULT_SLO_MS, SloError, fault_class, judge, \

@@ -38,6 +38,19 @@ Actions (per target)
               ``x=5 for=20``).  Injectors realize it as an extra
               load-generator process at ``(x-1)``× the client's rate,
               killed when the window closes.
+    leader-cascade: ``kill`` with ``k=<n>`` (graftview) — the view-change
+              storm drill: SIGKILL the leader of each of the next ``k``
+              rounds (round-robin election over the sorted committee, the
+              C++ LeaderElector's rule), so the committee must survive k
+              chained view changes — timeout broadcast, batched TC
+              assembly, backoff pacemaker — before a live leader
+              proposes again.  DSL: ``"10 leader-cascade kill k=3"``.
+              Judged by the ``view-change`` SLO class and the parser's
+              TC-formed / round-jump notes.  Which node indices die is a
+              runtime decision (it depends on the round the committee
+              has reached), so the per-target state machine does not
+              track them: mixing leader-cascade with ANY node:<i>
+              event in one plan is rejected.
 
 Validation is a per-target state machine over the time-ordered events:
 ``restart`` must follow ``kill``, ``resume`` must follow ``pause``,
@@ -57,6 +70,7 @@ from dataclasses import dataclass, field
 ACTIONS = ("kill", "restart", "pause", "resume", "degrade",
            "partition", "heal", "surge", "wedge")
 SIDECAR = "sidecar"
+LEADER_CASCADE = "leader-cascade"
 
 _NODE_RE = re.compile(r"^node:(\d+)$")
 _LINK_RE = re.compile(r"^link:(\S+)$")
@@ -97,6 +111,21 @@ def surge_window_s(params) -> float:
     except (TypeError, ValueError):
         return SURGE_DEFAULT_FOR_S
 
+
+# graftview: default cascade depth — ONE definition shared by validation,
+# the injector, the harness pre-flight quorum check, and the parser's
+# client-death tolerance, so an omitted ``k`` means the same thing at
+# every layer.
+CASCADE_DEFAULT_K = 1
+
+
+def cascade_k(params) -> int:
+    """A leader-cascade event's kill depth (default applied)."""
+    try:
+        return int((params or {}).get("k", CASCADE_DEFAULT_K))
+    except (TypeError, ValueError):
+        return CASCADE_DEFAULT_K
+
 # Actions each target kind accepts (sidecar pause would stop the shared
 # verify engine for EVERY replica at once — use degrade for that class
 # of fault instead, it is observable and bounded).
@@ -104,6 +133,7 @@ _NODE_ACTIONS = {"kill", "restart", "pause", "resume"}
 _SIDECAR_ACTIONS = {"kill", "restart", "degrade", "wedge"}
 _LINK_ACTIONS = {"partition", "heal"}
 _CLIENT_ACTIONS = {"surge"}
+_CASCADE_ACTIONS = {"kill"}
 
 # degrade params the sidecar's ChaosState accepts (mirrored there; the
 # plan validates early so a typo fails at parse time).
@@ -243,6 +273,8 @@ def _validate(events) -> FaultPlan:
                             f"{', '.join(ACTIONS)})")
         if e.target == SIDECAR:
             allowed = _SIDECAR_ACTIONS
+        elif e.target == LEADER_CASCADE:
+            allowed = _CASCADE_ACTIONS
         elif _NODE_RE.match(e.target):
             allowed = _NODE_ACTIONS
         elif _LINK_RE.match(e.target):
@@ -251,13 +283,25 @@ def _validate(events) -> FaultPlan:
             allowed = _CLIENT_ACTIONS
         else:
             raise PlanError(f"{e.label()}: target must be 'sidecar', "
-                            "'node:<i>', 'link:<name>', or 'client:<i>'")
+                            "'leader-cascade', 'node:<i>', 'link:<name>', "
+                            "or 'client:<i>'")
         if e.action not in allowed:
             raise PlanError(f"{e.label()}: {e.target} does not support "
                             f"{e.action} (allowed: {', '.join(sorted(allowed))})")
-        if e.params and e.action not in ("degrade", "surge", "wedge"):
-            raise PlanError(f"{e.label()}: only degrade, surge, and "
-                            "wedge take params")
+        if e.params and e.action not in ("degrade", "surge", "wedge") \
+                and e.target != LEADER_CASCADE:
+            raise PlanError(f"{e.label()}: only degrade, surge, wedge, "
+                            "and leader-cascade take params")
+        if e.target == LEADER_CASCADE:
+            bad = set(e.params) - {"k"}
+            if bad:
+                raise PlanError(f"{e.label()}: unknown leader-cascade "
+                                f"param(s) {sorted(bad)} (have k)")
+            k = e.params.get("k", CASCADE_DEFAULT_K)
+            if not isinstance(k, int) or isinstance(k, bool) or k < 1:
+                raise PlanError(
+                    f"{e.label()}: leader-cascade k must be an int >= 1 "
+                    f"(got {k!r})")
         if e.action == "wedge":
             bad = set(e.params) - {"n"}
             if bad:
@@ -303,6 +347,11 @@ def _validate(events) -> FaultPlan:
                     raise PlanError(
                         f"{e.label()}: degrade {key} must be an int >= 0 "
                         f"(got {v!r})")
+        if e.target == LEADER_CASCADE:
+            # Which node indices die is a runtime decision, so the
+            # per-target state machine cannot track a cascade; keep it
+            # stateless (two cascades in one plan are legal).
+            continue
         cur = state.get(e.target, "up")
         if e.action == "kill" and cur == "down":
             raise PlanError(f"{e.label()}: target is already down")
@@ -324,6 +373,18 @@ def _validate(events) -> FaultPlan:
                            "degrade": "up", "partition": "partitioned",
                            "heal": "up", "surge": "up",
                            "wedge": "up"}[e.action]
+    # A cascade kills nodes the state machine cannot name, so ANY
+    # explicit node:<i> event in the same plan could operate on a
+    # replica the cascade already murdered — a later restart/resume
+    # would fail at runtime, and a paused replica reads as live to the
+    # cascade (poll() is None under SIGSTOP) so even pause/resume pairs
+    # can have their second half invalidated.  Unexecutable: reject.
+    if any(e.target == LEADER_CASCADE for e in ordered) and \
+            any(node_index(e.target) is not None for e in ordered):
+        raise PlanError(
+            "a plan mixing leader-cascade with node:<i> events cannot "
+            "be validated (the cascade's victims are chosen at "
+            "runtime); use separate plans")
     return FaultPlan(tuple(ordered))
 
 

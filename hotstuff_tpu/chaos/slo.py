@@ -24,7 +24,8 @@ import json
 import os
 import re
 
-from .plan import SIDECAR, client_index, link_name, node_index
+from .plan import LEADER_CASCADE, SIDECAR, client_index, link_name, \
+    node_index
 
 # class -> max recovery_ms (the table --slo overlays).
 DEFAULT_SLO_MS = {
@@ -47,6 +48,12 @@ DEFAULT_SLO_MS = {
     # (the commit-scalar verdict measures from the injection like every
     # other class; the metrics verdict below measures from the end).
     "client-surge": 30_000.0,
+    # graftview: a leader-cascade kill k drill — k chained view changes,
+    # each costing one backed-off timeout (default schedule: 5 s, 10 s,
+    # 20 s, ... capped) plus batched TC assembly, before a live leader
+    # proposes.  The budget covers a depth-3 cascade under the default
+    # pacemaker; deeper drills override per run.
+    "view-change": 60_000.0,
 }
 
 # Metrics-driven recovery-to-baseline defaults (judge_baseline_recovery):
@@ -67,6 +74,10 @@ class SloError(ValueError):
 def fault_class(event: dict) -> str:
     """Executed-event dict (PlanRunner.events shape) -> fault class."""
     target = str(event.get("target", ""))
+    if target == LEADER_CASCADE:
+        # The drill IS the view change: one class regardless of action,
+        # per the graftview acceptance grammar.
+        return "view-change"
     if target == SIDECAR:
         kind = "sidecar"
     elif node_index(target) is not None:

@@ -59,6 +59,7 @@ def cmd_aggregate(args):
     agg = LogAggregator(max_latencies=args.max_latency)
     agg.print()
     agg.print_matrix()
+    agg.print_bands()
     print("aggregated series + matrix written to plots/")
 
 

@@ -65,15 +65,24 @@ class Setup:
 
 
 class Result:
-    def __init__(self, mean_tps, mean_latency, std_tps=0, std_latency=0):
+    def __init__(self, mean_tps, mean_latency, std_tps=0, std_latency=0,
+                 runs=1):
         self.mean_tps = mean_tps
         self.mean_latency = mean_latency
         self.std_tps = std_tps
         self.std_latency = std_latency
+        # Repeatability (VERDICT r5 "do this" #4): how many same-settings
+        # runs this mean±stdev aggregates — a band over one run is a
+        # point estimate wearing a costume, and the artifacts must say
+        # which one they are quoting.
+        self.runs = runs
 
     def __str__(self):
+        # " TPS: m +/- s tx/s" prefix is frozen (plot.py findall); the
+        # run count rides after it.
         return (
-            f" TPS: {self.mean_tps} +/- {self.std_tps} tx/s\n"
+            f" TPS: {self.mean_tps} +/- {self.std_tps} tx/s "
+            f"over {self.runs} run(s)\n"
             f" Latency: {self.mean_latency} +/- {self.std_latency} ms\n"
         )
 
@@ -95,7 +104,8 @@ class Result:
         mean_latency = round(mean(r.mean_latency for r in results))
         std_tps = round(stdev(r.mean_tps for r in results))
         std_latency = round(stdev(r.mean_latency for r in results))
-        return cls(mean_tps, mean_latency, std_tps, std_latency)
+        return cls(mean_tps, mean_latency, std_tps, std_latency,
+                   runs=len(results))
 
 
 class LogAggregator:
@@ -230,6 +240,48 @@ class LogAggregator:
             organized[key].sort(key=lambda x: x[0])
         return "robustness", organized
 
+    # -- repeatability bands (VERDICT r5 "do this" #4) -----------------------
+
+    def bands(self, min_runs: int = 2) -> list:
+        """Per-setup repeatability bands from multi-run same-settings
+        result files: every configuration with >= ``min_runs`` aggregated
+        runs, as JSON-safe dicts quoting mean±stdev — the shape
+        results/README's committee rows should be quoted in (a band,
+        not a point estimate)."""
+        out = []
+        for setup, result in sorted(
+                self.records.items(),
+                key=lambda kv: (kv[0].faults, kv[0].nodes, kv[0].rate)):
+            if result.runs < min_runs:
+                continue
+            out.append({
+                "faults": setup.faults, "nodes": setup.nodes,
+                "rate": setup.rate, "tx_size": setup.tx_size,
+                "chaos": setup.chaos, "runs": result.runs,
+                "tps": result.mean_tps, "tps_std": result.std_tps,
+                "latency_ms": result.mean_latency,
+                "latency_std": result.std_latency,
+            })
+        return out
+
+    def print_bands(self, min_runs: int = 2):
+        """Human-readable repeatability table on stdout (the aggregate
+        CLI surfaces it so quoting a band is copy-paste, not archaeology
+        over result files)."""
+        bands = self.bands(min_runs=min_runs)
+        if not bands:
+            print(f"no setup has >= {min_runs} same-settings runs yet "
+                  "(repeatability bands need repeats)")
+            return
+        print("Repeatability bands (mean +/- stdev over same-settings "
+              "runs):")
+        for b in bands:
+            chaos = " [chaos]" if b["chaos"] else ""
+            print(f"  N={b['nodes']} f={b['faults']} rate={b['rate']:,}"
+                  f"{chaos}: {b['tps']:,} +/- {b['tps_std']:,} tx/s, "
+                  f"{b['latency_ms']:,} +/- {b['latency_std']:,} ms "
+                  f"over {b['runs']} runs")
+
     # -- graftwan matrix ----------------------------------------------------
 
     def matrix(self) -> dict:
@@ -254,6 +306,7 @@ class LogAggregator:
                 "tps": result.mean_tps, "tps_std": result.std_tps,
                 "latency_ms": result.mean_latency,
                 "latency_std": result.std_latency,
+                "runs": result.runs,
             }
             if setup in self.chaos:
                 cell["chaos"] = self.chaos[setup]

@@ -153,6 +153,25 @@ class NodeParameters:
             inputs += [json_input["mempool"]["max_batch_delay"]]
         except KeyError as e:
             raise ConfigError(f"Malformed parameters: missing key {e}")
+        # graftview pacemaker knobs: optional, but when present they must
+        # be ints the C++ reader accepts (its own range checks mirror
+        # these — a typo'd value must fail at harness time, not as a
+        # node-boot crash mid-bench).
+        cons = json_input["consensus"]
+        for key, lo, hi in (("timeout_backoff_factor_pct", 100, None),
+                            ("timeout_backoff_cap", 1, None),
+                            ("timeout_jitter_pct", 0, 100),
+                            ("timeout_future_horizon", 1, None)):
+            v = cons.get(key)
+            if v is None:
+                continue
+            if not isinstance(v, int) or isinstance(v, bool) or v < lo \
+                    or (hi is not None and v > hi):
+                raise ConfigError(
+                    f"{key} must be an int >= {lo}"
+                    + (f" and <= {hi}" if hi is not None else "")
+                    + f" (got {v!r})")
+            inputs += [v]
         if not all(isinstance(x, int) for x in inputs):
             raise ConfigError("Invalid parameters type")
         sidecar = json_input.get("tpu_sidecar")

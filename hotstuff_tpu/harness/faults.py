@@ -37,8 +37,8 @@ import os
 import signal
 import threading
 
-from ..chaos.plan import SIDECAR, FaultEvent, client_index, link_name, \
-    node_index
+from ..chaos.plan import LEADER_CASCADE, SIDECAR, FaultEvent, cascade_k, \
+    client_index, link_name, node_index
 
 
 class InjectionError(RuntimeError):
@@ -58,6 +58,9 @@ class LocalFaultInjector:
         if event.target == SIDECAR:
             fn = getattr(self, f"_sidecar_{event.action}")
             fn(**event.params)
+            return
+        if event.target == LEADER_CASCADE:
+            self._cascade_kill(cascade_k(event.params))
             return
         name = link_name(event.target)
         if name is not None:
@@ -124,6 +127,83 @@ class LocalFaultInjector:
     def _node_resume(self, i: int):
         self._signal_node(i, signal.SIGCONT)
         self._paused.discard(i)
+
+    # -- graftview leader cascade -------------------------------------------
+
+    # How much log tail the round estimate scans per node.  The highest
+    # round is always near the END of an append-only log, and this runs
+    # on the INJECTION path: reading a multi-GB log in full would delay
+    # the SIGKILLs past the event's recorded wall stamp and skew the
+    # recovery measurement the drill exists to take.
+    _ROUND_SCAN_TAIL_BYTES = 64 * 1024
+
+    def _estimate_round(self) -> int:
+        """Best estimate of the round the committee is working on, from
+        the highest proposed/committed block round in the node logs (the
+        frozen log grammar's ``Created B<r>`` / ``Committed B<r>``
+        lines), scanning only each log's tail.  Proposals run ahead of
+        commits, so +1 on the max is a round the committee has NOT
+        finished yet."""
+        import os
+        import re
+
+        from .utils import PathMaker
+
+        best = 0
+        for i in self._bench._node_procs:
+            try:
+                with open(PathMaker.node_log_file(i), "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    f.seek(max(0, f.tell() - self._ROUND_SCAN_TAIL_BYTES))
+                    tail = f.read().decode("utf-8", errors="replace")
+                for m in re.finditer(r"(?:Created|Committed) B(\d+)\b",
+                                     tail):
+                    best = max(best, int(m.group(1)))
+            except OSError:
+                continue
+        return best + 1
+
+    def _cascade_kill(self, k: int):
+        """graftview drill: SIGKILL the leader of each of the next ``k``
+        rounds.  Leader election is round-robin over the SORTED
+        committee keys (native LeaderElector), and sorted order means
+        the base64-decoded public-key bytes — the same ordering
+        std::map<PublicKey, ...> iterates.  Round-robin guarantees the
+        chosen nodes each lead within the next committee-size rounds,
+        so even a stale round estimate still produces k dead leader
+        slots (= k forced view changes); killing them all at once is
+        what makes the cascade chain instead of interleaving with
+        healthy rounds."""
+        import base64
+
+        names = getattr(self._bench, "_node_names", None)
+        if not names:
+            raise InjectionError(
+                "bench records no committee names; leader-cascade needs "
+                "a LocalBench run (boot order -> leader slots)")
+        order = sorted(range(len(names)),
+                       key=lambda i: base64.b64decode(names[i]))
+        base = self._estimate_round()
+        killed, dead = [], []
+        for r in range(base + 1, base + 1 + int(k)):
+            i = order[r % len(names)]
+            if i in killed:
+                continue  # k > committee wraps onto an already-dead slot
+            proc = self._bench._node_procs.get(i)
+            if proc is None or proc.poll() is not None:
+                dead.append(i)  # crash fault / earlier event: already out
+                continue
+            self._signal_node(i, signal.SIGKILL)
+            self._paused.discard(i)
+            killed.append(i)
+        if not killed:
+            raise InjectionError(
+                f"leader-cascade kill {k}: no live leader among rounds "
+                f"{base + 1}..{base + k} (already dead: {dead})")
+        from .utils import Print
+
+        Print.info(f"Leader cascade: killed node(s) {killed} (leaders of "
+                   f"rounds {base + 1}..{base + k})")
 
     # -- sidecar ------------------------------------------------------------
 
@@ -306,6 +386,14 @@ class RemoteFaultInjector:
         if event.target == SIDECAR:
             getattr(self, f"_sidecar_{event.action}")(**event.params)
             return
+        if event.target == LEADER_CASCADE:
+            # Pre-flight (remote._check_fault_plan) rejects cascade plans
+            # before boot; this is the belt for hand-driven injectors
+            # (the remote bench has no live round estimate to pick
+            # leaders from).
+            raise InjectionError(
+                "leader-cascade events are local-harness only (the "
+                "remote bench cannot estimate the live round)")
         name = link_name(event.target)
         if name is not None:
             getattr(self, f"_link_{event.action}")(name)

@@ -77,6 +77,10 @@ class LocalBench:
         # clients, so a plan's client:<i> surge event can boot an extra
         # generator at a multiple of the baseline (harness/faults.py).
         self._client_targets = {}
+        # graftview: committee names in BOOT order — the leader-cascade
+        # injector maps round-robin leader slots (sorted-key order, the
+        # C++ LeaderElector's rule) back to the node index to SIGKILL.
+        self._node_names = []
         fp = getattr(bench_parameters, "fault_plan", None)
         if fp:
             from ..chaos import PlanError, parse_plan
@@ -326,13 +330,43 @@ class LocalBench:
         if self.fault_plan is None or not self.fault_plan.events:
             return
         alive = self.nodes - self.faults
+        # graftview: a leader-cascade must leave a quorum of live voters
+        # behind (stake is uniform here: quorum = 2n/3+1 over the FULL
+        # committee, the node's own formula) — a drill that kills the
+        # quorum is a permanent stall, not a view-change storm.
+        from ..chaos.plan import LEADER_CASCADE, cascade_k
+
+        cascades = [cascade_k(e.params) for e in self.fault_plan.events
+                    if e.target == LEADER_CASCADE]
+        quorum = 2 * self.nodes // 3 + 1
+        if cascades and alive - sum(cascades) < quorum:
+            raise BenchError(
+                f"leader-cascade kills {sum(cascades)} leader(s) but "
+                f"only {alive - quorum} of the {alive} booted replicas "
+                f"are expendable (quorum {quorum} of {self.nodes}); "
+                "reduce k or grow the committee")
         # Window headroom: the strict recovery assertion (logs.py) needs
         # commits AFTER every event, and recovery from a kill legitimately
         # costs view changes plus the node-side breaker's failure window —
         # an event too close to teardown would either silently never fire
         # (runner.stop() skips it) or fail a healthy run.  Reject the plan
-        # up front instead.
+        # up front instead.  A cascade's recovery is k BACKED-OFF view
+        # changes, so its grace follows the pacemaker schedule the run
+        # will actually execute (node-parameter overrides win).
         grace = 2 * self.node_parameters.timeout_delay / 1000 + 3
+        if cascades:
+            cons = self.node_parameters.json.get("consensus", {})
+            factor = cons.get("timeout_backoff_factor_pct", 200) / 100.0
+            cap = cons.get("timeout_backoff_cap", 60_000) / 1000.0
+            jitter = cons.get("timeout_jitter_pct", 10) / 100.0
+            base = self.node_parameters.timeout_delay / 1000.0
+            # Worst case includes the full jitter draw on every backed-off
+            # delay — the core adds up to jitter_pct on top of the
+            # schedule, and an unlucky run must not outrun the headroom
+            # this check promised it.
+            worst = sum(min(max(cap, base), base * factor ** d)
+                        for d in range(max(cascades) + 1)) * (1 + jitter)
+            grace = max(grace, worst + 3)
         if self.fault_plan.max_time() > self.duration - grace:
             raise BenchError(
                 f"fault plan's last event (t={self.fault_plan.max_time():g}s) "
@@ -544,6 +578,7 @@ class LocalBench:
                     check=True)
                 keys.append(Key.from_file(filename))
             names = [k.name for k in keys]
+            self._node_names = names
             bls_pubkeys = None
             if self.scheme == "bls":
                 from .config import add_bls_keys

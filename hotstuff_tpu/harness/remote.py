@@ -257,6 +257,14 @@ class Bench:
                 "remote bench cannot express yet (it does not track "
                 "per-host client boot commands); run the surge scenario "
                 "on the local harness")
+        from ..chaos.plan import LEADER_CASCADE
+
+        if any(e.target == LEADER_CASCADE for e in self.fault_plan.events):
+            raise BenchError(
+                "fault plan schedules leader-cascade events, which the "
+                "remote bench cannot express yet (it has no live round "
+                "estimate to pick the upcoming leaders from); run the "
+                "cascade drill on the local harness")
         missing = [name for name in self.fault_plan.link_names()
                    if self.wan is None or self.wan.by_name(name) is None]
         if missing:

@@ -22,7 +22,13 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
+try:  # jax >= 0.6: top-level export, replication checking via check_vma
+    from jax import shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map
+    _SHARD_MAP_KW = {"check_rep": False}
 from jax.sharding import Mesh, PartitionSpec as Pspec
 
 from ..offchain import bls12381 as host
@@ -64,7 +70,7 @@ def _cached_checker(mesh: Mesh):
         _shard_body, mesh=mesh,
         in_specs=(Pspec(BATCH_AXIS), Pspec(BATCH_AXIS)),
         out_specs=Pspec(BATCH_AXIS),
-        check_vma=False)
+        **_SHARD_MAP_KW)
     return jax.jit(fn)
 
 

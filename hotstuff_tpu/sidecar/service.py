@@ -443,11 +443,15 @@ class VerifyEngine:
                         with self._tracer.span(
                                 "device", kind="bls",
                                 rid=item.request.request_id):
-                            try:
-                                self._execute_bls(item)
-                            except Exception:
-                                log.exception("BLS request failed")
-                                item.reply_fn(None)
+                            # Single-reply discipline: _execute_bls owns
+                            # its whole failure surface and replies
+                            # EXACTLY once through its idempotent
+                            # helper — no backstop reply here (the old
+                            # one could double-reply when an exception
+                            # escaped after a success path had already
+                            # answered, e.g. a wedged-then-completing
+                            # pairing).
+                            self._execute_bls(item)
                         continue
                     batch = launch.items
                     packing.append(
@@ -1054,41 +1058,73 @@ class VerifyEngine:
     def _execute_bls(self, item):
         """Run one BLS request on the engine thread.
 
+        SINGLE-REPLY DISCIPLINE (the PR 14 double-reply hazard, closed):
+        every success AND failure path — cached hits, decode failures,
+        completed verifications, escaping exceptions — answers through
+        ONE idempotent ``reply`` helper.  A second reply attempt (e.g. a
+        wedged-then-completing pairing racing an exception handler, once
+        BLS launches ride the guard's disposable threads — ROADMAP item
+        3) is suppressed and logged instead of writing a duplicate frame
+        onto the connection.  _run therefore installs NO backstop reply.
+
         Reply/caching contract: verdicts are cached ONLY at the explicit
         sites below that pass ``cacheable=True`` — i.e. verdicts that are
         a pure function of the request bytes (decode/subgroup failures,
         completed verifications).  Transient failures (a wedged device, a
         backend exception) must reply ``None`` and NEVER a cacheable
         ``[False]``: the verdict cache is shared by every replica, so one
-        poisoned entry would reject a valid certificate fleet-wide.  An
-        exception escaping this method is replied as ``None`` by _run's
-        handler and, by construction, cannot touch the cache.
+        poisoned entry would reject a valid certificate fleet-wide.
         """
+        req = item.request
+        cache_key = self.bls_cache_key(req) \
+            if not isinstance(req, proto.BlsSignRequest) else None
+        replied = [False]
+
+        def reply(payload, *, cacheable=False):
+            # cacheable=True asserts this verdict is a pure function of
+            # the request bytes; nothing else may enter the shared cache.
+            if replied[0]:
+                log.warning(
+                    "BLS double-reply suppressed for rid=%s (%s)",
+                    req.request_id, type(req).__name__)
+                return
+            replied[0] = True
+            if cacheable and cache_key is not None and payload:
+                self._cache_verdict(cache_key, bool(payload[0]))
+            item.reply_fn(payload)
+
+        try:
+            self._execute_bls_inner(req, cache_key, reply)
+        except Exception:
+            log.exception("BLS request failed")
+            # Transient by definition (deterministic failures replied
+            # inline above): never cacheable.
+            reply(None)
+        if not replied[0]:
+            # Belt: a path that forgot to answer would leave the client
+            # blocked until its recv deadline — reply the transient form.
+            log.error("BLS path for rid=%s never replied; replying None",
+                      req.request_id)
+            reply(None)
+
+    def _execute_bls_inner(self, req, cache_key, reply):
+        """The BLS request body; every exit replies via ``reply`` (the
+        idempotent helper _execute_bls built) exactly once."""
         from ..offchain import bls12381 as bls
 
-        req = item.request
         if isinstance(req, proto.BlsSignRequest):
             # Signing is G2 scalar multiplication — host bigint work, no
             # pairing; mirrors the reference keeping signing on CPU.
             sk = int.from_bytes(req.sk, "big")
-            sig = bls.g2_encode(bls.sign(sk, req.msg))
-            item.reply_fn(sig)
+            reply(bls.g2_encode(bls.sign(sk, req.msg)))
             return
         # Verdict cache (same FIFO as Ed25519, keyed on the full request):
         # N replicas verifying one certificate cost one pairing.  Decode
         # failures cache as False — deterministic in the request bytes.
-        cache_key = self.bls_cache_key(req)
         cached = self._verdicts.get(cache_key) if cache_key else None
         if cached is not None:
-            item.reply_fn([cached])
+            reply([cached])
             return
-
-        def reply(mask, *, cacheable):
-            # cacheable=True asserts this verdict is a pure function of
-            # the request bytes; nothing else may enter the shared cache.
-            if cacheable and cache_key is not None and mask:
-                self._cache_verdict(cache_key, bool(mask[0]))
-            item.reply_fn(mask)
 
         if isinstance(req, proto.BlsMultiRequest):
             # TC shape: per-vote signatures over DISTINCT digests in one

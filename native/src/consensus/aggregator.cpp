@@ -1,5 +1,7 @@
 #include "consensus/aggregator.hpp"
 
+#include <algorithm>
+
 namespace hotstuff {
 namespace consensus {
 
@@ -23,24 +25,115 @@ Aggregator::AddResult Aggregator::add_vote(const Vote& vote) {
   return result;
 }
 
-Aggregator::AddTimeoutResult Aggregator::add_timeout(const Timeout& timeout) {
-  TCMaker& maker = timeouts_aggregators_[timeout.round];
+Digest Aggregator::signature_id(const PublicKey& author,
+                                const Signature& sig) {
+  return DigestBuilder().update(author.data).update(sig.data).finalize();
+}
+
+Aggregator::AddTimeoutResult Aggregator::add_timeout(const Timeout& timeout,
+                                                     bool pre_verified) {
   AddTimeoutResult result;
+  // Stake check at admission: with verification deferred to the batch,
+  // this is what bounds a round's aggregation state to the committee —
+  // fabricated authorities must not be able to grow `used`/`entries`.
+  Stake stake = committee_.stake(timeout.author);
+  if (stake == 0) {
+    result.error = "unknown timeout author: " + timeout.author.to_base64();
+    return result;
+  }
+  TCMaker& maker = timeouts_aggregators_[timeout.round];
+  if (maker.rejected.count(signature_id(timeout.author, timeout.signature))) {
+    result.error = "previously ejected timeout signature from " +
+                   timeout.author.to_base64();
+    return result;
+  }
   if (!maker.used.insert(timeout.author).second) {
     result.error = "authority reuse: " + timeout.author.to_base64();
     return result;
   }
-  maker.votes.emplace_back(timeout.author, timeout.signature,
-                           timeout.high_qc.round);
-  maker.weight += committee_.stake(timeout.author);
-  if (maker.weight >= committee_.quorum_threshold()) {
-    maker.weight = 0;  // ensures the TC is only made once
-    TC tc;
-    tc.round = timeout.round;
-    tc.votes = maker.votes;
-    result.tc = std::move(tc);
-  }
+  maker.entries.push_back({timeout.author, timeout.signature,
+                           timeout.high_qc.round, pre_verified});
+  maker.weight += stake;
+  if (pre_verified) maker.verified_weight += stake;
+  maybe_complete(timeout.round, maker, &result);
   return result;
+}
+
+Aggregator::AddTimeoutResult Aggregator::resolve_timeouts(
+    Round round, const std::vector<PublicKey>& verified,
+    const std::vector<PublicKey>& ejected) {
+  AddTimeoutResult result;
+  auto it = timeouts_aggregators_.find(round);
+  if (it == timeouts_aggregators_.end()) return result;  // round moved on
+  TCMaker& maker = it->second;
+  maker.batch_inflight = false;
+  for (const PublicKey& name : verified) {
+    for (TimeoutEntry& e : maker.entries) {
+      if (e.author == name && !e.verified) {
+        e.verified = true;
+        maker.verified_weight += committee_.stake(name);
+      }
+    }
+  }
+  size_t rejected_cap =
+      kRejectedCapPerAuthority * std::max<size_t>(1, committee_.size());
+  // Blacklist rejected bytes only on a MIXED outcome: at least one
+  // candidate verifying proves the verifier itself worked, so the
+  // failures are genuinely bad signatures.  An all-fail batch is more
+  // consistent with a verifier outage (scheme=bls with the sidecar
+  // down has no host pairing: every honest signature reads false) —
+  // ejecting drops the quorum either way, but remembering the bytes
+  // would refuse the DETERMINISTIC honest re-broadcasts forever and
+  // wedge the round past the outage.
+  bool blacklist = !verified.empty();
+  for (const PublicKey& name : ejected) {
+    auto entry = std::find_if(
+        maker.entries.begin(), maker.entries.end(),
+        [&](const TimeoutEntry& e) { return e.author == name; });
+    if (entry == maker.entries.end()) continue;
+    if (blacklist && maker.rejected.size() < rejected_cap) {
+      maker.rejected.insert(signature_id(entry->author, entry->signature));
+    }
+    maker.weight -= committee_.stake(name);
+    // Reopen the authority slot: the bad bytes may be a THIRD party's
+    // spoof, and the genuine author's honest timeout must still count.
+    maker.used.erase(name);
+    maker.entries.erase(entry);
+    ejected_total_++;
+  }
+  maybe_complete(round, maker, &result);
+  return result;
+}
+
+void Aggregator::maybe_complete(Round round, TCMaker& maker,
+                                AddTimeoutResult* out) {
+  if (maker.batch_inflight) return;  // one verdict at a time per round
+  Stake quorum = committee_.quorum_threshold();
+  if (maker.verified_weight >= quorum) {
+    // Seal from verified entries only, in admission order, stopping at
+    // the quorum: under equal stakes this emits the MINIMAL certificate
+    // the structural over-quorum guard (messages.cpp) demands.
+    TC tc;
+    tc.round = round;
+    Stake weight = 0;
+    for (const TimeoutEntry& e : maker.entries) {
+      if (!e.verified) continue;
+      tc.votes.emplace_back(e.author, e.signature, e.high_qc_round);
+      weight += committee_.stake(e.author);
+      if (weight >= quorum) break;
+    }
+    maker.verified_weight = 0;  // ensures the TC is only made once
+    maker.weight = 0;
+    out->tc = std::move(tc);
+    return;
+  }
+  if (maker.weight >= quorum) {
+    for (const TimeoutEntry& e : maker.entries) {
+      if (e.verified) continue;
+      out->candidates.push_back({e.author, e.signature, e.high_qc_round});
+    }
+    if (!out->candidates.empty()) maker.batch_inflight = true;
+  }
 }
 
 void Aggregator::cleanup(Round round) {

@@ -1,6 +1,21 @@
 // Vote/timeout aggregation into QCs/TCs at 2f+1 stake, with authority-reuse
 // rejection and per-round garbage collection
 // (consensus/src/aggregator.rs:13-139 in the reference).
+//
+// graftview: timeout aggregation is OPTIMISTIC — timeouts are admitted
+// after structure/stake checks only (their own signatures UNVERIFIED), and
+// once 2f+1 stake accumulates the pending candidate set is handed back to
+// the Core for ONE batched signature verification (the sidecar launch that
+// replaced the per-sender host verify of handle_timeout).  Signers the
+// batch rejects are EJECTED: their entry is removed (the authority slot
+// reopens, so a spoofed timeout cannot permanently lock out the genuine
+// author), the exact rejected signature bytes are remembered (bounded) so
+// a Byzantine re-send is dropped on arrival, and aggregation re-arms with
+// the next arrivals — one bad timeout can delay TC formation by a batch
+// round-trip, never prevent it.
+//
+// Threading: owned exclusively by the consensus Core thread (OWNED_BY is
+// documentation, not locking — the Core serializes every call).
 #pragma once
 
 #include <map>
@@ -17,6 +32,15 @@ class Aggregator {
   explicit Aggregator(Committee committee)
       : committee_(std::move(committee)) {}
 
+  // One admitted-but-unverified timeout vote: what the Core's batched TC
+  // verify launch needs to rebuild the signed digest per candidate
+  // (Timeout::vote_digest(round, high_qc_round)).
+  struct TimeoutVote {
+    PublicKey author;
+    Signature signature;
+    Round high_qc_round = 0;
+  };
+
   // Returns a QC when this vote completes a quorum; error when the
   // authority already voted for this (round, digest).
   struct AddResult {
@@ -25,14 +49,37 @@ class Aggregator {
   };
   AddResult add_vote(const Vote& vote);
 
+  // add_timeout / resolve_timeouts outcome: at most one of `tc` (a sealed
+  // certificate, built from VERIFIED entries only) or `candidates` (2f+1
+  // stake is present but some entries are unverified — verify these in one
+  // batch, then call resolve_timeouts with the verdicts).  While a batch
+  // is in flight no further candidate set is issued for that round.
   struct AddTimeoutResult {
     std::string error;
     std::optional<TC> tc;
+    std::vector<TimeoutVote> candidates;
   };
-  AddTimeoutResult add_timeout(const Timeout& timeout);
+  // `pre_verified` marks a timeout whose own signature the caller already
+  // checked (the no-sidecar synchronous path keeps working unchanged).
+  AddTimeoutResult add_timeout(const Timeout& timeout,
+                               bool pre_verified = false);
+
+  // Batched-verify verdicts for a round's in-flight candidate set:
+  // `verified` authors' entries become sealable, `ejected` authors'
+  // entries are removed and their signature bytes blacklisted (bounded).
+  // Returns a TC when verified stake reaches the quorum, or a fresh
+  // candidate set when unverified arrivals (admitted during the flight)
+  // still complete one.
+  AddTimeoutResult resolve_timeouts(Round round,
+                                    const std::vector<PublicKey>& verified,
+                                    const std::vector<PublicKey>& ejected);
 
   // Drop aggregation state for rounds < round.
   void cleanup(Round round);
+
+  // Total timeout entries ejected by failed batch verdicts (telemetry;
+  // the Core logs it with the round that ejected).
+  uint64_t ejected_total() const { return ejected_total_; }
 
  private:
   struct QCMaker {
@@ -40,15 +87,44 @@ class Aggregator {
     std::vector<std::pair<PublicKey, Signature>> votes;
     std::set<PublicKey> used;
   };
-  struct TCMaker {
-    Stake weight = 0;
-    std::vector<std::tuple<PublicKey, Signature, Round>> votes;
-    std::set<PublicKey> used;
+  // Per-entry verification state rides with the vote: `verified` entries
+  // are the only ones a sealed TC may carry.
+  struct TimeoutEntry {
+    PublicKey author;
+    Signature signature;
+    Round high_qc_round = 0;
+    bool verified = false;
   };
+  struct TCMaker {
+    Stake weight = 0;           // admitted stake (verified + pending)
+    Stake verified_weight = 0;  // batch- or pre-verified stake
+    std::vector<TimeoutEntry> entries;  // OWNED_BY(core thread)
+    std::set<PublicKey> used;           // OWNED_BY(core thread)
+    // Digests of (author || signature) pairs a batch verdict ejected:
+    // the same bad bytes re-sent are refused at admission instead of
+    // costing another batch round-trip.  Populated only on MIXED batch
+    // outcomes (an all-fail batch reads as a verifier outage — see
+    // resolve_timeouts) and bounded (kRejectedCap) so a signature-
+    // flooding adversary cannot grow it without limit — past the cap
+    // new rejects are simply not remembered (they re-eject at the next
+    // batch, costing the attacker a round-trip each time).
+    std::set<Digest> rejected;          // OWNED_BY(core thread)
+    bool batch_inflight = false;
+  };
+
+  // Rejected-signature memory per round: 4 slots per authority is enough
+  // for honest re-sends while keeping the worst case a small multiple of
+  // the committee size.
+  static constexpr size_t kRejectedCapPerAuthority = 4;
+
+  static Digest signature_id(const PublicKey& author, const Signature& sig);
+  // Shared sealing/candidate logic for add_timeout and resolve_timeouts.
+  void maybe_complete(Round round, TCMaker& maker, AddTimeoutResult* out);
 
   Committee committee_;
   std::map<Round, std::map<Digest, QCMaker>> votes_aggregators_;
   std::map<Round, TCMaker> timeouts_aggregators_;
+  uint64_t ejected_total_ = 0;
 };
 
 }  // namespace consensus

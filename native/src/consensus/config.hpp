@@ -24,6 +24,21 @@ struct Parameters {
   // 3 = 3-chain (the variant behind benchmark/data/3-chain/ in the
   // reference's published results; one extra round of commit latency).
   uint32_t chain_depth = 2;
+  // graftview pacemaker hardening.  The view-change timer backs off
+  // exponentially on CONSECUTIVE no-progress rounds (reset on any QC
+  // advance or commit): delay(k) = min(cap, timeout_delay * (factor_pct /
+  // 100)^k), plus seeded per-node jitter of up to jitter_pct% for k >= 1
+  // so a storm's re-broadcast waves desynchronize instead of colliding.
+  // Defaults preserve today's behavior at depth 1 (the first timeout of a
+  // round fires after exactly timeout_delay, no jitter).
+  uint64_t timeout_backoff_factor_pct = 200;  // 200 = x2 per depth
+  uint64_t timeout_backoff_cap = 60'000;      // ms
+  uint64_t timeout_jitter_pct = 10;           // % of the backed-off delay
+  // Bounded timeout aggregation: timeouts for rounds further than this
+  // ahead of the local round are dropped (with a logged count) instead of
+  // allocating aggregation state — the attacker-controlled `round` key
+  // must not be able to grow the aggregator map without limit.
+  uint64_t timeout_future_horizon = 1'000;    // rounds
 
   static Parameters from_json(const Json& j) {
     Parameters p;
@@ -33,6 +48,25 @@ struct Parameters {
       p.chain_depth = uint32_t(v->as_u64());
       if (p.chain_depth < 2 || p.chain_depth > 3)
         throw std::runtime_error("chain_depth must be 2 or 3");
+    }
+    if (auto* v = j.find("timeout_backoff_factor_pct")) {
+      p.timeout_backoff_factor_pct = v->as_u64();
+      if (p.timeout_backoff_factor_pct < 100)
+        throw std::runtime_error(
+            "timeout_backoff_factor_pct must be >= 100 (100 = no backoff)");
+    }
+    if (auto* v = j.find("timeout_backoff_cap")) {
+      p.timeout_backoff_cap = v->as_u64();
+    }
+    if (auto* v = j.find("timeout_jitter_pct")) {
+      p.timeout_jitter_pct = v->as_u64();
+      if (p.timeout_jitter_pct > 100)
+        throw std::runtime_error("timeout_jitter_pct must be <= 100");
+    }
+    if (auto* v = j.find("timeout_future_horizon")) {
+      p.timeout_future_horizon = v->as_u64();
+      if (p.timeout_future_horizon == 0)
+        throw std::runtime_error("timeout_future_horizon must be >= 1");
     }
     return p;
   }
@@ -46,8 +80,36 @@ struct Parameters {
         << "Sync retry delay set to " << sync_retry_delay << " ms";
     LOG_INFO("consensus::config")
         << "Chain depth set to " << chain_depth;
+    LOG_INFO("consensus::config")
+        << "Timeout backoff factor set to " << timeout_backoff_factor_pct
+        << " pct";
+    LOG_INFO("consensus::config")
+        << "Timeout backoff cap set to " << timeout_backoff_cap << " ms";
+    LOG_INFO("consensus::config")
+        << "Timeout jitter set to " << timeout_jitter_pct << " pct";
+    LOG_INFO("consensus::config")
+        << "Timeout future horizon set to " << timeout_future_horizon
+        << " rounds";
   }
 };
+
+// The pacemaker's pre-jitter delay schedule at a given no-progress depth
+// (depth 0 = the round's first timer arming).  Free function so the
+// schedule is unit-testable without spinning a Core thread; the Core adds
+// its seeded jitter on top for depth >= 1.
+inline uint64_t backoff_delay_ms(const Parameters& p, uint32_t depth) {
+  uint64_t cap = p.timeout_backoff_cap > p.timeout_delay
+                     ? p.timeout_backoff_cap
+                     : p.timeout_delay;
+  double delay = double(p.timeout_delay);
+  double factor = double(p.timeout_backoff_factor_pct) / 100.0;
+  for (uint32_t i = 0; i < depth; i++) {
+    delay *= factor;
+    if (delay >= double(cap)) return cap;
+  }
+  uint64_t out = uint64_t(delay);
+  return out > cap ? cap : (out < 1 ? 1 : out);
+}
 
 struct Authority {
   Stake stake = 1;

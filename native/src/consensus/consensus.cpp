@@ -83,8 +83,8 @@ std::unique_ptr<Consensus> Consensus::spawn(
   // is destroyed at thread exit).
   c->threads_.push_back(Core::spawn(
       name, committee, signature_service, store, leader_elector,
-      mempool_driver, synchronizer, parameters.timeout_delay,
-      parameters.chain_depth, tx_core, tx_proposer_cmd, tx_commit));
+      mempool_driver, synchronizer, parameters, tx_core, tx_proposer_cmd,
+      tx_commit));
 
   c->threads_.push_back(Proposer::spawn(name, committee, signature_service,
                                         rx_mempool, tx_proposer_cmd, tx_core,

@@ -4,6 +4,7 @@
 #include <atomic>
 #include <deque>
 #include <map>
+#include <random>
 #include <set>
 #include <thread>
 
@@ -35,8 +36,8 @@ class CoreImpl {
            SignatureService signature_service, Store store,
            std::shared_ptr<LeaderElector> leader_elector,
            std::shared_ptr<MempoolDriver> mempool_driver,
-           std::shared_ptr<Synchronizer> synchronizer, uint64_t timeout_delay,
-           uint32_t chain_depth, ChannelPtr<CoreEvent> rx_event,
+           std::shared_ptr<Synchronizer> synchronizer, Parameters params,
+           ChannelPtr<CoreEvent> rx_event,
            ChannelPtr<ProposerMessage> tx_proposer,
            ChannelPtr<Block> tx_commit)
       : name_(name),
@@ -46,12 +47,13 @@ class CoreImpl {
         leader_elector_(std::move(leader_elector)),
         mempool_driver_(std::move(mempool_driver)),
         synchronizer_(std::move(synchronizer)),
-        timeout_delay_(timeout_delay),
-        chain_depth_(chain_depth),
+        params_(params),
+        chain_depth_(params.chain_depth),
         rx_event_(std::move(rx_event)),
         tx_proposer_(std::move(tx_proposer)),
         tx_commit_(std::move(tx_commit)),
-        aggregator_(committee_) {}
+        aggregator_(committee_),
+        jitter_rng_(jitter_seed(name)) {}
 
   void run() {
     // Crash recovery first: a restarted replica resumes at its persisted
@@ -77,6 +79,9 @@ class CoreImpl {
         result = process_block(event.block);
       } else if (event.kind == CoreEvent::Kind::kVerdict) {
         result = handle_verdict(event.block, event.verdict);
+      } else if (event.kind == CoreEvent::Kind::kTcVerdict) {
+        result = resolve_tc_batch(event.tc_round, event.tc_gen,
+                                  event.verdict);
       } else {
         switch (event.message.kind) {
           case ConsensusMessage::Kind::kPropose:
@@ -105,10 +110,34 @@ class CoreImpl {
  private:
   // -- timer ---------------------------------------------------------------
 
-  void reset_timer() {
-    timer_deadline_ = std::chrono::steady_clock::now() +
-                      std::chrono::milliseconds(timeout_delay_);
+  // Per-node deterministic jitter seed: fold the public key's bytes so
+  // every replica draws a DIFFERENT (but reproducible) jitter sequence —
+  // the point of pacemaker jitter is desynchronizing the committee's
+  // timeout waves, which a shared seed would defeat.
+  static uint64_t jitter_seed(const PublicKey& name) {
+    uint64_t seed = 0x9e3779b97f4a7c15ULL;
+    for (size_t i = 0; i < name.data.size(); i++) {
+      seed = seed * 131 + name.data[i];
+    }
+    return seed;
   }
+
+  // graftview pacemaker: exponential backoff with a cap on consecutive
+  // no-progress rounds (schedule in config.hpp backoff_delay_ms), plus
+  // seeded jitter at depth >= 1.  Depth 0 — every healthy round — arms
+  // after exactly timeout_delay, today's behavior.
+  void reset_timer() {
+    uint64_t delay = backoff_delay_ms(params_, consecutive_timeouts_);
+    if (consecutive_timeouts_ > 0 && params_.timeout_jitter_pct > 0) {
+      uint64_t span = delay * params_.timeout_jitter_pct / 100;
+      if (span > 0) delay += jitter_rng_() % (span + 1);
+    }
+    timer_deadline_ = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(delay);
+  }
+
+  // Any certificate progress re-arms the pacemaker at depth 0.
+  void note_progress() { consecutive_timeouts_ = 0; }
 
   // -- persistence ---------------------------------------------------------
 
@@ -171,6 +200,7 @@ class CoreImpl {
 
     last_committed_round_ = block.round;
     state_dirty_ = true;
+    note_progress();
 
     for (const Block& b : to_commit) {
       trace_stage("commit", b);
@@ -195,15 +225,22 @@ class CoreImpl {
     if (qc.round > high_qc_.round) {
       high_qc_ = qc;
       state_dirty_ = true;
+      // QC progress: the pacemaker's backoff depth resets (a TC advance
+      // deliberately does NOT — consecutive view changes keep backing
+      // off until a certificate or commit proves the system is moving).
+      note_progress();
     }
   }
 
   void advance_round(Round round) {
     if (round < round_) return;
-    reset_timer();
     round_ = round + 1;
+    reset_timer();
     LOG_DEBUG("consensus::core") << "Moved to round " << round_;
     aggregator_.cleanup(round_);
+    tc_batches_.erase(tc_batches_.begin(), tc_batches_.lower_bound(round_));
+    tc_inline_rounds_.erase(tc_inline_rounds_.begin(),
+                            tc_inline_rounds_.lower_bound(round_));
     state_dirty_ = true;
   }
 
@@ -288,6 +325,7 @@ class CoreImpl {
 
   void local_timeout_round() {
     LOG_WARN("consensus::core") << "Timeout reached for round " << round_;
+    consecutive_timeouts_++;  // backoff depth; reset on QC/commit progress
     increase_last_voted_round(round_);
     Timeout timeout =
         Timeout::make(high_qc_, round_, name_, signature_service_);
@@ -301,33 +339,206 @@ class CoreImpl {
     if (!r.ok()) LOG_WARN("consensus::core") << r.error;
   }
 
+  // graftview: optimistic batched TC assembly.  Arriving timeouts are
+  // admitted into the aggregator after structure/stake checks only — the
+  // per-sender host signature verify that used to run inline here was the
+  // N=100 fault-path wall (one synchronous ed25519 per timeout on the
+  // core thread, during the exact storm the system is trying to survive).
+  // Once 2f+1 stake accumulates, the candidate set's own signatures are
+  // verified in ONE batch: asynchronously through the sidecar when it has
+  // pipeline room (all honest timeouts for a round share the
+  // (round, high_qc_round) digest, so the batch is QC-shaped and rides
+  // the warmed verify path + verdict cache), else one synchronous
+  // verify_batch_multi (sidecar or host loop).  A failed batch ejects the
+  // bad signers per-signature host-side and re-arms with later arrivals.
   VerifyResult handle_timeout(const Timeout& timeout) {
     if (timeout.round < round_) return VerifyResult::good();
-    // Own signature first, then the embedded high QC through the verified
-    // cache: during a view change the 2f+1 timeouts typically all carry
-    // the same high QC — one signature batch instead of 2f+1.
-    VerifyResult valid = timeout.verify_own(committee_);
+    // Bounded aggregation: a flood of timeouts for round r + 10^9 must
+    // not allocate per-round state forever.  Dropped count is logged on
+    // powers of two so a storm costs O(log n) log lines.
+    if (timeout.round > round_ + params_.timeout_future_horizon) {
+      dropped_future_timeouts_++;
+      if ((dropped_future_timeouts_ & (dropped_future_timeouts_ - 1)) == 0) {
+        LOG_WARN("consensus::core")
+            << "Dropped " << dropped_future_timeouts_
+            << " future-round timeout(s) beyond horizon (round "
+            << timeout.round << " > " << round_ << " + "
+            << params_.timeout_future_horizon << ")";
+      }
+      return VerifyResult::good();
+    }
+    if (committee_.stake(timeout.author) == 0) {
+      return VerifyResult::bad("unknown timeout author: " +
+                               timeout.author.to_base64());
+    }
+    // The embedded high QC is self-certifying (its own signature quorum),
+    // so verifying and processing it before the timeout's own signature
+    // is safe — and during a view change the 2f+1 timeouts typically all
+    // carry the same high QC: one cached verification instead of 2f+1.
+    VerifyResult valid = verify_qc_cached(timeout.high_qc);
     if (!valid.ok()) return valid;
-    valid = verify_qc_cached(timeout.high_qc);
-    if (!valid.ok()) return valid;
-
     process_qc(timeout.high_qc);
+    if (timeout.round < round_) return VerifyResult::good();  // QC moved us
 
-    auto added = aggregator_.add_timeout(timeout);
+    // A lost batch verdict (the reply channel was full) must delay TC
+    // formation by one expiry, never wedge it: re-resolve as a transport
+    // failure (host per-signature) before admitting more arrivals.
+    auto inflight = tc_batches_.find(timeout.round);
+    if (inflight != tc_batches_.end() &&
+        std::chrono::steady_clock::now() >= inflight->second.expires) {
+      LOG_WARN("consensus::core")
+          << "TC batch verdict for round " << timeout.round
+          << " expired; resolving on host";
+      VerifyResult r = resolve_tc_batch(timeout.round,
+                                        inflight->second.gen, std::nullopt);
+      if (!r.ok()) return r;
+      // The host resolve may have sealed the TC and advanced the round:
+      // this timeout is then stale and must not re-create aggregation
+      // state for a round the cleanup already dropped.
+      if (timeout.round < round_) return VerifyResult::good();
+    }
+
+    // Optimism is per round and one strike: once a batch for this round
+    // ejected ANY signer, later arrivals verify inline (the old per-sig
+    // admission, pre-verified entries).  Without this, a spoofer racing
+    // the genuine authors could re-occupy the reopened slots with fresh
+    // garbage bytes faster than the backed-off honest re-broadcasts
+    // return, starving TC formation batch after batch; with it, a
+    // Byzantine flood wastes exactly one batch round-trip per round
+    // before the round degrades to the unspoofable path.
+    bool inline_verify = tc_inline_rounds_.count(timeout.round) != 0;
+    if (inline_verify) {
+      VerifyResult own = timeout.verify_own(committee_);
+      if (!own.ok()) return own;
+    }
+    auto added = aggregator_.add_timeout(timeout, inline_verify);
     if (!added.error.empty()) return VerifyResult::bad(added.error);
-    if (added.tc) {
-      // Formed from individually verified timeouts (see the QC analogue in
-      // handle_vote).
-      cert_insert(added.tc->content_digest());
-      advance_round(added.tc->round);
-      std::vector<Address> addresses;
-      for (const auto& [_, addr] : committee_.broadcast_addresses(name_)) {
-        addresses.push_back(addr);
+    if (added.tc) return finish_tc(std::move(*added.tc));
+    if (!added.candidates.empty()) {
+      return dispatch_tc_batch(timeout.round, std::move(added.candidates));
+    }
+    return VerifyResult::good();
+  }
+
+  // One batched verification launch over a round's unverified timeout
+  // candidates.  Async when the sidecar has pipeline room (the verdict
+  // loops back as a kTcVerdict event); synchronous otherwise.
+  VerifyResult dispatch_tc_batch(
+      Round round, std::vector<Aggregator::TimeoutVote> cands) {
+    uint64_t gen = ++tc_batch_gen_;
+    std::vector<std::tuple<Digest, PublicKey, Signature>> items;
+    items.reserve(cands.size());
+    for (const auto& c : cands) {
+      items.emplace_back(Timeout::vote_digest(round, c.high_qc_round),
+                         c.author, c.signature);
+    }
+    LOG_DEBUG("consensus::core")
+        << "Batched TC verify for round " << round << ": " << items.size()
+        << " timeout signature(s), one launch";
+    if (Signature::async_available()) {
+      int deadline_ms = 2 * TpuVerifier::kRecvTimeoutMs;
+      tc_batches_[round] = TcBatch{
+          gen, std::move(cands),
+          std::chrono::steady_clock::now() +
+              std::chrono::milliseconds(deadline_ms)};
+      auto ch = rx_event_;
+      // Context tag (protocol v5): the round's shared timeout digest —
+      // one stable tag per (round, high_qc wave), so the sidecar's stage
+      // spans for the view-change batch are joinable like a block's.
+      Digest ctx = Timeout::vote_digest(round, round);
+      Signature::verify_batch_multi_async(
+          std::move(items),
+          [ch, round, gen](std::optional<bool> ok) {
+            ch->try_send(CoreEvent::tc_verdict(round, gen, ok));
+          },
+          &ctx);
+      return VerifyResult::good();
+    }
+    // Synchronous path: still ONE batch (a connected sidecar without
+    // async budget, or the host loop), resolved inline.
+    tc_batches_[round] =
+        TcBatch{gen, std::move(cands), std::chrono::steady_clock::now()};
+    bool ok = Signature::verify_batch_multi(items);
+    return resolve_tc_batch(round, gen, ok);
+  }
+
+  // Completion of a batched TC verify.  ok=true: every candidate's
+  // signature held — seal.  ok=false/nullopt: find the bad signers by
+  // per-signature HOST verification (bit-equivalent to the verify_own
+  // the optimistic path skipped) and eject exactly those, so the
+  // accepted set is identical to what per-signature admission would
+  // have built.
+  VerifyResult resolve_tc_batch(Round round, uint64_t gen,
+                                std::optional<bool> ok) {
+    auto it = tc_batches_.find(round);
+    if (it == tc_batches_.end() || it->second.gen != gen) {
+      return VerifyResult::good();  // stale verdict: round re-armed/moved
+    }
+    std::vector<Aggregator::TimeoutVote> cands = std::move(it->second.cands);
+    tc_batches_.erase(it);
+    std::vector<PublicKey> verified, ejected;
+    if (ok.has_value() && *ok) {
+      verified.reserve(cands.size());
+      for (const auto& c : cands) verified.push_back(c.author);
+    } else {
+      for (const auto& c : cands) {
+        if (c.signature.verify(Timeout::vote_digest(round, c.high_qc_round),
+                               c.author)) {
+          verified.push_back(c.author);
+        } else {
+          ejected.push_back(c.author);
+        }
       }
-      network_.broadcast(addresses, ConsensusMessage::tc_msg(*added.tc));
-      if (name_ == leader_elector_->get_leader(round_)) {
-        generate_proposal(std::move(added.tc));
+      if (!ejected.empty()) {
+        LOG_WARN("consensus::core")
+            << "Ejected " << ejected.size()
+            << " invalid timeout signer(s) for round " << round
+            << " (batched TC verify failed; per-signature fallback)";
+        // One strike: this round's later arrivals verify inline (see
+        // handle_timeout) so re-spoofed slots cannot waste another
+        // batch.  Bounded by the same horizon/advance cleanup as the
+        // batches themselves.
+        tc_inline_rounds_.insert(round);
       }
+    }
+    auto res = aggregator_.resolve_timeouts(round, verified, ejected);
+    if (!res.error.empty()) return VerifyResult::bad(res.error);
+    if (res.tc) return finish_tc(std::move(*res.tc));
+    if (!res.candidates.empty()) {
+      // Arrivals during the batch flight completed another quorum.
+      return dispatch_tc_batch(round, std::move(res.candidates));
+    }
+    return VerifyResult::good();
+  }
+
+  // TC-driven round advance: the ONE emitter of the "View change" line
+  // (a frozen grammar hotstuff_tpu/harness/logs.py mines for the
+  // view-change notes and the strict leader-cascade assertion — change
+  // both sides together), shared by the formed-here and received paths.
+  void advance_round_via_tc(Round tc_round) {
+    Round prev = round_;
+    advance_round(tc_round);
+    if (round_ > prev) {
+      LOG_INFO("consensus::core")
+          << "View change: round " << prev << " -> " << round_ << " via TC";
+    }
+  }
+
+  // A TC sealed from batch-verified timeouts: certify, advance, share.
+  VerifyResult finish_tc(TC tc) {
+    // NOTE: the "Formed TC" phrasing is mined by logs.py too.
+    LOG_INFO("consensus::core")
+        << "Formed TC for round " << tc.round << " (" << tc.votes.size()
+        << " timeouts, batched verify)";
+    cert_insert(tc.content_digest());
+    advance_round_via_tc(tc.round);
+    std::vector<Address> addresses;
+    for (const auto& [_, addr] : committee_.broadcast_addresses(name_)) {
+      addresses.push_back(addr);
+    }
+    network_.broadcast(addresses, ConsensusMessage::tc_msg(tc));
+    if (name_ == leader_elector_->get_leader(round_)) {
+      generate_proposal(std::move(tc));
     }
     return VerifyResult::good();
   }
@@ -340,7 +551,7 @@ class CoreImpl {
     // run). Verify before trusting the round number.
     VerifyResult valid = verify_tc_cached(tc);
     if (!valid.ok()) return valid;
-    advance_round(tc.round);
+    advance_round_via_tc(tc.round);
     if (name_ == leader_elector_->get_leader(round_)) {
       generate_proposal(tc);
     }
@@ -679,7 +890,7 @@ class CoreImpl {
   std::shared_ptr<LeaderElector> leader_elector_;
   std::shared_ptr<MempoolDriver> mempool_driver_;
   std::shared_ptr<Synchronizer> synchronizer_;
-  uint64_t timeout_delay_;
+  Parameters params_;
   uint32_t chain_depth_ = 2;
   bool state_dirty_ = false;
   ChannelPtr<CoreEvent> rx_event_;
@@ -693,6 +904,26 @@ class CoreImpl {
   Aggregator aggregator_;
   SimpleSender network_;
   std::chrono::steady_clock::time_point timer_deadline_;
+
+  // graftview pacemaker + batched TC assembly (all core-thread-owned).
+  // consecutive_timeouts_ is the backoff depth; the rng draws the seeded
+  // per-node jitter; tc_batches_ tracks the one in-flight batched verify
+  // per round (generation-tagged so a stale verdict after an expiry
+  // re-arm cannot resolve the wrong snapshot), bounded by the same
+  // future-round horizon that bounds the aggregator.
+  struct TcBatch {
+    uint64_t gen = 0;
+    std::vector<Aggregator::TimeoutVote> cands;
+    std::chrono::steady_clock::time_point expires;
+  };
+  uint32_t consecutive_timeouts_ = 0;
+  uint64_t dropped_future_timeouts_ = 0;
+  uint64_t tc_batch_gen_ = 0;
+  std::map<Round, TcBatch> tc_batches_;
+  // Rounds whose optimism expired (a batch ejected someone): later
+  // timeout arrivals for these rounds verify inline at admission.
+  std::set<Round> tc_inline_rounds_;
+  std::mt19937_64 jitter_rng_;
 
   // Async-verify bookkeeping: block digests with a device verdict in
   // flight (value = expiry, after which a re-delivered copy re-verifies),
@@ -710,7 +941,7 @@ std::thread Core::spawn(PublicKey name, Committee committee,
                         std::shared_ptr<LeaderElector> leader_elector,
                         std::shared_ptr<MempoolDriver> mempool_driver,
                         std::shared_ptr<Synchronizer> synchronizer,
-                        uint64_t timeout_delay, uint32_t chain_depth,
+                        Parameters parameters,
                         ChannelPtr<CoreEvent> rx_event,
                         ChannelPtr<ProposerMessage> tx_proposer,
                         ChannelPtr<Block> tx_commit) {
@@ -719,8 +950,8 @@ std::thread Core::spawn(PublicKey name, Committee committee,
     CoreImpl core(name, std::move(committee), std::move(signature_service),
                   std::move(store), std::move(leader_elector),
                   std::move(mempool_driver), std::move(synchronizer),
-                  timeout_delay, chain_depth, std::move(rx_event),
-                  std::move(tx_proposer), std::move(tx_commit));
+                  parameters, std::move(rx_event), std::move(tx_proposer),
+                  std::move(tx_commit));
     core.run();
   });
 }

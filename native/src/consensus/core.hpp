@@ -27,13 +27,20 @@ namespace consensus {
 // to the verify latency the reference pays synchronously
 // (messages.rs:180-198).
 struct CoreEvent {
-  enum class Kind { kMessage, kLoopback, kVerdict };
+  enum class Kind { kMessage, kLoopback, kVerdict, kTcVerdict };
   Kind kind = Kind::kMessage;
   ConsensusMessage message;  // kMessage
   Block block;               // kLoopback, kVerdict
   // kVerdict: true/false = device verdict on the block's certificates;
   // nullopt = transport failure, re-verify synchronously (host fallback).
   std::optional<bool> verdict;
+  // kTcVerdict (graftview): completion loopback of a BATCHED timeout-set
+  // verification — the round whose TC candidate set was launched, the
+  // batch generation (stale verdicts for a re-armed round are ignored),
+  // and the overall verdict (nullopt = transport failure; false = at
+  // least one bad signer — the Core ejects per-signature host-side).
+  Round tc_round = 0;
+  uint64_t tc_gen = 0;
 
   static CoreEvent loopback(Block b) {
     CoreEvent e;
@@ -54,6 +61,15 @@ struct CoreEvent {
     e.verdict = ok;
     return e;
   }
+  static CoreEvent tc_verdict(Round round, uint64_t gen,
+                              std::optional<bool> ok) {
+    CoreEvent e;
+    e.kind = Kind::kTcVerdict;
+    e.tc_round = round;
+    e.tc_gen = gen;
+    e.verdict = ok;
+    return e;
+  }
 };
 
 struct ProposerMessage {
@@ -68,12 +84,16 @@ struct ProposerMessage {
 class Core {
  public:
   // Returns the replica thread; it exits when rx_event is closed.
+  // `parameters` carries every consensus tunable (timeout/backoff
+  // schedule, chain depth, aggregation bounds) — graftview replaced the
+  // old (timeout_delay, chain_depth) argument pair so the pacemaker
+  // knobs flow through without widening this signature again.
   static std::thread spawn(PublicKey name, Committee committee,
                            SignatureService signature_service, Store store,
                            std::shared_ptr<LeaderElector> leader_elector,
                            std::shared_ptr<MempoolDriver> mempool_driver,
                            std::shared_ptr<Synchronizer> synchronizer,
-                           uint64_t timeout_delay, uint32_t chain_depth,
+                           Parameters parameters,
                            ChannelPtr<CoreEvent> rx_event,
                            ChannelPtr<ProposerMessage> tx_proposer,
                            ChannelPtr<Block> tx_commit);

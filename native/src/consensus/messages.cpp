@@ -159,11 +159,8 @@ std::vector<std::tuple<Digest, PublicKey, Signature>> TC::vote_items()
   std::vector<std::tuple<Digest, PublicKey, Signature>> items;
   items.reserve(votes.size());
   for (const auto& [author, sig, high_qc_round] : votes) {
-    Digest d = DigestBuilder()
-                   .update_u64_le(round)
-                   .update_u64_le(high_qc_round)
-                   .finalize();
-    items.emplace_back(d, author, sig);
+    items.emplace_back(Timeout::vote_digest(round, high_qc_round), author,
+                       sig);
   }
   return items;
 }
@@ -328,13 +325,15 @@ Timeout Timeout::make(QC high_qc, Round round, const PublicKey& author,
   return t;
 }
 
-Digest Timeout::digest() const {
+Digest Timeout::vote_digest(Round round, Round high_qc_round) {
   // round LE || high_qc.round LE (messages.rs:267-273).
   return DigestBuilder()
       .update_u64_le(round)
-      .update_u64_le(high_qc.round)
+      .update_u64_le(high_qc_round)
       .finalize();
 }
+
+Digest Timeout::digest() const { return vote_digest(round, high_qc.round); }
 
 VerifyResult Timeout::verify_own(const Committee& committee) const {
   if (committee.stake(author) == 0) {

@@ -132,6 +132,14 @@ struct Timeout {
   static Timeout make(QC high_qc, Round round, const PublicKey& author,
                       const SignatureService& service);
 
+  // The digest a timeout vote signs: round LE || high_qc_round LE
+  // (messages.rs:267-273).  Exposed statically because THREE layers must
+  // agree byte-for-byte on it: Timeout::digest() at signing time,
+  // TC::vote_items() when a formed TC's batch re-verifies, and the
+  // Core's per-signature eject loop when a batched TC verify fails
+  // (graftview) — a divergence would make the eject path accept/reject
+  // different sets than per-signature verification.
+  static Digest vote_digest(Round round, Round high_qc_round);
   Digest digest() const;
   VerifyResult verify(const Committee& committee) const;
   // Author + signature checks only — without the embedded high_qc, which

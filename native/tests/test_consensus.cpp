@@ -85,6 +85,14 @@ struct CoreFixture {
   // Spawns a core for fixture key `idx` with the given committee.
   void spawn_core(size_t idx, const Committee& committee,
                   uint64_t timeout_delay = 60'000, uint32_t chain_depth = 2) {
+    Parameters params;
+    params.timeout_delay = timeout_delay;
+    params.chain_depth = chain_depth;
+    spawn_core_params(idx, committee, params);
+  }
+
+  void spawn_core_params(size_t idx, const Committee& committee,
+                         const Parameters& params) {
     auto kp = keys()[idx];
     SignatureService service(kp.secret);
     auto leader_elector = std::make_shared<LeaderElector>(committee);
@@ -94,8 +102,7 @@ struct CoreFixture {
         kp.name, committee, store, tx_core, /*sync_retry_delay=*/60'000);
     core_thread = Core::spawn(kp.name, committee, service, store,
                               leader_elector, mempool_driver, synchronizer,
-                              timeout_delay, chain_depth, tx_core,
-                              tx_proposer, tx_commit);
+                              params, tx_core, tx_proposer, tx_commit);
   }
 
   ~CoreFixture() {
@@ -347,6 +354,325 @@ TEST(core_restores_persisted_state_after_restart) {
   CHECK(msg.timeout.round == 3);
   CHECK(msg.timeout.verify(committee).ok());
   for (auto& t : threads) t.join();
+}
+
+// ---------------------------------------------------------------------------
+// graftview: optimistic batched TC assembly + pacemaker hardening
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// A timeout for `round` from fixture key `i`; valid=false forges the
+// author with a garbage signature (the spoof a Byzantine peer can send
+// now that admission defers signature verification to the batch).
+consensus::Timeout make_timeout(size_t i, uint64_t round,
+                                bool valid = true) {
+  consensus::Timeout t;
+  t.round = round;
+  t.author = keys()[i].name;
+  if (valid) {
+    t.signature = Signature::sign(
+        consensus::Timeout::vote_digest(round, t.high_qc.round),
+        keys()[i].secret);
+  }
+  return t;  // default signature = 64 zero bytes, never verifies
+}
+
+}  // namespace
+
+TEST(aggregator_batched_timeout_eject_matches_per_sig) {
+  // The eject path must accept/reject EXACTLY the sets per-signature
+  // verification would: a spoofed entry is ejected when the batch
+  // verdict (realized here as the per-sig loop the Core runs on batch
+  // failure) rejects it, the authority slot reopens for the genuine
+  // author, the same bad bytes are refused at admission, and the sealed
+  // TC re-verifies per-signature.
+  auto committee = consensus_committee(8650);
+  Aggregator agg(committee);
+  auto ks = keys();
+  const uint64_t round = 7;
+
+  CHECK(agg.add_timeout(make_timeout(2, round, false)).candidates.empty());
+  CHECK(agg.add_timeout(make_timeout(0, round)).candidates.empty());
+  auto res = agg.add_timeout(make_timeout(1, round));
+  CHECK(!res.tc.has_value());
+  CHECK(res.candidates.size() == 3);  // quorum stake present, unverified
+
+  // The batch verdict: per-signature host verification (what the Core
+  // does when the one-launch verdict comes back false).
+  std::vector<PublicKey> good, bad;
+  for (const auto& c : res.candidates) {
+    if (c.signature.verify(
+            consensus::Timeout::vote_digest(round, c.high_qc_round),
+            c.author)) {
+      good.push_back(c.author);
+    } else {
+      bad.push_back(c.author);
+    }
+  }
+  CHECK(good.size() == 2);
+  CHECK(bad.size() == 1 && bad[0] == ks[2].name);
+
+  auto after = agg.resolve_timeouts(round, good, bad);
+  CHECK(!after.tc.has_value());        // quorum lost: delay, not a TC
+  CHECK(after.candidates.empty());
+  CHECK(agg.ejected_total() == 1);
+
+  // The identical bad bytes re-sent are refused without another batch.
+  CHECK(!agg.add_timeout(make_timeout(2, round, false)).error.empty());
+
+  // ... but the GENUINE author's honest timeout re-completes the quorum:
+  // one Byzantine spoof delayed TC formation, it could not prevent it.
+  auto res2 = agg.add_timeout(make_timeout(2, round));
+  CHECK(res2.candidates.size() == 1);
+  auto sealed = agg.resolve_timeouts(round, {ks[2].name}, {});
+  CHECK(sealed.tc.has_value());
+  CHECK(sealed.tc->votes.size() == 3);
+  CHECK(sealed.tc->verify(committee).ok());  // per-signature re-verify
+}
+
+TEST(aggregator_all_fail_batch_does_not_blacklist) {
+  // An ALL-fail batch reads as a verifier outage (scheme=bls with a
+  // dead sidecar has no host pairing: every honest signature fails), so
+  // the bytes are NOT blacklisted — the deterministic honest
+  // re-broadcasts re-enter once the verifier is back, and the round can
+  // still form its TC.  Only a MIXED outcome (some candidate verified)
+  // proves the failures are genuinely bad signatures worth remembering.
+  auto committee = consensus_committee(8655);
+  Aggregator agg(committee);
+  auto ks = keys();
+  const uint64_t round = 9;
+  agg.add_timeout(make_timeout(0, round));
+  agg.add_timeout(make_timeout(1, round));
+  auto res = agg.add_timeout(make_timeout(2, round));
+  CHECK(res.candidates.size() == 3);
+  // Outage: everyone "failed" — eject all, blacklist none.
+  auto after = agg.resolve_timeouts(
+      round, {}, {ks[0].name, ks[1].name, ks[2].name});
+  CHECK(!after.tc.has_value());
+  // The SAME bytes re-sent are re-admitted (not "previously ejected")
+  // and complete the quorum once the verifier answers honestly.
+  CHECK(agg.add_timeout(make_timeout(0, round)).error.empty());
+  CHECK(agg.add_timeout(make_timeout(1, round)).error.empty());
+  auto res2 = agg.add_timeout(make_timeout(2, round));
+  CHECK(res2.candidates.size() == 3);
+  auto sealed = agg.resolve_timeouts(
+      round, {ks[0].name, ks[1].name, ks[2].name}, {});
+  CHECK(sealed.tc.has_value());
+  CHECK(sealed.tc->verify(committee).ok());
+}
+
+TEST(aggregator_pre_verified_timeouts_seal_without_batch) {
+  // The synchronous path (no sidecar pipeline room) verifies inline and
+  // admits pre-verified entries: the third one seals directly, no
+  // candidate round-trip.
+  auto committee = consensus_committee(8660);
+  Aggregator agg(committee);
+  CHECK(agg.add_timeout(make_timeout(0, 3), true).candidates.empty());
+  CHECK(agg.add_timeout(make_timeout(1, 3), true).candidates.empty());
+  auto res = agg.add_timeout(make_timeout(2, 3), true);
+  CHECK(res.candidates.empty());
+  CHECK(res.tc.has_value());
+  CHECK(res.tc->verify(committee).ok());
+}
+
+TEST(aggregator_rejects_unknown_timeout_author) {
+  // Stake check moved to admission: with signatures unverified until the
+  // batch, this is what bounds aggregation state to the committee.
+  auto committee = consensus_committee(8670);
+  Aggregator agg(committee);
+  std::array<uint8_t, 32> seed{};
+  seed[0] = 77;
+  auto unknown = keypair_from_seed(seed);
+  consensus::Timeout t;
+  t.round = 2;
+  t.author = unknown.name;
+  t.signature = Signature::sign(t.digest(), unknown.secret);
+  auto res = agg.add_timeout(t);
+  CHECK(!res.error.empty());
+  CHECK(res.error.find("unknown timeout author") != std::string::npos);
+}
+
+TEST(core_forms_tc_batched_with_spoofed_signer_ejected) {
+  // End to end through the Core's event loop: a spoofed timeout is
+  // admitted optimistically, the quorum-triggered batch verify ejects
+  // it (per-sig host fallback), and the genuine author's later timeout
+  // completes the TC — which every peer receives and verifies.
+  auto committee = consensus_committee(8850);
+  auto ks = keys();
+  auto delivered = make_channel<Bytes>();
+  std::vector<std::thread> threads;
+  for (const auto& [name, addr] :
+       committee.broadcast_addresses(ks[0].name)) {
+    auto l = Listener::bind(addr);
+    CHECK(l.has_value());
+    threads.push_back(listener(std::move(*l), [delivered](Bytes b) {
+      delivered->send(std::move(b));
+    }));
+  }
+  CoreFixture fx;
+  fx.spawn_core(0, committee);  // timer far away (60 s)
+  // Spoof first so it occupies k1's authority slot before the genuine
+  // timeout could; then two honest timeouts complete the quorum stake.
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(1, 1, false)))));
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(2, 1)))));
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(3, 1)))));
+  // Quorum reached -> batch verify (host loop, no sidecar) -> spoof
+  // ejected -> no TC yet.  The genuine k1 timeout re-completes it.
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(1, 1)))));
+  auto got = delivered->recv();
+  CHECK(got.has_value());
+  auto msg = ConsensusMessage::deserialize(*got);
+  CHECK(msg.kind == ConsensusMessage::Kind::kTC);
+  CHECK(msg.tc.round == 1);
+  CHECK(msg.tc.votes.size() == 3);
+  CHECK(msg.tc.verify(committee).ok());
+  // The spoofed signature is NOT in the sealed set: the accepted set is
+  // exactly what per-signature admission would have built.
+  for (const auto& [author, sig, hq] : msg.tc.votes) {
+    CHECK(sig.verify(consensus::Timeout::vote_digest(1, hq), author));
+  }
+  for (auto& t : threads) t.join();
+}
+
+TEST(core_spoof_flood_cannot_starve_tc_formation) {
+  // One-strike optimism: after a batch ejects a spoof, the round falls
+  // back to inline per-signature admission — a spoofer re-occupying the
+  // reopened slot with FRESH garbage bytes is now rejected at arrival
+  // (it cannot waste a second batch or block the genuine author), and
+  // the honest re-broadcasts still complete the TC.
+  auto committee = consensus_committee(8870);
+  auto ks = keys();
+  auto delivered = make_channel<Bytes>();
+  std::vector<std::thread> threads;
+  for (const auto& [name, addr] :
+       committee.broadcast_addresses(ks[0].name)) {
+    auto l = Listener::bind(addr);
+    CHECK(l.has_value());
+    threads.push_back(listener(std::move(*l), [delivered](Bytes b) {
+      delivered->send(std::move(b));
+    }));
+  }
+  CoreFixture fx;
+  fx.spawn_core(0, committee);
+  // Spoofs for TWO authors + one honest timeout reach quorum stake;
+  // the batch ejects both spoofs (round goes inline).
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(1, 1, false)))));
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(2, 1, false)))));
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(3, 1)))));
+  // The attacker races the reopened slots with FRESH garbage (distinct
+  // bytes, so the blacklist alone would not catch them): inline
+  // admission rejects each without a batch.
+  for (int wave = 0; wave < 3; wave++) {
+    consensus::Timeout spoof = make_timeout(1, 1, false);
+    spoof.signature.data[0] = uint8_t(7 + wave);  // fresh bytes per wave
+    fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+        ConsensusMessage::timeout_msg(spoof))));
+  }
+  // The genuine authors' honest re-broadcasts complete the quorum.
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(1, 1)))));
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(2, 1)))));
+  auto got = delivered->recv();
+  CHECK(got.has_value());
+  auto msg = ConsensusMessage::deserialize(*got);
+  CHECK(msg.kind == ConsensusMessage::Kind::kTC);
+  CHECK(msg.tc.round == 1);
+  CHECK(msg.tc.verify(committee).ok());
+  for (auto& t : threads) t.join();
+}
+
+TEST(core_drops_future_round_timeout_flood) {
+  // Bounded timeout aggregation: a flood of timeouts for rounds far past
+  // the horizon is dropped without consuming authority slots or
+  // aggregation state — afterwards a legitimate in-horizon view change
+  // still completes from the same authors.
+  auto committee = consensus_committee(8860);
+  auto ks = keys();
+  auto delivered = make_channel<Bytes>();
+  std::vector<std::thread> threads;
+  for (const auto& [name, addr] :
+       committee.broadcast_addresses(ks[0].name)) {
+    auto l = Listener::bind(addr);
+    CHECK(l.has_value());
+    threads.push_back(listener(std::move(*l), [delivered](Bytes b) {
+      delivered->send(std::move(b));
+    }));
+  }
+  CoreFixture fx;
+  Parameters params;
+  params.timeout_delay = 60'000;
+  params.timeout_future_horizon = 5;
+  fx.spawn_core_params(0, committee, params);
+  // 100 far-future rounds from every authority: all dropped (the
+  // aggregator map must not grow a TCMaker per attacker-chosen round).
+  for (uint64_t r = 1'000'000'000; r < 1'000'000'100; r++) {
+    fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+        ConsensusMessage::timeout_msg(make_timeout(1, r, false)))));
+  }
+  // An in-horizon view change for round 6 (= round_ 1 + horizon 5) from
+  // the same authors completes: nothing was consumed by the flood.
+  for (size_t i = 1; i <= 3; i++) {
+    fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+        ConsensusMessage::timeout_msg(make_timeout(i, 6)))));
+  }
+  auto got = delivered->recv();
+  CHECK(got.has_value());
+  auto msg = ConsensusMessage::deserialize(*got);
+  CHECK(msg.kind == ConsensusMessage::Kind::kTC);
+  CHECK(msg.tc.round == 6);
+  CHECK(msg.tc.verify(committee).ok());
+  for (auto& t : threads) t.join();
+}
+
+TEST(backoff_schedule_exponential_capped) {
+  Parameters p;
+  p.timeout_delay = 1'000;
+  p.timeout_backoff_factor_pct = 200;
+  p.timeout_backoff_cap = 7'000;
+  CHECK(backoff_delay_ms(p, 0) == 1'000);  // today's behavior at depth 1
+  CHECK(backoff_delay_ms(p, 1) == 2'000);
+  CHECK(backoff_delay_ms(p, 2) == 4'000);
+  CHECK(backoff_delay_ms(p, 3) == 7'000);  // capped
+  CHECK(backoff_delay_ms(p, 50) == 7'000);  // deep storms cannot overflow
+  p.timeout_backoff_factor_pct = 100;  // flat schedule = legacy pacemaker
+  CHECK(backoff_delay_ms(p, 9) == 1'000);
+  p.timeout_backoff_factor_pct = 150;
+  CHECK(backoff_delay_ms(p, 1) == 1'500);
+  p.timeout_backoff_cap = 10;  // a cap below the base never undercuts it
+  CHECK(backoff_delay_ms(p, 0) == 1'000);
+  CHECK(backoff_delay_ms(p, 5) == 1'000);
+}
+
+TEST(parameters_reject_bad_pacemaker_values) {
+  bool threw = false;
+  try {
+    Parameters::from_json(Json::parse("{\"timeout_backoff_factor_pct\": 50}"));
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+  threw = false;
+  try {
+    Parameters::from_json(Json::parse("{\"timeout_future_horizon\": 0}"));
+  } catch (const std::exception&) {
+    threw = true;
+  }
+  CHECK(threw);
+  // defaults parse clean and preserve the documented schedule knobs
+  Parameters p = Parameters::from_json(Json::parse("{}"));
+  CHECK(p.timeout_backoff_factor_pct == 200);
+  CHECK(p.timeout_backoff_cap == 60'000);
+  CHECK(p.timeout_jitter_pct == 10);
+  CHECK(p.timeout_future_horizon == 1'000);
 }
 
 TEST(qc_verify_rejects_overweight_certificate) {

@@ -133,6 +133,11 @@ if __name__ == "__main__":
                     "cxxsync:native/src/crypto/sidecar_client.cpp",
                     "cxxsync:native/src/consensus/mempool_driver.hpp",
                     "cxxsync:native/src/consensus/core.cpp",
+                    # graftview: the optimistic timeout aggregator and
+                    # the cascade-driving chaos modules stay inside
+                    # their checkers' scans.
+                    "cxxsync:native/src/consensus/aggregator.hpp",
+                    "cxxsync:native/src/consensus/aggregator.cpp",
                     "cxxsync:native/src/mempool/ingress.hpp",
                     "cxxsync:native/src/common/metrics.hpp",
                     "cxxsync:native/src/common/metrics.cpp"):

@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Plain (no-sanitizer) native build for containers without cmake: the same
+# direct-g++ recipe scripts/native_sanitize.sh uses, producing
+# native/build/{node,client,offchain_bench,test_*} with per-object mtime
+# caching (any header edit rebuilds everything — no dep scanning).  With
+# cmake available, prefer `cmake -S native -B native/build`.
+#
+#   scripts/native_build.sh [test ...]    # tests to build; default: all
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+NATIVE="$ROOT/native"
+BUILD="$NATIVE/build"
+mkdir -p "$BUILD"
+
+CXX="${CXX:-g++}"
+FLAGS=(-std=c++17 -Wall -Wextra -O2 -g -I"$NATIVE/src" -pthread)
+
+LIBCRYPTO=""
+for cand in /lib/x86_64-linux-gnu/libcrypto.so.3 \
+            /usr/lib/x86_64-linux-gnu/libcrypto.so.3 \
+            /lib/x86_64-linux-gnu/libcrypto.so.1.1 \
+            /usr/lib/x86_64-linux-gnu/libcrypto.so.1.1; do
+  if [ -e "$cand" ]; then LIBCRYPTO="$cand"; break; fi
+done
+if [ -z "$LIBCRYPTO" ]; then
+  echo "native_build: no libcrypto found" >&2
+  exit 1
+fi
+
+hdr_mtime=$(find "$NATIVE/src" -name '*.hpp' -printf '%T@\n' \
+            | sort -rn | head -1 | cut -d. -f1)
+
+stale() {  # stale <obj> <src>: needs rebuilding?
+  [ ! -e "$1" ] && return 0
+  [ "$2" -nt "$1" ] && return 0
+  [ "$hdr_mtime" -gt "$(stat -c %Y "$1")" ] && return 0
+  return 1
+}
+
+build_obj() {  # build_obj <src> <obj> [extra flags...]
+  local src="$1" obj="$2"; shift 2
+  if stale "$obj" "$src"; then
+    echo "CXX $(basename "$obj")"
+    "$CXX" "${FLAGS[@]}" "$@" -c "$src" -o "$obj" &
+  fi
+}
+
+lib_objs=()
+for src in "$NATIVE"/src/*/*.cpp; do
+  obj="$BUILD/$(basename "$(dirname "$src")")_$(basename "$src").o"
+  case "$src" in
+    */node/main.cpp|*/node/client.cpp|*/node/offchain_bench.cpp) ;;
+    *) lib_objs+=("$obj") ;;
+  esac
+  build_obj "$src" "$obj"
+done
+
+TESTS=("$@")
+if [ ${#TESTS[@]} -eq 0 ]; then
+  TESTS=(serde crypto store network mempool consensus client e2e)
+fi
+for t in "${TESTS[@]}"; do
+  src="$NATIVE/tests/test_$t.cpp"
+  [ -e "$src" ] && build_obj "$src" "$BUILD/test_$t.o" -I"$NATIVE/tests"
+done
+wait
+
+link() {  # link <out> <main-obj>
+  echo "LNK $(basename "$1")"
+  "$CXX" "${FLAGS[@]}" "$2" "${lib_objs[@]}" "$LIBCRYPTO" -o "$1"
+}
+
+link "$BUILD/node" "$BUILD/node_main.cpp.o"
+link "$BUILD/client" "$BUILD/node_client.cpp.o"
+link "$BUILD/offchain_bench" "$BUILD/node_offchain_bench.cpp.o"
+for t in "${TESTS[@]}"; do
+  [ -e "$BUILD/test_$t.o" ] && link "$BUILD/test_$t" "$BUILD/test_$t.o"
+done
+echo "native_build: done"

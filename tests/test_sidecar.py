@@ -324,19 +324,49 @@ def test_bls_transient_failure_replies_none_and_never_caches(host_server):
     assert key not in engine._verdicts
 
     # Engine-thread behavior under a transient backend failure: the
-    # exception escapes _execute_bls (its _run caller replies None).
+    # exception is contained INSIDE _execute_bls, which answers None
+    # through its single idempotent reply helper (graftview satellite:
+    # _run installs no backstop reply any more, so a path that both
+    # replied and raised can no longer double-reply).
     replies = []
     with patch.object(bls, "verify_aggregate_common",
                       side_effect=RuntimeError("device wedged")):
-        with pytest.raises(RuntimeError):
-            engine._execute_bls(service._Pending(req, replies.append))
-    assert replies == [], "no cacheable reply may fire on the error path"
+        engine._execute_bls(service._Pending(req, replies.append))
+    assert replies == [None], "transient failure must reply exactly None"
     assert key not in engine._verdicts, "transient failure poisoned cache"
 
     # A retry without the fault verifies and NOW caches the true verdict.
     engine._execute_bls(service._Pending(req, replies.append))
-    assert replies == [[True]]
+    assert replies == [None, [True]]
     assert engine._verdicts[key] is True
+
+
+def test_bls_single_reply_discipline_suppresses_double_reply(host_server):
+    """Every BLS path answers EXACTLY once: an exception escaping AFTER
+    a successful reply (the wedged-then-completing shape the guard will
+    produce once BLS launches are supervised, ROADMAP item 3) must not
+    drive the error path into a second reply — the idempotent helper
+    suppresses it."""
+    from hotstuff_tpu.offchain import bls12381 as bls
+    from hotstuff_tpu.sidecar import service
+
+    engine = host_server.engine
+    sk, pk = bls.key_gen(bytes([55]) * 32)
+    msg = b"once" * 8
+    sig = bls.g2_encode(bls.sign(sk, msg))
+    req = proto.BlsVotesRequest(11, msg, [bls.g1_encode(pk)], [sig])
+
+    attempts = []
+
+    def reply_then_die(payload):
+        attempts.append(payload)
+        raise BrokenPipeError("client went away mid-reply")
+
+    # The reply itself raises: _execute_bls's exception handler runs
+    # with replied already set — its None is suppressed, and exactly one
+    # reply attempt (the real verdict) was made.
+    engine._execute_bls(service._Pending(req, reply_then_die))
+    assert attempts == [[True]]
 
 
 def test_bls_decode_failure_is_cacheable_false(host_server):

@@ -629,8 +629,11 @@ def test_chaos_matrix_e2e_local(tmp_path, monkeypatch):
 
     out = parser.result()
     # the kill was injected, recovery was measured, and the verdict is
-    # a PASS against the run's own SLO table
-    assert "Chaos node:1 kill" in out
+    # a PASS against the run's own SLO table (note label = the
+    # recovery.event_label spelling: "t=<t>s <action> <target>" — this
+    # assertion had rotted against an older ordering and the slow lane
+    # carried it silently)
+    assert "Chaos t=3s kill node:1" in out
     assert "Chaos SLO node-kill" in out and "PASS" in out
     assert parser.chaos["slo"]["ok"], parser.chaos["slo"]
     assert "WAN: 1 shaped link(s)" in out

@@ -2,7 +2,8 @@
 
 Runs every registered checker (hot path, wire, sanitizer wiring, launch
 shapes, timing fences, socket bounds, trace spans, thread discipline,
-C++ lock discipline); prints one line per finding — or the
+C++ lock discipline, verification-gate taint provenance); prints one
+line per finding — or the
 ``graftlint-findings-v1`` JSON document under ``--json``/``--json-out``
 — and exits non-zero when anything fires.  ``scripts/lint_gate.py`` is
 the CI entry point.
@@ -18,12 +19,13 @@ import sys
 
 CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
             "obsspan", "obsgrammar", "threads", "cxxsync", "ingress",
-            "guard")
+            "guard", "taint")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
     from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
-        obsspan, padshape, sanitize, sockets, threads, timing, wirecheck
+        obsspan, padshape, sanitize, sockets, taint, threads, timing, \
+        wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -50,6 +52,10 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += ingress.check(root)
     if "guard" in checkers:
         findings += guardlint.check(root)
+    if "taint" in checkers:
+        # CLI runs refresh the wire→gate→sink proof artifact alongside
+        # the findings (tests call taint.check() directly, no write)
+        findings += taint.check(root, map_out=taint.MAP_OUT)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -75,7 +81,7 @@ def check_coverage(root: str, must_cover) -> list:
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
     from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
-        obsspan, padshape, sockets, threads, timing
+        obsspan, padshape, sockets, taint, threads, timing
     from .common import Finding
 
     target_sets = {
@@ -89,6 +95,7 @@ def check_coverage(root: str, must_cover) -> list:
         "cxxsync": tuple(cxxsync.DEFAULT_TARGETS),
         "ingress": tuple(ingress.DEFAULT_TARGETS),
         "guard": tuple(guardlint.DEFAULT_TARGETS),
+        "taint": tuple(taint.DEFAULT_TARGETS),
     }
     findings = []
     for pin in must_cover:

@@ -143,6 +143,13 @@ def _strip(source: str) -> str:
                 if out[k] != "\n":
                     out[k] = " "
             i = j + 2
+        elif c == "'" and i > 0 and (source[i - 1].isalnum() or
+                                     source[i - 1] == "_"):
+            # C++14 digit separator (20'000): part of the number, not a
+            # char literal — treating it as one would swallow the file
+            # to the next apostrophe.  (Cost: u8'x'-style prefixed char
+            # literals would be misread; the tree has none.)
+            i += 1
         elif c in "\"'":
             q = c
             j = i + 1

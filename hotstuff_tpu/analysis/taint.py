@@ -1,0 +1,495 @@
+"""grafttaint checker: whole-program verification-gate provenance.
+
+Proves (lexically, clang-free, import-free) that **no unverified wire
+bytes reach a consensus sink** — in both the Python sidecar and the C++
+node.  Three vocabularies drive it:
+
+  sources     where untrusted bytes enter: socket/unix reads and wire
+              deserialization (``read_frame``/``recv`` in the sidecar,
+              ``::deserialize``/``recv_until`` and the network
+              ``*receiver_.spawn`` handlers in the native tree).
+  sanitizers  verification gates, DECLARED in the code itself:
+              ``// VERIFIES(<label>)`` on (or above) a C++ function
+              definition marks that function as a gate; the same comment
+              INSIDE a body marks a gate point whose scope is the
+              enclosing brace block (for verdict-``ok`` checks and
+              loopback re-entry facts).  Python uses
+              ``# graftlint: sanitizes=<label>`` with the same two
+              positions (def line / body line).
+  sinks       where acceptance becomes irreversible: QC acceptance
+              (``process_qc``), TC assembly (``finish_tc`` /
+              ``advance_round_via_tc``), commit, block-store writes,
+              mempool admission (``admit``), device-launch packing
+              (``VerifyEngine.submit``) and sidecar VERDICT emission
+              (``encode_reply``/``encode_reply_raw`` with a non-literal
+              mask).
+
+Model: per-function taint summaries over a bare-name call graph.  Taint
+enters a body at its wire-source points (and transitively: a call to a
+function that reads the wire is itself a source point) and at function
+entry when some caller passes tainted data.  A gate call — or an inline
+gate point — sanitizes every lexically later position in scope with its
+label.  Entry states meet across call sites: a function is
+*entry-verified* only when EVERY tainted call edge into it carries at
+least one gate label (labels union; one ungated edge collapses the
+state, which is what the mutation fixtures exercise).  Each sink accepts
+a specific label set — e.g. ``commit`` accepts ``qc``/``device-verdict``
+but not ``frame-structure`` — so parsing alone can never stand in for
+signature verification.
+
+Rules:
+  unverified-flow-to-sink  wire-tainted data reaches a sink with no
+                           acceptable gate label on the path
+  unreachable-sanitizer    a declared gate is never called anywhere in
+                           the scanned tree (the classic deleted-verify
+                           mutation)
+  unannotated-gate         a ``verify*``-shaped function is called on a
+                           tainted path but its definition carries no
+                           gate annotation — the analysis cannot credit
+                           what the author did not declare
+
+Soundness limits (deliberate, documented): the call graph is bare-name
+and lexical — callbacks passed as values (the sidecar reply closures,
+channel handoffs) are not edges, a gate call gates later positions even
+when its result is ignored, and C++ lambdas attribute their calls to the
+enclosing named function (which is exactly right for the network
+receiver handlers).  ``results/taintmap.json`` records every PROVEN
+wire→gate→sink path so the gate coverage is auditable, not just the
+absence of findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+from .common import Finding, parse_source, read_source, suppressed_rules
+
+
+# ---------------------------------------------------------------------------
+# Shared model (taintcxx builds the same records from the native tree)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Call:
+    callee: str
+    pos: int      # comparable intra-function position (char or line*1e6+col)
+    line: int
+    exempt: bool = False   # never classified as a sink (literal-mask replies)
+
+
+@dataclass
+class TaintFn:
+    name: str
+    path: str
+    line: int
+    language: str  # "py" | "cxx"
+    calls: list = field(default_factory=list)
+    # extractor-detected wire entries beyond source-named calls
+    # (the C++ ``*receiver_.spawn`` handler lambdas): [(pos, line)]
+    source_points: list = field(default_factory=list)
+    # inline gate points: [(pos, scope_end_pos|None, label, line)]
+    gate_points: list = field(default_factory=list)
+    # non-empty => this function IS a declared gate
+    def_labels: frozenset = frozenset()
+
+
+from . import taintcxx  # noqa: E402  (needs Call/TaintFn defined above)
+
+
+PY_TARGETS = (
+    "hotstuff_tpu/sidecar/protocol.py",
+    "hotstuff_tpu/sidecar/service.py",
+)
+
+DEFAULT_TARGETS = PY_TARGETS + taintcxx.CXX_TARGETS
+
+# Written by check() (and therefore by the CLI / lint_gate) — the
+# machine-readable proof of which wire→gate→sink paths exist.
+MAP_OUT = os.path.join("results", "taintmap.json")
+
+PY_SOURCE_CALLS = frozenset({"read_frame", "recv", "recv_into", "recvfrom"})
+
+# callee -> (sink label, acceptable gate labels)
+PY_SINKS = {
+    "encode_reply": ("verdict-emission",
+                     frozenset({"device-verdict", "sig"})),
+    "encode_reply_raw": ("verdict-emission",
+                         frozenset({"device-verdict", "sig"})),
+    # admission into the verify engine = the device-launch pack pipeline;
+    # frame-structure (decode_request's bounds/shape validation) is the
+    # gate that keeps hostile lengths out of the packer.
+    "submit": ("device-launch-pack", frozenset({"frame-structure"})),
+}
+
+SOURCES = {"py": PY_SOURCE_CALLS, "cxx": taintcxx.CXX_SOURCE_CALLS}
+SINKS = {"py": PY_SINKS, "cxx": taintcxx.CXX_SINKS}
+
+VERIFY_SHAPE = re.compile(r"^_?verify")
+
+_SANITIZES_RE = re.compile(r"#\s*graftlint:\s*sanitizes=([\w\-]+)")
+
+_LINE_POS = 10 ** 6  # python positions: line * _LINE_POS + col
+
+
+# ---------------------------------------------------------------------------
+# Python extraction
+# ---------------------------------------------------------------------------
+
+def _is_literal(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(isinstance(e, ast.Constant) for e in node.elts)
+    return False
+
+
+class _PyCalls(ast.NodeVisitor):
+    """Calls of one function body; nested defs are skipped entirely (their
+    bodies run later via callbacks the name graph cannot see)."""
+
+    def __init__(self):
+        self.calls: list[Call] = []
+        self.nested: list[tuple[int, int]] = []
+
+    def visit_FunctionDef(self, node):
+        self.nested.append((node.lineno, node.end_lineno or node.lineno))
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name:
+            exempt = False
+            if name in ("encode_reply", "encode_reply_raw") and \
+                    len(node.args) >= 3 and _is_literal(node.args[2]):
+                exempt = True  # literal mask (PING/CHAOS echo), no verdict
+            self.calls.append(Call(
+                name, node.lineno * _LINE_POS + node.col_offset,
+                node.lineno, exempt))
+        self.generic_visit(node)
+
+
+def _py_extract(sources: dict) -> list:
+    fns = []
+    for path, src in sources.items():
+        tree = parse_source(src, path)
+        gate_lines: dict[int, str] = {}
+        for i, text in enumerate(src.splitlines(), start=1):
+            m = _SANITIZES_RE.search(text)
+            if m:
+                gate_lines[i] = m.group(1)
+        defs: list = []
+
+        def collect(nodes):
+            for n in nodes:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.append(n)
+                elif isinstance(n, ast.ClassDef):
+                    collect(n.body)
+
+        collect(tree.body)
+        for d in defs:
+            fn = TaintFn(name=d.name, path=path, line=d.lineno,
+                         language="py")
+            header = {d.lineno, d.lineno - 1} | \
+                {dec.lineno for dec in d.decorator_list}
+            fn.def_labels = frozenset(
+                gate_lines[ln] for ln in header if ln in gate_lines)
+            visitor = _PyCalls()
+            for stmt in d.body:
+                visitor.visit(stmt)
+            fn.calls = visitor.calls
+            end = d.end_lineno or d.lineno
+            for ln, label in gate_lines.items():
+                if d.lineno < ln <= end and ln not in header and \
+                        not any(a <= ln <= b for a, b in visitor.nested):
+                    # inline gate point: sanitizes the rest of the body
+                    fn.gate_points.append(
+                        (ln * _LINE_POS + _LINE_POS - 1, None, label, ln))
+            fns.append(fn)
+    return fns
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural solver (both languages)
+# ---------------------------------------------------------------------------
+
+class _Analysis:
+    def __init__(self, fns: list):
+        self.fns = fns
+        self.registry: dict = {}
+        for fn in fns:
+            self.registry.setdefault((fn.language, fn.name), []).append(fn)
+        self.gate_labels: dict = {}
+        for fn in fns:
+            if fn.def_labels:
+                key = (fn.language, fn.name)
+                self.gate_labels[key] = \
+                    self.gate_labels.get(key, frozenset()) | fn.def_labels
+        # id(fn) -> [verified: bool, labels: set] (present = entry-tainted)
+        self.entry: dict = {}
+        # id(fn) -> (caller fn, call line, origin str)
+        self.witness: dict = {}
+        self.origin: dict = {}
+        self._source_closure()
+
+    # -- sources -----------------------------------------------------------
+
+    def _source_closure(self):
+        """Effective wire-entry points per body: genuine source calls plus
+        calls to any function that transitively reads the wire."""
+        self.eff_sources = {id(fn): list(fn.source_points)
+                            for fn in self.fns}
+        is_src = {id(fn): bool(fn.source_points) for fn in self.fns}
+        for fn in self.fns:
+            if fn.source_points:
+                self.origin[id(fn)] = \
+                    f"{fn.path}:{fn.source_points[0][1]}"
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.fns:
+                have = {p for p, _ in self.eff_sources[id(fn)]}
+                for c in fn.calls:
+                    direct = c.callee in SOURCES[fn.language]
+                    via = next(
+                        (t for t in self.registry.get(
+                            (fn.language, c.callee), ()) if is_src[id(t)]),
+                        None)
+                    if (direct or via is not None) and c.pos not in have:
+                        self.eff_sources[id(fn)].append((c.pos, c.line))
+                        have.add(c.pos)
+                        is_src[id(fn)] = True
+                        self.origin.setdefault(
+                            id(fn),
+                            f"{fn.path}:{c.line}" if direct
+                            else self.origin.get(
+                                id(via), f"{fn.path}:{c.line}"))
+                        changed = True
+        for pts in self.eff_sources.values():
+            pts.sort(key=lambda t: t[0])
+
+    # -- state queries -----------------------------------------------------
+
+    def _gates_before(self, fn, start, pos) -> set:
+        """Gate labels active at ``pos``: inline gate points and gate-fn
+        calls after ``start`` (None = function entry) and before ``pos``,
+        whose scope still covers ``pos``."""
+        out: set = set()
+        for gpos, gend, label, _ln in fn.gate_points:
+            if (start is None or gpos > start) and gpos < pos and \
+                    (gend is None or pos <= gend):
+                out.add(label)
+        for c in fn.calls:
+            if (start is None or c.pos > start) and c.pos < pos:
+                labels = self.gate_labels.get((fn.language, c.callee))
+                if labels:
+                    out |= labels
+        return out
+
+    def _contexts(self, fn, pos) -> list:
+        """Live taints at ``pos``: [(gate labels, origin)] — one entry for
+        in-body wire taint (from the LAST source point before pos), one
+        for entry taint.  Empty list = position unreachable by taint."""
+        ctxs = []
+        before = [s for s in self.eff_sources[id(fn)] if s[0] < pos]
+        if before:
+            ctxs.append((
+                frozenset(self._gates_before(fn, before[-1][0], pos)),
+                self.origin.get(id(fn), f"{fn.path}:{fn.line}")))
+        ent = self.entry.get(id(fn))
+        if ent is not None:
+            base = set(ent[1]) if ent[0] else set()
+            w = self.witness.get(id(fn))
+            ctxs.append((
+                frozenset(base | self._gates_before(fn, None, pos)),
+                w[2] if w else f"{fn.path}:{fn.line}"))
+        return ctxs
+
+    # -- fixpoint ----------------------------------------------------------
+
+    def propagate(self):
+        changed, iters = True, 0
+        while changed and iters < 64:
+            changed, iters = False, iters + 1
+            for fn in self.fns:
+                for c in sorted(fn.calls, key=lambda c: c.pos):
+                    ctxs = self._contexts(fn, c.pos)
+                    if not ctxs:
+                        continue
+                    for tgt in self.registry.get(
+                            (fn.language, c.callee), ()):
+                        if tgt is fn:
+                            continue
+                        for labels, origin in ctxs:
+                            verified = bool(labels)
+                            ent = self.entry.get(id(tgt))
+                            if ent is None:
+                                self.entry[id(tgt)] = \
+                                    [verified, set(labels)]
+                                self.witness[id(tgt)] = \
+                                    (fn, c.line, origin)
+                                changed = True
+                            else:
+                                nv = ent[0] and verified
+                                nl = ent[1] | labels
+                                if nv != ent[0] or nl != ent[1]:
+                                    ent[0], ent[1] = nv, nl
+                                    changed = True
+
+    # -- reporting ---------------------------------------------------------
+
+    def _chain(self, fn) -> list:
+        chain, seen, cur = [fn.name], {id(fn)}, fn
+        while True:
+            w = self.witness.get(id(cur))
+            if not w or id(w[0]) in seen:
+                break
+            cur = w[0]
+            chain.append(cur.name)
+            seen.add(id(cur))
+        chain.reverse()
+        return chain
+
+    def report(self):
+        findings, paths = [], []
+        called = {(fn.language, c.callee)
+                  for fn in self.fns for c in fn.calls}
+        for fn in self.fns:
+            if fn.def_labels and (fn.language, fn.name) not in called:
+                findings.append(Finding(
+                    fn.path, fn.line, "unreachable-sanitizer",
+                    f"sanitizer '{fn.name}' "
+                    f"(VERIFIES {', '.join(sorted(fn.def_labels))}) is "
+                    f"never called anywhere in the scanned tree: the gate "
+                    f"it declares protects nothing — wire the call back "
+                    f"in or retire the annotation"))
+        for fn in self.fns:
+            for c in sorted(fn.calls, key=lambda c: c.pos):
+                ctxs = self._contexts(fn, c.pos)
+                if not ctxs:
+                    continue
+                cfg = SINKS[fn.language].get(c.callee)
+                if cfg and not c.exempt:
+                    label, accepted = cfg
+                    self_gate = self.gate_labels.get(
+                        (fn.language, c.callee), frozenset())
+                    for labels, origin in ctxs:
+                        eff = labels | self_gate
+                        if eff & accepted:
+                            paths.append({
+                                "language": fn.language, "sink": label,
+                                "call": c.callee, "file": fn.path,
+                                "line": c.line,
+                                "gates": sorted(eff & accepted),
+                                "source": origin,
+                                "via": self._chain(fn)})
+                        else:
+                            findings.append(Finding(
+                                fn.path, c.line, "unverified-flow-to-sink",
+                                f"wire-tainted data reaches {label} sink "
+                                f"'{c.callee}' with no acceptable "
+                                f"verification gate on the path (needs "
+                                f"one of: "
+                                f"{', '.join(sorted(accepted))}; saw: "
+                                f"{', '.join(sorted(eff)) or 'none'}; "
+                                f"taint from {origin})"))
+                if VERIFY_SHAPE.match(c.callee) and \
+                        not self.gate_labels.get(
+                            (fn.language, c.callee)):
+                    tgts = self.registry.get((fn.language, c.callee), ())
+                    if tgts:
+                        findings.append(Finding(
+                            fn.path, c.line, "unannotated-gate",
+                            f"verification-shaped call '{c.callee}' on a "
+                            f"wire-tainted path, but its definition "
+                            f"({tgts[0].path}:{tgts[0].line}) carries no "
+                            f"VERIFIES/sanitizes annotation: declare the "
+                            f"gate's label so the taint analysis can "
+                            f"credit it (or rename it if it does not "
+                            f"verify anything)"))
+        seen, unique = set(), []
+        for f in findings:
+            key = (f.path, f.line, f.rule)
+            if key not in seen:
+                seen.add(key)
+                unique.append(f)
+        seen, upaths = set(), []
+        for p in paths:
+            key = (p["language"], p["sink"], p["file"], p["line"])
+            if key not in seen:
+                seen.add(key)
+                upaths.append(p)
+        return unique, upaths
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def analyze_sources(py_sources: dict, cxx_sources: dict):
+    """Lint {relpath: source} mappings for both languages.  Returns
+    ``(findings, mapdoc)`` where mapdoc is the taintmap document."""
+    fns = _py_extract(py_sources) + taintcxx.extract(cxx_sources)
+    an = _Analysis(fns)
+    an.propagate()
+    findings, paths = an.report()
+    # inline suppressions, same contract as every other checker
+    py_sup = {p: suppressed_rules(s) for p, s in py_sources.items()}
+    cxx_sup = {p: taintcxx.cpp_suppressed_rules(s)
+               for p, s in cxx_sources.items()}
+    kept = []
+    for f in findings:
+        sup = py_sup.get(f.path) or cxx_sup.get(f.path) or {}
+        if f.rule in sup.get(f.line, ()):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    paths.sort(key=lambda p: (p["sink"], p["file"], p["line"]))
+    coverage: dict = {}
+    for p in paths:
+        coverage[p["sink"]] = coverage.get(p["sink"], 0) + 1
+    mapdoc = {
+        "schema": "grafttaint-map-v1",
+        "clean": not kept,
+        "gates": sorted(
+            [{"name": fn.name, "file": fn.path, "line": fn.line,
+              "labels": sorted(fn.def_labels)}
+             for fn in fns if fn.def_labels],
+            key=lambda g: (g["file"], g["line"])),
+        "sinks_covered": coverage,
+        "paths": paths,
+    }
+    return kept, mapdoc
+
+
+def check_sources(py_sources: dict, cxx_sources: dict | None = None) -> list:
+    """Unit-test entry point; findings only."""
+    return analyze_sources(py_sources, cxx_sources or {})[0]
+
+
+def check(root: str, targets=DEFAULT_TARGETS, map_out=None) -> list:
+    """Lint the repo under ``root``.  When ``map_out`` is set (the CLI
+    passes MAP_OUT), the proven wire→gate→sink paths are written there as
+    the auditable coverage artifact."""
+    py_sources, cxx_sources = {}, {}
+    for rel in targets:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        (py_sources if rel.endswith(".py") else cxx_sources)[rel] = \
+            read_source(path)
+    findings, mapdoc = analyze_sources(py_sources, cxx_sources)
+    if map_out:
+        out = os.path.join(root, map_out)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w", encoding="utf-8") as fh:
+            json.dump(mapdoc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    return findings

@@ -1,0 +1,151 @@
+"""grafttaint C++ extractor: the native-tree half of the taint checker.
+
+Builds the same ``TaintFn`` records the Python extractor produces, from
+the brace/lexer machinery the cxxsync checker already proved out
+(``_strip`` blanks comments/strings offset-stably; ``_Blocks`` matches
+braces and names function blocks).  No clang, no compilation.
+
+Vocabulary (see taint.py for the model):
+  sources   ``::deserialize`` / ``recv`` / ``recv_until`` calls, plus
+            the network receiver handler lambdas (``*receiver_.spawn``
+            — the mempool tx/peer ingress entry points, whose bodies
+            attribute to the enclosing named function by design).
+  gates     ``// VERIFIES(<label>)`` immediately above a function
+            definition marks the function; the same comment inside a
+            body marks an inline gate point scoped to its innermost
+            brace block (verdict-``ok`` branches, loopback re-entry).
+  sinks     QC acceptance, TC assembly, commit, store writes, mempool
+            admission — each with the gate labels it accepts.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .cxxsync import _Blocks, _line_of, _strip, cpp_suppressed_rules
+
+CXX_TARGETS = (
+    "native/src/consensus/core.cpp",
+    "native/src/consensus/consensus.cpp",
+    "native/src/consensus/messages.cpp",
+    "native/src/consensus/aggregator.cpp",
+    "native/src/mempool/mempool.cpp",
+    "native/src/mempool/processor.hpp",
+    "native/src/mempool/processor.cpp",
+    "native/src/mempool/ingress.hpp",
+    "native/src/crypto/crypto.cpp",
+)
+
+CXX_SOURCE_CALLS = frozenset({
+    "deserialize", "recv", "recv_until", "read_frame"})
+
+# callee -> (sink label, acceptable gate labels)
+CXX_SINKS = {
+    "process_qc": ("qc-accept",
+                   frozenset({"qc", "sig", "tc", "block",
+                              "device-verdict"})),
+    "finish_tc": ("tc-assembly",
+                  frozenset({"qc", "sig", "tc", "device-verdict"})),
+    "advance_round_via_tc": ("tc-assembly",
+                             frozenset({"qc", "sig", "tc",
+                                        "device-verdict"})),
+    "commit": ("commit",
+               frozenset({"qc", "sig", "tc", "block",
+                          "device-verdict"})),
+    "store_block": ("store-write",
+                    frozenset({"qc", "sig", "tc", "block",
+                               "device-verdict"})),
+    "try_write": ("store-write",
+                  frozenset({"batch-digest", "qc", "sig",
+                             "device-verdict"})),
+    "admit": ("mempool-admission", frozenset({"ingress-budget"})),
+}
+
+_VERIFIES_RE = re.compile(r"//\s*VERIFIES\(([\w\-]+)\)")
+_CALL_RE = re.compile(r"\b([A-Za-z_]\w*)\s*\(")
+_RECEIVER_SPAWN_RE = re.compile(
+    r"\b\w*receiver_?\s*(?:\.|->)\s*spawn\s*\(")
+# control-flow / operator keywords _CALL_RE would otherwise pick up
+_NOT_CALLS = frozenset({
+    "if", "while", "for", "switch", "catch", "return", "sizeof",
+    "new", "delete", "throw", "static_cast", "const_cast",
+    "dynamic_cast", "reinterpret_cast", "alignof", "decltype",
+    "assert", "defined", "noexcept",
+})
+# how far below a def-level VERIFIES comment the function header may sit
+_DEF_ATTACH_SPAN = 600
+
+from .taint import Call, TaintFn  # noqa: E402  (circular-by-design)
+
+
+def _named_blocks(blocks: _Blocks):
+    """(start, end, name) for real function bodies — named, non-lambda."""
+    return [(s, e, n) for s, e, n in blocks.ranges
+            if n is not None and n != "<lambda>"]
+
+
+def _owner(named, pos):
+    """Innermost named function block containing ``pos`` (lambda bodies
+    therefore attribute to their enclosing named function)."""
+    best = None
+    for s, e, _n in named:
+        if s < pos < e and (best is None or e - s < best[1] - best[0]):
+            best = (s, e, _n)
+    return best
+
+
+def extract(sources: dict) -> list:
+    fns = []
+    for path, src in sources.items():
+        stripped = _strip(src)
+        blocks = _Blocks(stripped)
+        named = _named_blocks(blocks)
+        by_range = {}
+        for s, e, name in named:
+            fn = TaintFn(name=name, path=path,
+                         line=_line_of(stripped, s), language="cxx")
+            by_range[(s, e)] = fn
+            fns.append(fn)
+
+        for m in _CALL_RE.finditer(stripped):
+            name = m.group(1)
+            if name in _NOT_CALLS:
+                continue
+            own = _owner(named, m.start())
+            if own is None:
+                continue  # declaration scope / class body, not code
+            by_range[(own[0], own[1])].calls.append(Call(
+                name, m.start(), _line_of(stripped, m.start())))
+
+        for m in _RECEIVER_SPAWN_RE.finditer(stripped):
+            own = _owner(named, m.start())
+            if own is not None:
+                by_range[(own[0], own[1])].source_points.append(
+                    (m.start(), _line_of(stripped, m.start())))
+
+        # VERIFIES annotations live in comments — scan the ORIGINAL text
+        # (offsets align with the stripped text by construction).
+        for m in _VERIFIES_RE.finditer(src):
+            label = m.group(1)
+            own = _owner(named, m.start())
+            if own is not None:
+                # inline gate point, scoped to the innermost brace block
+                fn = by_range[(own[0], own[1])]
+                fn.gate_points.append(
+                    (m.start(), blocks.block_end(m.start()), label,
+                     _line_of(stripped, m.start())))
+                continue
+            # def-level: attach to the next function header below
+            cand = None
+            for s, e, _n in named:
+                if m.start() < s <= m.start() + _DEF_ATTACH_SPAN and \
+                        (cand is None or s < cand[0]):
+                    cand = (s, e)
+            if cand is not None:
+                fn = by_range[cand]
+                fn.def_labels = fn.def_labels | {label}
+    return fns
+
+
+__all__ = ["CXX_TARGETS", "CXX_SOURCE_CALLS", "CXX_SINKS",
+           "cpp_suppressed_rules", "extract"]

@@ -62,11 +62,14 @@ subsets under the guard until the minimal poison set is isolated.  A
 poisoned record is host-verified (and counted) forever after — one
 adversarial or cursed record can never take the device leg down again.
 
-BLS launches stay outside the guard for now: ``_execute_bls`` replies
-inline from multiple sites, so a wedged-then-completing pairing would
-double-reply; its existing protection is the unwarmed-shape host
-fallback (``_bls_multi_warmed``).  Threading it through the guard means
-restructuring its reply contract — noted in ROADMAP item 3.
+BLS launches ride the guard too: ``_execute_bls_inner`` RETURNS its
+verdict (it never touches the connection), the engine thread replies
+only after the guarded call comes back clean, and a wedged pairing gets
+the BLS arm of the ladder — transient reply (the C++ client reads
+nullopt and runs its own outage handling) plus the crash-only reboot.
+The unwarmed-shape host fallback (``_bls_multi_warmed``) remains as the
+first line; the guard is what bounds it when the host pairing itself
+wedges.
 """
 
 from __future__ import annotations

@@ -90,17 +90,20 @@ OP_BUSY = 10
 # lint anchor, not a handshake.
 PROTOCOL_VERSION = 5
 
-# Protocol v5 (graftscope): OP_VERIFY_BATCH / OP_VERIFY_BULK requests may
-# carry a 32-byte CONTEXT TAG between the fixed header and the records —
-# the block digest whose certificate this verify serves.  The sidecar
-# tags its admit/queue/pack/dispatch/device/reply spans with it, which
-# is what lets obs/trace.py nest the sidecar stage chain (device time
-# included) inside that block's verify segment in logs/trace.json.
+# Protocol v5 (graftscope): OP_VERIFY_BATCH / OP_VERIFY_BULK — and, since
+# the BLS trace-parity work, OP_BLS_VERIFY_VOTES / OP_BLS_VERIFY_MULTI —
+# requests may carry a 32-byte CONTEXT TAG between the fixed header and
+# the records: the block digest whose certificate this verify serves.
+# The sidecar tags its admit/queue/pack/dispatch/device/reply spans with
+# it, which is what lets obs/trace.py nest the sidecar stage chain
+# (device time included) inside that block's verify segment in
+# logs/trace.json — for scheme=bls runs exactly like EdDSA ones.
 #
 # The tag is OPTIONAL and self-describing by frame length: a verify
 # payload is either header + N records (legacy, ctx None) or header +
-# 32 tag bytes + N records — unambiguous because a record is msg_len +
-# 96 >= 96 bytes, so 32 extra bytes can never alias a record count.
+# 32 tag bytes + N records — unambiguous because an Ed25519 record is
+# msg_len + 96 >= 96 bytes and a BLS record is >= 288 bytes, so 32
+# extra bytes can never alias a record count.
 # Writers emit the tag only when they HAVE a block context (the C++
 # client's no-context frames stay byte-identical to v4, so a node
 # upgraded before its sidecar keeps verifying), an ALL-ZERO tag is
@@ -165,6 +168,9 @@ class BlsVotesRequest:
     msg: bytes
     pks: list             # n x 96 B uncompressed G1
     sigs: list            # n x 192 B uncompressed G2
+    # graftscope (protocol v5): block-digest context tag, as on
+    # VerifyRequest — BLS spans join block traces like EdDSA ones.
+    ctx: bytes | None = None
 
 
 @dataclass
@@ -173,6 +179,7 @@ class BlsMultiRequest:
     msgs: list            # n x msg_len digests (distinct per vote)
     pks: list             # n x 96 B uncompressed G1
     sigs: list            # n x 192 B uncompressed G2
+    ctx: bytes | None = None
 
 
 @dataclass
@@ -221,6 +228,7 @@ def encode_stats_reply(request_id: int, snapshot: dict) -> bytes:
     import json
 
     body = json.dumps(snapshot, sort_keys=True).encode("utf-8")
+    # graftlint: disable=unverified-flow-to-sink (locally-built telemetry snapshot, carries no verdict bits)
     return encode_reply_raw(OP_STATS, request_id, body)
 
 
@@ -277,26 +285,39 @@ def encode_bls_sign_request(request_id: int, msg: bytes, sk: bytes) -> bytes:
     return struct.pack(">I", len(payload)) + payload
 
 
-def encode_bls_votes_request(request_id: int, msg: bytes, pks,
-                             sigs) -> bytes:
+def encode_bls_votes_request(request_id: int, msg: bytes, pks, sigs,
+                             ctx: bytes | None = None) -> bytes:
+    """``ctx`` (protocol v5) rides between header and the shared message,
+    the same slot as OP_VERIFY_BATCH; None emits the legacy frame."""
     assert len(pks) == len(sigs)
     recs = b"".join(p + s for p, s in zip(pks, sigs))
-    payload = (_HDR.pack(OP_BLS_VERIFY_VOTES, request_id, len(pks),
-                         len(msg)) + msg + recs)
+    parts = [_HDR.pack(OP_BLS_VERIFY_VOTES, request_id, len(pks), len(msg))]
+    if ctx is not None:
+        assert len(ctx) == CTX_LEN
+        parts.append(ctx)
+    parts.append(msg)
+    parts.append(recs)
+    payload = b"".join(parts)
     return struct.pack(">I", len(payload)) + payload
 
 
-def encode_bls_multi_request(request_id: int, msgs, pks, sigs) -> bytes:
+def encode_bls_multi_request(request_id: int, msgs, pks, sigs,
+                             ctx: bytes | None = None) -> bytes:
     n = len(msgs)
     assert len(pks) == n and len(sigs) == n
     msg_len = len(msgs[0]) if n else 0
     assert all(len(m) == msg_len for m in msgs)
     recs = b"".join(m + p + s for m, p, s in zip(msgs, pks, sigs))
-    payload = (_HDR.pack(OP_BLS_VERIFY_MULTI, request_id, n, msg_len)
-               + recs)
+    parts = [_HDR.pack(OP_BLS_VERIFY_MULTI, request_id, n, msg_len)]
+    if ctx is not None:
+        assert len(ctx) == CTX_LEN
+        parts.append(ctx)
+    parts.append(recs)
+    payload = b"".join(parts)
     return struct.pack(">I", len(payload)) + payload
 
 
+# graftlint: sanitizes=frame-structure
 def decode_request(payload: bytes):
     """payload (no length prefix) -> (opcode, request dataclass).
 
@@ -345,9 +366,16 @@ def decode_request(payload: bytes):
         return opcode, BlsSignRequest(request_id, msg, sk)
     if opcode == OP_BLS_VERIFY_VOTES:
         off = _HDR.size
+        rec = BLS_PK_LEN + BLS_SIG_LEN
+        # v5 context tag: frame length discriminates (a BLS record is
+        # 288 bytes, so 32 tag bytes can never alias a record count).
+        ctx = None
+        if len(payload) == off + CTX_LEN + msg_len + n * rec:
+            tag = payload[off:off + CTX_LEN]
+            ctx = None if tag == ZERO_CTX else tag
+            off += CTX_LEN
         msg = payload[off:off + msg_len]
         off += msg_len
-        rec = BLS_PK_LEN + BLS_SIG_LEN
         if len(payload) != off + n * rec:
             raise ValueError("bad BLS votes frame")
         pks, sigs = [], []
@@ -355,10 +383,15 @@ def decode_request(payload: bytes):
             base = off + i * rec
             pks.append(payload[base:base + BLS_PK_LEN])
             sigs.append(payload[base + BLS_PK_LEN:base + rec])
-        return opcode, BlsVotesRequest(request_id, msg, pks, sigs)
+        return opcode, BlsVotesRequest(request_id, msg, pks, sigs, ctx=ctx)
     if opcode == OP_BLS_VERIFY_MULTI:
         off = _HDR.size
         rec = msg_len + BLS_PK_LEN + BLS_SIG_LEN
+        ctx = None
+        if len(payload) == off + CTX_LEN + n * rec:
+            tag = payload[off:off + CTX_LEN]
+            ctx = None if tag == ZERO_CTX else tag
+            off += CTX_LEN
         if len(payload) != off + n * rec:
             raise ValueError("bad BLS multi frame")
         msgs, pks, sigs = [], [], []
@@ -367,7 +400,7 @@ def decode_request(payload: bytes):
             msgs.append(payload[base:base + msg_len])
             pks.append(payload[base + msg_len:base + msg_len + BLS_PK_LEN])
             sigs.append(payload[base + msg_len + BLS_PK_LEN:base + rec])
-        return opcode, BlsMultiRequest(request_id, msgs, pks, sigs)
+        return opcode, BlsMultiRequest(request_id, msgs, pks, sigs, ctx=ctx)
     rec = msg_len + ED_PK_LEN + ED_SIG_LEN
     off = _HDR.size
     # Protocol v5 context tag: frame length discriminates (a record is

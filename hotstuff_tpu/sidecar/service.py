@@ -327,6 +327,7 @@ class VerifyEngine:
             snap["guard"] = g
         return snap
 
+    # graftlint: sanitizes=device-verdict
     def cached_verdicts(self, request):
         """[bool] if EVERY (msg, pk, sig) record of this Ed25519 verify
         request already has a cached verdict, else None.  Called from
@@ -374,6 +375,7 @@ class VerifyEngine:
             return ("ba", h(b"ba", req.msg, req.pks, req.agg_sig))
         return None
 
+    # graftlint: sanitizes=device-verdict
     def cached_bls_verdict(self, req):
         """[bool] reply if this BLS verify request's verdict is cached,
         else None.  Connection-thread-safe for the same reason as
@@ -440,9 +442,17 @@ class VerifyEngine:
                         (item,) = launch.items
                         while inflight:
                             self._drain_one(inflight)
+                        tags = {}
+                        if self._tracer.enabled:
+                            ctx = _ctx_tag(item.request)
+                            if ctx:
+                                # v5 context tag: scheme=bls device spans
+                                # join the tagged block's trace exactly
+                                # like EdDSA ones (ROADMAP item-2 parity).
+                                tags["ctx"] = ctx
                         with self._tracer.span(
                                 "device", kind="bls",
-                                rid=item.request.request_id):
+                                rid=item.request.request_id, **tags):
                             # Single-reply discipline: _execute_bls owns
                             # its whole failure surface and replies
                             # EXACTLY once through its idempotent
@@ -1055,21 +1065,45 @@ class VerifyEngine:
                     self._verdicts.pop(next(iter(self._verdicts)))
             self._verdicts[record] = ok
 
+    def _bls_guard_key(self, req) -> str:
+        """Launch-shape key for BLS work under the guard's per-shape
+        deadlines: kind x pow2 committee size — a 4-vote aggregate and a
+        100-vote one are genuinely different pairings (the Miller-loop
+        count scales with the key set), so their p99 histories must not
+        train each other's deadline."""
+        from ..crypto.eddsa import next_pow2
+
+        if isinstance(req, proto.BlsSignRequest):
+            return "bls:sign"
+        kind = {proto.BlsAggRequest: "agg",
+                proto.BlsVotesRequest: "votes",
+                proto.BlsMultiRequest: "multi"}[type(req)]
+        return f"bls:{kind}:{next_pow2(max(1, len(req.pks)))}"
+
     def _execute_bls(self, item):
-        """Run one BLS request on the engine thread.
+        """Run one BLS request under the launch guard (engine thread).
+
+        The request body executes on one of the guard's DISPOSABLE
+        launch threads under the shape's deadline (``_guarded``), so a
+        wedged pairing — a hung tunneled device call mid
+        ``verify_aggregate`` — trips the BLS arm of the degradation
+        ladder instead of parking the engine thread: the client gets the
+        TRANSIENT reply (``None`` -> the C++ side reads nullopt and runs
+        its own outage handling, e.g. TC re-arm), and the crash-only
+        engine reboot begins.  This closes ROADMAP item 3: BLS launches
+        no longer sit outside the guard.
 
         SINGLE-REPLY DISCIPLINE (the PR 14 double-reply hazard, closed):
-        every success AND failure path — cached hits, decode failures,
-        completed verifications, escaping exceptions — answers through
-        ONE idempotent ``reply`` helper.  A second reply attempt (e.g. a
-        wedged-then-completing pairing racing an exception handler, once
-        BLS launches ride the guard's disposable threads — ROADMAP item
-        3) is suppressed and logged instead of writing a duplicate frame
-        onto the connection.  _run therefore installs NO backstop reply.
+        ``_execute_bls_inner`` RETURNS its verdict instead of replying —
+        replies happen here, on the engine thread, only after the
+        guarded call came back clean, so a wedged-then-completing
+        pairing's late result is discarded by the guard and can never
+        race a ladder reply.  The idempotent ``reply`` helper stays as
+        the belt.  _run installs NO backstop reply.
 
-        Reply/caching contract: verdicts are cached ONLY at the explicit
-        sites below that pass ``cacheable=True`` — i.e. verdicts that are
-        a pure function of the request bytes (decode/subgroup failures,
+        Reply/caching contract: verdicts are cached ONLY when the inner
+        body marks them cacheable — i.e. verdicts that are a pure
+        function of the request bytes (decode/subgroup failures,
         completed verifications).  Transient failures (a wedged device, a
         backend exception) must reply ``None`` and NEVER a cacheable
         ``[False]``: the verdict cache is shared by every replica, so one
@@ -1093,12 +1127,25 @@ class VerifyEngine:
                 self._cache_verdict(cache_key, bool(payload[0]))
             item.reply_fn(payload)
 
+        key = self._bls_guard_key(req)
         try:
-            self._execute_bls_inner(req, cache_key, reply)
+            payload, cacheable = self._guarded(
+                key, lambda: self._execute_bls_inner(req, cache_key))
+            reply(payload, cacheable=cacheable)
+        except WedgedLaunch:
+            # BLS arm of the wedge ladder.  No host re-verify here: the
+            # host pairing is the very work that may have wedged, and
+            # re-running it inline would re-park the engine thread the
+            # guard just saved.  Transient reply only — never a
+            # cacheable [False] for a verdict nobody computed.
+            log.error("guard: BLS launch %s WEDGED (deadline overrun); "
+                      "transient reply, starting crash-only reboot", key)
+            reply(None)
+            self._begin_reboot()
         except Exception:
             log.exception("BLS request failed")
-            # Transient by definition (deterministic failures replied
-            # inline above): never cacheable.
+            # Transient by definition (deterministic failures return
+            # cacheable verdicts from the inner body): never cacheable.
             reply(None)
         if not replied[0]:
             # Belt: a path that forgot to answer would leave the client
@@ -1107,24 +1154,24 @@ class VerifyEngine:
                       req.request_id)
             reply(None)
 
-    def _execute_bls_inner(self, req, cache_key, reply):
-        """The BLS request body; every exit replies via ``reply`` (the
-        idempotent helper _execute_bls built) exactly once."""
+    def _execute_bls_inner(self, req, cache_key):
+        """The BLS request body; runs on a disposable guard launch
+        thread and RETURNS ``(payload, cacheable)`` — it must not touch
+        the connection (a wedged call's late completion is discarded by
+        the guard; only the engine thread replies)."""
         from ..offchain import bls12381 as bls
 
         if isinstance(req, proto.BlsSignRequest):
             # Signing is G2 scalar multiplication — host bigint work, no
             # pairing; mirrors the reference keeping signing on CPU.
             sk = int.from_bytes(req.sk, "big")
-            reply(bls.g2_encode(bls.sign(sk, req.msg)))
-            return
+            return bls.g2_encode(bls.sign(sk, req.msg)), False
         # Verdict cache (same FIFO as Ed25519, keyed on the full request):
         # N replicas verifying one certificate cost one pairing.  Decode
         # failures cache as False — deterministic in the request bytes.
         cached = self._verdicts.get(cache_key) if cache_key else None
         if cached is not None:
-            reply([cached])
-            return
+            return [cached], False
 
         if isinstance(req, proto.BlsMultiRequest):
             # TC shape: per-vote signatures over DISTINCT digests in one
@@ -1136,12 +1183,10 @@ class VerifyEngine:
                 agg = bls.aggregate(
                     [bls.g2_decode_lax(s) for s in req.sigs])
                 if not bls.g2_in_subgroup(agg):
-                    reply([False], cacheable=True)
-                    return
+                    return [False], True
                 pks = [bls.g1_decode(p) for p in req.pks]
             except ValueError:
-                reply([False], cacheable=True)
-                return
+                return [False], True
             if self._use_host or len(pks) not in self._bls_multi_warmed:
                 if not self._use_host:
                     log.warning(
@@ -1152,8 +1197,7 @@ class VerifyEngine:
                 from ..ops import bls381 as dbls
 
                 ok = dbls.verify_aggregate_multi(pks, req.msgs, agg)
-            reply([bool(ok)], cacheable=True)
-            return
+            return [bool(ok)], True
         try:
             if isinstance(req, proto.BlsVotesRequest):
                 # C++ nodes ship per-vote signatures; aggregate them here
@@ -1166,22 +1210,21 @@ class VerifyEngine:
                 agg = bls.aggregate(
                     [bls.g2_decode_lax(s) for s in req.sigs])
                 if not bls.g2_in_subgroup(agg):
-                    reply([False], cacheable=True)
-                    return
+                    return [False], True
             else:
                 agg = bls.g2_decode(req.agg_sig)
             pks = [bls.g1_decode(p) for p in req.pks]
         except ValueError:
-            reply([False], cacheable=True)
-            return
+            return [False], True
         if self._use_host:
             ok = bls.verify_aggregate_common(pks, req.msg, agg)
         else:
             from ..ops import bls381 as dbls
 
             ok = dbls.verify_aggregate_common(pks, req.msg, agg)
-        reply([bool(ok)], cacheable=True)
+        return [bool(ok)], True
 
+    # graftlint: sanitizes=device-verdict
     def _verify_submit(self, msgs, pks, sigs, force_device: bool = False):
         """Dispatch one slice; returns fetch() -> (n,) bool mask.
 
@@ -1282,6 +1325,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     # (PING/STATS/CHAOS above stay honest).  Decided
                     # BEFORE the verdict-cache fast path so a scripted
                     # shed/drop cannot be masked by a cache hit.
+                    # graftlint: disable=unannotated-gate (fault injector, verify-shaped by name only)
                     drop, shed, delay_s = chaos.verify_action()
                     if drop:
                         log.warning("chaos: dropping connection")
@@ -1477,7 +1521,9 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
             # a deserialization pass (38 s measured warm vs 149 s cold,
             # PR 11), during which the host path owns live traffic.
             # BLS warmups are skipped: the pairing programs are minutes
-            # of compile and BLS launches run outside the guard.
+            # of compile; un-warmed shapes fall back to the host pairing
+            # (_bls_multi_warmed), which now runs under the guard's
+            # deadline like every other BLS launch.
             _warmup(engine, warm_max)
             if warm_bulk:
                 _warmup_bulk(engine, warm_max)

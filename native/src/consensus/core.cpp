@@ -76,6 +76,10 @@ class CoreImpl {
       }
       VerifyResult result = VerifyResult::good();
       if (event.kind == CoreEvent::Kind::kLoopback) {
+        // Loopback blocks re-enter after handle_proposal fully verified
+        // them; they were suspended for ancestor/payload sync only, and
+        // the synchronizer loops back the SAME bytes it suspended.
+        // VERIFIES(block)
         result = process_block(event.block);
       } else if (event.kind == CoreEvent::Kind::kVerdict) {
         result = handle_verdict(event.block, event.verdict);
@@ -455,11 +459,15 @@ class CoreImpl {
       return VerifyResult::good();
     }
     // Synchronous path: still ONE batch (a connected sidecar without
-    // async budget, or the host loop), resolved inline.
+    // async budget, or the host loop), resolved inline.  The checked
+    // variant distinguishes "the BLS remainder was unreachable" (nullopt
+    // — re-arm, don't eject) from a definitive verdict;
+    // allow_redispatch=false bounds the resolve->dispatch recursion to
+    // one round-trip per resolve chain.
     tc_batches_[round] =
         TcBatch{gen, std::move(cands), std::chrono::steady_clock::now()};
-    bool ok = Signature::verify_batch_multi(items);
-    return resolve_tc_batch(round, gen, ok);
+    std::optional<bool> ok = Signature::verify_batch_multi_checked(items);
+    return resolve_tc_batch(round, gen, ok, /*allow_redispatch=*/false);
   }
 
   // Completion of a batched TC verify.  ok=true: every candidate's
@@ -467,17 +475,28 @@ class CoreImpl {
   // per-signature HOST verification (bit-equivalent to the verify_own
   // the optimistic path skipped) and eject exactly those, so the
   // accepted set is identical to what per-signature admission would
-  // have built.
+  // have built.  EXCEPT under scheme=bls, where nullopt means the
+  // sidecar was unreachable — the 192-byte signatures are UNKNOWN, not
+  // forged (per-signature "fallback" would just re-ask the dead sidecar
+  // and read every honest one as false, ejecting + one-striking the
+  // whole candidate set for the outage) — so that case diverts to
+  // resolve_tc_outage below.
   VerifyResult resolve_tc_batch(Round round, uint64_t gen,
-                                std::optional<bool> ok) {
+                                std::optional<bool> ok,
+                                bool allow_redispatch = true) {
     auto it = tc_batches_.find(round);
     if (it == tc_batches_.end() || it->second.gen != gen) {
       return VerifyResult::good();  // stale verdict: round re-armed/moved
     }
     std::vector<Aggregator::TimeoutVote> cands = std::move(it->second.cands);
     tc_batches_.erase(it);
+    if (!ok.has_value() && current_scheme() == Scheme::kBls) {
+      return resolve_tc_outage(round, std::move(cands), allow_redispatch);
+    }
     std::vector<PublicKey> verified, ejected;
     if (ok.has_value() && *ok) {
+      // The sidecar's batch verdict covered every candidate signature.
+      // VERIFIES(device-verdict)
       verified.reserve(cands.size());
       for (const auto& c : cands) verified.push_back(c.author);
     } else {
@@ -508,6 +527,55 @@ class CoreImpl {
       // Arrivals during the batch flight completed another quorum.
       return dispatch_tc_batch(round, std::move(res.candidates));
     }
+    return VerifyResult::good();
+  }
+
+  // The scheme=bls sidecar-outage arm of resolve_tc_batch: host-verify
+  // the 64-byte Ed25519 fallback entries now (sidecar-down signers keep
+  // the view change live through them — see Signature::sign), defer the
+  // BLS remainder.  A TC can form from fallback signatures alone while
+  // every sidecar is dark; deferred BLS entries re-verify when one
+  // answers again.
+  VerifyResult resolve_tc_outage(Round round,
+                                 std::vector<Aggregator::TimeoutVote> cands,
+                                 bool allow_redispatch) {
+    std::vector<PublicKey> verified, ejected;
+    size_t deferred = 0;
+    for (const auto& c : cands) {
+      if (c.signature.data.size() != 64) {
+        deferred++;  // BLS: unknown under the outage, stays a candidate
+      } else if (c.signature.verify(
+                     Timeout::vote_digest(round, c.high_qc_round),
+                     c.author)) {
+        verified.push_back(c.author);
+      } else {
+        ejected.push_back(c.author);
+      }
+    }
+    LOG_WARN("consensus::core")
+        << "TC batch for round " << round << " hit a sidecar outage: "
+        << verified.size() + ejected.size()
+        << " fallback signature(s) resolved on host, " << deferred
+        << " BLS signature(s) deferred (unknown, not ejected)";
+    if (!ejected.empty()) tc_inline_rounds_.insert(round);
+    auto res = aggregator_.resolve_timeouts(round, verified, ejected);
+    if (!res.error.empty()) return VerifyResult::bad(res.error);
+    if (res.tc) return finish_tc(std::move(*res.tc));
+    if (res.candidates.empty()) return VerifyResult::good();
+    TpuVerifier* tpu = TpuVerifier::instance();
+    if (allow_redispatch && tpu && tpu->connected()) {
+      // The sidecar recovered (or answered other traffic since): one
+      // fresh dispatch.  Its own inline resolve runs with redispatch
+      // disabled, bounding the resolve->dispatch recursion.
+      return dispatch_tc_batch(round, std::move(res.candidates));
+    }
+    // Still down: re-arm already-expired, so the NEXT timeout arrival
+    // for this round re-resolves (handle_timeout's expiry branch) —
+    // host-verifying any new fallback arrivals and re-probing the
+    // sidecar, paced by the pacemaker's re-broadcasts.
+    uint64_t gen = ++tc_batch_gen_;
+    tc_batches_[round] = TcBatch{gen, std::move(res.candidates),
+                                 std::chrono::steady_clock::now()};
     return VerifyResult::good();
   }
 
@@ -659,6 +727,7 @@ class CoreImpl {
     }
   }
 
+  // VERIFIES(qc)
   VerifyResult verify_qc_cached(const QC& qc) {
     if (qc.is_genesis()) return VerifyResult::good();
     Digest d = qc.content_digest();
@@ -668,6 +737,7 @@ class CoreImpl {
     return r;
   }
 
+  // VERIFIES(tc)
   VerifyResult verify_tc_cached(const TC& tc) {
     Digest d = tc.content_digest();
     if (cert_cached(d)) return VerifyResult::good();
@@ -699,14 +769,30 @@ class CoreImpl {
       // view-change proposal onto the slow host pairing path.
       TpuVerifier* tpu = TpuVerifier::instance();
       if (!tpu) return false;
+      // Mixed certificates — any 64-byte Ed25519 fallback signature
+      // (signed during a peer's sidecar outage, see Signature::sign) —
+      // take the synchronous path, which partitions host/device; the
+      // BLS opcodes' fixed-size records would read the mix as malformed
+      // and reject an honest block.
+      if (need_qc) {
+        for (const auto& [pk, sig] : block.qc.votes) {
+          if (sig.data.size() == 64) return false;
+        }
+      }
+      if (need_tc) {
+        for (const auto& [d, pk, sig] : block.tc->vote_items()) {
+          if (sig.data.size() == 64) return false;
+        }
+      }
       struct Join {
-        // graftsync: the two atomics are the synchronization (acq_rel
-        // on the decrement publishes all_ok to the last callback); ch
-        // and block are written before either callback is registered
-        // and only READ afterwards — the thread-start/submit edge is
-        // the happens-before.
+        // graftsync: the atomics are the synchronization (acq_rel on
+        // the decrement publishes all_ok/transport_fail to the last
+        // callback); ch and block are written before either callback is
+        // registered and only READ afterwards — the thread-start/submit
+        // edge is the happens-before.
         std::atomic<int> remaining;      // SHARED_OK(atomic join counter)
         std::atomic<bool> all_ok{true};  // SHARED_OK(atomic)
+        std::atomic<bool> transport_fail{false};  // SHARED_OK(atomic)
         ChannelPtr<CoreEvent> ch;  // SHARED_OK(written pre-registration)
         Block block;               // SHARED_OK(written pre-registration)
       };
@@ -715,27 +801,42 @@ class CoreImpl {
       join->ch = ch;
       join->block = block;
       auto complete = [join](std::optional<bool> ok) {
-        // Transport failure is a definitive reject under BLS (no host
-        // pairing exists) — same policy as the synchronous path.
-        // Ordering: each callback's relaxed all_ok store is published
-        // to the LAST decrementer through the acq_rel RMW chain on
-        // `remaining` (release on every decrement, acquire on the one
-        // that reads 1), so the final load may stay relaxed.
-        if (!ok.value_or(false)) {
+        // A transport failure makes the joint verdict nullopt (unless a
+        // definitive reject already landed): handle_verdict then
+        // re-verifies synchronously instead of rejecting an honest
+        // block because the sidecar died mid-flight.  Ordering: each
+        // callback's relaxed stores are published to the LAST
+        // decrementer through the acq_rel RMW chain on `remaining`
+        // (release on every decrement, acquire on the one that reads
+        // 1), so the final loads may stay relaxed.
+        if (!ok.has_value()) {
+          join->transport_fail.store(true, std::memory_order_relaxed);
+        } else if (!*ok) {
           join->all_ok.store(false, std::memory_order_relaxed);
         }
         if (join->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-          CoreEvent e = CoreEvent::verdict_of(
-              join->block, join->all_ok.load(std::memory_order_relaxed));
+          bool all_ok = join->all_ok.load(std::memory_order_relaxed);
+          std::optional<bool> verdict(all_ok);
+          if (all_ok &&
+              join->transport_fail.load(std::memory_order_relaxed)) {
+            verdict = std::nullopt;
+          }
+          CoreEvent e = CoreEvent::verdict_of(join->block, verdict);
           join->ch->try_send(std::move(e));
         }
       };
+      // graftscope: the block digest rides both BLS verify RPCs as the
+      // protocol v5 context tag (EdDSA parity, ROADMAP item 2), so
+      // scheme=bls stage spans join this block's trace segment too.
+      // As below, the frame is built before each call returns, so the
+      // stack digest is safe to pass by pointer.
+      Digest ctx = block.digest();
       if (need_qc) {
         tpu->bls_verify_votes_async(block.qc.digest(), block.qc.votes,
-                                    complete);
+                                    complete, &ctx);
       }
       if (need_tc) {
-        tpu->bls_verify_multi_async(block.tc->vote_items(), complete);
+        tpu->bls_verify_multi_async(block.tc->vote_items(), complete, &ctx);
       }
       return true;
     }
@@ -783,6 +884,9 @@ class CoreImpl {
       return VerifyResult::bad("invalid certificate signatures in block " +
                                block.digest().to_base64());
     }
+    // The device judged every certificate signature in this block good
+    // (the !*verdict reject above is the other half of the gate).
+    // VERIFIES(device-verdict)
     if (!block.qc.is_genesis()) cert_insert(block.qc.content_digest());
     if (block.tc) cert_insert(block.tc->content_digest());
     return proposal_postverify(block);

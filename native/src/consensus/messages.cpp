@@ -73,6 +73,7 @@ VerifyResult check_vote_stakes(const VoteList& votes, GetAuthority author_of,
 
 }  // namespace
 
+// VERIFIES(stake-structure)
 VerifyResult QC::verify_structure(const Committee& committee) const {
   return check_vote_stakes(
       votes, [](const auto& v) -> const PublicKey& { return v.first; },
@@ -94,6 +95,7 @@ Digest QC::content_digest() const {
   return DigestBuilder().update(w.out).finalize();
 }
 
+// VERIFIES(qc)
 VerifyResult QC::verify(const Committee& committee) const {
   VerifyResult r = verify_structure(committee);
   if (!r.ok()) return r;
@@ -145,6 +147,7 @@ std::vector<Round> TC::high_qc_rounds() const {
   return rounds;
 }
 
+// VERIFIES(stake-structure)
 VerifyResult TC::verify_structure(const Committee& committee) const {
   return check_vote_stakes(
       votes,
@@ -171,6 +174,7 @@ Digest TC::content_digest() const {
   return DigestBuilder().update(w.out).finalize();
 }
 
+// VERIFIES(tc)
 VerifyResult TC::verify(const Committee& committee) const {
   VerifyResult r = verify_structure(committee);
   if (!r.ok()) return r;
@@ -225,6 +229,7 @@ Digest Block::digest() const {
   return b.finalize();
 }
 
+// VERIFIES(block)
 VerifyResult Block::verify(const Committee& committee) const {
   if (committee.stake(author) == 0) {
     return VerifyResult::bad("unknown block author: " + author.to_base64());
@@ -285,6 +290,7 @@ Digest Vote::digest() const {
   return DigestBuilder().update(hash.data).update_u64_le(round).finalize();
 }
 
+// VERIFIES(sig)
 VerifyResult Vote::verify(const Committee& committee) const {
   if (committee.stake(author) == 0) {
     return VerifyResult::bad("unknown vote author: " + author.to_base64());
@@ -335,6 +341,7 @@ Digest Timeout::vote_digest(Round round, Round high_qc_round) {
 
 Digest Timeout::digest() const { return vote_digest(round, high_qc.round); }
 
+// VERIFIES(sig)
 VerifyResult Timeout::verify_own(const Committee& committee) const {
   if (committee.stake(author) == 0) {
     return VerifyResult::bad("unknown timeout author: " + author.to_base64());
@@ -345,6 +352,7 @@ VerifyResult Timeout::verify_own(const Committee& committee) const {
   return VerifyResult::good();
 }
 
+// VERIFIES(sig)
 VerifyResult Timeout::verify(const Committee& committee) const {
   VerifyResult r = verify_own(committee);
   if (!r.ok()) return r;

@@ -112,27 +112,35 @@ Signature Signature::sign(const Digest& digest, const SecretKey& sk) {
     if (!tpu || !bls) {
       throw std::runtime_error("scheme=bls requires sidecar + BLS keys");
     }
-    // Bounded retries over transient sidecar failures, then degrade to an
-    // invalid (all-zero) signature: peers simply reject the vote. This
-    // runs on the SignatureService worker thread, which has no exception
-    // handler — a throw here would std::terminate the whole node on one
-    // sidecar hiccup.
-    for (int attempt = 0; attempt < 10; attempt++) {
+    // Bounded retries over transient sidecar failures.  This runs on the
+    // SignatureService worker thread, which has no exception handler — a
+    // throw here would std::terminate the whole node on one sidecar
+    // hiccup.  When the sidecar is already unreachable (breaker open /
+    // never connected) skip the retry dance: bls_sign fails fast and
+    // every vote/timeout queued behind this one would otherwise eat the
+    // full backoff.
+    const int attempts = tpu->connected() ? 3 : 1;
+    for (int attempt = 0; attempt < attempts; attempt++) {
       auto sig = tpu->bls_sign(digest, bls->secret);
       if (sig) {
         Signature s;
         s.data = std::move(*sig);
         return s;
       }
-      LOG_WARN("crypto") << "BLS sign attempt " << attempt + 1
-                         << " failed; retrying";
-      std::this_thread::sleep_for(std::chrono::milliseconds(500));
+      LOG_WARN("crypto") << "BLS sign attempt " << attempt + 1 << "/"
+                         << attempts << " failed";
+      if (attempt + 1 < attempts) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(500));
+      }
     }
-    LOG_ERROR("crypto") << "BLS signing unavailable; emitting invalid "
-                           "signature (vote will be rejected)";
-    Signature s;
-    s.data = Bytes(192, 0);
-    return s;
+    // Sidecar-down fallback: sign with the host Ed25519 identity key
+    // (every committee entry carries it under both schemes).  Verifiers
+    // dispatch on signature length, so timeouts and votes signed during
+    // an outage still verify on the HOST path — the node keeps
+    // participating in view changes instead of emitting invalid bytes
+    // and stalling TC assembly until the sidecar returns.
+    LOG_ERROR("crypto") << "BLS signing unavailable; falling back to the "
+                           "host Ed25519 identity key";
   }
   PkeyGuard key{EVP_PKEY_new_raw_private_key(kEvpPkeyEd25519, nullptr,
                                              sk.seed(), 32)};
@@ -216,8 +224,13 @@ bool is_small_order_encoding(const uint8_t* enc32) {
 
 }  // namespace
 
+// VERIFIES(sig)
 bool Signature::verify(const Digest& digest, const PublicKey& pk) const {
-  if (current_scheme() == Scheme::kBls) {
+  // 192-byte signatures are BLS G2 and verify through the sidecar.
+  // 64-byte signatures take the host Ed25519 path EVEN under scheme=bls:
+  // they are the sidecar-down fallback (see Signature::sign), verified
+  // against the signer's Ed25519 identity key.
+  if (current_scheme() == Scheme::kBls && data.size() != 64) {
     return verify_batch(digest, {{pk, *this}});
   }
   if (data.size() != 64) return false;
@@ -239,15 +252,31 @@ bool Signature::verify(const Digest& digest, const PublicKey& pk) const {
                           digest.data.data(), digest.data.size()) == 1;
 }
 
+// VERIFIES(sig)
 bool Signature::verify_batch(
     const Digest& digest,
     const std::vector<std::pair<PublicKey, Signature>>& votes) {
   if (current_scheme() == Scheme::kBls) {
-    // No host pairing exists in the C++ plane; the sidecar is mandatory
-    // for BLS (asserted at boot) and a transport failure rejects.
+    // Partition by signature length: 64-byte entries are host Ed25519
+    // fallback signatures (signed while their author's sidecar was
+    // down — see Signature::sign) and verify right here; only genuine
+    // 192-byte G2 signatures ride the sidecar pairing op (whose records
+    // are fixed-size and would reject the mix).  No host pairing exists
+    // in the C++ plane, so a transport failure on the BLS remainder
+    // rejects.
+    std::vector<std::pair<PublicKey, Signature>> bls_votes;
+    bls_votes.reserve(votes.size());
+    for (const auto& [pk, sig] : votes) {
+      if (sig.data.size() == 64) {
+        if (!sig.verify(digest, pk)) return false;
+      } else {
+        bls_votes.emplace_back(pk, sig);
+      }
+    }
+    if (bls_votes.empty()) return true;
     TpuVerifier* tpu = TpuVerifier::instance();
     if (!tpu) return false;
-    auto ok = tpu->bls_verify_votes(digest, votes);
+    auto ok = tpu->bls_verify_votes(digest, bls_votes);
     return ok.value_or(false);
   }
   std::vector<std::tuple<Digest, PublicKey, Signature>> items;
@@ -256,19 +285,41 @@ bool Signature::verify_batch(
   return verify_batch_multi(items);
 }
 
+// VERIFIES(sig)
 bool Signature::verify_batch_multi(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    bool bulk) {
+  // Callers without a retry path: a transport failure on the BLS
+  // remainder (nullopt) maps to reject here.
+  return verify_batch_multi_checked(items, bulk).value_or(false);
+}
+
+// VERIFIES(sig)
+std::optional<bool> Signature::verify_batch_multi_checked(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
     bool bulk) {
   // BLS TCs carry per-vote BLS signatures over distinct digests: ONE
   // multi-digest sidecar round-trip, verified device-side as a single
   // product of pairings (TC verify parity: consensus/src/messages.rs:
-  // 307-313).  No host pairing exists, so transport failure rejects.
+  // 307-313).  Same partition as verify_batch: 64-byte Ed25519 fallback
+  // signatures verify on host first (a forged one rejects definitively),
+  // then the 192-byte remainder goes to the sidecar.  nullopt = that
+  // remainder is UNKNOWN (no transport), never forged — TC assembly
+  // re-arms on it instead of ejecting honest signers for the outage.
   if (current_scheme() == Scheme::kBls) {
-    if (items.empty()) return true;
+    std::vector<std::tuple<Digest, PublicKey, Signature>> bls_items;
+    bls_items.reserve(items.size());
+    for (const auto& [d, pk, sig] : items) {
+      if (sig.data.size() == 64) {
+        if (!sig.verify(d, pk)) return false;
+      } else {
+        bls_items.emplace_back(d, pk, sig);
+      }
+    }
+    if (bls_items.empty()) return true;
     TpuVerifier* tpu = TpuVerifier::instance();
-    if (!tpu) return false;
-    auto ok = tpu->bls_verify_multi(items);
-    return ok.value_or(false);
+    if (!tpu) return std::nullopt;
+    return tpu->bls_verify_multi(bls_items);
   }
   TpuVerifier* tpu = TpuVerifier::instance();
   if (tpu && tpu->connected()) {
@@ -308,6 +359,7 @@ bool Signature::async_available() {
   return tpu->connected();
 }
 
+// VERIFIES(sig)
 void Signature::verify_batch_multi_async(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
     AsyncCallback cb, const Digest* ctx) {
@@ -317,14 +369,30 @@ void Signature::verify_batch_multi_async(
     return;
   }
   if (current_scheme() == Scheme::kBls) {
-    // No host pairing exists in C++: transport failure is a definitive
-    // reject (same policy as the synchronous path above), so map nullopt
-    // to false rather than asking the caller to retry.  (The BLS opcodes
-    // predate the v5 context tag; ctx is Ed25519-path-only for now.)
-    tpu->bls_verify_multi_async(items, [cb = std::move(cb)](
-                                           std::optional<bool> ok) {
-      cb(ok.value_or(false));
-    });
+    // Same partition as the synchronous path: 64-byte Ed25519 fallback
+    // signatures verify on host inline (microseconds), only genuine G2
+    // signatures ship to the sidecar.  Transport failure propagates as
+    // nullopt so the caller's synchronous retry — which can host-verify
+    // or re-arm — decides, instead of turning a mid-flight outage into
+    // a definitive "invalid certificate" verdict.  The ctx tag rides
+    // the BLS frame exactly as it does the Ed25519 one (v5 parity).
+    std::vector<std::tuple<Digest, PublicKey, Signature>> bls_items;
+    bls_items.reserve(items.size());
+    for (const auto& [d, pk, sig] : items) {
+      if (sig.data.size() == 64) {
+        if (!sig.verify(d, pk)) {
+          cb(false);
+          return;
+        }
+      } else {
+        bls_items.emplace_back(d, pk, sig);
+      }
+    }
+    if (bls_items.empty()) {
+      cb(true);
+      return;
+    }
+    tpu->bls_verify_multi_async(bls_items, std::move(cb), ctx);
     return;
   }
   tpu->verify_batch_multi_async(

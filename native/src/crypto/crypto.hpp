@@ -150,14 +150,24 @@ struct Signature {
   }
 
   // Sign a 32-byte digest (the message is always a Digest in this
-  // protocol). scheme=bls routes to the sidecar's host signer.
+  // protocol). scheme=bls routes to the sidecar's host signer; when the
+  // sidecar is unreachable it falls back to the host Ed25519 identity
+  // key (the 64-byte signature verifiers dispatch on by length), so a
+  // node with a dead sidecar keeps signing votes/timeouts and view
+  // changes stay live instead of stalling on invalid BLS bytes.
   static Signature sign(const Digest& digest, const SecretKey& sk);
 
+  // Under scheme=bls, 64-byte signatures take the HOST Ed25519 path —
+  // they are the sidecar-down fallback above, verified against the
+  // signer's Ed25519 identity key; only 192-byte G2 signatures ride the
+  // sidecar pairing ops.
   bool verify(const Digest& digest, const PublicKey& pk) const;
 
   // Batch verification over a QC's votes. Uses the process-wide TpuVerifier
   // if one is installed (see sidecar_client.hpp), else a host loop
-  // (scheme=bls requires the sidecar: there is no host pairing in C++).
+  // (scheme=bls requires the sidecar: there is no host pairing in C++;
+  // mixed batches are partitioned — 64-byte fallback entries verify on
+  // host, the BLS remainder in one sidecar op).
   static bool verify_batch(
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes);
@@ -170,6 +180,17 @@ struct Signature {
   // latency class; only throughput-bound batch workloads (the offchain
   // sweep, mempool-style verification) pass true.
   static bool verify_batch_multi(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      bool bulk = false);
+
+  // Transport-aware form of verify_batch_multi: nullopt means the BLS
+  // remainder of the batch could not be checked at all (sidecar
+  // unreachable / timed out) — UNKNOWN, not forged.  Callers that can
+  // retry later (TC assembly) must not eject signers on nullopt; callers
+  // without a retry path use verify_batch_multi, which maps it to
+  // reject.  Ed25519 batches never return nullopt (the host loop always
+  // exists).
+  static std::optional<bool> verify_batch_multi_checked(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
       bool bulk = false);
 

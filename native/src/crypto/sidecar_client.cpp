@@ -637,7 +637,7 @@ std::optional<Bytes> TpuVerifier::bls_sign(const Digest& digest,
 void TpuVerifier::bls_verify_votes_async(
     const Digest& digest,
     const std::vector<std::pair<PublicKey, Signature>>& votes,
-    BoolCallback cb) {
+    BoolCallback cb, const Digest* ctx) {
   BlsContext* bls = BlsContext::instance();
   if (!bls) {
     cb(std::nullopt);
@@ -651,6 +651,13 @@ void TpuVerifier::bls_verify_votes_async(
   }
   write_header(&w, kOpBlsVerifyVotes, rid,
                static_cast<uint32_t>(votes.size()));
+  // v5 context tag: same slot (between header and body) and same
+  // length-discriminated optionality as the Ed25519 frames — a BLS
+  // record is 288 bytes, so the 32 tag bytes can never alias one.
+  if (ctx != nullptr) {
+    static_assert(sizeof(ctx->data) == kCtxLen, "ctx tag is a digest");
+    w.fixed(ctx->data);
+  }
   w.fixed(digest.data);  // one shared digest for the whole QC
   for (const auto& [pk, sig] : votes) {
     if (!append_bls_record_(bls, &w, pk, sig)) {
@@ -666,17 +673,18 @@ void TpuVerifier::bls_verify_votes_async(
 
 std::optional<bool> TpuVerifier::bls_verify_votes(
     const Digest& digest,
-    const std::vector<std::pair<PublicKey, Signature>>& votes) {
+    const std::vector<std::pair<PublicKey, Signature>>& votes,
+    const Digest* ctx) {
   Oneshot<std::optional<bool>> done;
-  bls_verify_votes_async(digest, votes, [done](std::optional<bool> ok) {
-    done.set(std::move(ok));
-  });
+  bls_verify_votes_async(
+      digest, votes,
+      [done](std::optional<bool> ok) { done.set(std::move(ok)); }, ctx);
   return done.wait();
 }
 
 void TpuVerifier::bls_verify_multi_async(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-    BoolCallback cb) {
+    BoolCallback cb, const Digest* ctx) {
   BlsContext* bls = BlsContext::instance();
   if (!bls) {
     cb(std::nullopt);
@@ -690,6 +698,10 @@ void TpuVerifier::bls_verify_multi_async(
   }
   write_header(&w, kOpBlsVerifyMulti, rid,
                static_cast<uint32_t>(items.size()));
+  if (ctx != nullptr) {
+    static_assert(sizeof(ctx->data) == kCtxLen, "ctx tag is a digest");
+    w.fixed(ctx->data);
+  }
   for (const auto& [digest, pk, sig] : items) {
     w.fixed(digest.data);  // one digest PER record (the TC shape)
     if (!append_bls_record_(bls, &w, pk, sig)) {
@@ -704,11 +716,12 @@ void TpuVerifier::bls_verify_multi_async(
 }
 
 std::optional<bool> TpuVerifier::bls_verify_multi(
-    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items) {
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    const Digest* ctx) {
   Oneshot<std::optional<bool>> done;
-  bls_verify_multi_async(items, [done](std::optional<bool> ok) {
-    done.set(std::move(ok));
-  });
+  bls_verify_multi_async(
+      items,
+      [done](std::optional<bool> ok) { done.set(std::move(ok)); }, ctx);
   return done.wait();
 }
 

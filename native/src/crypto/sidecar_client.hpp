@@ -108,23 +108,28 @@ class TpuVerifier {
 
   // scheme=bls operations (pairing lives only in the sidecar; signing is
   // its host G2 scalar mult). These use a longer deadline than Ed25519
-  // batches — a pairing is milliseconds-to-seconds, not micro.
+  // batches — a pairing is milliseconds-to-seconds, not micro.  `ctx` is
+  // the same optional v5 context tag as verify_batch_multi: BLS verifies
+  // carrying the block digest join that block's trace spans exactly like
+  // EdDSA ones (ROADMAP item-2 parity); nullptr emits the legacy frame.
   using BoolCallback = std::function<void(std::optional<bool>)>;
   std::optional<Bytes> bls_sign(const Digest& digest, const Bytes& sk48);
   std::optional<bool> bls_verify_votes(
       const Digest& digest,
-      const std::vector<std::pair<PublicKey, Signature>>& votes);
+      const std::vector<std::pair<PublicKey, Signature>>& votes,
+      const Digest* ctx = nullptr);
   void bls_verify_votes_async(
       const Digest& digest,
       const std::vector<std::pair<PublicKey, Signature>>& votes,
-      BoolCallback cb);
+      BoolCallback cb, const Digest* ctx = nullptr);
   // Distinct digest per vote (the TC shape): ONE round-trip, verified
   // device-side as a single product of pairings.
   std::optional<bool> bls_verify_multi(
-      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items);
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      const Digest* ctx = nullptr);
   void bls_verify_multi_async(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-      BoolCallback cb);
+      BoolCallback cb, const Digest* ctx = nullptr);
 
   // Deadlines (ms). Every sidecar interaction is bounded: a slow or wedged
   // device process fails the pending request (host fallback), never stalls

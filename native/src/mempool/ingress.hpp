@@ -53,6 +53,7 @@ class IngressGate {
 
   // Reactor thread: admit one client tx of `tx_bytes` into the pipeline
   // (true), or shed it (false; *retry_ms carries the BUSY hint).
+  // VERIFIES(ingress-budget)
   bool admit(size_t tx_bytes, uint32_t* retry_ms) {
     bool pause_now = false;
     bool admitted;

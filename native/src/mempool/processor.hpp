@@ -24,6 +24,7 @@ class Processor {
   // consensus carries in block payloads — if these ever diverged between
   // the own-batch and peer-batch paths, synchronizers would request
   // batches under keys peers never stored.
+  // VERIFIES(batch-digest)
   static Digest digest_of(const Bytes& serialized_batch) {
     return sha512_digest(serialized_batch);
   }

@@ -539,6 +539,72 @@ TEST(core_forms_tc_batched_with_spoofed_signer_ejected) {
   for (auto& t : threads) t.join();
 }
 
+TEST(core_forms_tc_from_fallback_sigs_with_sidecar_stopped) {
+  // Sidecar stopped mid-round under scheme=bls (the PR 15 view-change
+  // note): the committee keeps signing timeouts with the 64-byte host
+  // Ed25519 fallback (Signature::sign with a dead sidecar), and the
+  // quorum-triggered batch verify takes the HOST path — no sidecar
+  // round-trip, no stall — so TC assembly stays live through the outage.
+  uint16_t dead_port;
+  {
+    // Reserve a port with nothing listening by binding and releasing it.
+    auto l = Listener::bind({"127.0.0.1", 0});
+    CHECK(l.has_value());
+    dead_port = l->port();
+  }
+  // Uninstalls the globals and restores the scheme even on early CHECK
+  // failure; declared before the fixture so the core thread joins first.
+  struct BlsGuard {
+    ~BlsGuard() {
+      TpuVerifier::install(nullptr);
+      BlsContext::install(nullptr);
+      set_scheme(Scheme::kEd25519);
+    }
+  } guard;
+  TpuVerifier::install(
+      std::make_unique<TpuVerifier>(Address{"127.0.0.1", dead_port}));
+  auto bls = std::make_unique<BlsContext>();
+  bls->secret = Bytes(48, 1);
+  BlsContext::install(std::move(bls));
+  set_scheme(Scheme::kBls);
+
+  auto committee = consensus_committee(8880);
+  auto ks = keys();
+  auto delivered = make_channel<Bytes>();
+  std::vector<std::thread> threads;
+  for (const auto& [name, addr] :
+       committee.broadcast_addresses(ks[0].name)) {
+    auto l = Listener::bind(addr);
+    CHECK(l.has_value());
+    threads.push_back(listener(std::move(*l), [delivered](Bytes b) {
+      delivered->send(std::move(b));
+    }));
+  }
+  CoreFixture fx;
+  fx.spawn_core(0, committee);  // timer far away (60 s)
+  // make_timeout signs through Signature::sign, which with the dead
+  // sidecar produces exactly what outage peers emit: 64-byte fallback.
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(1, 1)))));
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(2, 1)))));
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::timeout_msg(make_timeout(3, 1)))));
+  auto got = delivered->recv();
+  CHECK(got.has_value());
+  auto msg = ConsensusMessage::deserialize(*got);
+  CHECK(msg.kind == ConsensusMessage::Kind::kTC);
+  CHECK(msg.tc.round == 1);
+  CHECK(msg.tc.votes.size() == 3);
+  // The sealed TC is all host-fallback signatures and verifies under
+  // scheme=bls via length dispatch — receivers do not need the sidecar.
+  for (const auto& [author, sig, hq] : msg.tc.votes) {
+    CHECK(sig.data.size() == 64);
+  }
+  CHECK(msg.tc.verify(committee).ok());
+  for (auto& t : threads) t.join();
+}
+
 TEST(core_spoof_flood_cannot_starve_tc_formation) {
   // One-strike optimism: after a batch ejects a spoof, the round falls
   // back to inline per-signature admission — a spoofer re-occupying the

@@ -123,16 +123,96 @@ struct SchemeGuard {
 };
 }  // namespace
 
-TEST(bls_signature_paths_reject_without_sidecar) {
-  // Under scheme=bls with no sidecar installed, verification rejects
-  // (it must never fall through to the Ed25519 host loop).
+TEST(bls_length_dispatch_without_sidecar) {
+  // Under scheme=bls, 64-byte signatures are the sidecar-down host
+  // Ed25519 fallback (see Signature::sign) and verify on the HOST path;
+  // only 192-byte G2 bytes need the sidecar.  With no sidecar installed
+  // the BLS remainder is UNKNOWN (nullopt), never silently accepted.
   auto kp = keys()[0];
   Digest d = sha512_digest(Bytes{9});
-  Signature sig = Signature::sign(d, kp.secret);  // ed25519-signed
+  Signature sig = Signature::sign(d, kp.secret);  // ed25519-signed, 64 B
   SchemeGuard guard;
   set_scheme(Scheme::kBls);
-  CHECK(!sig.verify(d, kp.name));
-  CHECK(!Signature::verify_batch(d, {{kp.name, sig}}));
+  // Length dispatch: the fallback signature verifies against the
+  // signer's Ed25519 identity key even under scheme=bls ...
+  CHECK(sig.verify(d, kp.name));
+  CHECK(Signature::verify_batch(d, {{kp.name, sig}}));
+  // ... and a corrupted one still rejects — the host check is real.
+  Signature bad = sig;
+  bad.data[5] ^= 1;
+  CHECK(!bad.verify(d, kp.name));
+  CHECK(!Signature::verify_batch(d, {{kp.name, bad}}));
+  // 192-byte BLS bytes cannot be checked without a sidecar: the plain
+  // forms reject, and the transport-aware form reports UNKNOWN so TC
+  // assembly can defer/retry instead of ejecting an honest signer.
+  Signature g2;
+  g2.data = Bytes(192, 7);
+  CHECK(!g2.verify(d, kp.name));
+  CHECK(!Signature::verify_batch(d, {{kp.name, g2}}));
+  CHECK(!Signature::verify_batch_multi({{d, kp.name, g2}}));
+  auto unknown = Signature::verify_batch_multi_checked({{d, kp.name, g2}});
+  CHECK(!unknown.has_value());
+  // A forged 64-byte entry in a mixed batch is DEFINITIVELY false even
+  // though the BLS remainder is unknowable.
+  auto mixed = Signature::verify_batch_multi_checked(
+      {{d, kp.name, bad}, {d, kp.name, g2}});
+  CHECK(mixed.has_value());
+  CHECK(!*mixed);
+  // An all-fallback batch needs no sidecar at all.
+  auto host = Signature::verify_batch_multi_checked({{d, kp.name, sig}});
+  CHECK(host.has_value());
+  CHECK(*host);
+  set_scheme(Scheme::kEd25519);
+  CHECK(sig.verify(d, kp.name));
+}
+
+namespace {
+// Uninstalls the process-global sidecar client + BLS context and
+// restores scheme=ed25519 even when a failing CHECK returns early.
+struct SidecarGuard {
+  ~SidecarGuard() {
+    TpuVerifier::install(nullptr);
+    BlsContext::install(nullptr);
+    set_scheme(Scheme::kEd25519);
+  }
+};
+}  // namespace
+
+TEST(bls_sign_falls_back_to_host_key_when_sidecar_dead) {
+  // scheme=bls with a sidecar that is installed but unreachable (stopped
+  // mid-run): Signature::sign must fall back to the host Ed25519
+  // identity key — a VALID 64-byte signature — instead of emitting
+  // invalid BLS bytes that would stall TC assembly at every verifier.
+  uint16_t port;
+  {
+    // Reserve a port with nothing listening by binding and releasing it.
+    auto l = Listener::bind({"127.0.0.1", 0});
+    CHECK(l.has_value());
+    port = l->port();
+  }
+  SidecarGuard guard;
+  TpuVerifier::install(
+      std::make_unique<TpuVerifier>(Address{"127.0.0.1", port}));
+  auto kp = keys()[0];
+  auto bls = std::make_unique<BlsContext>();
+  bls->secret = Bytes(48, 1);
+  // Register a (garbage) G1 key for the signer so a 192-byte check is a
+  // TRANSPORT question, not an unknown-authority reject.
+  bls->public_keys[kp.name] = Bytes(96, 9);
+  BlsContext::install(std::move(bls));
+  set_scheme(Scheme::kBls);
+
+  Digest d = sha512_digest(Bytes{4, 2});
+  Signature sig = Signature::sign(d, kp.secret);
+  CHECK(sig.data.size() == 64);
+  // Verifies under scheme=bls (length dispatch) and under ed25519.
+  CHECK(sig.verify(d, kp.name));
+  CHECK(Signature::verify_batch(d, {{kp.name, sig}}));
+  // The dead transport still reports UNKNOWN for genuine BLS bytes.
+  Signature g2;
+  g2.data = Bytes(192, 7);
+  CHECK(!Signature::verify_batch_multi_checked({{d, kp.name, g2}})
+             .has_value());
   set_scheme(Scheme::kEd25519);
   CHECK(sig.verify(d, kp.name));
 }

@@ -1,0 +1,8 @@
+// taint fixture: deserialized wire bytes reach the commit sink with no
+// verification gate anywhere on the path.
+#include "messages.hpp"
+
+VerifyResult Core::receive(const Bytes& msg) {
+  ConsensusMessage m = ConsensusMessage::deserialize(msg);
+  return commit(m.block);
+}

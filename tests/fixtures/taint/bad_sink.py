@@ -1,0 +1,17 @@
+"""taint fixture: wire bytes reach verdict emission with no gate.
+
+``parse`` is neither a declared sanitizer nor verify-shaped, so the
+frame flows from the socket straight into an OP reply carrying a
+non-literal verdict mask."""
+import protocol as proto
+
+
+def parse(payload):
+    return payload[0], payload
+
+
+def handle(sock):
+    payload = proto.read_frame(sock)
+    opcode, req = parse(payload)
+    verdicts = [True] * len(req)
+    return proto.encode_reply(opcode, 1, verdicts)

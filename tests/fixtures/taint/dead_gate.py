@@ -1,0 +1,15 @@
+"""taint fixture: a declared sanitizer nothing calls.
+
+``check_frame`` promises a signature gate, but every handler bypasses
+it — the annotation protects nothing (the classic outcome of deleting
+the one call site during a refactor)."""
+
+
+# graftlint: sanitizes=sig
+def check_frame(payload):
+    return len(payload) >= 16
+
+
+def handle(sock):
+    payload = sock.recv(4096)
+    return payload

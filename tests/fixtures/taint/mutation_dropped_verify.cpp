@@ -1,0 +1,17 @@
+// taint fixture: the "delete the verify call" mutation.  handle_vote
+// admits the QC without calling Vote::verify — so the wire bytes reach
+// process_qc ungated AND the declared sanitizer goes dark (two rules
+// from one deleted line, which is exactly the review signal wanted).
+#include "messages.hpp"
+
+// VERIFIES(sig)
+VerifyResult Vote::verify(const Committee& committee) const {
+  return VerifyResult::good();
+}
+
+VerifyResult Core::handle_vote(const Bytes& raw) {
+  Vote vote = Vote::deserialize(raw);
+  // MUTATION: the `vote.verify(committee_)` admission check was here.
+  process_qc(vote.qc);
+  return VerifyResult::good();
+}

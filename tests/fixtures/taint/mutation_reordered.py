@@ -1,0 +1,17 @@
+"""taint fixture: the "reorder admission before verification" mutation.
+
+The request is packed for device launch BEFORE decode_request's
+frame-structure gate runs, so hostile lengths reach the packer."""
+import protocol as proto
+
+
+# graftlint: sanitizes=frame-structure
+def decode_request(payload):
+    return payload[0], payload
+
+
+def handle(sock, engine):
+    payload = proto.read_frame(sock)
+    engine.submit(payload, None)
+    opcode, req = decode_request(payload)
+    return opcode

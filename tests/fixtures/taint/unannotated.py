@@ -1,0 +1,16 @@
+"""taint fixture: a verify-shaped call with an unannotated definition.
+
+``verify_payload`` looks like a gate and is used like a gate, but its
+definition declares no label — the analysis cannot credit it, and the
+author must either annotate it or rename it."""
+
+
+def verify_payload(payload):
+    return len(payload) > 0
+
+
+def handle(sock):
+    payload = sock.recv(4096)
+    if not verify_payload(payload):
+        return None
+    return payload

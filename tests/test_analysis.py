@@ -1611,3 +1611,206 @@ def test_guard_checker_real_tree_is_clean():
     from hotstuff_tpu.analysis import guardlint
 
     assert guardlint.check(REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# grafttaint: verification-gate provenance (wire -> gate -> consensus sink)
+# ---------------------------------------------------------------------------
+
+from hotstuff_tpu.analysis import taint
+from hotstuff_tpu.analysis.__main__ import findings_json
+
+TAINT_FIXTURES = os.path.join(REPO, "tests", "fixtures", "taint")
+
+
+def _taint_fixture(name):
+    with open(os.path.join(TAINT_FIXTURES, name), encoding="utf-8") as fh:
+        src = fh.read()
+    if name.endswith(".py"):
+        return taint.check_sources({name: src})
+    return taint.check_sources({}, {name: src})
+
+
+def test_taint_wire_to_verdict_sink_without_gate():
+    findings = _taint_fixture("bad_sink.py")
+    assert [f.rule for f in findings] == ["unverified-flow-to-sink"]
+    assert "verdict-emission" in findings[0].message
+    assert "bad_sink.py:14" in findings[0].message  # the read_frame origin
+
+
+def test_taint_dead_gate_is_unreachable_sanitizer():
+    findings = _taint_fixture("dead_gate.py")
+    assert [(f.rule, f.line) for f in findings] == \
+        [("unreachable-sanitizer", 9)]
+    assert "check_frame" in findings[0].message
+
+
+def test_taint_verify_shaped_call_needs_annotation():
+    findings = _taint_fixture("unannotated.py")
+    assert [f.rule for f in findings] == ["unannotated-gate"]
+    assert "verify_payload" in findings[0].message
+
+
+def test_taint_cxx_deserialize_to_commit_without_gate():
+    findings = _taint_fixture("bad_core.cpp")
+    assert [f.rule for f in findings] == ["unverified-flow-to-sink"]
+    assert "commit" in findings[0].message
+
+
+def test_taint_mutation_dropped_verify_fires_both_rules():
+    # Deleting the one verify call produces BOTH signals: the QC flows
+    # to process_qc ungated, and the declared gate is never called.
+    findings = _taint_fixture("mutation_dropped_verify.cpp")
+    assert sorted(f.rule for f in findings) == \
+        ["unreachable-sanitizer", "unverified-flow-to-sink"]
+
+
+def test_taint_mutation_reordered_admission_before_gate():
+    findings = _taint_fixture("mutation_reordered.py")
+    assert [f.rule for f in findings] == ["unverified-flow-to-sink"]
+    assert "device-launch-pack" in findings[0].message
+
+
+def test_taint_gate_call_clears_the_same_flow():
+    # The un-mutated shape of mutation_reordered.py: gate first, then
+    # pack — the identical sink call is now a PROVEN path, not a finding.
+    with open(os.path.join(TAINT_FIXTURES, "mutation_reordered.py"),
+              encoding="utf-8") as fh:
+        src = fh.read()
+    fixed = src.replace(
+        "    engine.submit(payload, None)\n"
+        "    opcode, req = decode_request(payload)\n",
+        "    opcode, req = decode_request(payload)\n"
+        "    engine.submit(payload, None)\n")
+    assert fixed != src
+    findings, mapdoc = taint.analyze_sources(
+        {"mutation_reordered.py": fixed}, {})
+    assert findings == []
+    assert mapdoc["sinks_covered"] == {"device-launch-pack": 1}
+    (path,) = mapdoc["paths"]
+    assert path["gates"] == ["frame-structure"]
+
+
+def test_taint_suppression_silences_with_rationale():
+    with open(os.path.join(TAINT_FIXTURES, "bad_sink.py"),
+              encoding="utf-8") as fh:
+        src = fh.read()
+    suppressed = src.replace(
+        "    return proto.encode_reply(",
+        "    # graftlint: disable=unverified-flow-to-sink (fixture)\n"
+        "    return proto.encode_reply(")
+    assert suppressed != src
+    assert taint.check_sources({"bad_sink.py": suppressed}) == []
+
+
+def test_taint_cxx_suppression_contract_matches_python():
+    with open(os.path.join(TAINT_FIXTURES, "bad_core.cpp"),
+              encoding="utf-8") as fh:
+        src = fh.read()
+    suppressed = src.replace(
+        "  return commit(m.block);",
+        "  // graftlint: disable=unverified-flow-to-sink (fixture)\n"
+        "  return commit(m.block);")
+    assert suppressed != src
+    assert taint.check_sources({}, {"bad_core.cpp": suppressed}) == []
+
+
+def test_taint_findings_json_golden():
+    findings = _taint_fixture("mutation_dropped_verify.cpp")
+    doc = findings_json(findings, ("taint",))
+    assert doc["schema"] == "graftlint-findings-v1"
+    assert doc["checkers"] == ["taint"]
+    assert doc["clean"] is False
+    assert [(f["rule"], f["file"], f["line"]) for f in doc["findings"]] == [
+        ("unreachable-sanitizer", "mutation_dropped_verify.cpp", 8),
+        ("unverified-flow-to-sink", "mutation_dropped_verify.cpp", 15),
+    ]
+    assert all(f["evidence"] for f in doc["findings"])
+
+
+def test_taint_literal_reply_masks_are_exempt():
+    # PING/CHAOS echoes reply with literal masks — not verdicts.
+    assert taint.check_sources({"svc.py": textwrap.dedent("""\
+        def handle(sock):
+            payload = read_frame(sock)
+            send(encode_reply(1, 2, []))
+            send(encode_reply(1, 2, [0]))
+    """)}) == []
+
+
+def test_taint_cxx_digit_separator_does_not_eat_the_file():
+    # 20'000 is a number, not a char literal: the functions after it
+    # must still be scanned (regression: ingress.hpp lost its admit gate
+    # to exactly this).
+    findings = taint.check_sources({}, {"g.cpp": (
+        "const size_t kBudget = 20'000;\n"
+        "void Core::receive(const Bytes& raw) {\n"
+        "  auto m = Message::deserialize(raw);\n"
+        "  commit(m.block);\n"
+        "}\n")})
+    assert [f.rule for f in findings] == ["unverified-flow-to-sink"]
+
+
+def test_taint_entry_meet_one_ungated_caller_poisons():
+    # Two callers reach the same helper; only one gates.  The meet is
+    # AND over verified-ness, so the helper's sink stays a finding.
+    src = textwrap.dedent("""\
+        # graftlint: sanitizes=device-verdict
+        def check(req):
+            return True
+
+        def emit(req):
+            return encode_reply(1, 2, req.verdicts)
+
+        def gated(sock):
+            req = read_frame(sock)
+            check(req)
+            return emit(req)
+
+        def ungated(sock):
+            req = read_frame(sock)
+            return emit(req)
+    """)
+    findings = taint.check_sources({"svc.py": src})
+    assert [f.rule for f in findings] == ["unverified-flow-to-sink"]
+    # removing the ungated caller clears it
+    clean = src[:src.index("def ungated")]
+    assert taint.check_sources({"svc.py": clean}) == []
+
+
+def test_taint_real_tree_is_clean():
+    assert taint.check(REPO) == []
+
+
+def test_taint_map_proves_the_required_sink_paths():
+    py_sources, cxx_sources = {}, {}
+    for rel in taint.DEFAULT_TARGETS:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            src = fh.read()
+        (py_sources if rel.endswith(".py") else cxx_sources)[rel] = src
+    findings, mapdoc = taint.analyze_sources(py_sources, cxx_sources)
+    assert findings == []
+    assert mapdoc["schema"] == "grafttaint-map-v1"
+    assert mapdoc["clean"] is True
+    # the PR's acceptance bar: at least one PROVEN wire->gate->sink path
+    # through each consensus-critical sink
+    for sink in ("qc-accept", "tc-assembly", "mempool-admission",
+                 "verdict-emission", "commit", "store-write",
+                 "device-launch-pack"):
+        assert mapdoc["sinks_covered"].get(sink, 0) >= 1, sink
+    # every path names its gates and its wire origin
+    for p in mapdoc["paths"]:
+        assert p["gates"], p
+        assert ":" in p["source"], p
+        assert p["via"], p
+
+
+def test_taint_must_cover_pins():
+    from hotstuff_tpu.analysis.__main__ import check_coverage
+
+    assert check_coverage(REPO, [
+        "taint:native/src/consensus/core.cpp",
+        "taint:hotstuff_tpu/sidecar/protocol.py",
+    ]) == []
+    bad = check_coverage(REPO, ["taint:hotstuff_tpu/obs.py"])
+    assert [f.rule for f in bad] == ["must-cover"]

@@ -331,6 +331,49 @@ def test_chaos_wedge_end_to_end_and_recovery():
         g.close()
 
 
+def test_chaos_wedge_bls_launch_transient_reply_and_reboot():
+    """BLS launches ride the guard (ROADMAP item 3 closed): a wedged
+    pairing answers TRANSIENT (None — never a cacheable [False] for a
+    verdict nobody computed) and starts the crash-only reboot instead of
+    parking the engine thread; the shared verdict cache stays empty and
+    the recovered engine serves traffic normally."""
+    from hotstuff_tpu.offchain import bls12381 as bls
+
+    chaos = ChaosState()
+    g = LaunchGuard(deadlines=LaunchDeadlines(**FAST))
+    engine = VerifyEngine(use_host=True, guard=g, chaos=chaos)
+    try:
+        msg = b"qc digest under bls".ljust(32, b"\0")
+        keys = [bls.key_gen(bytes([i]) * 32) for i in range(1, 4)]
+        pks = [bls.g1_encode(pk) for _, pk in keys]
+        sigs = [bls.g2_encode(bls.sign(sk, msg)) for sk, _ in keys]
+        done, reply_to, wait_for = _collector()
+        chaos.configure({"wedge": 1})
+        assert engine.submit(proto.BlsVotesRequest(1, msg, pks, sigs),
+                             reply_to(1), is_bls=True)
+        assert wait_for(1)
+        assert done[1] is None  # transient form, not a verdict mask
+        snap = engine.stats_snapshot()["guard"]
+        assert snap["wedges"] == 1
+        assert _wait(lambda: not engine._rebooting and engine._device_ok)
+        assert engine.stats_snapshot()["guard"]["reboots"] == 1
+        # Nothing entered the shared verdict cache — a wedge must never
+        # record a [False] other replicas would then share.
+        assert not engine._verdicts
+        # ... and the recovered engine serves verify traffic normally
+        # (a real pairing would overrun FAST's test deadlines, so the
+        # health probe is an Ed25519 batch).
+        msgs, vpks, vsigs = _sigs(4, tamper={2}, seed=9)
+        expect = [bool(b) for b in eddsa.verify_batch(msgs, vpks, vsigs)]
+        assert engine.submit(proto.VerifyRequest(2, msgs, vpks, vsigs),
+                             reply_to(2), cls=vsched.LATENCY)
+        assert wait_for(2)
+        assert done[2] == expect
+    finally:
+        engine.stop()
+        g.close()
+
+
 def test_repeat_wedge_triggers_poison_bisection():
     """A cursed record that wedges every launch carrying it: after the
     second wedge the bisection isolates EXACTLY that record, and later

@@ -17,6 +17,8 @@ import pytest
 
 from hotstuff_tpu.harness.config import (Key, LocalCommittee, NodeParameters,
                                          add_bls_keys)
+from hotstuff_tpu.obs import (chain_spans, join_blocks, parse_node_trace,
+                              parse_spans, stitch_blocks)
 
 from conftest import (
     CLIENT_BIN, NODE_BIN, count_in_log, free_port, wait_sidecar_ping,
@@ -54,11 +56,13 @@ def test_bls_committee_commits(testbed):
         tpu_sidecar=f"127.0.0.1:{sidecar_port}", scheme="bls")
     params.json["consensus"]["timeout_delay"] = TIMEOUT_DELAY_MS
     params.json["mempool"]["batch_size"] = 1000
+    params.json["trace"] = True
     params.print(str(tmp_path / ".parameters.json"))
 
+    spans_file = tmp_path / ".sidecar-spans.jsonl"
     sidecar = spawn(
         [sys.executable, "-m", "hotstuff_tpu.sidecar", "--port",
-         str(sidecar_port), "--host-crypto"],
+         str(sidecar_port), "--host-crypto", "--trace", str(spans_file)],
         "sidecar.log")
     assert wait_sidecar_ping(sidecar_port), "sidecar never became ready"
 
@@ -88,3 +92,20 @@ def test_bls_committee_commits(testbed):
         f"{[count_in_log(p, 'Signature scheme: bls') for p in node_logs]}")
     assert all(count_in_log(p, "Signature scheme: bls") == 1
                for p in node_logs)
+
+    # join_rate parity with the EdDSA e2e: the v5 block-digest context tag
+    # now rides OP_BLS_VERIFY_VOTES/MULTI, so sidecar device spans must
+    # stitch into node block traces under scheme=bls too.  `with_verify`
+    # counts only async-dispatched blocks (verify_submit traced), which is
+    # exactly the population whose BLS verifies carried a ctx tag.
+    time.sleep(2)  # let the sidecar tracer flush its last spans
+    traces = stitch_blocks(
+        [s for p in node_logs for s in parse_node_trace(p.read_text())])
+    spans, malformed = parse_spans(
+        spans_file.read_text() if spans_file.exists() else "")
+    assert not malformed, f"malformed sidecar spans: {malformed}"
+    join, _joined = join_blocks(traces, chain_spans(spans))
+    assert join["with_verify"] > 0, (
+        f"no BLS block rode the traced async verify path: {join}")
+    assert join["rate"] >= 0.9, (
+        f"BLS join_rate below EdDSA parity bar: {join}")

@@ -503,6 +503,44 @@ def test_protocol_v5_legacy_and_zero_tag_frames():
         proto.decode_request(bad)
 
 
+def test_protocol_v5_ctx_rides_bls_ops():
+    """scheme=bls trace parity (ROADMAP item 2): the v5 context tag
+    rides OP_BLS_VERIFY_VOTES / OP_BLS_VERIFY_MULTI exactly like the
+    Ed25519 verifies — optional, length-discriminated (a BLS record is
+    >= 288 bytes, so the 32 tag bytes can never alias one), all-zero
+    tag decodes as 'no context'."""
+    from hotstuff_tpu.sidecar import protocol as proto
+
+    ctx = bytes(range(32))
+    msg = b"d" * 32
+    pks = [b"k" * 96] * 2
+    sigs = [b"g" * 192] * 2
+
+    votes = proto.encode_bls_votes_request(5, msg, pks, sigs, ctx=ctx)
+    opcode, req = proto.decode_request(votes[4:])
+    assert opcode == proto.OP_BLS_VERIFY_VOTES
+    assert req.ctx == ctx
+    assert req.msg == msg and req.pks == pks and req.sigs == sigs
+    legacy = proto.encode_bls_votes_request(5, msg, pks, sigs)
+    assert len(votes) == len(legacy) + proto.CTX_LEN
+    _, req = proto.decode_request(legacy[4:])
+    assert req.ctx is None
+    zero = proto.encode_bls_votes_request(5, msg, pks, sigs,
+                                          ctx=proto.ZERO_CTX)
+    _, req = proto.decode_request(zero[4:])
+    assert req.ctx is None
+
+    msgs = [b"a" * 32, b"b" * 32]
+    multi = proto.encode_bls_multi_request(6, msgs, pks, sigs, ctx=ctx)
+    opcode, req = proto.decode_request(multi[4:])
+    assert opcode == proto.OP_BLS_VERIFY_MULTI
+    assert req.ctx == ctx
+    assert req.msgs == msgs and req.pks == pks and req.sigs == sigs
+    _, req = proto.decode_request(
+        proto.encode_bls_multi_request(6, msgs, pks, sigs)[4:])
+    assert req.ctx is None
+
+
 def test_verify_engine_spans_carry_ctx(tmp_path):
     """An engine-path verify tagged with a block digest must leave the
     ctx on its per-request spans (admit/queue/reply) and the b64 tag in

@@ -1481,6 +1481,250 @@ def guard_headline_probe() -> dict:
         guard.close()
 
 
+def cadence_probe(n_devices: int = 8, budget_s: float = 240.0) -> dict:
+    """Child half of the ``cadence`` headline (graftcadence): ring vs
+    staged sigs/sec at a FIXED offered load, swept across ring depth
+    k in {2, 4, 8} (knob hygiene: the trained depth-k supersedes the
+    staged depth-2 constant, and this sweep is where a measurement pin
+    would come from), queue-wait p99 from the OP_STATS ``cadence``
+    section under a seeded surge-style load through the REAL cadence
+    engine, and the mesh leg: ``ring_slot_pack`` — the pre-donated
+    fixed-shape resident entry a mesh ring slot arms — proven
+    bit-identical to ``verify_batch`` on the forced-host n-device mesh.
+
+    The engine legs run host-mode (pure-python reference verify), so
+    ring-vs-staged numbers measure PIPELINE overheads honestly relative
+    to each other but are never comparable to device throughput.  The
+    acceptance bar rides in ``ok``: staged stays the default (a
+    default-built engine has no ring), every reply bit-identical to the
+    reference (one tampered signature pins the comparison), every
+    cadence dispatch guard-supervised under the ``tick:`` deadline
+    class, queue-wait percentiles present, and the mesh slot
+    bit-identical.  Prints one JSON progress line per completed leg
+    (the parent salvages partials) and returns the dict."""
+    import threading
+
+    from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
+    from hotstuff_tpu.harness.loadgen import UserLoad
+    from hotstuff_tpu.parallel import sharded_verify as shv
+    from hotstuff_tpu.parallel.mesh import make_mesh
+    from hotstuff_tpu.sidecar import protocol as proto
+    from hotstuff_tpu.sidecar import sched as vsched
+    from hotstuff_tpu.sidecar.guard import LaunchDeadlines, LaunchGuard
+    from hotstuff_tpu.sidecar.ring import CadenceRing, RingDepth
+    from hotstuff_tpu.sidecar.service import VerifyEngine
+    from hotstuff_tpu.utils.xla_cache import configure_xla_cache
+
+    t0 = time.perf_counter()
+    out = {"n_devices": n_devices}
+
+    def emit_progress():
+        print(json.dumps({"cadence": out}), flush=True)
+
+    # Fixed offered load shared by every engine leg: REQS requests of
+    # REQ_SIGS records, one tampered signature pinning the bit-identity
+    # comparison on every single reply.
+    REQS, REQ_SIGS = 12, 8
+    msgs, pks, sigs = _make_ref_sigs(REQ_SIGS, seed=41)
+    sigs = list(sigs)
+    sigs[3] = sigs[3][:1] + bytes([sigs[3][1] ^ 0xFF]) + sigs[3][2:]
+    expect = [bool(ref.verify(pk, m, s))
+              for m, pk, s in zip(msgs, pks, sigs)]
+
+    def drive(engine):
+        """Submit the fixed load, wait out every reply; (sigs/s, ok)."""
+        done = {}
+        cond = threading.Condition()
+
+        def reply_to(rid):
+            def _reply(mask):
+                with cond:
+                    done.setdefault(rid, []).append(mask)
+                    cond.notify_all()
+            return _reply
+
+        t = time.perf_counter()
+        for rid in range(1, REQS + 1):
+            engine.submit(proto.VerifyRequest(rid, msgs, pks, sigs),
+                          reply_to(rid), cls=vsched.LATENCY)
+        with cond:
+            cond.wait_for(lambda: len(done) == REQS, timeout=120.0)
+        dt = time.perf_counter() - t
+        masks_ok = (len(done) == REQS
+                    and all(v == [expect] for v in done.values()))
+        return round(REQS * REQ_SIGS / dt, 1), masks_ok
+
+    # Staged stays the DEFAULT: a default-built engine has no ring; the
+    # ring engages only behind --cadence / HOTSTUFF_TPU_CADENCE.
+    probe_engine = VerifyEngine(use_host=True)
+    staged_default = probe_engine._ring is None
+    probe_engine.stop()
+    out["staged_default"] = staged_default
+
+    masks = {}
+    eng = VerifyEngine(use_host=True)
+    try:
+        rate, masks["staged"] = drive(eng)
+    finally:
+        eng.stop()
+    out["staged_sigs_per_s"] = rate
+    emit_progress()
+
+    tick_supervised = True
+    for k in RingDepth.DEPTHS:
+        if time.perf_counter() - t0 > budget_s:
+            out[f"ring_k{k}"] = {"skipped": True}
+            continue
+        guard = LaunchGuard(deadlines=LaunchDeadlines(warm_boot=True))
+        eng = VerifyEngine(
+            use_host=True, guard=guard,
+            ring_factory=lambda e, k=k: CadenceRing(
+                e, depth=RingDepth(pinned=k)))
+        try:
+            rate, masks[f"ring_k{k}"] = drive(eng)
+            snap = eng.stats_snapshot()["cadence"]
+            deadlines = guard.snapshot()["deadlines"]
+        finally:
+            eng.stop()
+            guard.close()
+        # Supervision evidence: the guard's deadline trainer saw the
+        # tick class — every cadence dispatch went through guard.call.
+        ticked = any(dkey.startswith("tick:") and v.get("n", 0) >= 1
+                     for dkey, v in deadlines.items())
+        tick_supervised = tick_supervised and ticked
+        out[f"ring_k{k}"] = {
+            "sigs_per_s": rate,
+            "dispatch_ticks": snap["dispatch_ticks"],
+            "tick_rate_hz": snap["tick_rate_hz"],
+            "pad_fill_ratio": snap["pad_fill"]["ratio"],
+            "queue_wait_p99_ms": snap["queue_wait"]["p99_ms"],
+            "generation_drops": snap["generation"]["drops"],
+            "guard_tick_launches": ticked,
+        }
+        emit_progress()
+    out["tick_launches_supervised"] = tick_supervised
+
+    # Queue-wait p99 under the seeded surge-style plan: the loadgen's
+    # heavy-tailed multi-user generator (the surge headline's seeded
+    # twin of the C++ client's UserLoadModel) offers bulk bursts over a
+    # steady consensus-class stream into the REAL cadence engine, BUSY
+    # backoff honored; the reported percentiles are the OP_STATS
+    # ``cadence.queue_wait`` reservoir — admission to cadence dispatch.
+    if time.perf_counter() - t0 > budget_s:
+        out["surge_wait"] = {"skipped": True}
+    else:
+        guard = LaunchGuard(deadlines=LaunchDeadlines(warm_boot=True))
+        eng = VerifyEngine(
+            use_host=True, guard=guard,
+            ring_factory=lambda e: CadenceRing(
+                e, depth=RingDepth(pinned=4)))
+        try:
+            done = []
+            cond = threading.Condition()
+
+            def _reply(mask):
+                with cond:
+                    done.append(1)
+                    cond.notify_all()
+
+            load = UserLoad(rate=40.0, users=50, seed=11)
+            TICK_S, TICKS = 0.02, 25
+            rid = 1000
+            accepted = 0
+            t_load = time.perf_counter()
+            for i in range(1, TICKS + 1):
+                t_rel = i * TICK_S
+                rid += 1
+                accepted += 1
+                eng.submit(proto.VerifyRequest(rid, msgs, pks, sigs),
+                           _reply, cls=vsched.LATENCY)
+                for _ in range(load.arrivals(t_rel)):
+                    rid += 1
+                    if eng.submit(
+                            proto.VerifyRequest(rid, msgs, pks, sigs),
+                            _reply, cls=vsched.BULK):
+                        accepted += 1
+                    else:
+                        load.busy(t_rel,
+                                  eng.retry_after_ms(vsched.BULK) / 1e3)
+                sleep_left = t_load + t_rel - time.perf_counter()
+                if sleep_left > 0:
+                    time.sleep(sleep_left)
+            with cond:
+                cond.wait_for(lambda: len(done) >= accepted,
+                              timeout=120.0)
+            snap = eng.stats_snapshot()["cadence"]
+        finally:
+            eng.stop()
+            guard.close()
+        out["surge_wait"] = {
+            "accepted_reqs": accepted,
+            "answered": len(done),
+            "deferred_by_busy": load.deferred,
+            "queue_wait_p50_ms": snap["queue_wait"]["p50_ms"],
+            "queue_wait_p99_ms": snap["queue_wait"]["p99_ms"],
+            "occupancy_hist": snap["occupancy_hist"],
+        }
+        emit_progress()
+
+    # Mesh leg: the fixed-shape pre-donated resident entry a mesh ring
+    # slot arms (parallel.sharded_verify.ring_slot_pack), bit-identical
+    # to verify_batch on the forced-host n-device mesh.
+    if time.perf_counter() - t0 > budget_s:
+        out["mesh_ring_slot"] = {"skipped": True}
+    else:
+        try:
+            configure_xla_cache()
+            mesh = make_mesh(n_devices)
+            n = 16
+            mm, mp, ms = _make_ref_sigs(n, seed=43)
+            ms = list(ms)
+            ms[5] = ms[5][:1] + bytes([ms[5][1] ^ 0xFF]) + ms[5][2:]
+            want = [bool(b) for b in eddsa.verify_batch(mm, mp, ms)]
+            rows = shv.shard_aligned_rows(n, n_devices,
+                                          eddsa.MAX_SUBBATCH)
+            prep = eddsa.prepare_batch(mm, mp, ms)
+            got = [bool(b)
+                   for b in shv.ring_slot_pack(mesh, prep, rows)()()]
+            out["mesh_ring_slot"] = {"rows": rows,
+                                     "bit_identical": got == want}
+        except Exception as e:  # noqa: BLE001 — leg isolation
+            out["mesh_ring_slot"] = {"error": f"{e!r:.160}"}
+        emit_progress()
+
+    masks_ok = bool(masks) and all(masks.values())
+    ring_rates = [v.get("sigs_per_s", 0.0) for kk, v in out.items()
+                  if kk.startswith("ring_k") and isinstance(v, dict)
+                  and not v.get("skipped")]
+    sw = out.get("surge_wait", {})
+    wait_ok = bool(sw.get("skipped")) or \
+        sw.get("queue_wait_p99_ms") is not None
+    mr = out.get("mesh_ring_slot", {})
+    mesh_ok = bool(mr.get("skipped")) or mr.get("bit_identical") is True
+    out["masks_bit_identical"] = masks_ok
+    out["ok"] = (staged_default and masks_ok and tick_supervised
+                 and bool(ring_rates)
+                 and all(r > 0 for r in ring_rates)
+                 and wait_ok and mesh_ok)
+    emit_progress()
+    return out
+
+
+def cadence_headline(n_devices: int = 8,
+                     budget_s: float | None = None) -> dict:
+    """Parent half of the ``cadence`` headline field (graftcadence,
+    ROADMAP item 6): run :func:`cadence_probe` on the forced-host CPU
+    mesh (see :func:`_forced_host_mesh_headline` for the subprocess
+    contract; HOTSTUFF_TPU_CADENCE_BUDGET seconds, default 240, bounds
+    the stage).  Emitted on BOTH the live and degraded lines."""
+    if budget_s is None:
+        budget_s = float(
+            os.environ.get("HOTSTUFF_TPU_CADENCE_BUDGET", "240"))
+    return _forced_host_mesh_headline(
+        "cadence", f"cadence_probe({n_devices}, budget_s={budget_s})",
+        n_devices, budget_s)
+
+
 def viewchange_headline(committees=(20, 100, 300), repeats: int = 2,
                         budget_s: float | None = None) -> dict:
     """The headline ``viewchange`` field (graftview): batched vs
@@ -1796,6 +2040,17 @@ def run_degraded(reason: str):
             guard = guard_headline_probe()
         except Exception as e:  # noqa: BLE001 — guard probe is best-effort
             guard = {"error": f"{e!r:.120}"}
+        # graftcadence ring-vs-staged on the forced-host mesh: the same
+        # bounded-subprocess emit-or-die discipline as mesh_rlc — the
+        # ring story (depth sweep, queue-wait p99, resident-slot
+        # bit-identity) is proven on the degraded line too.
+        try:
+            cadence = cadence_headline(budget_s=min(
+                float(os.environ.get("HOTSTUFF_TPU_CADENCE_BUDGET",
+                                     "240")),
+                max(0.0, budget_left_s() - 90.0)))
+        except Exception as e:  # noqa: BLE001 — headline isolation
+            cadence = {"error": f"{e!r:.120}"}
         # The watchdog stays armed until the moment of the real emit: a
         # stall anywhere above (including the sched probe) must still
         # produce a parseable line, which is this path's whole contract.
@@ -1806,7 +2061,7 @@ def run_degraded(reason: str):
              note=reason, rlc=rlc, mesh_rlc=mesh_rlc,
              committee_scale=committee_scale, roofline=roofline,
              viewchange=viewchange, sched=sched, chaos=chaos, trace=trace,
-             surge=surge, guard=guard)
+             surge=surge, guard=guard, cadence=cadence)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -2156,10 +2411,20 @@ def main(argv=None):
         guard = guard_headline_probe()
     except Exception as e:  # noqa: BLE001 — guard probe is best-effort
         guard = {"error": f"{e!r:.120}"}
+    # graftcadence: ring vs staged on the forced-host mesh — a bounded
+    # subprocess like mesh_rlc (its own watchdog discipline), budgeted
+    # against what is left of the outer window.
+    try:
+        cadence = cadence_headline(budget_s=min(
+            float(os.environ.get("HOTSTUFF_TPU_CADENCE_BUDGET", "240")),
+            max(0.0, budget_left_s() - 60.0)))
+    except Exception as e:  # noqa: BLE001 — headline isolation
+        cadence = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
                mesh_rlc=mesh_rlc, committee_scale=committee_scale,
                roofline=roofline, viewchange=viewchange, sched=sched,
-               chaos=chaos, trace=trace, surge=surge, guard=guard)
+               chaos=chaos, trace=trace, surge=surge, guard=guard,
+               cadence=cadence)
 
 
 if __name__ == "__main__":

@@ -19,13 +19,13 @@ import sys
 
 CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
             "obsspan", "obsgrammar", "threads", "cxxsync", "ingress",
-            "guard", "taint")
+            "guard", "ring", "taint")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
     from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
-        obsspan, padshape, sanitize, sockets, taint, threads, timing, \
-        wirecheck
+        obsspan, padshape, ringlint, sanitize, sockets, taint, threads, \
+        timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -52,6 +52,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         findings += ingress.check(root)
     if "guard" in checkers:
         findings += guardlint.check(root)
+    if "ring" in checkers:
+        findings += ringlint.check(root)
     if "taint" in checkers:
         # CLI runs refresh the wire→gate→sink proof artifact alongside
         # the findings (tests call taint.check() directly, no write)
@@ -81,7 +83,7 @@ def check_coverage(root: str, must_cover) -> list:
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
     from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
-        obsspan, padshape, sockets, taint, threads, timing
+        obsspan, padshape, ringlint, sockets, taint, threads, timing
     from .common import Finding
 
     target_sets = {
@@ -95,6 +97,7 @@ def check_coverage(root: str, must_cover) -> list:
         "cxxsync": tuple(cxxsync.DEFAULT_TARGETS),
         "ingress": tuple(ingress.DEFAULT_TARGETS),
         "guard": tuple(guardlint.DEFAULT_TARGETS),
+        "ring": tuple(ringlint.DEFAULT_TARGETS),
         "taint": tuple(taint.DEFAULT_TARGETS),
     }
     findings = []

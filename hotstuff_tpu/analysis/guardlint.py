@@ -45,6 +45,10 @@ from .common import Finding, apply_suppressions, parse_source, \
 DEFAULT_TARGETS = (
     "hotstuff_tpu/sidecar/service.py",
     "hotstuff_tpu/sidecar/guard.py",
+    # graftcadence: the ring shares the engine thread, so its blocking
+    # discipline is the engine's (the ring checker adds the tick-body
+    # rules on top).
+    "hotstuff_tpu/sidecar/ring.py",
 )
 
 _WAIT_ATTRS = {"result", "exception", "wait"}

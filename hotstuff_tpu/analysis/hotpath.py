@@ -62,6 +62,7 @@ DEFAULT_TARGETS = (
     "hotstuff_tpu/ops/kern",
     "hotstuff_tpu/parallel",
     "hotstuff_tpu/sidecar/service.py",
+    "hotstuff_tpu/sidecar/ring.py",
     "hotstuff_tpu/sidecar/sched",
     "hotstuff_tpu/crypto/eddsa.py",
     "hotstuff_tpu/offchain/bls12381.py",

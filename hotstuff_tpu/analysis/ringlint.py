@@ -1,0 +1,166 @@
+"""graftlint ring checker: cadence tick-body discipline (graftcadence).
+
+The resident ring's whole value is a BOUNDED, steady-state tick: every
+cadence tick expires, collects, and arms within the guard's deadline
+class, so the loop's wall is always a few guarded launches — never a
+park.  Two structural hazards would silently break that:
+
+  * an unbounded wait inside the tick body — one hung ``.result()`` /
+    ``.wait()`` outside the guard's deadline helper parks the ring (and
+    with it the engine thread and every queued consensus verify), which
+    is exactly the wedge class graftguard exists to preempt;
+
+  * a launch of an UNWARMED shape inside the tick — the ring's contract
+    is ONE resident compiled program per warmed ShapeRegistry bucket,
+    re-dispatched at cadence.  A direct ``verify_batch``-family call
+    picks its own compile bucket, so a single odd-shaped tick smuggles
+    a fresh XLA compile (seconds to minutes) into a loop whose deadline
+    class is the warm grace — a guaranteed false wedge.
+
+The type system cannot hold either invariant; this checker holds both
+mechanically, as the single rule ``blocking-call-in-ring-tick``.
+
+Scope: methods of ring classes (a ``ClassDef`` whose name contains
+``Ring``) in the scanned modules.  Waits lexically inside the thunks
+handed TO the guard (``engine._guarded(...)`` / ``<guard>.call(...)``
+argument subtrees) are by definition supervised — the monitor preempts
+them — so those subtrees are exempt, same as the guard checker.  The
+legal launch routes are the engine's own pack worker (``engine._pack``,
+warmed registry buckets by construction) and the fixed-shape resident
+entry ``ring_slot_pack``; everything in ``_FRESH_COMPILE_CALLS`` picks
+its own bucket and is banned from tick bodies.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob as _glob
+import os
+
+from .common import Finding, apply_suppressions, parse_source, \
+    read_source
+
+DEFAULT_TARGETS = (
+    "hotstuff_tpu/sidecar/ring.py",
+)
+
+_WAIT_ATTRS = {"result", "exception", "wait"}
+
+# Launch entries that choose their own compile bucket from the batch
+# shape: legal in the staged engine (whose deadline class tolerates a
+# compile), illegal inside a cadence tick (warm-grace deadline class;
+# the ring must route through engine._pack or ring_slot_pack).
+_FRESH_COMPILE_CALLS = {
+    "verify_batch",
+    "verify_batch_rlc",
+    "verify_batch_sharded",
+    "verify_batch_sharded_pack",
+    "verify_rlc_sharded",
+    "verify_rlc_sharded_pack",
+    "verify_sharded_chunked",
+    "verify_sharded_chunked_pack",
+    "make_sharded_verifier",
+}
+
+
+def _is_unbounded_wait(node: ast.Call) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _WAIT_ATTRS:
+        return False
+    if node.args:
+        return False  # positional timeout (Event.wait(t), cv.wait(t))
+    if any(kw.arg == "timeout" for kw in node.keywords):
+        return False
+    return True
+
+
+def _call_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _names_guard(node: ast.expr) -> bool:
+    while isinstance(node, ast.Attribute):
+        if "guard" in node.attr.lower():
+            return True
+        node = node.value
+    return isinstance(node, ast.Name) and "guard" in node.id.lower()
+
+
+def _is_guard_entry(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "_guarded":
+            return True
+        if func.attr == "call" and _names_guard(func.value):
+            return True
+    return isinstance(func, ast.Name) and func.id == "_guarded"
+
+
+def _ring_bodies(tree: ast.AST):
+    """Yield every method body of every ring class in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and "ring" in node.name.lower():
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield item
+
+
+def check_source(path: str, source: str) -> list:
+    findings = []
+    tree = parse_source(source, path)
+    for fn in _ring_bodies(tree):
+        supervised: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _is_guard_entry(node):
+                for arg in list(node.args) + [kw.value for kw in
+                                              node.keywords]:
+                    for child in ast.walk(arg):
+                        supervised.add(id(child))
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in supervised:
+                continue
+            if _is_unbounded_wait(node):
+                findings.append(Finding(
+                    path, node.lineno, "blocking-call-in-ring-tick",
+                    f"unbounded .{node.func.attr}() wait inside ring "
+                    f"tick body {fn.name}: one hung call parks the "
+                    "cadence loop and every queued consensus verify "
+                    "behind it — route it through self.engine._guarded "
+                    "(the tick deadline class), or bound it with a "
+                    "timeout"))
+            elif _call_name(node) in _FRESH_COMPILE_CALLS:
+                findings.append(Finding(
+                    path, node.lineno, "blocking-call-in-ring-tick",
+                    f"{_call_name(node)}() inside ring tick body "
+                    f"{fn.name} picks its own compile bucket: an "
+                    "odd-shaped tick smuggles a fresh XLA compile into "
+                    "the warm-grace deadline class (guaranteed false "
+                    "wedge) — arm through engine._pack (warmed "
+                    "registry buckets) or ring_slot_pack (the "
+                    "fixed-shape resident entry)"))
+    return findings
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: source} mapping (the unit-test entry point)."""
+    findings = []
+    for path, src in sources.items():
+        findings += check_source(path, src)
+    return sorted(apply_suppressions(findings, sources),
+                  key=lambda f: (f.path, f.line))
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    sources = {}
+    for target in targets:
+        for path in sorted(_glob.glob(os.path.join(root, target))):
+            if not path.endswith(".py"):
+                continue
+            sources[os.path.relpath(path, root)] = read_source(path)
+    return check_sources(sources)

@@ -67,6 +67,7 @@ from .common import Finding, apply_suppressions, parse_source, read_source
 DEFAULT_TARGETS = (
     "hotstuff_tpu/sidecar/service.py",
     "hotstuff_tpu/sidecar/guard.py",
+    "hotstuff_tpu/sidecar/ring.py",
     "hotstuff_tpu/sidecar/sched",
     "hotstuff_tpu/obs/sampler.py",
     "hotstuff_tpu/chaos/runner.py",

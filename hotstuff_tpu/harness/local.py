@@ -279,20 +279,21 @@ class LocalBench:
         (obs/sampler.py), appending the time series to logs/metrics.jsonl
         — so throughput/queue-wait over time is plottable and a
         chaos-killed sidecar's telemetry survives as the last good
-        sample.  Each tick dials a fresh connection: the sampler must
-        outlive a sidecar kill/restart, not die with the first socket."""
+        sample.  The connection persists across ticks with reconnect-
+        on-failure (obs/sampler.persistent_fetch): the sampler still
+        outlives a sidecar kill/restart — a dead socket costs one
+        ok-false tick and the next tick re-dials — without paying (and
+        measuring) a TCP dial on every healthy 1 Hz sample."""
         if not self.tpu_sidecar:
             return None
         from ..obs import MetricsSampler
+        from ..obs.sampler import persistent_fetch
         from ..sidecar.client import SidecarClient
 
-        def fetch():
-            with SidecarClient(port=self.SIDECAR_PORT,
-                               timeout=5.0) as client:
-                return client.stats()
-
         self._sampler = MetricsSampler(
-            fetch, PathMaker.metrics_file(),
+            persistent_fetch(
+                lambda: SidecarClient(port=self.SIDECAR_PORT, timeout=5.0)),
+            PathMaker.metrics_file(),
             interval_s=self.METRICS_INTERVAL_S)
         return self._sampler.start()
 

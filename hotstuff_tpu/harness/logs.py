@@ -107,6 +107,10 @@ class LogParser:
         self.trace = None
         self.metrics = None
         self.node_metrics = None
+        # graftcadence: the OP_STATS ``cadence`` section (ring tick
+        # rate, occupancy, pad-fill, generation drops, queue waits)
+        # lands here machine-readable for bench.py's round trip.
+        self.cadence = None
         if self.malformed_lines:
             self.notes.append(
                 f"Parser: skipped {self.malformed_lines} torn/malformed "
@@ -668,6 +672,27 @@ class LogParser:
             surge = stats.get("surge")
             if isinstance(surge, dict):
                 lines.extend(self._surge_lines(surge))
+            # graftcadence: a run served by the resident ring says so —
+            # tick rate, pad-fill and generation accounting in the
+            # CONFIG notes, the full section machine-readable on
+            # self.cadence for bench.py's round trip.
+            cad = stats.get("cadence")
+            if isinstance(cad, dict) and cad.get("ticks"):
+                self.cadence = cad
+                gen = cad.get("generation", {})
+                wait = cad.get("queue_wait", {})
+                pad = cad.get("pad_fill", {})
+                lines.append(
+                    f"Sidecar cadence ring: depth {cad.get('depth', 0)}"
+                    f"{'' if cad.get('enabled') else ' (FELL BACK TO STAGED)'}"
+                    f", {cad['ticks']:,} tick(s) @ "
+                    f"{cad.get('tick_rate_hz', 0):g} Hz "
+                    f"({cad.get('dispatch_ticks', 0):,} dispatching), "
+                    f"pad fill {pad.get('ratio', 0.0):.0%}, "
+                    f"{gen.get('drops', 0):,} generation drop(s) / "
+                    f"{gen.get('expiries', 0):,} expiry(ies), "
+                    f"queue wait p50 {wait.get('p50_ms', 0)} ms / "
+                    f"p99 {wait.get('p99_ms', 0)} ms")
         except (TypeError, ValueError, AttributeError):
             return
         self.notes.extend(lines)

@@ -45,6 +45,7 @@ from .sampler import (
     commit_rate_divergence,
     merge_node_series,
     parse_node_metrics,
+    persistent_fetch,
     read_samples,
     recovery_curve,
     split_samples,
@@ -77,6 +78,7 @@ __all__ = [
     "parse_node_metrics",
     "parse_node_trace",
     "parse_spans",
+    "persistent_fetch",
     "read_samples",
     "recovery_curve",
     "split_samples",

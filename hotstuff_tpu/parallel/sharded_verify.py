@@ -171,6 +171,46 @@ def verify_batch_sharded_pack(mesh: Mesh, prep: dict, *,
     return dispatch
 
 
+def ring_slot_pack(mesh: Mesh, prep: dict, rows: int, *,
+                   max_subbatch: int = MAX_SUBBATCH):
+    """graftcadence: arm ONE cadence-ring slot with this batch at the
+    ring's FIXED shard-aligned row count.
+
+    Same ``dispatch() -> fetch()`` contract (and the same donated mesh
+    program, hence bit-identical masks) as
+    :func:`verify_batch_sharded_pack`, with one difference: the padded
+    row count is pinned to ``rows`` — the ring's per-tick quota bucket,
+    a shape the warmup compiled — instead of the batch's own bucket.
+    Every cadence tick therefore re-dispatches the SAME resident
+    compiled program regardless of how full the tick was (partially-
+    filled ticks are pad-filled from the bulk backlog upstream; what
+    remains is dead rows with ``present = 0``), which is the
+    fixed-shape ring discipline: never a fresh compile mid-run.
+
+    "Pre-donated" means the SHAPES are resident, not the bytes:
+    donation consumes a buffer per dispatch, so each generation's
+    transfer happens at arm time on the pack thread — overlapping the
+    in-flight generations' device compute exactly like the staged
+    pipeline's h2d — into buffers of the one ring shape.  A batch
+    larger than ``rows`` (defensive; the scheduler's tick quota caps
+    the coalesce) falls back to its own shard-aligned bucket."""
+    n = prep["a"].shape[0]
+    n_dev = mesh.devices.size
+    m = max(int(rows), shard_aligned_rows(n, n_dev, max_subbatch))
+    dev = _pack_sharded_arrays(mesh, prep, m)
+
+    def dispatch():
+        mask_dev, _bad = _cached_verifier_donated(
+            mesh, max_subbatch)(*dev)
+
+        def fetch():
+            return np.asarray(mask_dev)[:n]
+
+        return fetch
+
+    return dispatch
+
+
 def verify_batch_sharded(mesh: Mesh, prep: dict, *, return_bad_total=False,
                          max_subbatch: int = MAX_SUBBATCH):
     """Run a host-prepared batch (see crypto/eddsa.prepare_batch) across the

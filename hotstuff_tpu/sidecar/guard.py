@@ -147,6 +147,13 @@ class LaunchDeadlines:
     # tight warmed deadline there would false-wedge the recovery
     # itself (observed: a contended host failing every canary).
     COMPILE_CLASS_PREFIXES = ("canary:", "poison-probe:")
+    # graftcadence tick launches: the ring only ever dispatches warmed
+    # ShapeRegistry buckets (a fresh compile mid-run is the lint rule's
+    # whole point), so an unobserved ``tick:`` key gets the warm grace
+    # regardless of boot state — the compile budget would let a wedged
+    # cadence tick stall the resident pipeline for minutes on a cold
+    # manifest that the ring, by construction, never compiles under.
+    TICK_CLASS_PREFIX = "tick:"
 
     def __init__(self, warm_boot: bool = False,
                  compile_budget_s: float | None = None,
@@ -192,6 +199,8 @@ class LaunchDeadlines:
             if len(samples) >= self.MIN_OBSERVATIONS:
                 p99 = _percentile(sorted(samples), 0.99)
                 return max(self.min_deadline_s, self.p99_multiple * p99)
+        if key.startswith(self.TICK_CLASS_PREFIX):
+            return self.warm_grace_s
         return self.warm_grace_s if self.warm_boot \
             else self.compile_budget_s
 

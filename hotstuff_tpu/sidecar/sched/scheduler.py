@@ -212,10 +212,28 @@ class Scheduler:
                     timeout: float | None = None) -> Launch | None:
         """Assemble the next launch, or None when (a) non-blocking and
         idle, or (b) the timeout expired."""
+        return self._next(None, block, timeout)
+
+    def next_tick(self, quota_sigs: int,
+                  timeout: float | None = None) -> Launch | None:
+        """graftcadence: assemble one cadence tick's quota — the same
+        strict-priority, carry-over, pad-fill policy as next_launch,
+        but the coalesce run is capped at ``quota_sigs`` (the ring's
+        per-tick budget, a warmed bucket) instead of the class launch
+        cap.  Pad-fill still pads to the compiled bucket of the deduped
+        record count: dead slots are free FLOPs whether the launch came
+        from a tick quota or a staged coalesce.  Non-blocking by
+        default (the ring paces itself); with a timeout the fully-idle
+        ring parks here so a fresh offer wakes it immediately instead
+        of eating an idle-backoff interval."""
+        return self._next(quota_sigs, timeout is not None, timeout)
+
+    def _next(self, cap: int | None, block: bool,
+              timeout: float | None) -> Launch | None:
         deadline = None if timeout is None else monotonic() + timeout
         with self._cond:
             while True:
-                launch = self._assemble_locked()
+                launch = self._assemble_locked(cap=cap)
                 if launch is not None or not block:
                     return launch
                 wait = None if deadline is None \
@@ -224,7 +242,7 @@ class Scheduler:
                     if deadline is not None and monotonic() >= deadline:
                         return None
 
-    def _assemble_locked(self) -> Launch | None:
+    def _assemble_locked(self, cap: int | None = None) -> Launch | None:
         lat, blk = self._queues[LATENCY], self._queues[BULK]
         if lat:
             if lat.items[0].is_bls:
@@ -235,7 +253,7 @@ class Scheduler:
                 # seconds-long pairing backlog shows up — stay honest.
                 self.stats.note_launch(launch, 1, monotonic())
                 return launch
-            items, total = self._coalesce_locked(lat)
+            items, total = self._coalesce_locked(lat, cap=cap)
             # Fill room comes from the DEDUPED record count, not the raw
             # total: the engine dedups (msg, pk, sig) records before
             # dispatch and launches bucket(unique), so under the headline
@@ -271,25 +289,29 @@ class Scheduler:
             self.stats.note_launch(launch, capacity, monotonic())
             return launch
         if blk:
-            items, total = self._coalesce_locked(blk)
+            items, total = self._coalesce_locked(blk, cap=cap)
             launch = Launch("verify", items, BULK)
             self.stats.note_launch(
                 launch, self.shapes.bucket_capacity(total), monotonic())
             return launch
         return None
 
-    def _coalesce_locked(self, q: ClassQueue):
+    def _coalesce_locked(self, q: ClassQueue, cap: int | None = None):
         """Pop a FIFO run of same-class Ed25519 requests up to the launch
         cap.  The head always ships (an oversized single request slices
         inside the engine dispatch); a later head that would overflow the
         budget stays queued and leads the next launch (carry-over).
 
-        The cap is the registry's launch_cap: MAX_SUBBATCH until the
-        bulk shapes are warmed, then the single-chip MAX_COALESCED — or,
-        on a mesh, the whole-backlog scan capacity the gated enable_bulk
-        raised it to (graftscale): everything coalesced here then drains
-        as ONE chunked mesh scan instead of per-cap ladder slices."""
-        cap = self.shapes.launch_cap
+        The default cap is the registry's launch_cap: MAX_SUBBATCH until
+        the bulk shapes are warmed, then the single-chip MAX_COALESCED —
+        or, on a mesh, the whole-backlog scan capacity the gated
+        enable_bulk raised it to (graftscale): everything coalesced here
+        then drains as ONE chunked mesh scan instead of per-cap ladder
+        slices.  The cadence ring passes its per-tick quota instead
+        (never above launch_cap — a tick must stay inside one warmed
+        shape)."""
+        cap = self.shapes.launch_cap if cap is None \
+            else min(cap, self.shapes.launch_cap)
         items = [q._pop_locked()]
         total = len(items[0])
         while q.items and not q.items[0].is_bls:

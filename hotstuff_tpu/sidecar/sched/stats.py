@@ -24,6 +24,18 @@ launch.
 from __future__ import annotations
 
 import threading
+from time import monotonic
+
+# Rolling window for the ``pipeline`` overlap section: bounded both by
+# entry count and by age.  Lifetime totals once lived here — on a
+# long-running sidecar they dampened the overlap ratio exactly when a
+# surge arrived (hours of healthy history outvoting the collapse in
+# front of it), which also starved the surge controller's derate.  The
+# window matches the admission controller's recency discipline
+# (surge.PACK_WINDOW_S); lifetime totals stay visible under
+# ``lifetime_*`` keys for trend tooling.
+PIPE_WINDOW = 512
+PIPE_WINDOW_S = 30.0
 
 
 def _percentile(sorted_vals: list, q: float) -> float:
@@ -41,10 +53,11 @@ class SchedStats:
     # grow without limit (newest samples win — the interesting tail).
     WAIT_SAMPLES_CAP = 4096
 
-    def __init__(self):
+    def __init__(self, clock=monotonic):
         from collections import deque
 
         self._lock = threading.Lock()
+        self._clock = clock
         self.launches = 0
         self.launches_by_class: dict[str, int] = {}
         # coalesce-size histogram: padded-bucket capacity -> launches
@@ -71,12 +84,15 @@ class SchedStats:
         self.scan_sigs = 0
         self.scan_chunk_hist: dict[int, int] = {}
         self.scan_slices_avoided = 0
-        # Double-buffered dispatch pipeline: total host pack time, and
-        # the share of it that ran while a launch was already executing
-        # on the device (hidden == free; the overlap ratio is the
-        # pipeline doing its job).
+        # Double-buffered dispatch pipeline: host pack time and the
+        # share of it that ran while a launch was already executing on
+        # the device (hidden == free; the overlap ratio is the pipeline
+        # doing its job).  The reported section is computed over the
+        # bounded rolling window; the lifetime accumulators survive for
+        # trend tooling only.
         self.pack_s = 0.0
         self.pack_hidden_s = 0.0
+        self._pack_window = deque(maxlen=PIPE_WINDOW)  # (t, dur, hidden)
         self._waits = {c: deque(maxlen=self.WAIT_SAMPLES_CAP)
                        for c in ("latency", "bulk")}
         # graftsurge: the admission controller (sched/surge.py), attached
@@ -152,20 +168,45 @@ class SchedStats:
             self.scan_chunk_hist[g] = self.scan_chunk_hist.get(g, 0) + 1
             self.scan_slices_avoided += max(0, slices_avoided)
 
-    def note_pack(self, duration_s: float, hidden: bool):
+    def note_pack(self, duration_s: float, hidden: bool,
+                  now: float | None = None):
         """One host-side pack stage: ``hidden`` says a launch was
         executing on the device when the pack began, i.e. the pipeline
         overlapped this pack with device compute (the approximation is
         conservative per-launch and exact in the steady state, where
         pack N+1 runs entirely under launch N)."""
+        now = self._clock() if now is None else now
         if self.surge is not None:
-            self.surge.note_pack(duration_s, hidden)
+            self.surge.note_pack(duration_s, hidden, now=now)
         with self._lock:
             self.pack_s += duration_s
             if hidden:
                 self.pack_hidden_s += duration_s
+            self._pack_window.append((now, duration_s, bool(hidden)))
 
     # -- reporting ----------------------------------------------------------
+
+    def _pipeline_locked(self) -> dict:
+        """The ``pipeline`` section over the bounded rolling window —
+        the same keys the LogParser and the surge derate have always
+        read, now answering for RECENT pack-boundedness; lifetime
+        accumulators ride along under ``lifetime_*``."""
+        now = self._clock()
+        while self._pack_window and \
+                now - self._pack_window[0][0] > PIPE_WINDOW_S:
+            self._pack_window.popleft()
+        win = sum(d for _, d, _ in self._pack_window)
+        win_hidden = sum(d for _, d, h in self._pack_window if h)
+        return {
+            "pack_ms": round(win * 1e3, 3),
+            "pack_hidden_ms": round(win_hidden * 1e3, 3),
+            "overlap_ratio": round(win_hidden / win, 3) if win else 0.0,
+            "window_s": PIPE_WINDOW_S,
+            "lifetime_pack_ms": round(self.pack_s * 1e3, 3),
+            "lifetime_overlap_ratio": round(
+                self.pack_hidden_s / self.pack_s, 3)
+            if self.pack_s else 0.0,
+        }
 
     def snapshot(self) -> dict:
         """JSON-safe dict: the OP_STATS reply body, byte-for-byte."""
@@ -206,13 +247,7 @@ class SchedStats:
                         sorted(self.scan_chunk_hist.items())},
                     "slices_avoided": self.scan_slices_avoided,
                 },
-                "pipeline": {
-                    "pack_ms": round(self.pack_s * 1e3, 3),
-                    "pack_hidden_ms": round(self.pack_hidden_s * 1e3, 3),
-                    "overlap_ratio": round(
-                        self.pack_hidden_s / self.pack_s, 3)
-                    if self.pack_s else 0.0,
-                },
+                "pipeline": self._pipeline_locked(),
             }
             if surge is not None:
                 out["surge"] = surge

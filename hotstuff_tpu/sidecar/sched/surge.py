@@ -9,7 +9,11 @@ per-replica async in-flight budget when the engine is congested
 (crypto/sidecar_client.adapt_budget), and the pack-side admission here
 derates BULK intake off the pipeline overlap stats, so the two compose —
 the client sends less, and what still arrives is shed earlier when the
-host cannot hide pack work behind device execution anyway.
+host cannot hide pack work behind device execution anyway.  Under the
+cadence ring (graftcadence) the same derate reads ring occupancy
+instead — the resident pipeline hides pack time by construction, so a
+full ring, not a collapsed overlap, is the honest congestion signal
+there.
 
 Three policies, one controller:
 
@@ -64,12 +68,31 @@ from .classes import BULK, LATENCY
 OVERLAP_KNEE = 0.5
 DERATE_FLOOR = 0.25
 # Judged over the most recent packs only — a surge decision off minutes-
-# old telemetry would derate long after the burst passed.
+# old telemetry would derate long after the burst passed.  The window is
+# bounded BOTH ways: at most PACK_WINDOW entries, and nothing older than
+# PACK_WINDOW_S seconds.  The count bound alone is not enough — on a
+# long-running sidecar a quiet hour keeps 64 stale healthy packs alive,
+# and exactly when a surge arrives the derate answers off history
+# instead of the collapsing overlap in front of it.
 PACK_WINDOW = 64
+PACK_WINDOW_S = 10.0
 # Minimum evidence before derating: a cold engine must not shed bulk off
 # one unlucky pack.
 MIN_PACKS = 8
 MIN_PACK_S = 0.005
+
+# graftcadence: when the ring is running, the freshest congestion signal
+# is ring occupancy, not pack overlap (the resident pipeline hides pack
+# time by construction — overlap saturates near 1.0 and stops carrying
+# information).  Occupancy samples arrive once per tick; evidence older
+# than RING_OCC_WINDOW_S means the ring stopped (wedge fallback or
+# shutdown) and the controller falls back to the overlap rule.  Above
+# RING_OCC_KNEE mean occupancy the bulk cap scales linearly down to
+# DERATE_FLOOR at a permanently-full ring: every slot occupied every
+# tick means the device cannot drain what is already admitted.
+RING_OCC_WINDOW = 256
+RING_OCC_WINDOW_S = 2.0
+RING_OCC_KNEE = 0.75
 
 # A latency-class shed opens this pressure window (s): while it is open,
 # bulk is shed before latency ever is.
@@ -91,8 +114,9 @@ class AdmissionController:
     def __init__(self, clock=monotonic):
         self._clock = clock
         self._lock = threading.Lock()
-        self._packs = deque(maxlen=PACK_WINDOW)     # (dur_s, hidden)
+        self._packs = deque(maxlen=PACK_WINDOW)     # (t, dur_s, hidden)
         self._launches = deque(maxlen=LAUNCH_WINDOW)  # (t, sigs)
+        self._ring_occ = deque(maxlen=RING_OCC_WINDOW)  # (t, occ_frac)
         self._lat_pressure_until = 0.0
         self._derate_engaged = False
         self.admitted = {LATENCY: 0, BULK: 0}
@@ -104,9 +128,11 @@ class AdmissionController:
 
     # -- pipeline evidence (engine / pack threads) --------------------------
 
-    def note_pack(self, duration_s: float, hidden: bool):
+    def note_pack(self, duration_s: float, hidden: bool,
+                  now: float | None = None):
+        now = self._clock() if now is None else now
         with self._lock:
-            self._packs.append((duration_s, bool(hidden)))
+            self._packs.append((now, duration_s, bool(hidden)))
             self._update_engagement_locked()
 
     def note_launch(self, sigs: int, now: float | None = None):
@@ -114,20 +140,53 @@ class AdmissionController:
         with self._lock:
             self._launches.append((now, sigs))
 
+    def note_ring_occupancy(self, occupied: int, depth: int,
+                            now: float | None = None):
+        """graftcadence: one per-tick ring occupancy sample (occupied
+        slots out of the current depth k).  While these stay fresh the
+        derate reads occupancy instead of pack overlap."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            frac = occupied / depth if depth > 0 else 0.0
+            self._ring_occ.append((now, min(1.0, max(0.0, frac))))
+            self._update_engagement_locked()
+
     def recent_overlap(self) -> float | None:
         """Hidden share of recent pack time, or None without evidence."""
         with self._lock:
             return self._recent_overlap_locked()
 
-    def _recent_overlap_locked(self):
+    def _recent_overlap_locked(self, now: float | None = None):
+        now = self._clock() if now is None else now
+        while self._packs and now - self._packs[0][0] > PACK_WINDOW_S:
+            self._packs.popleft()
         if len(self._packs) < MIN_PACKS:
             return None
-        total = sum(d for d, _ in self._packs)
+        total = sum(d for _, d, _ in self._packs)
         if total < MIN_PACK_S:
             return None
-        return sum(d for d, h in self._packs if h) / total
+        return sum(d for _, d, h in self._packs if h) / total
+
+    def _ring_occupancy_locked(self, now: float | None = None):
+        """Mean recent ring occupancy fraction, or None when the ring
+        evidence is stale (ring disengaged) or absent."""
+        now = self._clock() if now is None else now
+        while self._ring_occ and now - self._ring_occ[0][0] > \
+                RING_OCC_WINDOW_S:
+            self._ring_occ.popleft()
+        if not self._ring_occ:
+            return None
+        return sum(f for _, f in self._ring_occ) / len(self._ring_occ)
 
     def _derate_factor_locked(self) -> float:
+        occ = self._ring_occupancy_locked()
+        if occ is not None:
+            # Ring evidence wins while fresh: occupancy below the knee
+            # means the resident pipeline has headroom — full bulk cap.
+            if occ <= RING_OCC_KNEE:
+                return 1.0
+            span = (occ - RING_OCC_KNEE) / (1.0 - RING_OCC_KNEE)
+            return max(DERATE_FLOOR, 1.0 - (1.0 - DERATE_FLOOR) * span)
         o = self._recent_overlap_locked()
         if o is None or o >= OVERLAP_KNEE:
             return 1.0
@@ -203,6 +262,7 @@ class AdmissionController:
         """JSON-safe ``surge`` section of the OP_STATS reply."""
         with self._lock:
             overlap = self._recent_overlap_locked()
+            ring_occ = self._ring_occupancy_locked()
             return {
                 "admitted": dict(self.admitted),
                 "shed": dict(self.shed),
@@ -215,5 +275,7 @@ class AdmissionController:
                     "engagements": self.derate_engagements,
                     "overlap_recent": round(overlap, 3)
                     if overlap is not None else None,
+                    "ring_occupancy_recent": round(ring_occ, 3)
+                    if ring_occ is not None else None,
                 },
             }

@@ -195,7 +195,8 @@ class VerifyEngine:
     def __init__(self, mesh_devices: int | None = None, use_host: bool = False,
                  committee: int | None = None,
                  client_rate: int | None = None,
-                 tracer=None, guard=None, chaos=None, rewarm_fn=None):
+                 tracer=None, guard=None, chaos=None, rewarm_fn=None,
+                 cadence: bool = False, ring_factory=None):
         # All launch-shape policy lives in the scheduler subsystem: the
         # shape registry records what the warmup compiled (until
         # enable_bulk, launches cap at MAX_SUBBATCH; _warmup covers every
@@ -274,9 +275,26 @@ class VerifyEngine:
         self._pack_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="verify-pack")
         self._inflight_n = 0  # launches executing on device (telemetry)
+        self._stopped = threading.Event()
+        # graftcadence: the resident continuous-batching ring
+        # (sidecar/ring.py).  Opt-in (--cadence / HOTSTUFF_TPU_CADENCE)
+        # — the staged loop below stays the default until a committed
+        # ``cadence`` bench headline shows the ring winning.  The ring
+        # runs ON this engine thread first; a wedge fallback (or a
+        # constructor without cadence) lands in the staged loop.
+        if ring_factory is not None:
+            # Tests inject rings with virtual clocks/waits; the factory
+            # runs before the engine thread starts so the ring is in
+            # place when _run checks for it.
+            self._ring = ring_factory(self)
+        elif cadence:
+            from .ring import CadenceRing
+
+            self._ring = CadenceRing(self)
+        else:
+            self._ring = None
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="verify-engine")
-        self._stopped = threading.Event()
         self._thread.start()
 
     def submit(self, request, reply_fn, cls: str = vsched.LATENCY,
@@ -325,6 +343,10 @@ class VerifyEngine:
             g["device_ok"] = self._device_ok
             g["rebooting"] = self._rebooting
             snap["guard"] = g
+        if self._ring is not None:
+            # graftcadence: tick rate, occupancy hist, pad-fill ratio,
+            # generation drops, queue-wait p50/p99 (sidecar/ring.py).
+            snap["cadence"] = self._ring.snapshot()
         return snap
 
     # graftlint: sanitizes=device-verdict
@@ -397,18 +419,39 @@ class VerifyEngine:
 
     # -- consumer ----------------------------------------------------------
 
-    # Ed25519 launches kept in flight before the oldest result is fetched.
-    # The tunneled device charges a fixed ~15-20 ms per dispatch that
-    # OVERLAPS device execution of the previous launch — but only if the
-    # engine dispatches launch i+1 before fetching launch i's mask.  Depth
-    # 2 covers dispatch ~= execute; deeper only adds reply latency.  On
-    # top of the dispatch depth sits ONE pack slot (the pack worker in
-    # __init__): while up to two launches execute, the host side of the
-    # next launch — byte decode, prepare_batch, h2d — is already staging,
-    # so in the steady state the device never waits for host packing.
+    # Ed25519 launches kept in flight before the oldest result is fetched
+    # (STAGED path only).  The tunneled device charges a fixed ~15-20 ms
+    # per dispatch that OVERLAPS device execution of the previous launch
+    # — but only if the engine dispatches launch i+1 before fetching
+    # launch i's mask.  Depth 2 covers dispatch ~= execute; deeper only
+    # adds reply latency.  On top of the dispatch depth sits ONE pack
+    # slot (the pack worker in __init__): while up to two launches
+    # execute, the host side of the next launch — byte decode,
+    # prepare_batch, h2d — is already staging, so in the steady state
+    # the device never waits for host packing.
+    # Knob hygiene (VERDICT item 6): this constant is PINNED BY
+    # MEASUREMENT, not superseded into an env knob — the cadence ring
+    # (sidecar/ring.py) generalizes it to a TRAINED depth k in {2,4,8}
+    # (RingDepth, swept in the bench ``cadence`` headline), so anyone
+    # needing depth > 2 turns the ring on rather than growing a second
+    # depth knob here.
     PIPELINE_DEPTH = 2
 
     def _run(self):
+        """Engine thread body: the cadence ring first when one is
+        attached (graftcadence; returns on stop or on wedge fallback
+        with every in-flight generation answered), then the staged
+        request-driven loop — the DEFAULT path and the ladder's landing
+        zone."""
+        ring = self._ring
+        if ring is not None:
+            ring.run()
+            if self._stopped.is_set():
+                self._pack_pool.shutdown(wait=False)
+                return
+        self._run_staged()
+
+    def _run_staged(self):
         import collections
         from concurrent import futures as cfut
 
@@ -1032,10 +1075,27 @@ class VerifyEngine:
         # would land on a per-shard bucket only the scan programs were
         # compiled for (see ShapeRegistry.ladder_cap).
         step = self._shapes.ladder_cap()
+        # graftcadence: while the ring is engaged, every ladder slice
+        # arms at the ring's FIXED shard-aligned shape (the ladder-cap
+        # bucket — warmed) instead of the slice's own bucket, so each
+        # cadence tick re-dispatches ONE resident compiled program
+        # (parallel/sharded_verify.ring_slot_pack) with the slack rows
+        # dead (present=0) rather than a different shape per fill level.
+        ring = self._ring
+        ring_rows = None
+        if ring is not None and ring.enabled and self._mesh is not None:
+            ring_rows = shv.shard_aligned_rows(
+                step, self._mesh.devices.size, MAX_SUBBATCH)
         buckets, out = [], []
         for i in range(0, len(msgs), step):
             sl = slice(i, i + step)
             n = len(msgs[sl])
+            if ring_rows is not None:
+                buckets.append(self._shapes.shard_bucket_of(ring_rows))
+                out.append(shv.ring_slot_pack(
+                    self._mesh, prepare_batch(msgs[sl], pks[sl], sigs[sl]),
+                    ring_rows))
+                continue
             buckets.append(self._shapes.shard_bucket_of(n))
             out.append(shv.verify_batch_sharded_pack(
                 self._mesh, prepare_batch(msgs[sl], pks[sl], sigs[sl])))
@@ -1434,7 +1494,14 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
           warm_rlc: bool = False, warm_rlc_sharded: bool = False,
           chaos: bool = False,
           committee: int | None = None, client_rate: int | None = None,
-          trace_path: str | None = None):
+          trace_path: str | None = None,
+          cadence: bool | None = None):
+    # graftcadence opt-in: --cadence wins, then HOTSTUFF_TPU_CADENCE;
+    # the staged engine stays the default (ring.cadence_enabled).
+    from .ring import RingDepth, cadence_enabled
+
+    if cadence is None:
+        cadence = cadence_enabled()
     tracer = None
     if trace_path:
         from ..obs.spans import Tracer
@@ -1474,7 +1541,17 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         guard = LaunchGuard(deadlines=LaunchDeadlines(warm_boot=True))
     engine = VerifyEngine(mesh_devices=mesh_devices, use_host=use_host,
                           committee=committee, client_rate=client_rate,
-                          tracer=tracer, guard=guard, chaos=chaos_state)
+                          tracer=tracer, guard=guard, chaos=chaos_state,
+                          cadence=cadence)
+    if cadence:
+        log.info("graftcadence: resident ring ENABLED (depth %d)",
+                 engine._ring.depth.depth())
+        if tracker is not None:
+            # Seed the depth trainer from the manifest's measured
+            # per-shape walls, the same record LaunchDeadlines reads
+            # for its warm-boot decision.
+            engine._ring.depth = RingDepth.from_manifest(
+                tracker.manifest, tracker.kernel)
     # Warm the jit cache BEFORE binding: until the socket exists, node
     # crypto gets ECONNREFUSED and falls back to host verify instead of
     # connecting into a server whose device thread is still compiling.
@@ -1863,6 +1940,14 @@ def main(argv=None):
                          "pack/dispatch/device/reply, tagged rid + "
                          "scheduler class) to PATH; obs/trace.py merges "
                          "them into the run's trace.json")
+    ap.add_argument("--cadence", action="store_true",
+                    help="run the graftcadence resident verify ring "
+                         "(continuous batching: depth-k dispatch at a "
+                         "load-adaptive tick, generation-tagged "
+                         "verdicts) instead of the staged request-"
+                         "driven loop; HOTSTUFF_TPU_CADENCE=1 is the "
+                         "env equivalent and the staged engine stays "
+                         "the default")
     ap.add_argument("--chaos", action="store_true",
                     help="enable the OP_CHAOS fault-injection hook "
                          "(bounded reply delay, forced connection drops, "
@@ -1887,7 +1972,8 @@ def main(argv=None):
           warm_rlc_sharded=args.warm_rlc_sharded,
           chaos=args.chaos, committee=args.committee or None,
           client_rate=args.client_rate or None,
-          trace_path=args.trace)
+          trace_path=args.trace,
+          cadence=True if args.cadence else None)
 
 
 if __name__ == "__main__":

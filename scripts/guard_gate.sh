@@ -17,6 +17,11 @@
 #      wedge -> reboot -> bisect cycle costs well under a second; the
 #      slow e2e lane (live server + chaos plan + parser round trip)
 #      adds a few seconds of real traffic.
+#   3. The graftcadence ring lane (tests/test_ring.py) rides the same
+#      bound: generation-tag lifecycle on a virtual clock, plus the
+#      ring wedge-recovery drill — a forced wedge mid-cadence must drop
+#      the ring back to the staged engine through the ladder with
+#      bit-identical masks and no double reply.
 #
 # GUARD_GATE_BUDGET_S overrides the window; the gate FAILS (rc 124) if
 # the budget is exceeded, so a supervisor-latency regression is a loud
@@ -35,6 +40,7 @@ start=$(date +%s)
 rc=0
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu HOTSTUFF_TPU_SLOW_TESTS=1 \
     python -m pytest "$ROOT/tests/test_fuzz.py" "$ROOT/tests/test_guard.py" \
+    "$ROOT/tests/test_ring.py" \
     -q -p no:cacheprovider "$@" || rc=$?
 if [ "$rc" -ne 0 ]; then
   if [ "$rc" -eq 124 ]; then

@@ -182,6 +182,15 @@ if __name__ == "__main__":
                     "guard:hotstuff_tpu/sidecar/service.py",
                     "guard:hotstuff_tpu/sidecar/guard.py",
                     "threads:hotstuff_tpu/sidecar/guard.py",
+                    # graftcadence: the resident ring stays inside the
+                    # ring checker's tick-body scan (unbounded waits /
+                    # unwarmed-shape launches in the cadence loop), the
+                    # guard scan (it shares the engine thread), the
+                    # THREADS scan, and the hot-path taint scan.
+                    "ring:hotstuff_tpu/sidecar/ring.py",
+                    "guard:hotstuff_tpu/sidecar/ring.py",
+                    "threads:hotstuff_tpu/sidecar/ring.py",
+                    "hotpath:hotstuff_tpu/sidecar/ring.py",
                     # graftsurge: the admission controller and the load
                     # model stay inside the THREADS scan (both are
                     # called from multiple threads), and every surge

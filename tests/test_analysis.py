@@ -1614,6 +1614,84 @@ def test_guard_checker_real_tree_is_clean():
 
 
 # ---------------------------------------------------------------------------
+# ring rules (graftcadence: blocking-call-in-ring-tick)
+# ---------------------------------------------------------------------------
+
+def _ring_findings(src, path="hotstuff_tpu/sidecar/ring.py"):
+    from hotstuff_tpu.analysis import ringlint
+
+    return ringlint.check_sources({path: textwrap.dedent(src)})
+
+
+def test_ring_rule_flags_unbounded_wait_in_tick_body():
+    findings = _ring_findings("""
+        class CadenceRing:
+            def _collect_oldest(self):
+                fl = self._pending.popleft()
+                return fl.fetch.result()
+    """)
+    assert [f.rule for f in findings] == ["blocking-call-in-ring-tick"]
+    assert ".result()" in findings[0].message
+
+
+def test_ring_rule_flags_fresh_compile_entry_in_tick_body():
+    findings = _ring_findings("""
+        class CadenceRing:
+            def _arm(self, launch):
+                from ..crypto import eddsa
+                return eddsa.verify_batch(msgs, pks, sigs)
+    """)
+    assert [f.rule for f in findings] == ["blocking-call-in-ring-tick"]
+    assert "verify_batch" in findings[0].message
+    assert "compile" in findings[0].message
+
+
+def test_ring_rule_guard_entry_subtrees_are_supervised():
+    assert _ring_findings("""
+        class CadenceRing:
+            def _arm(self, launch):
+                fut = self.engine._pack_pool.submit(self.engine._pack,
+                                                    launch.items)
+                return self.engine._guarded("tick:8",
+                                            lambda: fut.result()())
+
+            def _collect_oldest(self):
+                fl = self._pending.popleft()
+                return self.engine._guarded(fl.key, fl.fetch)
+    """) == []
+
+
+def test_ring_rule_bounded_waits_are_legal():
+    assert _ring_findings("""
+        class CadenceRing:
+            def run(self):
+                self._wait(0.002)
+                self.engine._stopped.wait(timeout=0.25)
+    """) == []
+
+
+def test_ring_rule_ignores_non_ring_classes():
+    # The staged engine may block (its deadline class tolerates it);
+    # the rule scopes to ring classes only.
+    assert _ring_findings("""
+        class VerifyEngine:
+            def _dispatch_one(self, fut):
+                return fut.result()
+
+        def module_level(fut):
+            return fut.result()
+    """) == []
+
+
+def test_ring_checker_registered_and_real_tree_is_clean():
+    from hotstuff_tpu.analysis import ringlint
+    from hotstuff_tpu.analysis.__main__ import CHECKERS
+
+    assert "ring" in CHECKERS
+    assert ringlint.check(REPO) == []
+
+
+# ---------------------------------------------------------------------------
 # grafttaint: verification-gate provenance (wire -> gate -> consensus sink)
 # ---------------------------------------------------------------------------
 

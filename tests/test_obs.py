@@ -33,6 +33,7 @@ from hotstuff_tpu.obs import (
     parse_node_metrics,
     parse_node_trace,
     parse_spans,
+    persistent_fetch,
     read_samples,
     recovery_curve,
     split_samples,
@@ -373,6 +374,95 @@ def test_sampler_thread_lifecycle(tmp_path):
     samples, _ = read_samples(path)
     assert samples and all(s["ok"] for s in samples)
     assert sampler.last is not None
+
+
+class _Conn:
+    """SidecarClient stand-in for the persistent-fetch contract."""
+
+    def __init__(self, broken=False):
+        self.broken = broken
+        self.closed = False
+        self.stats_calls = 0
+
+    def stats(self):
+        self.stats_calls += 1
+        if self.broken:
+            raise ConnectionResetError("sidecar died mid-call")
+        return {"launches": self.stats_calls}
+
+    def close(self):
+        self.closed = True
+
+
+def test_persistent_fetch_reuses_one_connection():
+    """The satellite regression: ONE dial serves every healthy tick (the
+    1 Hz series stops paying a TCP dial per sample); a call failure
+    drops the connection before re-raising, and the NEXT call re-dials."""
+    conns = []
+
+    def dial():
+        conns.append(_Conn())
+        return conns[-1]
+
+    fetch = persistent_fetch(dial)
+    assert fetch() == {"launches": 1}
+    assert fetch() == {"launches": 2}
+    assert len(conns) == 1  # reused, never re-dialed while healthy
+    # the live connection dies mid-call: dropped (closed) + re-raised
+    conns[0].broken = True
+    with pytest.raises(ConnectionResetError):
+        fetch()
+    assert conns[0].closed
+    # the next tick re-dials a fresh connection
+    assert fetch() == {"launches": 1}
+    assert len(conns) == 2
+    # teardown closes the held connection
+    fetch.close()
+    assert conns[1].closed
+
+
+def test_persistent_fetch_dead_dial_leaves_no_connection():
+    calls = [0]
+
+    def dial():
+        calls[0] += 1
+        raise ConnectionRefusedError("sidecar down")
+
+    fetch = persistent_fetch(dial)
+    for _ in range(2):
+        with pytest.raises(ConnectionRefusedError):
+            fetch()
+    assert calls[0] == 2  # every failed tick re-dials, none leaks
+    fetch.close()  # nothing held; must not raise
+
+
+def test_sampler_gap_semantics_with_persistent_connection(tmp_path):
+    """Through the sampler: a mid-run kill is exactly one ok-false tick
+    (the dropped connection), the restart tick re-dials and records ok
+    again — byte-identical gap semantics to the old dial-per-tick
+    sampler — and stop() closes the held connection."""
+    conns = []
+
+    def dial():
+        conns.append(_Conn())
+        return conns[-1]
+
+    path = str(tmp_path / "metrics.jsonl")
+    now = [50.0]
+    sampler = MetricsSampler(persistent_fetch(dial), path,
+                             wall=lambda: now[0])
+    sampler.sample_once()
+    sampler.sample_once()
+    conns[0].broken = True  # the kill
+    sampler.sample_once()   # the gap tick
+    sampler.sample_once()   # the restart: re-dial, healthy again
+    sampler.stop()
+    samples, malformed = read_samples(path)
+    assert malformed == 0
+    assert [s["ok"] for s in samples] == [True, True, False, True]
+    assert "sidecar died" in samples[2]["error"]
+    assert len(conns) == 2
+    assert all(c.closed for c in conns)
 
 
 def test_read_samples_tolerates_garbage(tmp_path):
@@ -892,6 +982,42 @@ def test_bench_trend_flattens_committee_scale(tmp_path):
     # skipped flag are not measurements).
     assert "committee_scale.N1000.skipped" not in f
     assert f["committee_scale.N1000.quorum"]["latest"] == 667
+
+
+def test_bench_trend_flattens_cadence(tmp_path):
+    """graftcadence: the cadence headline's numeric leaves (ring-vs-
+    staged sigs/sec per depth, queue-wait p99, pad-fill ratio) land in
+    the ledger like every other field, and a degraded line's larger
+    CPU-backend cadence numbers never claim best."""
+    bt = _bench_trend()
+    cad = {"staged_sigs_per_s": 2000.0,
+           "ring_k2": {"sigs_per_s": 2100.0, "queue_wait_p99_ms": 40.0,
+                       "pad_fill_ratio": 0.25},
+           "ring_k8": {"skipped": True},
+           "surge_wait": {"queue_wait_p99_ms": 150.0},
+           "ok": True}
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r01.json", {"n": 1, "rc": 0,
+                            "parsed": {"metric": "m", "value": 100.0,
+                                       "cadence": cad}}),
+        ("BENCH_zz_degraded.json", {
+            "metric": "m", "value": 5.0, "degraded": True,
+            "cadence": {"staged_sigs_per_s": 9999.0,
+                        "ring_k2": {"sigs_per_s": 9999.0}}}),
+    )
+    trend = bt.build_trend(sorted(str(p) for p in
+                                  tmp_path.glob("BENCH_*.json")))
+    f = trend["fields"]
+    assert f["cadence.ring_k2.sigs_per_s"]["best"] == 2100.0
+    assert f["cadence.staged_sigs_per_s"]["best"] == 2000.0
+    # Degraded cadence values stay visible as latest, never best.
+    assert f["cadence.ring_k2.sigs_per_s"]["latest"] == 9999.0
+    assert f["cadence.ring_k2.sigs_per_s"]["latest_degraded"] is True
+    assert f["cadence.surge_wait.queue_wait_p99_ms"]["latest"] == 150.0
+    # Flags are not measurements: ok/skipped never become fields.
+    assert "cadence.ok" not in f
+    assert "cadence.ring_k8.skipped" not in f
 
 
 def test_bench_trend_unjudgeable_histories_pass(tmp_path):

@@ -138,6 +138,68 @@ def test_admission_derate_tracks_overlap_with_hysteresis_counts():
     assert DERATE_FLOOR < adm.bulk_derate() < 1.0
 
 
+def test_admission_overlap_window_is_time_bounded():
+    """The satellite regression: the derate judges RECENT packs — a
+    lifetime average would let hours of healthy history outvote the
+    collapse in front of it.  Healthy evidence older than PACK_WINDOW_S
+    ages out; with no fresh evidence at all the controller answers the
+    full cap, never a verdict off stale telemetry."""
+    from hotstuff_tpu.sidecar.sched.surge import PACK_WINDOW_S
+
+    now = [0.0]
+    adm = AdmissionController(clock=lambda: now[0])
+    for _ in range(64):
+        adm.note_pack(0.01, hidden=True)
+    assert adm.bulk_derate() == 1.0
+    # The surge arrives after a quiet stretch: only fresh packs decide.
+    now[0] += PACK_WINDOW_S + 1.0
+    for _ in range(MIN_PACKS):
+        adm.note_pack(0.01, hidden=False)
+    assert adm.recent_overlap() == 0.0
+    assert adm.bulk_derate() == pytest.approx(DERATE_FLOOR)
+    # ... and once THAT evidence ages out, no evidence -> full cap.
+    now[0] += PACK_WINDOW_S + 1.0
+    assert adm.recent_overlap() is None
+    assert adm.bulk_derate() == 1.0
+
+
+def test_admission_ring_occupancy_rules_while_fresh_then_goes_stale():
+    """graftcadence: while ring occupancy samples are fresh they REPLACE
+    the overlap rule (the resident pipeline hides pack time by
+    construction); a full ring derates toward the floor, headroom keeps
+    the full cap, and stale occupancy (ring disengaged) falls back to
+    the overlap rule."""
+    from hotstuff_tpu.sidecar.sched.surge import (RING_OCC_KNEE,
+                                                  RING_OCC_WINDOW_S)
+
+    now = [100.0]
+    adm = AdmissionController(clock=lambda: now[0])
+    # Occupancy at the knee or below: headroom, full cap.
+    for _ in range(16):
+        adm.note_ring_occupancy(2, 4)
+    assert adm.bulk_derate() == 1.0
+    # Every tick full: the device cannot drain what is admitted.
+    for _ in range(64):
+        adm.note_ring_occupancy(4, 4)
+    derated = adm.bulk_derate()
+    assert DERATE_FLOOR <= derated < 1.0
+    snap = adm.snapshot()["derate"]
+    assert snap["engaged"] and snap["engagements"] >= 1
+    assert snap["ring_occupancy_recent"] > RING_OCC_KNEE
+    # Fresh ring evidence WINS over a perfectly healthy overlap.
+    for _ in range(MIN_PACKS):
+        adm.note_pack(0.01, hidden=True)
+    assert adm.bulk_derate() == pytest.approx(derated)
+    # Ring disengaged (wedge fallback/stop): occupancy goes stale within
+    # RING_OCC_WINDOW_S and the healthy overlap rule takes back over.
+    now[0] += RING_OCC_WINDOW_S + 1.0
+    for _ in range(MIN_PACKS):
+        adm.note_pack(0.01, hidden=True)
+    snap = adm.snapshot()["derate"]
+    assert snap["ring_occupancy_recent"] is None
+    assert adm.bulk_derate() == 1.0
+
+
 def test_admission_retry_after_drain_rate_and_clamps():
     now = [100.0]
     adm = AdmissionController(clock=lambda: now[0])

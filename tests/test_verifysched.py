@@ -679,6 +679,34 @@ def test_scan_and_mesh_launch_stats_accounting():
                             "slices_avoided": 15}
 
 
+def test_pipeline_overlap_is_a_rolling_window():
+    """graftcadence satellite: the OP_STATS ``pipeline`` section answers
+    for RECENT pack-boundedness (entries older than PIPE_WINDOW_S age
+    out), while the lifetime accumulators survive under ``lifetime_*``
+    for trend tooling."""
+    from hotstuff_tpu.sidecar.sched.stats import PIPE_WINDOW_S
+
+    now = [1000.0]
+    stats = vsched.SchedStats(clock=lambda: now[0])
+    for _ in range(8):
+        stats.note_pack(0.010, hidden=False)
+    pipe = stats.snapshot()["pipeline"]
+    assert pipe["overlap_ratio"] == 0.0
+    assert pipe["pack_ms"] == pytest.approx(80.0)
+    # The unhealthy history ages out; only the recent packs report.
+    now[0] += PIPE_WINDOW_S + 1.0
+    for _ in range(4):
+        stats.note_pack(0.010, hidden=True)
+    pipe = stats.snapshot()["pipeline"]
+    assert pipe["overlap_ratio"] == 1.0
+    assert pipe["pack_ms"] == pytest.approx(40.0)
+    assert pipe["window_s"] == PIPE_WINDOW_S
+    # Lifetime keeps the whole story for bench_trend.
+    assert pipe["lifetime_pack_ms"] == pytest.approx(120.0)
+    assert pipe["lifetime_overlap_ratio"] == pytest.approx(0.333,
+                                                           abs=1e-3)
+
+
 @pytest.mark.slow
 def test_giant_quorum_engine_path_n1000():
     """The N=1000 acceptance shape through the REAL engine: a

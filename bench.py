@@ -172,6 +172,25 @@ segments ({name: {n, p50_ms, p99_ms}}), join ({committed, with_verify,
 joined, rate}), join_rate, chrome_events, offset_applied_ms,
 roundtrip_ok.
 
+graftingress (`"users"` field): the signed-transaction ingress tier at
+population scale — per user-population U in {1e5, 1e6}, the seeded
+heavy-tailed generator (harness/loadgen.py, the C++ UserLoadModel's
+twin) names which user each arrival belongs to, the probe derives that
+user's Ed25519 keypair on first arrival through the bounded
+crypto/txsign.UserKeyring LRU (exactly the client's derive-on-demand
+discipline: 1e6 users never means 1e6 resident keys), signs each frame
+with a seeded ~1% forgery mix, and drives the admission records through
+a host-mode VerifyEngine as INGRESS_CTX-tagged OP_VERIFY_BULK batches —
+the same (digest, pk, sig) triples and bulk-lane class the mempool
+admission stage ships.  Per point: {"users", "txs", "distinct_users",
+"key_derivations", "keyring_capacity", "forged_sent",
+"forged_rejected", "forgery_rejection_rate", "verified",
+"verified_goodput_sigs_per_s", "busy_rejected", "bulk_ingress_requests",
+"bulk_ingress_sigs", "bulk_ingress_share"} — or {"skipped": true} past
+the budget (HOTSTUFF_TPU_USERS_BUDGET seconds, default 240); acceptance
+bar in "ok" (every forged rejected, every honest verified, the bulk
+lane 100% ingress-fed).  Emitted on BOTH the live and degraded lines.
+
 Degraded mode (`"degraded": true`): the device probe is capped at
 HOTSTUFF_TPU_PROBE_ATTEMPTS tries (default 3) inside a
 HOTSTUFF_TPU_PROBE_WINDOW-second window (default 600) AND inside the
@@ -1725,6 +1744,165 @@ def cadence_headline(n_devices: int = 8,
         n_devices, budget_s)
 
 
+def users_headline_probe(populations=(100_000, 1_000_000),
+                         txs_per_point: int = 96,
+                         budget_s: float | None = None) -> dict:
+    """The headline ``users`` field (graftingress): the signed ingress
+    tier at user-population scale, end to end in process.
+
+    Per population U the seeded generator names which user each arrival
+    belongs to (``UserLoad.arrivals(out_users=...)`` — the same contract
+    the C++ client's UserLoadModel grew), the probe derives that user's
+    Ed25519 keypair on FIRST arrival through the bounded
+    ``txsign.UserKeyring`` LRU, builds version-2 signed frames with a
+    seeded ~1% forgery mix (at least one forged frame per point, so the
+    rejection rate is always a measured number), turns each frame into
+    its admission (digest, pk, sig) record, and submits QC-shaped
+    batches to a host-mode VerifyEngine as INGRESS_CTX-tagged bulk
+    requests — the exact class + ctx tag the mempool admission-verify
+    stage uses, so the engine's OP_STATS ``ingress`` section must report
+    the lane 100% ingress-fed.  Key generation and signing run OUTSIDE
+    the timed region; ``verified_goodput_sigs_per_s`` times only the
+    verify drive (host-mode reference verify: honest relative to the
+    other points, never comparable to device throughput).
+
+    Populations that miss ``budget_s`` report ``{"skipped": true}``.
+    Acceptance bar in ``ok``: every forged frame rejected, every honest
+    frame verified, goodput positive, and the bulk lane fully
+    ingress-fed on every completed point."""
+    import random
+    import threading
+
+    from hotstuff_tpu.crypto import txsign
+    from hotstuff_tpu.harness.loadgen import UserLoad
+    from hotstuff_tpu.sidecar import protocol as proto
+    from hotstuff_tpu.sidecar import sched as vsched
+    from hotstuff_tpu.sidecar.service import VerifyEngine
+
+    if budget_s is None:
+        budget_s = float(
+            os.environ.get("HOTSTUFF_TPU_USERS_BUDGET", "240"))
+    t0 = time.perf_counter()
+    out = {"mix_forge_pct": 1.0, "txs_per_point": txs_per_point}
+    BATCH = 32
+
+    for pop in populations:
+        key = f"u{pop}"
+        if time.perf_counter() - t0 > budget_s:
+            out[key] = {"skipped": True}
+            continue
+        # Arrival stream on a virtual clock: with U users at a fixed
+        # aggregate rate, a short window touches ~txs_per_point DISTINCT
+        # users (per-user gaps are U/rate seconds) — the population knob
+        # stresses the key-derivation path, not the verify path.
+        load = UserLoad(rate=64.0, users=pop, seed=13)
+        arrivals: list = []
+        tick = 0
+        while len(arrivals) < txs_per_point and tick < 4096:
+            tick += 1
+            load.arrivals(tick * 0.025, arrivals)
+        arrivals = arrivals[:txs_per_point]
+        keyring = txsign.UserKeyring(seed=7, capacity=4096)
+        mix = random.Random(2024 + pop)
+        frames, forged = [], []
+        for i, user in enumerate(arrivals):
+            forge = mix.random() < 0.01
+            marker = (txsign.TX_MARKER_FORGED if forge
+                      else txsign.TX_MARKER_FILLER)
+            frames.append(txsign.build_signed_tx(
+                keyring.get(user), nonce=i,
+                payload=txsign.build_payload(marker, i),
+                flip_sig_bit=forge))
+            forged.append(forge)
+        if not any(forged):  # seeded mix, floored at one forged frame
+            frames[-1] = txsign.build_signed_tx(
+                keyring.get(arrivals[-1]), nonce=len(arrivals) - 1,
+                payload=txsign.build_payload(
+                    txsign.TX_MARKER_FORGED, len(arrivals) - 1),
+                flip_sig_bit=True)
+            forged[-1] = True
+        records = [txsign.admission_record(f) for f in frames]
+
+        masks: dict = {}
+        cond = threading.Condition()
+
+        def reply_to(rid, masks=masks, cond=cond):
+            def _reply(mask):
+                with cond:
+                    masks[rid] = mask
+                    cond.notify_all()
+            return _reply
+
+        eng = VerifyEngine(use_host=True)
+        busy_rejected = 0
+        try:
+            t_drive = time.perf_counter()
+            rids = []
+            for b in range(0, len(records), BATCH):
+                chunk = records[b:b + BATCH]
+                rid = 1 + b // BATCH
+                req = proto.VerifyRequest(
+                    rid,
+                    [r[0] for r in chunk], [r[1] for r in chunk],
+                    [r[2] for r in chunk], ctx=txsign.INGRESS_CTX)
+                for attempt in range(8):
+                    if eng.submit(req, reply_to(rid), cls=vsched.BULK):
+                        rids.append(rid)
+                        break
+                    busy_rejected += 1
+                    time.sleep(eng.retry_after_ms(vsched.BULK) / 1e3)
+            with cond:
+                cond.wait_for(
+                    lambda: all(r in masks for r in rids), timeout=120.0)
+            dt = time.perf_counter() - t_drive
+            snap = eng.stats_snapshot().get("ingress", {})
+        finally:
+            eng.stop()
+
+        flat = []
+        for rid in rids:
+            flat.extend(masks.get(rid) or [])
+        answered = len(flat)
+        verified = sum(1 for ok, f in zip(flat, forged) if ok and not f)
+        forged_sent = sum(forged)
+        forged_rejected = sum(
+            1 for ok, f in zip(flat, forged) if f and not ok)
+        honest = len(frames) - forged_sent
+        total_bulk_sigs = (snap.get("bulk_sigs", 0)
+                           + snap.get("offchain_sigs", 0))
+        out[key] = {
+            "users": pop,
+            "txs": len(frames),
+            "distinct_users": len(set(arrivals)),
+            "key_derivations": keyring.derivations,
+            "keyring_capacity": keyring.capacity,
+            "forged_sent": forged_sent,
+            "forged_rejected": forged_rejected,
+            "forgery_rejection_rate": round(
+                forged_rejected / forged_sent, 3) if forged_sent else 0.0,
+            "verified": verified,
+            "verified_goodput_sigs_per_s": round(verified / dt, 1)
+            if dt > 0 else 0.0,
+            "busy_rejected": busy_rejected,
+            "bulk_ingress_requests": snap.get("bulk_requests", 0),
+            "bulk_ingress_sigs": snap.get("bulk_sigs", 0),
+            "bulk_ingress_share": round(
+                snap.get("bulk_sigs", 0) / total_bulk_sigs, 3)
+            if total_bulk_sigs else 0.0,
+            "answered": answered,
+            "point_ok": (answered == len(frames)
+                         and verified == honest
+                         and forged_rejected == forged_sent
+                         and snap.get("bulk_sigs", 0) == total_bulk_sigs
+                         > 0),
+        }
+    done = [v for k, v in out.items()
+            if k.startswith("u") and isinstance(v, dict)
+            and not v.get("skipped")]
+    out["ok"] = bool(done) and all(v["point_ok"] for v in done)
+    return out
+
+
 def viewchange_headline(committees=(20, 100, 300), repeats: int = 2,
                         budget_s: float | None = None) -> dict:
     """The headline ``viewchange`` field (graftview): batched vs
@@ -2051,6 +2229,16 @@ def run_degraded(reason: str):
                 max(0.0, budget_left_s() - 90.0)))
         except Exception as e:  # noqa: BLE001 — headline isolation
             cadence = {"error": f"{e!r:.120}"}
+        # graftingress user-population sweep: host-mode in-process (no
+        # device), so the degraded line proves the same signed-ingress
+        # story as the live one.
+        try:
+            users = users_headline_probe(budget_s=min(
+                float(os.environ.get("HOTSTUFF_TPU_USERS_BUDGET",
+                                     "240")),
+                max(0.0, budget_left_s() - 90.0)))
+        except Exception as e:  # noqa: BLE001 — headline isolation
+            users = {"error": f"{e!r:.120}"}
         # The watchdog stays armed until the moment of the real emit: a
         # stall anywhere above (including the sched probe) must still
         # produce a parseable line, which is this path's whole contract.
@@ -2061,7 +2249,7 @@ def run_degraded(reason: str):
              note=reason, rlc=rlc, mesh_rlc=mesh_rlc,
              committee_scale=committee_scale, roofline=roofline,
              viewchange=viewchange, sched=sched, chaos=chaos, trace=trace,
-             surge=surge, guard=guard, cadence=cadence)
+             surge=surge, guard=guard, cadence=cadence, users=users)
     except Exception as e:  # noqa: BLE001 — the line must still be emitted
         emitted.set()
         emit(0, 0, degraded=True,
@@ -2420,11 +2608,19 @@ def main(argv=None):
             max(0.0, budget_left_s() - 60.0)))
     except Exception as e:  # noqa: BLE001 — headline isolation
         cadence = {"error": f"{e!r:.120}"}
+    # graftingress user-population sweep: in-process host-mode engine,
+    # no device contention with anything above.
+    try:
+        users = users_headline_probe(budget_s=min(
+            float(os.environ.get("HOTSTUFF_TPU_USERS_BUDGET", "240")),
+            max(0.0, budget_left_s() - 60.0)))
+    except Exception as e:  # noqa: BLE001 — headline isolation
+        users = {"error": f"{e!r:.120}"}
     emit_final(tpu, cpu, rlc=rlc, msm_window_chunk=msm,
                mesh_rlc=mesh_rlc, committee_scale=committee_scale,
                roofline=roofline, viewchange=viewchange, sched=sched,
                chaos=chaos, trace=trace, surge=surge, guard=guard,
-               cadence=cadence)
+               cadence=cadence, users=users)
 
 
 if __name__ == "__main__":

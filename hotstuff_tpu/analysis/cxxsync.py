@@ -86,6 +86,10 @@ DEFAULT_TARGETS = (
     # sampler-thread state behind one mutex.
     "native/src/common/metrics.hpp",
     "native/src/common/metrics.cpp",
+    # graftingress: the admission-verify stage — reactor-thread enqueue
+    # against a verify-worker drain, one mutex + telemetry atomics.
+    "native/src/mempool/tx_verify.hpp",
+    "native/src/mempool/tx_verify.cpp",
 )
 
 # The atomic rule scans the whole native tree (any .cpp/.hpp under here).

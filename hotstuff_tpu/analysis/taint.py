@@ -103,6 +103,10 @@ from . import taintcxx  # noqa: E402  (needs Call/TaintFn defined above)
 PY_TARGETS = (
     "hotstuff_tpu/sidecar/protocol.py",
     "hotstuff_tpu/sidecar/service.py",
+    # graftingress: the Python twin of the signed-tx frame codec — no
+    # wire sources of its own, but scanned so a future recv/sink edge
+    # grown here cannot dodge the gate vocabulary silently.
+    "hotstuff_tpu/crypto/txsign.py",
 )
 
 DEFAULT_TARGETS = PY_TARGETS + taintcxx.CXX_TARGETS

@@ -33,6 +33,8 @@ CXX_TARGETS = (
     "native/src/mempool/processor.hpp",
     "native/src/mempool/processor.cpp",
     "native/src/mempool/ingress.hpp",
+    "native/src/mempool/tx_verify.hpp",
+    "native/src/mempool/tx_verify.cpp",
     "native/src/crypto/crypto.cpp",
 )
 
@@ -59,6 +61,11 @@ CXX_SINKS = {
                   frozenset({"batch-digest", "qc", "sig",
                              "device-verdict"})),
     "admit": ("mempool-admission", frozenset({"ingress-budget"})),
+    # graftingress: the admission-verify stage may hand a wire-sourced
+    # signed tx onward to the batch maker (the store-bound path) only
+    # under the tx-signature gate — a forged frame reaching this sink
+    # unverified is exactly the bug class the tier exists to kill.
+    "forward_admitted": ("store-write", frozenset({"tx-signature"})),
 }
 
 _VERIFIES_RE = re.compile(r"//\s*VERIFIES\(([\w\-]+)\)")

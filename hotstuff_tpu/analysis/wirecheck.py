@@ -23,6 +23,13 @@ Rules:
                           literals disagree across ops/field25519.py,
                           utils/intmath.py, ops/field381.py,
                           offchain/bls12381.py and crypto.hpp
+  txframe-mismatch        the graftingress signed-tx frame drifted
+                          between crypto/txsign.py and
+                          native/src/mempool/tx_frame.hpp: layout
+                          constants (version, field lengths, payload
+                          bounds, markers) or the domain-separator /
+                          ingress-ctx tag strings disagree — one side
+                          signs preimages the other cannot verify
 """
 
 from __future__ import annotations
@@ -62,9 +69,33 @@ _LEN_PAIRS = (
     ("CTX_LEN", "kCtxLen"),
 )
 
+# graftingress: (python constant in crypto/txsign.py, C++ constant in
+# mempool/tx_frame.hpp) — the signed-tx frame layout, pinned both sides.
+_TXFRAME_INT_PAIRS = (
+    ("TX_FRAME_VERSION", "kTxFrameVersion"),
+    ("TX_PK_LEN", "kTxPkLen"),
+    ("TX_NONCE_LEN", "kTxNonceLen"),
+    ("TX_LEN_LEN", "kTxLenLen"),
+    ("TX_SIG_LEN", "kTxSigLen"),
+    ("TX_FRAME_HEADER_LEN", "kTxFrameHeaderLen"),
+    ("TX_FRAME_OVERHEAD", "kTxFrameOverhead"),
+    ("TX_MIN_PAYLOAD", "kTxMinPayload"),
+    ("TX_MAX_PAYLOAD", "kTxMaxPayload"),
+    ("TX_MARKER_SAMPLE", "kTxMarkerSample"),
+    ("TX_MARKER_FILLER", "kTxMarkerFiller"),
+    ("TX_MARKER_FORGED", "kTxMarkerForged"),
+)
+_TXFRAME_STR_PAIRS = (
+    ("TX_SIGN_DOMAIN", "kTxSignDomain"),
+    ("TX_KEY_DOMAIN", "kTxKeyDomain"),
+    ("INGRESS_CTX", "kTxIngressCtxTag"),
+)
+
 PROTOCOL = "hotstuff_tpu/sidecar/protocol.py"
 SIDECAR_CLIENT = "native/src/crypto/sidecar_client.cpp"
 CRYPTO_HPP = "native/src/crypto/crypto.hpp"
+TXSIGN = "hotstuff_tpu/crypto/txsign.py"
+TX_FRAME_HPP = "native/src/mempool/tx_frame.hpp"
 FIELD25519 = "hotstuff_tpu/ops/field25519.py"
 INTMATH = "hotstuff_tpu/utils/intmath.py"
 FIELD381 = "hotstuff_tpu/ops/field381.py"
@@ -104,6 +135,52 @@ def cpp_hex_string_constants(source: str) -> dict:
         digits = "".join(re.findall(r'"([0-9a-fA-F]*)"', m.group(2)))
         if digits:
             out[m.group(1)] = int(digits, 16)
+    return out
+
+
+def cpp_shift_constants(source: str) -> dict:
+    """``constexpr <type> kName = N << S;`` declarations -> value (the
+    form kTxMaxPayload uses; cpp_int_constants only takes literals)."""
+    out = {}
+    for m in re.finditer(
+            r"constexpr\s+[\w:]+\s+(k\w+)\s*=\s*(\d+)[uUlL]*\s*<<\s*(\d+)",
+            source):
+        out[m.group(1)] = int(m.group(2)) << int(m.group(3))
+    return out
+
+
+def cpp_static_assert_values(source: str) -> dict:
+    """``static_assert(kName == N, ...)`` equality pins -> {name: N} —
+    how tx_frame.hpp anchors its derived header/overhead sums to
+    literal byte counts a cross-checker can read."""
+    out = {}
+    for m in re.finditer(r"static_assert\(\s*(k\w+)\s*==\s*(\d+)", source):
+        out[m.group(1)] = int(m.group(2))
+    return out
+
+
+def cpp_char_string_constants(source: str) -> dict:
+    """``constexpr char kName[] = "text";`` declarations -> text."""
+    out = {}
+    for m in re.finditer(
+            r"constexpr\s+char\s+(k\w+)\[\]\s*=\s*\"([^\"]*)\"", source):
+        out[m.group(1)] = m.group(2)
+    return out
+
+
+def py_bytes_constants(source: str) -> dict:
+    """Top-level ``NAME = b"..."`` assignments -> decoded text."""
+    import ast
+
+    out = {}
+    tree = parse_source(source)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, bytes):
+            out[node.targets[0].id] = node.value.value.decode(
+                "latin-1")
     return out
 
 
@@ -383,4 +460,46 @@ def check(root: str) -> list:
                     f"{label} field modulus disagrees across sources: "
                     f"{detail} — verification on one side will accept "
                     "what the other rejects"))
+
+    # -- graftingress signed-tx frame --------------------------------------
+    txsign_src = _read(root, TXSIGN)
+    txframe_src = _read(root, TX_FRAME_HPP)
+    if txsign_src is None or txframe_src is None:
+        for rel, src in ((TXSIGN, txsign_src), (TX_FRAME_HPP, txframe_src)):
+            if src is None:
+                miss(rel, "txframe-mismatch", "source file")
+        return findings
+    tx_py = module_int_constants(txsign_src, TXSIGN)
+    tx_cpp = cpp_int_constants(txframe_src)
+    tx_cpp.update(cpp_shift_constants(txframe_src))
+    # Derived sums (header/overhead) are pinned by static_asserts — the
+    # literal the assert names is the cross-checkable value.
+    tx_cpp.update(cpp_static_assert_values(txframe_src))
+    for py_name, cpp_name in _TXFRAME_INT_PAIRS:
+        if py_name not in tx_py:
+            miss(TXSIGN, "txframe-mismatch", f"constant {py_name}")
+        elif cpp_name not in tx_cpp:
+            miss(TX_FRAME_HPP, "txframe-mismatch", f"constant {cpp_name}")
+        elif tx_py[py_name] != tx_cpp[cpp_name]:
+            findings.append(Finding(
+                TX_FRAME_HPP, _line_of(txframe_src, cpp_name),
+                "txframe-mismatch",
+                f"{cpp_name}={tx_cpp[cpp_name]} but {TXSIGN} "
+                f"{py_name}={tx_py[py_name]}: client frames desync "
+                "against admission parsing"))
+    tx_py_str = py_bytes_constants(txsign_src)
+    tx_cpp_str = cpp_char_string_constants(txframe_src)
+    for py_name, cpp_name in _TXFRAME_STR_PAIRS:
+        if py_name not in tx_py_str:
+            miss(TXSIGN, "txframe-mismatch", f"bytes constant {py_name}")
+        elif cpp_name not in tx_cpp_str:
+            miss(TX_FRAME_HPP, "txframe-mismatch", f"constant {cpp_name}")
+        elif tx_py_str[py_name] != tx_cpp_str[cpp_name]:
+            findings.append(Finding(
+                TX_FRAME_HPP, _line_of(txframe_src, cpp_name),
+                "txframe-mismatch",
+                f"{cpp_name}={tx_cpp_str[cpp_name]!r} but {TXSIGN} "
+                f"{py_name}={tx_py_str[py_name]!r}: domain-separated "
+                "preimages (or the ingress ctx tag) diverge — one side "
+                "signs what the other cannot verify"))
     return findings

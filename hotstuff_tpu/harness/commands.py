@@ -59,24 +59,42 @@ class CommandMaker:
 
     @staticmethod
     def run_client(address, size, rate, timeout, nodes=None, users=None,
-                   seed=None):
+                   seed=None, sign=False, forge_pct=None, user_offset=None,
+                   sample_offset=None):
         """``users``/``seed`` opt into the graftsurge multi-user
         heavy-tailed generator (client --users/--seed); omitted, the
-        client keeps its legacy constant-rate stream."""
+        client keeps its legacy constant-rate stream.  ``sign`` opts
+        into graftingress signed-transaction frames (per-user Ed25519,
+        derived from the seed); ``forge_pct`` flips a signature bit on
+        that percentage of filler txs; the offsets shard the user-id
+        and sample-id spaces across multi-process client shards."""
         assert isinstance(address, str)
         assert isinstance(size, int) and size > 0
         assert isinstance(rate, int) and rate >= 0
         assert isinstance(nodes, list) or nodes is None
         assert users is None or (isinstance(users, int) and users > 0)
         assert seed is None or isinstance(seed, int)
+        assert forge_pct is None or \
+            (isinstance(forge_pct, (int, float)) and 0 <= forge_pct <= 100)
+        assert user_offset is None or \
+            (isinstance(user_offset, int) and user_offset >= 0)
+        assert sample_offset is None or \
+            (isinstance(sample_offset, int) and sample_offset >= 0)
         nodes = nodes or []
         assert all(isinstance(x, str) for x in nodes)
         nodes_str = f" --nodes {' '.join(nodes)}" if nodes else ""
         users_str = f" --users {users}" if users else ""
         seed_str = f" --seed {seed}" if seed is not None else ""
+        sign_str = " --sign" if sign else ""
+        forge_str = f" --forge-pct {forge_pct:g}" if forge_pct else ""
+        uoff_str = f" --user-offset {user_offset}" \
+            if user_offset else ""
+        soff_str = f" --sample-offset {sample_offset}" \
+            if sample_offset else ""
         return (
             f"./client {address} --size {size} "
             f"--rate {rate} --timeout {timeout}{users_str}{seed_str}"
+            f"{sign_str}{forge_str}{uoff_str}{soff_str}"
             f"{nodes_str}"
         )
 

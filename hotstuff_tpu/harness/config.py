@@ -274,12 +274,29 @@ class BenchParameters:
             self.wan = json_input.get("wan")
             self.slo = json_input.get("slo")
             self.twins = bool(json_input.get("twins", False))
+            # graftingress: signed-transaction ingress.  verify_ingress
+            # flips the nodes into admission-verify mode AND the clients
+            # into --sign; forge_pct seeds a forgery mix the admission
+            # stage must reject; client_shards fans each node's client
+            # out over k processes (disjoint user-id / sample-id spaces).
+            self.verify_ingress = bool(
+                json_input.get("verify_ingress", False))
+            self.forge_pct = float(json_input.get("forge_pct", 0.0))
+            self.client_shards = int(json_input.get("client_shards", 1))
         except KeyError as e:
             raise ConfigError(f"Malformed bench parameters: missing key {e}")
         except ValueError:
             raise ConfigError("Invalid parameters type")
         if min(self.nodes) <= self.faults:
             raise ConfigError("There should be more nodes than faults")
+        if self.client_shards < 1:
+            raise ConfigError("client_shards must be >= 1")
+        if not 0.0 <= self.forge_pct <= 100.0:
+            raise ConfigError("forge_pct must be within [0, 100]")
+        if self.forge_pct and not self.verify_ingress:
+            # Without admission verify, forged txs would commit and
+            # silently poison the run's numbers.
+            raise ConfigError("forge_pct requires verify_ingress")
 
 
 class PlotParameters:

@@ -78,10 +78,13 @@ class UserLoad:
             x = math.exp(self.sigma * z - 0.5 * self.sigma * self.sigma)
         return max(self._mean_gap * x / self.profile(t), 1e-9)
 
-    def arrivals(self, now: float) -> int:
+    def arrivals(self, now: float, out_users: list | None = None) -> int:
         """Transactions due at `now` (monotonic calls).  Arrivals inside
         a busy window defer per-user with jittered exponential backoff —
-        deferred, never dropped (this is an open loop)."""
+        deferred, never dropped (this is an open loop).  graftingress:
+        ``out_users`` (optional) receives the user index of each due
+        arrival in order — the signed-ingress probe derives the per-user
+        keypair from it (same contract as the C++ UserLoadModel)."""
         due = 0
         while self._heap and self._heap[0][0] <= now:
             t, user = heapq.heappop(self._heap)
@@ -97,6 +100,8 @@ class UserLoad:
             self._attempts[user] = 0
             due += 1
             self.sent += 1
+            if out_users is not None:
+                out_users.append(user)
             heapq.heappush(self._heap, (t + self.sample_gap(t), user))
         return due
 

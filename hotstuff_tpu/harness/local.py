@@ -54,10 +54,24 @@ class LocalBench:
         self.scheme = getattr(bench_parameters, "scheme", "ed25519")
         if self.scheme == "bls":
             self.tpu_sidecar = True  # no host pairing in the C++ plane
+        # graftingress: signed-transaction ingress knobs (config.py
+        # BenchParameters validated the ranges).
+        self.verify_ingress = bool(
+            getattr(bench_parameters, "verify_ingress", False))
+        self.forge_pct = float(
+            getattr(bench_parameters, "forge_pct", 0.0) or 0.0)
+        self.client_shards = max(1, int(
+            getattr(bench_parameters, "client_shards", 1) or 1))
         self.node_parameters = node_parameters or NodeParameters.default(
             tpu_sidecar=(f"127.0.0.1:{self.SIDECAR_PORT}"
                          if self.tpu_sidecar else None),
             scheme=self.scheme if self.scheme != "ed25519" else None)
+        if self.verify_ingress:
+            # The node-side admission-verify stage rides the mempool
+            # parameters straight into the C++ from_json reader;
+            # setdefault, so caller-provided parameters win.
+            self.node_parameters.json.setdefault(
+                "mempool", {}).setdefault("verify_ingress", True)
         # grafttrace: benched runs always trace (the span lines are one
         # relaxed atomic load when the committee config disables them,
         # and the critical-path breakdown is what makes the run's
@@ -642,13 +656,31 @@ class LocalBench:
             if self.twins:
                 self._boot_twin()
 
+            # graftingress: each node's client optionally fans out over
+            # client_shards processes (disjoint user-id and sample-id
+            # spaces via the offsets, so shard streams never collide),
+            # each signing with per-user keys when verify_ingress is on.
+            shards = self.client_shards
+            shard_rate = -(-rate_share // shards)  # ceil
             for i, address in enumerate(addresses):
-                cmd = CommandMaker.run_client(
-                    address, self.tx_size, rate_share, timeout,
-                    nodes=addresses)
+                for j in range(shards):
+                    g = i * shards + j  # globally unique shard index
+                    cmd = CommandMaker.run_client(
+                        address, self.tx_size, shard_rate, timeout,
+                        nodes=addresses,
+                        sign=self.verify_ingress,
+                        forge_pct=(self.forge_pct
+                                   if self.verify_ingress else None),
+                        seed=(g + 1 if self.verify_ingress or shards > 1
+                              else None),
+                        user_offset=(g << 24 if self.verify_ingress
+                                     else None),
+                        sample_offset=(g << 32 if shards > 1 else None))
+                    log = PathMaker.client_log_file(i) if shards == 1 \
+                        else PathMaker.shard_client_log_file(i, j)
+                    self._background_run(cmd, log)
                 self._client_targets[i] = (address, self.tx_size,
-                                           rate_share)
-                self._background_run(cmd, PathMaker.client_log_file(i))
+                                           shard_rate)
 
             # Wait for all transactions to be processed.
             Print.info(f"Running benchmark ({self.duration} sec)...")

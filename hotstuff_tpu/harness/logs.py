@@ -111,6 +111,9 @@ class LogParser:
         # rate, occupancy, pad-fill, generation drops, queue waits)
         # lands here machine-readable for bench.py's round trip.
         self.cadence = None
+        # graftingress: the OP_STATS ``ingress`` bulk-lane feed mix
+        # (ingress-fed vs offchain-fed), machine-readable for bench.py.
+        self.sidecar_ingress = None
         if self.malformed_lines:
             self.notes.append(
                 f"Parser: skipped {self.malformed_lines} torn/malformed "
@@ -124,8 +127,8 @@ class LogParser:
             results = [self._parse_client(x) for x in clients]
         except (ValueError, IndexError, AttributeError) as e:
             raise ParseError(f"Failed to parse client logs: {e}")
-        self.size, self.rate, self.start, misses, self.sent_samples = zip(
-            *results)
+        self.size, self.rate, self.start, misses, self.sent_samples, \
+            client_ingress = zip(*results)
         self.misses = sum(misses)
 
         try:
@@ -133,7 +136,7 @@ class LogParser:
         except (ValueError, IndexError, AttributeError) as e:
             raise ParseError(f"Failed to parse node logs: {e}")
         proposals, commits, sizes, self.received_samples, timeouts, \
-            configs, views, viewchanges = zip(*results)
+            configs, views, viewchanges, node_ingress = zip(*results)
         self.proposals = self._merge_earliest(proposals)
         self.commits = self._merge_earliest(commits)
         self.sizes = {
@@ -222,6 +225,39 @@ class LogParser:
                 f"{resumes} resume(s); clients logged {busy_lines} busy "
                 "backoff line(s)")
 
+        # graftingress: signed-ingress accounting + the two assertions
+        # that make a forgery-mix run meaningful — ALWAYS strict, chaos
+        # plan or not: (a) zero forged txs may reach a sealed batch on a
+        # verify-ingress run; (b) multi-process client shards must share
+        # the offered load fairly (open-loop shards at equal rates that
+        # diverge wildly mean a shard starved or died silently).
+        self.ingress = self._aggregate_ingress(client_ingress,
+                                               node_ingress)
+        ing = self.ingress
+        if ing["verify_on"] and ing["forged_committed"]:
+            raise ParseError(
+                f"{ing['forged_committed']} forged transaction(s) "
+                "reached a sealed batch on a verify-ingress run — the "
+                "admission-verify stage admitted a forgery")
+        if ing["shards"] >= 2:
+            sent = ing["shard_sent"]
+            if sent and min(sent) < 0.25 * max(sent):
+                raise ParseError(
+                    "client shard fairness violated: per-shard sent "
+                    f"totals {sent} diverge beyond 4x (a shard starved "
+                    "or died silently)")
+            self.notes.append(
+                f"Client shards: {ing['shards']} process(es), sent "
+                + ", ".join(f"{s:,}" for s in sent) + " tx")
+        if ing["signed"]:
+            self.notes.append(
+                f"Signed ingress: {ing['verified']:,} tx admission-"
+                f"verified; clients sent {ing['forged_sent']:,}+ forged "
+                f"({ing['forge_pct']:g}% mix), nodes rejected "
+                f"{ing['forged_rejected']:,} at admission, "
+                f"{ing['busy_shed']:,} shed busy, "
+                f"{ing['forged_committed']} committed")
+
         if self.wan is not None:
             self.note_wan(self.wan)
         if self.chaos_events is not None:
@@ -288,7 +324,26 @@ class LogParser:
             int(s): self._to_posix(t)
             for t, s in findall(r"\[(.*Z) .* sample transaction (\d+)", log)
         }
-        return size, rate, start, misses, samples
+        # graftingress accounting: all OPTIONAL (legacy unsigned logs
+        # parse exactly as before).  The forged/sent counters are
+        # cumulative in the log lines, so the per-log total is the max.
+        m = search(r"Signed ingress enabled \(seed \d+, forge ([0-9.]+)%, "
+                   r"user offset (\d+), sample offset (\d+)\)", log)
+        ingress = {
+            "signed": m is not None,
+            "forge_pct": float(m.group(1)) if m else 0.0,
+            "user_offset": int(m.group(2)) if m else 0,
+            "sample_offset": int(m.group(3)) if m else 0,
+            "forged_sent": max(
+                (int(n) for n in findall(
+                    r"Forged transaction sent \((\d+) total\)", log)),
+                default=0),
+            "sent": max(
+                (int(n) for n in findall(
+                    r"Sent (\d+) transactions", log)),
+                default=0),
+        }
+        return size, rate, start, misses, samples, ingress
 
     def _parse_node(self, log):
         # Fatal node conditions: ERROR-level lines (uncaught exceptions,
@@ -374,8 +429,34 @@ class LogParser:
             m = search(pattern, log)
             if m:
                 configs["consensus"][key] = int(m.group(1))
+        # graftingress: admission-verify evidence, all OPTIONAL (logs
+        # from unsigned runs parse exactly as before).  Rejection totals
+        # are cumulative in the WARN line, so max per log; verified
+        # totals ride the METRICS suffix (max per log, trace runs only).
+        m = search(r"Ingress signature verification enabled with batch "
+                   r"(\d+)", log)
+        if m:
+            configs["mempool"]["verify_batch"] = int(m.group(1))
+        ingress = {
+            "verify_on": m is not None,
+            "forged_committed": len(findall(r"contains forged tx", log)),
+            "forged_rejected": max(
+                (int(n) for n in findall(
+                    r"forged transaction\(s\) at ingress admission "
+                    r"\((\d+) total\)", log)),
+                default=0),
+            "verified": max(
+                (int(n) for n in findall(r"METRICS .* verified=(\d+)",
+                                         log)),
+                default=0),
+            "busy_shed": max(
+                (int(n) for n in findall(
+                    r"Admission verify busy; shed .* \((\d+) total\)",
+                    log)),
+                default=0),
+        }
         return proposals, commits, sizes, samples, timeouts, configs, \
-            self._parse_commit_view(log), viewchange
+            self._parse_commit_view(log), viewchange, ingress
 
     @staticmethod
     def _parse_commit_view(log):
@@ -437,6 +518,36 @@ class LogParser:
             "ejected": sum(vc["ejected"] for vc in viewchanges),
             "dropped_future": sum(
                 vc["dropped_future"] for vc in viewchanges),
+        }
+
+    @staticmethod
+    def _aggregate_ingress(client_ingress, node_ingress) -> dict:
+        """Run-wide signed-ingress summary from the per-log mining.
+        Client forged/sent counters are cumulative per log (already
+        max-reduced), so the run totals are sums; shard mode is
+        detected by >= 2 clients carrying disjoint sample-id offsets.
+        ``forged_sent`` undercounts by at most one forge-log interval
+        per client (the line is rate-limited)."""
+        shard_clients = [c for c in client_ingress
+                         if c["signed"] or c["sample_offset"]]
+        offsets = {c["sample_offset"] for c in shard_clients}
+        shards = len(shard_clients) if len(offsets) >= 2 else 0
+        return {
+            "signed": any(c["signed"] for c in client_ingress),
+            "verify_on": any(n["verify_on"] for n in node_ingress),
+            "forge_pct": max(
+                (c["forge_pct"] for c in client_ingress), default=0.0),
+            "forged_sent": sum(c["forged_sent"] for c in client_ingress),
+            "sent": sum(c["sent"] for c in client_ingress),
+            "shards": shards,
+            "shard_sent": [c["sent"] for c in shard_clients]
+            if shards else [],
+            "verified": sum(n["verified"] for n in node_ingress),
+            "forged_rejected": sum(
+                n["forged_rejected"] for n in node_ingress),
+            "busy_shed": sum(n["busy_shed"] for n in node_ingress),
+            "forged_committed": sum(
+                n["forged_committed"] for n in node_ingress),
         }
 
     # -- metrics -------------------------------------------------------------
@@ -672,6 +783,22 @@ class LogParser:
             surge = stats.get("surge")
             if isinstance(surge, dict):
                 lines.extend(self._surge_lines(surge))
+            # graftingress: bulk-lane feed mix — how much of the bulk
+            # lane the mempool admission-verify stage actually drove.
+            ing = stats.get("ingress")
+            if isinstance(ing, dict) and (ing.get("bulk_requests")
+                                          or ing.get("offchain_requests")):
+                self.sidecar_ingress = ing
+                total = ing.get("bulk_sigs", 0) + \
+                    ing.get("offchain_sigs", 0)
+                share = ing.get("bulk_sigs", 0) / total if total else 0.0
+                lines.append(
+                    f"Sidecar bulk lane: {ing.get('bulk_requests', 0):,} "
+                    f"ingress-fed request(s) "
+                    f"({ing.get('bulk_sigs', 0):,} sigs, {share:.0%} of "
+                    f"bulk), {ing.get('offchain_requests', 0):,} "
+                    f"offchain-fed "
+                    f"({ing.get('offchain_sigs', 0):,} sigs)")
             # graftcadence: a run served by the resident ring says so —
             # tick rate, pad-fill and generation accounting in the
             # CONFIG notes, the full section machine-readable on

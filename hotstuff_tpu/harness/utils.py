@@ -60,6 +60,16 @@ class PathMaker:
         return join(PathMaker.logs_path(), f"client-{i}.log")
 
     @staticmethod
+    def shard_client_log_file(i, j):
+        """graftingress client shard j of node i.  INSIDE the
+        client-*.log glob on purpose: shards are the baseline load,
+        split across processes, and each must parse as a benchmark
+        client (per-shard fairness rides on the per-log accounting)."""
+        assert isinstance(i, int) and i >= 0
+        assert isinstance(j, int) and j >= 0
+        return join(PathMaker.logs_path(), f"client-{i}-{j}.log")
+
+    @staticmethod
     def surge_client_log_file(i):
         """graftsurge flash-crowd generator aimed at replica i.  OUTSIDE
         the client-*.log glob on purpose: surge load is offered on top

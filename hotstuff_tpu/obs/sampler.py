@@ -201,10 +201,13 @@ def read_samples(path: str):
 # prefix is the node's standard grammar, the payload is append-only
 # key=value.  Torn fragments simply don't match — tolerance for free,
 # the parse_node_trace convention.
+# The graftingress admission-verify suffix (verified/forged/vq) is an
+# optional group so logs from pre-signed-ingress builds keep parsing.
 _NODE_METRICS_RE = (r"\[(\S+Z) \w+ [^\]]+\] METRICS "
                     r"commits=(\d+) commit_rate=([0-9.]+) "
                     r"ingress_tx=(\d+) ingress_bytes=(\d+) "
-                    r"busy=(\d+) breaker=(\w+)")
+                    r"busy=(\d+) breaker=(\w+)"
+                    r"(?: verified=(\d+) forged=(\d+) vq=(\d+))?")
 
 
 def parse_node_metrics(log: str, host: str = "node") -> list:
@@ -214,8 +217,8 @@ def parse_node_metrics(log: str, host: str = "node") -> list:
     from .trace import _to_posix
 
     records = []
-    for ts, commits, rate, itx, ibytes, busy, breaker in \
-            re.findall(_NODE_METRICS_RE, log):
+    for (ts, commits, rate, itx, ibytes, busy, breaker,
+         verified, forged, vq) in re.findall(_NODE_METRICS_RE, log):
         try:
             t = _to_posix(ts)
             metrics = {"commits": int(commits),
@@ -224,6 +227,10 @@ def parse_node_metrics(log: str, host: str = "node") -> list:
                        "ingress_bytes": int(ibytes),
                        "busy": int(busy),
                        "breaker": breaker}
+            if verified:
+                metrics["verified"] = int(verified)
+                metrics["forged"] = int(forged)
+                metrics["vq"] = int(vq)
         except ValueError:
             continue
         records.append({"t": t, "ok": True, "node": host,

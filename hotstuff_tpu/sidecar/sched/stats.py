@@ -75,6 +75,15 @@ class SchedStats:
         # warmup marked, or a cold compile happened mid-traffic).
         self.mesh_launches = 0
         self.shard_bucket_hist: dict[int, int] = {}
+        # graftingress bulk-lane class mix: OP_VERIFY_BULK requests are
+        # fed by the mempool admission-verify stage (request ctx ==
+        # the pinned ingress tag) or by offchain batches; the split is
+        # what makes "bulk-lane utilization under signed ingress" a
+        # number instead of a guess.
+        self.ingress_bulk_requests = 0
+        self.ingress_bulk_sigs = 0
+        self.offchain_bulk_requests = 0
+        self.offchain_bulk_sigs = 0
         # graftscale whole-backlog scans: backlogs drained as ONE
         # chunked mesh program instead of per-launch_cap ladder slices.
         # chunk_hist keys are the scan chunk counts g — like the shard
@@ -136,6 +145,19 @@ class SchedStats:
                 waits = self._waits.get(p.cls)
                 if waits is not None:
                     waits.append(now - p.enqueued_at)
+
+    def note_bulk_source(self, ingress: bool, sigs: int):
+        """One offered bulk-lane request, split by feed: ingress-fed
+        (mempool admission verify, pinned ctx tag) vs offchain-fed.
+        Counted at submit time — offered load, not admitted load — so
+        the mix stays honest under backpressure."""
+        with self._lock:
+            if ingress:
+                self.ingress_bulk_requests += 1
+                self.ingress_bulk_sigs += sigs
+            else:
+                self.offchain_bulk_requests += 1
+                self.offchain_bulk_sigs += sigs
 
     def note_path(self, path: str):
         with self._lock:
@@ -248,6 +270,12 @@ class SchedStats:
                     "slices_avoided": self.scan_slices_avoided,
                 },
                 "pipeline": self._pipeline_locked(),
+                "ingress": {
+                    "bulk_requests": self.ingress_bulk_requests,
+                    "bulk_sigs": self.ingress_bulk_sigs,
+                    "offchain_requests": self.offchain_bulk_requests,
+                    "offchain_sigs": self.offchain_bulk_sigs,
+                },
             }
             if surge is not None:
                 out["surge"] = surge

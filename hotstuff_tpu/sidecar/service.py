@@ -303,6 +303,16 @@ class VerifyEngine:
         queue-full — nothing was retained and the CALLER must reply
         (the handler sends the explicit empty-mask backpressure reply);
         never blocks the calling connection thread."""
+        if cls == vsched.BULK and not is_bls:
+            # graftingress feed mix: an admission-verify batch carries
+            # the pinned ingress ctx tag; everything else on the bulk
+            # lane is offchain-fed.  Counted on OFFER (before any shed)
+            # so the mix stays honest under backpressure.
+            from ..crypto.txsign import INGRESS_CTX
+
+            self._sched.stats.note_bulk_source(
+                getattr(request, "ctx", None) == INGRESS_CTX,
+                len(getattr(request, "msgs", ()) or ()))
         if self._rebooting and cls == vsched.BULK and not is_bls:
             # Crash-only reboot in progress (graftguard): the device leg
             # is re-warming and the host path is reserved for consensus

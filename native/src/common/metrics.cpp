@@ -6,6 +6,7 @@
 #include "common/log.hpp"
 #include "crypto/sidecar_client.hpp"
 #include "mempool/ingress.hpp"
+#include "mempool/tx_verify.hpp"
 
 namespace hotstuff {
 
@@ -23,6 +24,12 @@ void NodeMetrics::set_ingress_gate(
     std::weak_ptr<const mempool::IngressGate> gate) {
   std::lock_guard<std::mutex> lk(m_);
   gate_ = std::move(gate);
+}
+
+void NodeMetrics::set_tx_verifier(
+    std::weak_ptr<const mempool::TxVerifier> verifier) {
+  std::lock_guard<std::mutex> lk(m_);
+  tx_verifier_ = std::move(verifier);
 }
 
 namespace {
@@ -52,25 +59,38 @@ void NodeMetrics::emit_sample(double dt_s) {
   uint64_t ingress_tx = 0;
   uint64_t ingress_bytes = 0;
   uint64_t busy = 0;
+  uint64_t verified = 0;
+  uint64_t forged = 0;
+  uint64_t vq = 0;
   {
     std::shared_ptr<const mempool::IngressGate> gate;
+    std::shared_ptr<const mempool::TxVerifier> verifier;
     {
       std::lock_guard<std::mutex> lk(m_);
       gate = gate_.lock();
+      verifier = tx_verifier_.lock();
     }
     if (gate) {
       ingress_tx = gate->queued_txs();
       ingress_bytes = gate->queued_bytes();
       busy = gate->sheds();
     }
+    if (verifier) {
+      verified = verifier->verified();
+      forged = verifier->forged();
+      vq = verifier->queue_depth();
+    }
   }
   // FROZEN grammar (obs/sampler.py _NODE_METRICS_RE; graftlint
-  // obsgrammar cross-checks): append-only.
+  // obsgrammar cross-checks): append-only.  The graftingress suffix
+  // (verified/forged/vq) emits unconditionally — zeros on legacy
+  // unsigned-ingress runs — so the grammar has exactly one shape.
   LOG_INFO("node::metrics")
       << "METRICS commits=" << commits << " commit_rate=" << rate_buf
       << " ingress_tx=" << ingress_tx << " ingress_bytes=" << ingress_bytes
       << " busy=" << busy << " breaker=" << breaker_name(
-          TpuVerifier::instance());
+          TpuVerifier::instance())
+      << " verified=" << verified << " forged=" << forged << " vq=" << vq;
 }
 
 void NodeMetrics::start(uint64_t interval_ms) {

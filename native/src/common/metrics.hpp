@@ -39,6 +39,7 @@ namespace hotstuff {
 
 namespace mempool {
 class IngressGate;
+class TxVerifier;
 }  // namespace mempool
 
 class NodeMetrics {
@@ -57,6 +58,12 @@ class NodeMetrics {
   // fill + BUSY sheds; weak so the gate's lifetime stays the mempool's.
   void set_ingress_gate(std::weak_ptr<const mempool::IngressGate> gate);
 
+  // graftingress: the admission-verify stage registers itself the same
+  // way so the sampler can report verified/forged totals + queue depth
+  // (absent — legacy unsigned ingress — the gauges stay zero and the
+  // METRICS suffix still emits, keeping the grammar unconditional).
+  void set_tx_verifier(std::weak_ptr<const mempool::TxVerifier> verifier);
+
   // Start/stop the 1 Hz sampler thread (Node::create under the `trace`
   // parameter; idempotent — a second start is a no-op).
   void start(uint64_t interval_ms = 1000);
@@ -74,6 +81,7 @@ class NodeMetrics {
   std::mutex m_;
   std::condition_variable cv_;  // SHARED_OK(waited on under m_)
   std::weak_ptr<const mempool::IngressGate> gate_;  // GUARDED_BY(m_)
+  std::weak_ptr<const mempool::TxVerifier> tx_verifier_;  // GUARDED_BY(m_)
   bool running_ = false;                            // GUARDED_BY(m_)
   bool stopping_ = false;                           // GUARDED_BY(m_)
   std::thread thread_;                              // GUARDED_BY(m_)

@@ -455,7 +455,7 @@ class CoreImpl {
           [ch, round, gen](std::optional<bool> ok) {
             ch->try_send(CoreEvent::tc_verdict(round, gen, ok));
           },
-          &ctx);
+          /*bulk=*/false, &ctx);
       return VerifyResult::good();
     }
     // Synchronous path: still ONE batch (a connected sidecar without
@@ -864,7 +864,7 @@ class CoreImpl {
           CoreEvent e = CoreEvent::verdict_of(std::move(copy), ok);
           ch->try_send(std::move(e));
         },
-        &ctx);
+        /*bulk=*/false, &ctx);
     return true;
   }
 

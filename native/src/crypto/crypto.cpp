@@ -362,7 +362,7 @@ bool Signature::async_available() {
 // VERIFIES(sig)
 void Signature::verify_batch_multi_async(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-    AsyncCallback cb, const Digest* ctx) {
+    AsyncCallback cb, bool bulk, const Digest* ctx) {
   TpuVerifier* tpu = TpuVerifier::instance();
   if (!tpu) {
     cb(std::nullopt);
@@ -409,7 +409,23 @@ void Signature::verify_batch_multi_async(
         }
         cb(true);
       },
-      /*bulk=*/false, ctx);
+      bulk, ctx);
+}
+
+// VERIFIES(sig)
+void Signature::verify_batch_multi_async_masked(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    MaskedCallback cb, bool bulk, const Digest* ctx) {
+  // Ed25519-only lane: client tx signatures are Ed25519 under either
+  // scheme knob, so there is no BLS partition here — a non-64-byte
+  // signature is a caller bug and surfaces as the transport-shaped
+  // nullopt (the admission worker then host-verifies, which rejects it).
+  TpuVerifier* tpu = TpuVerifier::instance();
+  if (tpu == nullptr) {
+    cb(std::nullopt, -1);
+    return;
+  }
+  tpu->verify_batch_multi_async_ex(items, std::move(cb), bulk, ctx);
 }
 
 KeyPair generate_keypair() {

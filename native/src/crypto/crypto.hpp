@@ -211,10 +211,28 @@ struct Signature {
   // certificates this batch verifies — rides the verify RPC as the
   // context tag so the sidecar's stage spans join the block's trace.
   // nullptr sends the legacy tag-less frame (v4-compatible).
+  //
+  // `bulk` (graftingress) picks the sidecar scheduling class exactly as
+  // in verify_batch_multi: consensus certificate paths pass false (the
+  // default); only throughput-bound admission batches pass true.
   using AsyncCallback = std::function<void(std::optional<bool>)>;
   static void verify_batch_multi_async(
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
-      AsyncCallback cb, const Digest* ctx = nullptr);
+      AsyncCallback cb, bool bulk = false, const Digest* ctx = nullptr);
+
+  // graftingress admission-verify form: per-item verdict mask (one
+  // forged client tx must reject that tx, not the whole batch) plus the
+  // sidecar's OP_BUSY retry-after hint.  `busy_retry_ms` is -1 unless
+  // the sidecar explicitly shed the request with OP_BUSY (mask is then
+  // nullopt): overload is worth a bounded paced retry on the device;
+  // any other nullopt is a transport failure the caller host-verifies
+  // through.  Ed25519 records only (client tx keys are Ed25519 under
+  // either scheme knob — BLS is a committee-signature concern).
+  using MaskedCallback =
+      std::function<void(std::optional<std::vector<bool>>, int busy_retry_ms)>;
+  static void verify_batch_multi_async_masked(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      MaskedCallback cb, bool bulk = false, const Digest* ctx = nullptr);
 };
 
 struct KeyPair {

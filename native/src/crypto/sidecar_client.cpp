@@ -453,6 +453,16 @@ void TpuVerifier::submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
 void TpuVerifier::verify_batch_multi_async(
     const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
     MaskCallback cb, bool bulk, const Digest* ctx) {
+  verify_batch_multi_async_ex(
+      items,
+      [cb = std::move(cb)](std::optional<std::vector<bool>> mask,
+                           int /*busy_retry_ms*/) { cb(std::move(mask)); },
+      bulk, ctx);
+}
+
+void TpuVerifier::verify_batch_multi_async_ex(
+    const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+    MaskBusyCallback cb, bool bulk, const Digest* ctx) {
   // Class tag rides the opcode: consensus QC/TC verifies stay latency
   // class (the sidecar launches them ahead of any bulk backlog); bulk
   // callers (offchain sweeps, mempool-style batches) must say so.
@@ -477,7 +487,7 @@ void TpuVerifier::verify_batch_multi_async(
   }
   for (const auto& [digest, pk, sig] : items) {
     if (sig.data.size() != 64) {  // not an Ed25519 sig
-      cb(std::nullopt);
+      cb(std::nullopt, -1);
       return;
     }
     w.fixed(digest.data);
@@ -489,7 +499,7 @@ void TpuVerifier::verify_batch_multi_async(
           [cb = std::move(cb), rid, n_items,
            opcode](std::optional<Bytes> reply) {
             if (!reply) {
-              cb(std::nullopt);
+              cb(std::nullopt, -1);
               return;
             }
             try {
@@ -500,8 +510,9 @@ void TpuVerifier::verify_batch_multi_async(
               if (got_op == kOpBusy && got_rid == rid) {
                 // Explicit backpressure (v4): the sidecar shed this
                 // request; the body's u16 retry-after hint is advisory
-                // — the host fallback answers now and the async budget
-                // AIMD paces resubmission.
+                // — latency callers host-fallback now (the async budget
+                // AIMD paces resubmission), the ingress bulk lane paces
+                // a bounded retry off the surfaced hint.
                 uint32_t hint_ms = 0;
                 if (n == 2) {
                   // Sequenced reads: the | operands are unsequenced in
@@ -512,27 +523,27 @@ void TpuVerifier::verify_batch_multi_async(
                 LOG_DEBUG("crypto::sidecar")
                     << "sidecar busy (retry-after " << hint_ms
                     << " ms); falling back to host";
-                cb(std::nullopt);
+                cb(std::nullopt, int(hint_ms));
                 return;
               }
               if (got_op == opcode && got_rid == rid && n == 0 &&
                   n_items != 0) {
-                // Legacy (v2/v3) shed form: empty-count echo.
+                // Legacy (v2/v3) shed form: empty-count echo, no hint.
                 LOG_DEBUG("crypto::sidecar") << "sidecar queue full; "
                                                 "falling back to host";
-                cb(std::nullopt);
+                cb(std::nullopt, 0);
                 return;
               }
               if (got_op != opcode || got_rid != rid || n != n_items) {
                 LOG_WARN("crypto::sidecar") << "protocol mismatch from sidecar";
-                cb(std::nullopt);
+                cb(std::nullopt, -1);
                 return;
               }
               std::vector<bool> mask(n);
               for (uint32_t i = 0; i < n; i++) mask[i] = r.u8() != 0;
-              cb(std::move(mask));
+              cb(std::move(mask), -1);
             } catch (const SerdeError&) {
-              cb(std::nullopt);
+              cb(std::nullopt, -1);
             }
           });
 }

@@ -106,6 +106,19 @@ class TpuVerifier {
       const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
       MaskCallback cb, bool bulk = false, const Digest* ctx = nullptr);
 
+  // graftingress: backpressure-aware form.  `busy_retry_ms` is -1 except
+  // when the sidecar explicitly shed the request with OP_BUSY, in which
+  // case it carries the (clamped, advisory) retry-after hint and the
+  // mask is nullopt.  Consensus callers keep the plain form (an overload
+  // and an outage both mean "host fallback now"); the mempool
+  // admission-verify lane distinguishes them — BUSY is worth a bounded
+  // paced retry on the device, a dead transport is not.
+  using MaskBusyCallback =
+      std::function<void(std::optional<std::vector<bool>>, int busy_retry_ms)>;
+  void verify_batch_multi_async_ex(
+      const std::vector<std::tuple<Digest, PublicKey, Signature>>& items,
+      MaskBusyCallback cb, bool bulk = false, const Digest* ctx = nullptr);
+
   // scheme=bls operations (pairing lives only in the sidecar; signing is
   // its host G2 scalar mult). These use a longer deadline than Ed25519
   // batches — a pairing is milliseconds-to-seconds, not micro.  `ctx` is

@@ -1,6 +1,7 @@
 #include "mempool/batch_maker.hpp"
 
 #include "common/log.hpp"
+#include "mempool/tx_frame.hpp"
 
 namespace hotstuff {
 namespace mempool {
@@ -15,12 +16,28 @@ void seal_and_send(Batch* current, size_t* current_size,
 
   // Sample txs start with 0; their id is the next 8 bytes big-endian
   // (node/src/client.rs:126-133 convention, kept for the log parser).
+  // Signed frames (graftingress, first byte kTxFrameVersion=2) carry the
+  // same inner format at the payload offset: marker 0 keeps the sample
+  // id accounting, marker 2 is the forged-marker — a forged tx reaching
+  // a sealed batch is the failure the admission-verify stage exists to
+  // prevent, and the log parser treats the line as a hard error on
+  // verify-ingress runs.
   std::vector<uint64_t> tx_ids;
+  std::vector<uint64_t> forged_ids;
   for (const auto& tx : *current) {
     if (!tx.empty() && tx[0] == 0 && tx.size() > 8) {
       uint64_t id = 0;
       for (int i = 0; i < 8; i++) id = (id << 8) | tx[1 + i];
       tx_ids.push_back(id);
+    } else if (!tx.empty() && tx[0] == kTxFrameVersion &&
+               tx.size() > kTxFrameHeaderLen + 8) {
+      uint8_t marker = tx[kTxFrameHeaderLen];
+      if (marker != kTxMarkerSample && marker != kTxMarkerForged) continue;
+      uint64_t id = 0;
+      for (int i = 0; i < 8; i++) {
+        id = (id << 8) | tx[kTxFrameHeaderLen + 1 + i];
+      }
+      (marker == kTxMarkerSample ? tx_ids : forged_ids).push_back(id);
     }
   }
 
@@ -35,6 +52,10 @@ void seal_and_send(Batch* current, size_t* current_size,
   for (uint64_t id : tx_ids) {
     LOG_INFO("mempool::batch_maker")
         << "Batch " << digest.to_base64() << " contains sample tx " << id;
+  }
+  for (uint64_t id : forged_ids) {
+    LOG_WARN("mempool::batch_maker")
+        << "Batch " << digest.to_base64() << " contains forged tx " << id;
   }
   LOG_INFO("mempool::batch_maker")
       << "Batch " << digest.to_base64() << " contains " << size << " B";

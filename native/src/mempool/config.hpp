@@ -30,6 +30,14 @@ struct Parameters {
   // is ignored).
   size_t ingress_tx_budget = 20'000;
   size_t ingress_byte_budget = 16u << 20;  // 16 MiB
+  // graftingress admission verify (mempool/tx_verify.hpp): when true,
+  // client txs must be signed frames (mempool/tx_frame.hpp) and verify
+  // through the sidecar bulk lane before reaching the BatchMaker; false
+  // keeps the legacy unsigned path for A/B measurement.
+  bool verify_ingress = false;
+  size_t verify_batch = 64;           // records per admission launch
+  uint64_t verify_max_delay = 20;     // ms; seal a partial verify batch
+  size_t verify_queue_budget = 4096;  // txs queued ahead of verify
 
   static Parameters from_json(const Json& j) {
     Parameters p;
@@ -45,6 +53,16 @@ struct Parameters {
     }
     if (auto* v = j.find("ingress_byte_budget")) {
       p.ingress_byte_budget = size_t(v->as_u64());
+    }
+    if (auto* v = j.find("verify_ingress")) p.verify_ingress = v->as_bool();
+    if (auto* v = j.find("verify_batch")) {
+      p.verify_batch = size_t(v->as_u64());
+    }
+    if (auto* v = j.find("verify_max_delay")) {
+      p.verify_max_delay = v->as_u64();
+    }
+    if (auto* v = j.find("verify_queue_budget")) {
+      p.verify_queue_budget = size_t(v->as_u64());
     }
     return p;
   }
@@ -65,6 +83,13 @@ struct Parameters {
         << "Ingress tx budget set to " << ingress_tx_budget << " txs";
     LOG_INFO("mempool::config")
         << "Ingress byte budget set to " << ingress_byte_budget << " B";
+    // Optional line (logs.py mines it with a plain `search`): absent on
+    // legacy unsigned-ingress runs, so old logs keep parsing.
+    if (verify_ingress) {
+      LOG_INFO("mempool::config")
+          << "Ingress signature verification enabled with batch "
+          << verify_batch << " txs";
+    }
   }
 };
 

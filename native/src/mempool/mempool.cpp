@@ -7,6 +7,7 @@
 #include "mempool/processor.hpp"
 #include "mempool/quorum_waiter.hpp"
 #include "mempool/synchronizer.hpp"
+#include "mempool/tx_frame.hpp"
 
 namespace hotstuff {
 namespace mempool {
@@ -67,17 +68,62 @@ std::unique_ptr<Mempool> Mempool::spawn(
   // sheds from this gate (weak ref — the gate's lifetime stays ours).
   NodeMetrics::instance().set_ingress_gate(mp->ingress_gate_);
   auto gate = mp->ingress_gate_;
+  // graftingress admission verify: between the gate and the BatchMaker,
+  // admitted signed txs batch-verify through the sidecar bulk lane; the
+  // legacy unsigned path stays wired when the knob is off (A/B).
+  if (parameters.verify_ingress) {
+    TxVerifier::Config vc;
+    vc.batch = parameters.verify_batch;
+    vc.max_delay_ms = parameters.verify_max_delay;
+    vc.queue_budget = parameters.verify_queue_budget;
+    mp->tx_verifier_ = TxVerifier::spawn(vc, tx_batch_maker,
+                                         mp->ingress_gate_);
+    NodeMetrics::instance().set_tx_verifier(mp->tx_verifier_);
+  }
+  auto verifier = mp->tx_verifier_;
   auto tx_address = committee.transactions_address(name);
   if (!tx_address) throw std::runtime_error("our key is not in the committee");
   if (!mp->tx_receiver_.spawn(
           *tx_address,
-          [tx_batch_maker, gate](ConnectionWriter& writer, Bytes msg) {
-            // Reactor-thread handler: gate check + try_send only (see
-            // peer handler) — never a blocking channel op.
+          [tx_batch_maker, gate, verifier](ConnectionWriter& writer,
+                                           Bytes msg) {
+            // Reactor-thread handler: parse + gate check + try_send only
+            // (see peer handler) — never a blocking channel op.
             size_t tx_bytes = msg.size();
+            if (verifier) {
+              // Structural parse BEFORE any accounting: a malformed or
+              // legacy-unsigned frame under verify-ingress is dropped
+              // here (error, never a crash, never an admitted forgery —
+              // a forged-but-well-formed frame parses cleanly and dies
+              // at the verify stage instead).
+              TxParse pr = parse_signed_tx(msg.data(), msg.size(), nullptr);
+              if (pr != TxParse::kOk) {
+                LOG_DEBUG("mempool::tx_verify")
+                    << "dropping malformed client frame ("
+                    << (pr == TxParse::kNotSigned ? "unsigned"
+                        : pr == TxParse::kTruncated ? "truncated"
+                                                    : "bad payload length")
+                    << ", " << tx_bytes << " B)";
+                return true;
+              }
+            }
             uint32_t retry_ms = 0;
             if (!gate->admit(tx_bytes, &retry_ms)) {
               writer.send("BUSY " + std::to_string(retry_ms));
+              return true;
+            }
+            if (verifier) {
+              // The writer copy is retained for the verify stage's shed
+              // path (EventLoop::send is stale-id safe); the gate is
+              // unwound by TxVerifier for any tx that never reaches the
+              // BatchMaker.
+              if (!verifier->enqueue(std::move(msg), writer, &retry_ms)) {
+                gate->on_consumed(tx_bytes);
+                writer.send("BUSY " +
+                            std::to_string(retry_ms ? retry_ms : 100));
+                LOG_DEBUG("mempool::tx_verify")
+                    << "admission verify queue full; shedding transaction";
+              }
               return true;
             }
             if (!tx_batch_maker->try_send(std::move(msg))) {
@@ -193,6 +239,10 @@ void Mempool::stop() {
   stopped_ = true;
   stop_flag_->store(true, std::memory_order_relaxed);
   for (auto& close : closers_) close();
+  // The closers already closed tx_batch_maker, so the verify worker can
+  // never wedge in forward_admitted's blocking send; its own queue is
+  // closed (and the worker joined) here.
+  if (tx_verifier_) tx_verifier_->stop();
   tx_receiver_.stop();
   peer_receiver_.stop();
   for (auto& t : threads_) {

@@ -14,6 +14,7 @@
 #include "mempool/config.hpp"
 #include "mempool/ingress.hpp"
 #include "mempool/messages.hpp"
+#include "mempool/tx_verify.hpp"
 #include "network/receiver.hpp"
 #include "store/store.hpp"
 
@@ -39,6 +40,11 @@ class Mempool {
   NetworkReceiver& peer_receiver() { return peer_receiver_; }
   // graftsurge: the bounded-ingress admission gate (telemetry access).
   const IngressGate& ingress_gate() const { return *ingress_gate_; }
+  // graftingress: the admission-verify stage (null when verify_ingress
+  // is off — the legacy unsigned A/B path).
+  std::shared_ptr<const TxVerifier> tx_verifier() const {
+    return tx_verifier_;
+  }
 
  private:
   Mempool() = default;
@@ -46,6 +52,7 @@ class Mempool {
   NetworkReceiver tx_receiver_;
   NetworkReceiver peer_receiver_;
   std::shared_ptr<IngressGate> ingress_gate_;
+  std::shared_ptr<TxVerifier> tx_verifier_;
   std::shared_ptr<std::atomic<bool>> stop_flag_ =
       std::make_shared<std::atomic<bool>>(false);
   std::vector<std::function<void()>> closers_;

@@ -1,0 +1,227 @@
+// graftingress signed-transaction frame: the client→mempool wire format
+// for per-user Ed25519-authenticated transactions, pinned here as the
+// single C++ source of truth.  The Python twin is
+// hotstuff_tpu/crypto/txsign.py and graftlint's wire cross-checker
+// (analysis/wirecheck.py, rule `txframe-mismatch`) asserts the constant
+// sets match — edit BOTH sides or the gate fails.
+//
+// Frame layout (version 2, all integers big-endian):
+//
+//   offset  len  field
+//   ------  ---  -----------------------------------------------------
+//        0    1  version        (kTxFrameVersion = 2; legacy unsigned
+//                                txs start with 0=sample / 1=filler, so
+//                                the first byte discriminates)
+//        1   32  user pubkey    (Ed25519, derived from --seed + user id)
+//       33    8  nonce          (client-local monotonic counter)
+//       41    4  payload_len    (must equal frame_len - kTxFrameOverhead)
+//       45    n  payload        (legacy inner tx format: marker u8 +
+//                                id u64 BE + padding; marker 0=sample,
+//                                1=filler, 2=forged-marker for the A/B
+//                                forgery drill)
+//     45+n   64  signature      (Ed25519 over the signing preimage)
+//
+// Signing preimage: SHA-512/32 over (kTxSignDomain ‖ frame[0 .. 45+n)),
+// i.e. the domain-separated frame with the signature stripped.  The
+// 32-byte digest is the message handed to Ed25519 — the same
+// (digest, pk, sig) record shape every other verify path in this repo
+// ships to the sidecar, so admission batches ride OP_VERIFY_BULK
+// unchanged.
+//
+// Per-user keys: seed32 = SHA-512(kTxKeyDomain ‖ seed u64 BE ‖
+// user u64 BE)[:32] → Ed25519 keypair.  Deterministic on both sides, so
+// a verifier fixture can recompute any user's pubkey without key
+// distribution, and a 1e6-user client derives on first arrival behind a
+// bounded LRU (TxKeyring below) instead of materializing 1e6 keypairs.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <unordered_map>
+#include <utility>
+
+#include "common/bytes.hpp"
+#include "crypto/crypto.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+constexpr uint8_t kTxFrameVersion = 2;
+constexpr size_t kTxPkLen = 32;
+constexpr size_t kTxNonceLen = 8;
+constexpr size_t kTxLenLen = 4;
+constexpr size_t kTxSigLen = 64;
+// version + pubkey + nonce + payload_len header ahead of the payload.
+constexpr size_t kTxFrameHeaderLen = 1 + kTxPkLen + kTxNonceLen + kTxLenLen;
+// Total non-payload bytes in a signed frame.
+constexpr size_t kTxFrameOverhead = kTxFrameHeaderLen + kTxSigLen;
+static_assert(kTxFrameHeaderLen == 45, "signed-tx header drifted");
+static_assert(kTxFrameOverhead == 109, "signed-tx overhead drifted");
+// Payload bounds: the legacy inner format needs marker + u64 id; the
+// upper bound keeps one admission batch's memory footprint sane (and is
+// far under the 8 MiB network frame cap).
+constexpr size_t kTxMinPayload = 9;
+constexpr size_t kTxMaxPayload = 1u << 20;
+constexpr uint8_t kTxMarkerSample = 0;
+constexpr uint8_t kTxMarkerFiller = 1;
+constexpr uint8_t kTxMarkerForged = 2;
+
+// Domain separators (preimage + key derivation) and the sidecar context
+// tag for admission-verify batches.  The ctx tag is exactly kCtxLen(32)
+// chars and deliberately NON-zero: protocol.py decodes an all-zero ctx
+// as "no tag", so a zero sentinel would be invisible to the sidecar's
+// ingress-vs-offchain bulk class mix accounting.
+constexpr char kTxSignDomain[] = "graftingress-tx-v1";
+constexpr char kTxKeyDomain[] = "graftingress-key-v1";
+constexpr char kTxIngressCtxTag[] = "graftingress-tx-admission-ctx-v1";
+static_assert(sizeof(kTxIngressCtxTag) == 33,
+              "ingress ctx tag must be exactly 32 bytes");
+
+inline Digest tx_ingress_ctx() {
+  Digest d;
+  std::memcpy(d.data.data(), kTxIngressCtxTag, 32);
+  return d;
+}
+
+// Zero-copy view over a structurally valid signed frame.  Pointers alias
+// the caller's buffer.
+struct SignedTxView {
+  const uint8_t* pk = nullptr;       // kTxPkLen bytes
+  uint64_t nonce = 0;
+  const uint8_t* payload = nullptr;  // payload_len bytes
+  size_t payload_len = 0;
+  const uint8_t* sig = nullptr;      // kTxSigLen bytes
+};
+
+enum class TxParse {
+  kOk,
+  kNotSigned,       // first byte is not kTxFrameVersion (legacy tx)
+  kTruncated,       // shorter than overhead + min payload
+  kBadPayloadLen,   // declared length out of bounds or ≠ frame remainder
+};
+
+// Structural parse of one client frame.  Never throws, never reads past
+// `len`; the admission path feeds it raw client bytes (fuzz target).
+inline TxParse parse_signed_tx(const uint8_t* data, size_t len,
+                               SignedTxView* out) {
+  if (len == 0 || data[0] != kTxFrameVersion) return TxParse::kNotSigned;
+  if (len < kTxFrameOverhead + kTxMinPayload) return TxParse::kTruncated;
+  uint64_t nonce = 0;
+  for (size_t i = 0; i < kTxNonceLen; i++) {
+    nonce = (nonce << 8) | data[1 + kTxPkLen + i];
+  }
+  uint32_t plen = 0;
+  for (size_t i = 0; i < kTxLenLen; i++) {
+    plen = (plen << 8) | data[1 + kTxPkLen + kTxNonceLen + i];
+  }
+  if (plen < kTxMinPayload || plen > kTxMaxPayload) {
+    return TxParse::kBadPayloadLen;
+  }
+  // The declared payload length must exactly account for the frame: a
+  // lying length (short or long) is malformed, not silently truncated.
+  if (size_t(plen) + kTxFrameOverhead != len) return TxParse::kBadPayloadLen;
+  if (out != nullptr) {
+    out->pk = data + 1;
+    out->nonce = nonce;
+    out->payload = data + kTxFrameHeaderLen;
+    out->payload_len = plen;
+    out->sig = data + kTxFrameHeaderLen + plen;
+  }
+  return TxParse::kOk;
+}
+
+// Signing preimage digest over frame[0 .. signed_len) where signed_len =
+// kTxFrameHeaderLen + payload_len (everything but the signature).
+inline Digest tx_sign_digest(const uint8_t* frame, size_t signed_len) {
+  DigestBuilder b;
+  b.update(reinterpret_cast<const uint8_t*>(kTxSignDomain),
+           sizeof(kTxSignDomain) - 1);
+  b.update(frame, signed_len);
+  return b.finalize();
+}
+
+// Deterministic per-user key seed: SHA-512/32(domain ‖ seed ‖ user),
+// integers big-endian.
+inline std::array<uint8_t, 32> tx_user_seed(uint64_t seed, uint64_t user) {
+  uint8_t buf[16];
+  for (int i = 0; i < 8; i++) buf[i] = uint8_t(seed >> (56 - 8 * i));
+  for (int i = 0; i < 8; i++) buf[8 + i] = uint8_t(user >> (56 - 8 * i));
+  DigestBuilder b;
+  b.update(reinterpret_cast<const uint8_t*>(kTxKeyDomain),
+           sizeof(kTxKeyDomain) - 1);
+  b.update(buf, sizeof(buf));
+  return b.finalize().data;
+}
+
+inline KeyPair tx_user_keypair(uint64_t seed, uint64_t user) {
+  return keypair_from_seed(tx_user_seed(seed, user));
+}
+
+// Bounded LRU of expanded per-user keypairs: derive-on-first-arrival so
+// a 1e6-user client only ever holds `capacity` expanded keys.  Single
+// threaded (the client's send loop / the verifier fixture own one each).
+class TxKeyring {
+ public:
+  explicit TxKeyring(uint64_t seed, size_t capacity = 4096)
+      : seed_(seed), capacity_(capacity == 0 ? 1 : capacity) {}
+
+  const KeyPair& get(uint64_t user) {
+    auto it = map_.find(user);
+    if (it != map_.end()) {
+      lru_.splice(lru_.begin(), lru_, it->second.second);
+      return it->second.first;
+    }
+    if (map_.size() >= capacity_) {
+      map_.erase(lru_.back());
+      lru_.pop_back();
+    }
+    lru_.push_front(user);
+    auto [ins, _] =
+        map_.emplace(user, std::make_pair(tx_user_keypair(seed_, user),
+                                          lru_.begin()));
+    derivations_++;
+    return ins->second.first;
+  }
+
+  size_t size() const { return map_.size(); }
+  uint64_t derivations() const { return derivations_; }
+
+ private:
+  uint64_t seed_;
+  size_t capacity_;
+  uint64_t derivations_ = 0;
+  std::list<uint64_t> lru_;
+  std::unordered_map<uint64_t,
+                     std::pair<KeyPair, std::list<uint64_t>::iterator>>
+      map_;
+};
+
+// Build one signed frame: header + payload + signature over the
+// preimage digest.  `flip_sig_bit` forges the signature (the seeded
+// forgery mix in the A/B drill) while keeping the structure valid — a
+// forged frame must parse cleanly and die at verify, not at parse.
+inline Bytes build_signed_tx(const KeyPair& kp, uint64_t nonce,
+                             const uint8_t* payload, size_t payload_len,
+                             bool flip_sig_bit = false) {
+  Bytes frame(kTxFrameHeaderLen + payload_len + kTxSigLen);
+  frame[0] = kTxFrameVersion;
+  std::memcpy(frame.data() + 1, kp.name.data.data(), kTxPkLen);
+  for (size_t i = 0; i < kTxNonceLen; i++) {
+    frame[1 + kTxPkLen + i] = uint8_t(nonce >> (56 - 8 * i));
+  }
+  for (size_t i = 0; i < kTxLenLen; i++) {
+    frame[1 + kTxPkLen + kTxNonceLen + i] =
+        uint8_t(uint32_t(payload_len) >> (24 - 8 * i));
+  }
+  std::memcpy(frame.data() + kTxFrameHeaderLen, payload, payload_len);
+  Digest d = tx_sign_digest(frame.data(), kTxFrameHeaderLen + payload_len);
+  Signature sig = Signature::sign(d, kp.secret);
+  std::memcpy(frame.data() + kTxFrameHeaderLen + payload_len, sig.data.data(),
+              kTxSigLen);
+  if (flip_sig_bit) frame[kTxFrameHeaderLen + payload_len] ^= 0x01;
+  return frame;
+}
+
+}  // namespace mempool
+}  // namespace hotstuff

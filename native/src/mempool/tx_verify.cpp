@@ -1,0 +1,220 @@
+#include "mempool/tx_verify.hpp"
+
+#include <chrono>
+#include <cstring>
+#include <tuple>
+#include <utility>
+#include <vector>
+
+#include "common/log.hpp"
+#include "mempool/tx_frame.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+std::shared_ptr<TxVerifier> TxVerifier::spawn(
+    Config cfg, ChannelPtr<Transaction> tx_batch_maker,
+    std::shared_ptr<IngressGate> gate) {
+  auto v = std::shared_ptr<TxVerifier>(
+      new TxVerifier(cfg, std::move(tx_batch_maker), std::move(gate)));
+  LOG_INFO("mempool::tx_verify")
+      << "Admission verify enabled: batch " << cfg.batch << " txs, max delay "
+      << cfg.max_delay_ms << " ms, queue budget " << cfg.queue_budget
+      << " txs";
+  return v;
+}
+
+TxVerifier::TxVerifier(Config cfg, ChannelPtr<Transaction> tx_batch_maker,
+                       std::shared_ptr<IngressGate> gate)
+    : cfg_(cfg),
+      queue_(make_channel<PendingTx>(cfg.queue_budget + 64)),
+      tx_batch_maker_(std::move(tx_batch_maker)),
+      gate_(std::move(gate)) {
+  worker_ = std::thread([this] { run_(); });
+}
+
+bool TxVerifier::enqueue(Bytes frame,
+                         std::optional<ConnectionWriter> writer,
+                         uint32_t* retry_ms) {
+  // Budget first: the channel has slack above the budget (like the
+  // gate/channel split in Mempool::spawn), so the counter is the
+  // admission authority and try_send only fails at teardown.
+  if (depth_.load(std::memory_order_relaxed) >= cfg_.queue_budget) {
+    if (retry_ms != nullptr) {
+      *retry_ms = uint32_t(std::max<uint64_t>(50, 2 * cfg_.max_delay_ms));
+    }
+    return false;
+  }
+  PendingTx tx;
+  tx.frame = std::move(frame);
+  tx.writer = std::move(writer);
+  if (!queue_->try_send(std::move(tx))) {
+    if (retry_ms != nullptr) *retry_ms = 100;
+    return false;
+  }
+  depth_.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+void TxVerifier::run_() {
+  set_thread_name("tx-verify");
+  std::vector<PendingTx> batch;
+  auto delay = std::chrono::milliseconds(cfg_.max_delay_ms);
+  auto deadline = std::chrono::steady_clock::now() + delay;
+  while (true) {
+    PendingTx tx;
+    auto status = queue_->recv_until(&tx, deadline);
+    if (status == RecvStatus::kClosed) {
+      // Teardown: unwind the gate for anything still pending so a
+      // restart never inherits phantom backlog accounting.
+      for (auto& p : batch) {
+        if (gate_) gate_->on_consumed(p.frame.size());
+        depth_.fetch_sub(1, std::memory_order_relaxed);
+      }
+      return;
+    }
+    if (status == RecvStatus::kTimeout) {
+      settle_batch_(&batch);
+      deadline = std::chrono::steady_clock::now() + delay;
+      continue;
+    }
+    batch.push_back(std::move(tx));
+    if (batch.size() >= cfg_.batch) {
+      settle_batch_(&batch);
+      deadline = std::chrono::steady_clock::now() + delay;
+    }
+  }
+}
+
+void TxVerifier::settle_batch_(std::vector<PendingTx>* batch) {
+  if (batch->empty()) return;
+  // QC-shaped records: (preimage digest, user pubkey, signature) — the
+  // exact triple every consensus verify path ships, so the batch rides
+  // OP_VERIFY_BULK unchanged.  Frames were structurally validated at
+  // enqueue; the re-parse here is offset arithmetic, not trust.
+  std::vector<std::tuple<Digest, PublicKey, Signature>> items;
+  items.reserve(batch->size());
+  for (const auto& tx : *batch) {
+    SignedTxView v;
+    parse_signed_tx(tx.frame.data(), tx.frame.size(), &v);
+    Digest d = tx_sign_digest(tx.frame.data(),
+                              kTxFrameHeaderLen + v.payload_len);
+    PublicKey pk;
+    std::memcpy(pk.data.data(), v.pk, kTxPkLen);
+    Signature sig;
+    sig.data.assign(v.sig, v.sig + kTxSigLen);
+    items.emplace_back(d, pk, sig);
+  }
+
+  static const Digest kIngressCtx = tx_ingress_ctx();
+  std::optional<std::vector<bool>> mask;
+  int attempts = 0;
+  while (true) {
+    if (!Signature::async_available()) break;  // breaker open / no budget
+    Oneshot<std::pair<std::optional<std::vector<bool>>, int>> done;
+    Signature::verify_batch_multi_async_masked(
+        items,
+        [done](std::optional<std::vector<bool>> m, int busy_ms) {
+          done.set({std::move(m), busy_ms});
+        },
+        /*bulk=*/true, &kIngressCtx);
+    auto result = done.wait();  // bounded: callbacks fire by deadline
+    if (result.first) {
+      mask = std::move(result.first);
+      break;
+    }
+    int busy_ms = result.second;
+    if (busy_ms < 0) break;  // transport failure -> host path
+    // Explicit OP_BUSY backpressure: a bounded paced retry keeps the
+    // batch on the device through a transient surge; past the budget
+    // the whole batch sheds with a client-visible BUSY (honest load
+    // backs off per-user, the same contract as the ingress gate).
+    uint32_t pace = std::min<uint32_t>(
+        std::max(1, busy_ms), cfg_.busy_retry_cap_ms);
+    if (attempts >= cfg_.busy_retries) {
+      shed_busy_(batch, pace);
+      return;
+    }
+    attempts++;
+    busy_retries_.fetch_add(1, std::memory_order_relaxed);
+    std::this_thread::sleep_for(std::chrono::milliseconds(pace));
+  }
+
+  if (!mask) {
+    // Host path: breaker-open or mid-flight transport failure.  Same
+    // per-tx verdicts, pure OpenSSL — degraded goodput, never an
+    // unverified admission.
+    host_fallbacks_.fetch_add(1, std::memory_order_relaxed);
+    std::vector<bool> m(items.size());
+    for (size_t i = 0; i < items.size(); i++) {
+      m[i] = std::get<2>(items[i]).verify(std::get<0>(items[i]),
+                                          std::get<1>(items[i]));
+    }
+    mask = std::move(m);
+  }
+
+  size_t rejected = 0;
+  for (size_t i = 0; i < batch->size(); i++) {
+    if ((*mask)[i]) {
+      verified_.fetch_add(1, std::memory_order_relaxed);
+      // VERIFIES(tx-signature)
+      forward_admitted(std::move((*batch)[i].frame));
+    } else {
+      reject_forged_(&(*batch)[i]);
+      rejected++;
+    }
+    depth_.fetch_sub(1, std::memory_order_relaxed);
+  }
+  if (rejected > 0) {
+    // NOTE: mined by hotstuff_tpu/harness/logs.py (format frozen).
+    LOG_WARN("mempool::tx_verify")
+        << "Rejected " << rejected
+        << " forged transaction(s) at ingress admission ("
+        << forged_.load(std::memory_order_relaxed) << " total)";
+  }
+  batch->clear();
+}
+
+void TxVerifier::forward_admitted(Bytes frame) {
+  size_t tx_bytes = frame.size();
+  // Blocking send is safe on the worker: capacity tracks the ingress
+  // budget, which bounds how many admitted txs can be outstanding.  A
+  // false return means teardown — unwind the gate ourselves since the
+  // BatchMaker will never drain this tx.
+  if (!tx_batch_maker_->send(std::move(frame))) {
+    if (gate_) gate_->on_consumed(tx_bytes);
+  }
+}
+
+void TxVerifier::reject_forged_(PendingTx* tx) {
+  forged_.fetch_add(1, std::memory_order_relaxed);
+  if (gate_) gate_->on_consumed(tx->frame.size());
+}
+
+void TxVerifier::shed_busy_(std::vector<PendingTx>* batch,
+                            uint32_t retry_ms) {
+  for (auto& tx : *batch) {
+    if (tx.writer) tx.writer->send("BUSY " + std::to_string(retry_ms));
+    if (gate_) gate_->on_consumed(tx.frame.size());
+    shed_.fetch_add(1, std::memory_order_relaxed);
+    depth_.fetch_sub(1, std::memory_order_relaxed);
+  }
+  LOG_WARN("mempool::tx_verify")
+      << "Admission verify busy; shed " << batch->size()
+      << " tx(s) with retry-after " << retry_ms << " ms ("
+      << shed_.load(std::memory_order_relaxed) << " total)";
+  batch->clear();
+}
+
+void TxVerifier::stop() {
+  // acq_rel: the winning stop() publishes everything before the close +
+  // join below; a losing racer must observe that teardown as complete.
+  if (stopped_.exchange(true, std::memory_order_acq_rel)) return;
+  queue_->close();
+  if (worker_.joinable()) worker_.join();
+}
+
+TxVerifier::~TxVerifier() { stop(); }
+
+}  // namespace mempool
+}  // namespace hotstuff

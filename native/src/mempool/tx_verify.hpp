@@ -1,0 +1,118 @@
+// graftingress admission-verify stage: the signed-transaction verifier
+// between IngressGate::admit and the BatchMaker.
+//
+// Admitted signed txs (tx_frame.hpp) accumulate into QC-shaped batches
+// on a dedicated worker thread and verify through the sidecar BULK lane
+// (Signature::verify_batch_multi_async_masked → OP_VERIFY_BULK, tagged
+// with the pinned graftingress context so the sidecar's OP_STATS can
+// tell ingress-fed bulk records from offchain bench filler).  The
+// degradation ladder mirrors the consensus paths:
+//
+//   * device mask        -> per-tx verdicts: valid txs forward to the
+//     BatchMaker (the ONLY way client bytes reach a sealed batch when
+//     --verify-ingress is on; the forward carries the
+//     `// VERIFIES(tx-signature)` taint gate), forged txs are counted
+//     and dropped before they can reach a block;
+//   * OP_BUSY            -> bounded paced retry off the sidecar's
+//     retry-after hint, then shed the whole batch with a client-visible
+//     "BUSY <ms>" reply (the same backoff contract as the ingress gate);
+//   * breaker open / no async budget / transport failure -> host verify
+//     loop (OpenSSL), same per-tx verdicts — overload degrades goodput,
+//     never admits an unverified tx.
+//
+// Threading: enqueue() runs on the reactor thread (counter + try_send,
+// never blocks); everything else runs on the single worker thread.  The
+// retained ConnectionWriter copies are safe off-thread: EventLoop::send
+// looks up the connection id under the loop and is a no-op for stale
+// ids (see receiver.hpp).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <optional>
+#include <thread>
+
+#include "common/channel.hpp"
+#include "mempool/ingress.hpp"
+#include "mempool/messages.hpp"
+#include "network/receiver.hpp"
+
+namespace hotstuff {
+namespace mempool {
+
+class TxVerifier {
+ public:
+  struct Config {
+    size_t batch = 64;             // records per admission-verify launch
+    uint64_t max_delay_ms = 20;    // seal a partial batch after this
+    size_t queue_budget = 4096;    // txs queued ahead of verify
+    int busy_retries = 2;          // bounded OP_BUSY paced retries
+    uint32_t busy_retry_cap_ms = 500;  // clamp on the sidecar's hint
+  };
+
+  // One admitted signed tx awaiting verification.  The writer is a
+  // retained ConnectionWriter copy used only for the client-visible
+  // BUSY shed (absent in tests that drive frames without a connection).
+  struct PendingTx {
+    Bytes frame;
+    std::optional<ConnectionWriter> writer;
+  };
+
+  // `tx_batch_maker` receives verified frames; `gate` is unwound for
+  // every tx that does NOT reach the BatchMaker (forged / shed /
+  // dropped-at-teardown) — forwarded txs keep the existing drain-side
+  // accounting in BatchMaker.
+  static std::shared_ptr<TxVerifier> spawn(
+      Config cfg, ChannelPtr<Transaction> tx_batch_maker,
+      std::shared_ptr<IngressGate> gate);
+
+  // Reactor thread: queue one structurally valid signed frame for
+  // verification.  Returns false when the verify queue is over budget —
+  // the caller replies BUSY with *retry_ms and unwinds the gate.
+  bool enqueue(Bytes frame, std::optional<ConnectionWriter> writer,
+               uint32_t* retry_ms);
+
+  // Close the queue and join the worker; pending txs are dropped with
+  // their gate accounting unwound.  Idempotent; the destructor calls it.
+  void stop();
+  ~TxVerifier();
+
+  // -- telemetry (any thread; the node METRICS sampler reads these) -------
+  uint64_t verified() const { return verified_.load(std::memory_order_relaxed); }
+  uint64_t forged() const { return forged_.load(std::memory_order_relaxed); }
+  uint64_t shed() const { return shed_.load(std::memory_order_relaxed); }
+  uint64_t busy_retries() const {
+    return busy_retries_.load(std::memory_order_relaxed);
+  }
+  uint64_t host_fallbacks() const {
+    return host_fallbacks_.load(std::memory_order_relaxed);
+  }
+  size_t queue_depth() const { return depth_.load(std::memory_order_relaxed); }
+
+ private:
+  TxVerifier(Config cfg, ChannelPtr<Transaction> tx_batch_maker,
+             std::shared_ptr<IngressGate> gate);
+
+  void run_();
+  void settle_batch_(std::vector<PendingTx>* batch);
+  void forward_admitted(Bytes frame);
+  void reject_forged_(PendingTx* tx);
+  void shed_busy_(std::vector<PendingTx>* batch, uint32_t retry_ms);
+
+  const Config cfg_;  // SHARED_OK(immutable after construction)
+  ChannelPtr<PendingTx> queue_;          // SHARED_OK(Channel self-syncs)
+  ChannelPtr<Transaction> tx_batch_maker_;  // SHARED_OK(Channel self-syncs)
+  std::shared_ptr<IngressGate> gate_;    // SHARED_OK(IngressGate self-syncs)
+  std::atomic<uint64_t> verified_{0};
+  std::atomic<uint64_t> forged_{0};
+  std::atomic<uint64_t> shed_{0};
+  std::atomic<uint64_t> busy_retries_{0};
+  std::atomic<uint64_t> host_fallbacks_{0};
+  std::atomic<size_t> depth_{0};
+  std::atomic<bool> stopped_{false};
+  std::thread worker_;
+};
+
+}  // namespace mempool
+}  // namespace hotstuff

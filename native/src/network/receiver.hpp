@@ -18,8 +18,11 @@
 namespace hotstuff {
 
 // Reply-capable view of a connection handed to handlers (the Writer half of
-// the reference's split framed transport).  Valid only during the handler
-// call (handlers in this codebase ACK synchronously; none retain it).
+// the reference's split framed transport).  Copyable value: handlers may
+// retain a copy past the handler call (the mempool admission-verify stage
+// keeps one per queued tx for the deferred BUSY shed) — EventLoop::send
+// looks the connection id up and returns false if it has since closed, so
+// a stale copy is safe, its sends just drop.
 class ConnectionWriter {
  public:
   // Reply backlog cap: a peer that sends but never reads would otherwise

@@ -12,9 +12,18 @@
 // retry in model mode, a whole-stream pause in legacy mode.
 // Sample txs ([0u8][u64 BE counter][padding]) are logged for end-to-end
 // latency measurement; filler txs are [1u8][u64 BE r][padding].
+// graftingress (--sign): every tx rides the signed-transaction frame
+// (mempool/tx_frame.hpp) instead — the legacy bytes become the PAYLOAD,
+// wrapped in (pubkey ‖ nonce ‖ len ‖ payload ‖ sig) and signed with the
+// per-user Ed25519 key derived from --seed + user index.  --forge-pct
+// flips one signature bit on that fraction of filler txs (marker 2):
+// structurally valid frames the node's admission verify must reject.
+// --user-offset / --sample-offset shard the user-id and sample-id
+// spaces so multi-process client shards never collide.
 //   client ADDR --size BYTES --rate TXS [--timeout MS] [--nodes A1 A2 ...]
 //          [--users N] [--seed S] [--dist lognormal|pareto] [--sigma X]
 //          [--alpha X] [--diurnal AMP] [--diurnal-period SEC]
+//          [--sign] [--forge-pct P] [--user-offset K] [--sample-offset K]
 #include <algorithm>
 #include <atomic>
 #include <chrono>
@@ -25,10 +34,17 @@
 #include <vector>
 
 #include "common/log.hpp"
+#include "mempool/tx_frame.hpp"
 #include "network/socket.hpp"
 #include "node/rate_pacer.hpp"
 
 using namespace hotstuff;
+using hotstuff::mempool::build_signed_tx;
+using hotstuff::mempool::kTxFrameOverhead;
+using hotstuff::mempool::kTxMarkerFiller;
+using hotstuff::mempool::kTxMarkerForged;
+using hotstuff::mempool::kTxMarkerSample;
+using hotstuff::mempool::TxKeyring;
 
 namespace {
 constexpr uint64_t kPrecision = 20;  // sample precision: bursts per second
@@ -36,6 +52,9 @@ constexpr uint64_t kBurstDurationMs = 1000 / kPrecision;
 // BUSY replies are per-shed; log the first and every Nth so a surge
 // leaves evidence without drowning the log.
 constexpr uint64_t kBusyLogEvery = 50;
+// Forged sends carry a cumulative total, so sparse logging still lets
+// the parser recover the count to within one log interval.
+constexpr uint64_t kForgeLogEvery = 25;
 
 // "BUSY <retry_ms>" -> retry_ms, or -1 when the frame is something else.
 int64_t parse_busy(const Bytes& frame) {
@@ -67,6 +86,10 @@ int main(int argc, char** argv) {
   double alpha = 2.5;
   double diurnal_amp = 0.0;
   double diurnal_period_s = 600.0;
+  bool sign = false;
+  double forge_pct = 0.0;
+  uint64_t user_offset = 0;
+  uint64_t sample_offset = 0;
   std::vector<std::string> nodes;
   for (int i = 1; i < argc; i++) {
     std::string arg = argv[i];
@@ -86,6 +109,10 @@ int main(int argc, char** argv) {
     else if (arg == "--alpha") alpha = std::stod(next());
     else if (arg == "--diurnal") diurnal_amp = std::stod(next());
     else if (arg == "--diurnal-period") diurnal_period_s = std::stod(next());
+    else if (arg == "--sign") sign = true;
+    else if (arg == "--forge-pct") forge_pct = std::stod(next());
+    else if (arg == "--user-offset") user_offset = std::stoull(next());
+    else if (arg == "--sample-offset") sample_offset = std::stoull(next());
     else if (arg == "--dist") {
       std::string d = next();
       if (d == "pareto") dist = ArrivalDist::kPareto;
@@ -105,7 +132,8 @@ int main(int argc, char** argv) {
     std::cerr << "client ADDR --size BYTES --rate TXS [--timeout MS] "
                  "[--users N] [--seed S] [--dist lognormal|pareto] "
                  "[--sigma X] [--alpha X] [--diurnal AMP] "
-                 "[--diurnal-period SEC] [--nodes ...]\n";
+                 "[--diurnal-period SEC] [--sign] [--forge-pct P] "
+                 "[--user-offset K] [--sample-offset K] [--nodes ...]\n";
     return 2;
   }
   if (size < 9) {
@@ -120,9 +148,20 @@ int main(int argc, char** argv) {
 
   LOG_INFO("client") << "Node address: " << target->str();
   // NOTE: These log entries are used to compute performance
-  // (hotstuff_tpu/harness/logs.py client regexes).
-  LOG_INFO("client") << "Transactions size: " << size << " B";
+  // (hotstuff_tpu/harness/logs.py client regexes).  Signed frames put
+  // kTxFrameOverhead extra bytes on the wire per tx; the size logged is
+  // the ON-WIRE size so the parser's bytes→tx arithmetic stays exact.
+  LOG_INFO("client") << "Transactions size: "
+                     << (sign ? size + kTxFrameOverhead : size) << " B";
   LOG_INFO("client") << "Transactions rate: " << rate << " tx/s";
+  if (sign) {
+    // NOTE: This log entry switches the log parser into signed-ingress
+    // accounting (and marks shard identity via the offsets).
+    LOG_INFO("client") << "Signed ingress enabled (seed " << seed
+                       << ", forge " << forge_pct << "%, user offset "
+                       << user_offset << ", sample offset "
+                       << sample_offset << ")";
+  }
   if (users > 1) {
     LOG_INFO("client") << "Simulating " << users << " users ("
                        << (dist == ArrivalDist::kPareto ? "pareto alpha="
@@ -201,6 +240,17 @@ int main(int argc, char** argv) {
   uint64_t r = rng();
   uint64_t counter = 0;
   Bytes tx(size, 0);
+  // graftingress signing state: the keyring derives (and LRU-caches)
+  // per-user keypairs from --seed; forgery is a seeded coin flip on
+  // FILLER txs only — sample txs must commit for the latency join.
+  TxKeyring keyring(seed);
+  std::bernoulli_distribution forge(
+      std::min(1.0, std::max(0.0, forge_pct / 100.0)));
+  uint64_t nonce = 0;
+  uint64_t forged_total = 0;
+  uint64_t total_sent = 0;
+  uint64_t ticks = 0;
+  std::vector<size_t> burst_users;
 
   // NOTE: This log entry is used to compute performance.
   LOG_INFO("client") << "Start sending transactions";
@@ -216,9 +266,10 @@ int main(int argc, char** argv) {
     double now_s = std::chrono::duration<double>(now - start).count();
     int64_t hint = busy_hint_ms.exchange(-1, std::memory_order_acquire);
     uint64_t burst;
+    burst_users.clear();
     if (users > 1) {
       if (hint >= 0) model.busy(now_s, double(hint) / 1e3);
-      burst = model.arrivals(now_s);
+      burst = model.arrivals(now_s, sign ? &burst_users : nullptr);
     } else {
       if (hint >= 0) {
         legacy_busy_until =
@@ -227,24 +278,58 @@ int main(int argc, char** argv) {
       if (now < legacy_busy_until) continue;  // whole-stream pause
       burst = pacer.next_burst();
     }
+    if (++ticks % (5 * kPrecision) == 0) {
+      // NOTE: This log entry is used to compute performance (per-shard
+      // fairness accounting; cumulative, ~every 5 s).
+      LOG_INFO("client") << "Sent " << total_sent << " transactions";
+    }
     if (burst == 0) continue;  // no arrivals due on this tick
     auto burst_start = std::chrono::steady_clock::now();
     for (uint64_t x = 0; x < burst; x++) {
       uint64_t id;
+      uint8_t marker;
       if (x == counter % burst) {
+        id = sample_offset + counter;
         // NOTE: This log entry is used to compute performance.
-        LOG_INFO("client") << "Sending sample transaction " << counter;
-        tx[0] = 0;  // sample txs start with 0
-        id = counter;
+        LOG_INFO("client") << "Sending sample transaction " << id;
+        marker = kTxMarkerSample;  // sample txs start with 0
       } else {
-        tx[0] = 1;  // standard txs start with 1
+        marker = kTxMarkerFiller;  // standard txs start with 1
         id = ++r;
       }
+      bool forged = false;
+      if (sign && marker == kTxMarkerFiller && forge_pct > 0.0 &&
+          forge(rng)) {
+        marker = kTxMarkerForged;
+        forged = true;
+      }
+      tx[0] = marker;
       for (int b = 0; b < 8; b++) tx[1 + b] = (id >> (8 * (7 - b))) & 0xFF;
-      if (!sock->write_frame(tx)) {
+      bool ok;
+      if (sign) {
+        size_t user = size_t(user_offset) +
+                      (x < burst_users.size() ? burst_users[x] : 0);
+        Bytes frame = build_signed_tx(keyring.get(user), nonce++,
+                                      tx.data(), tx.size(),
+                                      /*flip_sig_bit=*/forged);
+        if (forged) {
+          forged_total++;
+          // NOTE: This log entry is used to compute performance
+          // (cumulative; first + every kForgeLogEvery-th).
+          if (forged_total == 1 || forged_total % kForgeLogEvery == 0) {
+            LOG_INFO("client") << "Forged transaction sent ("
+                               << forged_total << " total)";
+          }
+        }
+        ok = sock->write_frame(frame);
+      } else {
+        ok = sock->write_frame(tx);
+      }
+      if (!ok) {
         LOG_WARN("client") << "Failed to send transaction";
         return 1;
       }
+      total_sent++;
     }
     auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
         std::chrono::steady_clock::now() - burst_start);

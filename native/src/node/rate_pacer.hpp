@@ -84,7 +84,10 @@ class UserLoadModel {
 
   // Number of transactions to send at `now` (all user arrivals due up
   // to now).  Call with a monotonically non-decreasing clock.
-  uint64_t arrivals(double now) {
+  // graftingress: `out_users` (optional) receives the user index of
+  // each due arrival, in order — the signing client derives the
+  // per-user keypair from it.
+  uint64_t arrivals(double now, std::vector<size_t>* out_users = nullptr) {
     uint64_t due = 0;
     while (!heap_.empty() && heap_.top().t <= now) {
       Arrival a = heap_.top();
@@ -104,6 +107,7 @@ class UserLoadModel {
       u.attempt = 0;
       due++;
       sent_++;
+      if (out_users != nullptr) out_users->push_back(a.user);
       heap_.push({a.t + next_gap_(a.t), a.user});
     }
     return due;

@@ -2,14 +2,18 @@
 // timeout, quorum waiting with fake ACKing peers, processor hash+store,
 // synchronizer request emission, helper batch reply, and the full pipeline
 // client-tx -> digest.
+#include <chrono>
 #include <thread>
 
+#include "crypto/sidecar_client.hpp"
 #include "mempool/batch_maker.hpp"
 #include "mempool/helper.hpp"
 #include "mempool/mempool.hpp"
 #include "mempool/processor.hpp"
 #include "mempool/quorum_waiter.hpp"
 #include "mempool/synchronizer.hpp"
+#include "mempool/tx_frame.hpp"
+#include "mempool/tx_verify.hpp"
 #include "test_util.hpp"
 
 using namespace hotstuff;
@@ -402,6 +406,327 @@ TEST(peer_batch_digest_survives_consensus_backlog) {
     CHECK(digest.has_value());
     CHECK(store.read(digest->to_bytes()).has_value());
   }
+  mp->stop();
+}
+
+// -- graftingress: signed-tx admission verify -------------------------------
+
+namespace {
+
+// One signed frame in the legacy inner-payload shape (marker + id + pad).
+Bytes signed_tx_frame(const KeyPair& kp, uint64_t nonce, uint64_t id,
+                      size_t payload_len = 32, bool forge = false) {
+  Bytes payload(payload_len, 0);
+  payload[0] = forge ? kTxMarkerForged : kTxMarkerSample;
+  for (int i = 0; i < 8; i++) payload[1 + i] = uint8_t(id >> (56 - 8 * i));
+  return build_signed_tx(kp, nonce, payload.data(), payload.size(), forge);
+}
+
+// Uninstalls the process-global sidecar client even when a failing CHECK
+// returns early (test_crypto.cpp's SidecarGuard, Ed25519-only flavour).
+struct SidecarGuard {
+  ~SidecarGuard() { TpuVerifier::install(nullptr); }
+};
+
+// Poll a telemetry counter until it reaches `want` or the deadline hits;
+// the verify worker settles batches asynchronously off a max-delay timer.
+template <typename Fn>
+bool wait_counter(Fn&& read, uint64_t want, int timeout_ms = 5000) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (read() >= want) return true;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  return read() >= want;
+}
+
+}  // namespace
+
+TEST(tx_frame_parse_fuzz_never_crashes_or_misparses) {
+  // C++ twin of tests/test_fuzz.py's tx_corpus: every malformed mutation
+  // of a valid signed frame must classify cleanly — no crash, no read
+  // past len, and never a kOk verdict for a frame whose declared length
+  // lies about its body.
+  auto kp = tx_user_keypair(5, 0);
+  Bytes frame = signed_tx_frame(kp, 7, 42);
+  SignedTxView v;
+  CHECK(parse_signed_tx(frame.data(), frame.size(), &v) == TxParse::kOk);
+  CHECK(v.payload_len == 32);
+  CHECK(v.nonce == 7);
+  CHECK(std::memcmp(v.pk, kp.name.data.data(), kTxPkLen) == 0);
+  CHECK(v.sig == frame.data() + kTxFrameHeaderLen + 32);
+  // The out param is nullable (the reactor's structural pre-check).
+  CHECK(parse_signed_tx(frame.data(), frame.size(), nullptr) == TxParse::kOk);
+
+  // A forged frame (flipped sig bit) must parse kOk: forgeries die at
+  // the verify stage, never at parse.
+  Bytes forged = signed_tx_frame(kp, 7, 42, 32, /*forge=*/true);
+  CHECK(parse_signed_tx(forged.data(), forged.size(), &v) == TxParse::kOk);
+
+  // Empty / wrong leading version byte -> legacy-unsigned verdict.
+  CHECK(parse_signed_tx(frame.data(), 0, nullptr) == TxParse::kNotSigned);
+  for (uint8_t ver : {uint8_t(0), uint8_t(1), uint8_t(3), uint8_t(255)}) {
+    Bytes m = frame;
+    m[0] = ver;
+    CHECK(parse_signed_tx(m.data(), m.size(), nullptr) == TxParse::kNotSigned);
+  }
+  // Every truncation below overhead+min-payload is kTruncated.
+  for (size_t k = 1; k < kTxFrameOverhead + kTxMinPayload; k++) {
+    CHECK(parse_signed_tx(frame.data(), k, nullptr) == TxParse::kTruncated);
+  }
+  // Lying declared payload lengths: short, long, zero, max+1, absurd.
+  auto with_plen = [&frame](uint32_t plen) {
+    Bytes m = frame;
+    for (size_t i = 0; i < kTxLenLen; i++) {
+      m[1 + kTxPkLen + kTxNonceLen + i] = uint8_t(plen >> (24 - 8 * i));
+    }
+    return m;
+  };
+  for (uint32_t plen : {31u, 33u, 0u, uint32_t(kTxMaxPayload + 1),
+                        0xFFFFFFFFu}) {
+    Bytes m = with_plen(plen);
+    CHECK(parse_signed_tx(m.data(), m.size(), nullptr) ==
+          TxParse::kBadPayloadLen);
+  }
+  // Honest header, dishonest body: cut or pad the frame itself.
+  CHECK(parse_signed_tx(frame.data(), frame.size() - 1, nullptr) ==
+        TxParse::kBadPayloadLen);
+  Bytes padded = frame;
+  padded.push_back(0);
+  CHECK(parse_signed_tx(padded.data(), padded.size(), nullptr) ==
+        TxParse::kBadPayloadLen);
+}
+
+TEST(tx_keyring_bounded_lru_derives_on_demand) {
+  // Deterministic derivation (the python twin recomputes the same keys)
+  // and bounded residency: a 1e6-user load never holds more than
+  // `capacity` expanded keypairs.
+  CHECK(tx_user_keypair(5, 9).name == tx_user_keypair(5, 9).name);
+  CHECK(!(tx_user_keypair(5, 9).name == tx_user_keypair(5, 10).name));
+  CHECK(!(tx_user_keypair(6, 9).name == tx_user_keypair(5, 9).name));
+  TxKeyring ring(5, /*capacity=*/2);
+  PublicKey pk1 = ring.get(1).name;
+  ring.get(2);
+  CHECK(ring.size() == 2);
+  CHECK(ring.derivations() == 2);
+  ring.get(2);  // hit: no new derivation
+  CHECK(ring.derivations() == 2);
+  ring.get(3);  // evicts user 1 (LRU)
+  CHECK(ring.size() == 2);
+  CHECK(ring.derivations() == 3);
+  CHECK(ring.get(1).name == pk1);  // re-derived, same key
+  CHECK(ring.derivations() == 4);
+}
+
+TEST(admission_verify_host_path_admits_valid_rejects_forged) {
+  // Unit drive of TxVerifier with NO sidecar installed: the worker falls
+  // back to the host verify loop, valid txs forward to the batch-maker
+  // channel in order, and forged txs are counted + gate-unwound before
+  // they can ever reach a batch.
+  SidecarGuard guard;  // ensure no verifier leaks in from another test
+  TpuVerifier::install(nullptr);
+  IngressGate::Config gc;
+  gc.tx_budget = 100;
+  auto gate = std::make_shared<IngressGate>(gc, nullptr);
+  auto out = make_channel<Transaction>();
+  TxVerifier::Config vc;
+  vc.batch = 4;
+  vc.max_delay_ms = 10;
+  auto verifier = TxVerifier::spawn(vc, out, gate);
+
+  TxKeyring ring(5);
+  std::vector<Bytes> valid;
+  uint32_t retry = 0;
+  for (uint64_t u = 0; u < 3; u++) {
+    Bytes f = signed_tx_frame(ring.get(u), /*nonce=*/u, /*id=*/u);
+    CHECK(gate->admit(f.size(), &retry));
+    valid.push_back(f);
+    CHECK(verifier->enqueue(std::move(f), std::nullopt, &retry));
+  }
+  Bytes forged = signed_tx_frame(ring.get(9), 9, 9, 32, /*forge=*/true);
+  CHECK(gate->admit(forged.size(), &retry));
+  CHECK(verifier->enqueue(std::move(forged), std::nullopt, &retry));
+
+  // Batch of 4 seals by size; only the 3 valid frames come through.
+  for (const auto& f : valid) {
+    auto got = out->recv();
+    CHECK(got.has_value());
+    CHECK(*got == f);
+  }
+  CHECK(wait_counter([&] { return verifier->forged(); }, 1));
+  CHECK(verifier->verified() == 3);
+  CHECK(verifier->forged() == 1);
+  CHECK(verifier->host_fallbacks() == 1);
+  CHECK(verifier->shed() == 0);
+  // The forged tx's gate slot was unwound; the 3 forwarded ones keep
+  // their accounting until a BatchMaker would drain them.
+  CHECK(wait_counter([&] { return uint64_t(4 - gate->queued_txs()); }, 1));
+  CHECK(gate->queued_txs() == 3);
+  verifier->stop();
+  out->close();
+}
+
+TEST(admission_verify_dead_sidecar_falls_back_to_host) {
+  // A sidecar that is installed but unreachable must degrade to the host
+  // path (async_available() is false while disconnected) — overload or
+  // outage degrades goodput, never admits an unverified tx.
+  uint16_t port;
+  {
+    auto l = Listener::bind({"127.0.0.1", 0});
+    CHECK(l.has_value());
+    port = l->port();
+  }
+  SidecarGuard guard;
+  TpuVerifier::install(
+      std::make_unique<TpuVerifier>(Address{"127.0.0.1", port}));
+
+  auto gate = std::make_shared<IngressGate>(IngressGate::Config{}, nullptr);
+  auto out = make_channel<Transaction>();
+  TxVerifier::Config vc;
+  vc.batch = 2;
+  vc.max_delay_ms = 10;
+  auto verifier = TxVerifier::spawn(vc, out, gate);
+
+  TxKeyring ring(5);
+  uint32_t retry = 0;
+  Bytes ok_frame = signed_tx_frame(ring.get(1), 1, 1);
+  Bytes bad_frame = signed_tx_frame(ring.get(2), 2, 2, 32, /*forge=*/true);
+  CHECK(gate->admit(ok_frame.size(), &retry));
+  CHECK(gate->admit(bad_frame.size(), &retry));
+  Bytes expect = ok_frame;
+  CHECK(verifier->enqueue(std::move(ok_frame), std::nullopt, &retry));
+  CHECK(verifier->enqueue(std::move(bad_frame), std::nullopt, &retry));
+
+  auto got = out->recv();
+  CHECK(got.has_value());
+  CHECK(*got == expect);
+  CHECK(wait_counter([&] { return verifier->forged(); }, 1));
+  CHECK(verifier->verified() == 1);
+  CHECK(verifier->forged() == 1);
+  CHECK(verifier->host_fallbacks() >= 1);
+  verifier->stop();
+  out->close();
+}
+
+TEST(admission_verify_busy_retries_then_sheds) {
+  // Explicit OP_BUSY backpressure from a live sidecar: the worker paces
+  // a bounded retry off the surfaced hint, then sheds the whole batch
+  // (client-visible BUSY handled by the writer in the node wiring) with
+  // the gate fully unwound — nothing reaches the batch maker.
+  auto l = Listener::bind({"127.0.0.1", 0});
+  CHECK(l.has_value());
+  uint16_t port = l->port();
+  std::thread server([&l] {
+    auto sock = l->accept();
+    if (!sock) return;
+    Bytes frame;
+    while (sock->read_frame(&frame)) {
+      Reader r(frame);
+      r.u8();  // opcode (ignored: everything gets shed)
+      uint32_t rid = r.u32();
+      Writer w;
+      w.u8(10);  // OP_BUSY
+      w.u32(rid);
+      w.u32(2);
+      w.u8(7);  // retry-after hint: 7 ms, little-endian u16
+      w.u8(0);
+      if (!sock->write_frame(w.out)) return;
+    }
+  });
+
+  SidecarGuard guard;
+  TpuVerifier::install(
+      std::make_unique<TpuVerifier>(Address{"127.0.0.1", port}));
+  // Prime the connection: the sync path dials, eats the BUSY, and host-
+  // verifies — after which async_available() sees a live transport.
+  auto kp = keys()[0];
+  Digest d = sha512_digest(Bytes{1});
+  CHECK(Signature::verify_batch_multi(
+      {{d, kp.name, Signature::sign(d, kp.secret)}}));
+
+  auto gate = std::make_shared<IngressGate>(IngressGate::Config{}, nullptr);
+  auto out = make_channel<Transaction>();
+  TxVerifier::Config vc;
+  vc.batch = 2;
+  vc.max_delay_ms = 10;
+  vc.busy_retries = 1;
+  vc.busy_retry_cap_ms = 20;
+  auto verifier = TxVerifier::spawn(vc, out, gate);
+
+  TxKeyring ring(5);
+  uint32_t retry = 0;
+  for (uint64_t u = 0; u < 2; u++) {
+    Bytes f = signed_tx_frame(ring.get(u), u, u);
+    CHECK(gate->admit(f.size(), &retry));
+    CHECK(verifier->enqueue(std::move(f), std::nullopt, &retry));
+  }
+  CHECK(wait_counter([&] { return verifier->shed(); }, 2));
+  CHECK(verifier->shed() == 2);
+  CHECK(verifier->busy_retries() == 1);
+  CHECK(verifier->verified() == 0);
+  CHECK(verifier->forged() == 0);
+  CHECK(gate->queued_txs() == 0);  // fully unwound
+  Transaction leak;
+  CHECK(out->recv_until(&leak, std::chrono::steady_clock::now() +
+                                   std::chrono::milliseconds(100)) ==
+        RecvStatus::kTimeout);
+  verifier->stop();
+  out->close();
+  TpuVerifier::install(nullptr);  // closes the socket -> server sees EOF
+  l->shutdown();
+  server.join();
+}
+
+TEST(mempool_signed_ingress_end_to_end) {
+  // Full pipeline with --verify-ingress on: a malformed frame is dropped
+  // at parse, a forged-but-well-formed frame dies at the verify stage,
+  // and only the honestly signed frame seals a batch and reaches a
+  // quorum-acked digest.
+  SidecarGuard guard;  // host verify path: no sidecar in this test
+  TpuVerifier::install(nullptr);
+  auto committee = mempool_committee(7900);
+  auto myself = keys()[0].name;
+  auto delivered = make_channel<Bytes>();
+  auto threads = peer_listeners(committee, myself, delivered);
+
+  Store store = Store::open("");
+  Parameters params;
+  params.batch_size = 100;  // one signed frame (141 B) seals a batch
+  params.max_batch_delay = 10'000;
+  params.verify_ingress = true;
+  params.verify_batch = 1;  // settle every frame immediately
+  params.verify_max_delay = 10;
+  auto rx_consensus = make_channel<ConsensusMempoolMessage>();
+  auto tx_consensus = make_channel<Digest>();
+  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
+                           tx_consensus);
+  CHECK(mp->tx_verifier() != nullptr);
+
+  auto sock = Socket::connect(*committee.transactions_address(myself));
+  CHECK(sock.has_value());
+  TxKeyring ring(5);
+  // 1. Malformed: signed version byte but truncated body -> parse drop.
+  Bytes malformed = signed_tx_frame(ring.get(0), 0, 0);
+  malformed.resize(40);
+  CHECK(sock->write_frame(malformed));
+  // 2. Forged: parses cleanly, rejected + counted at the verify stage.
+  Bytes forged = signed_tx_frame(ring.get(1), 1, 1, 32, /*forge=*/true);
+  CHECK(sock->write_frame(forged));
+  CHECK(wait_counter([&] { return mp->tx_verifier()->forged(); }, 1));
+  // 3. Honest: verifies, seals, broadcasts, quorum-ACKs, commits.
+  Bytes honest = signed_tx_frame(ring.get(2), 2, 2);
+  CHECK(sock->write_frame(honest));
+  auto digest = tx_consensus->recv();
+  CHECK(digest.has_value());
+  auto stored = store.read(digest->to_bytes());
+  CHECK(stored.has_value());
+  auto m = MempoolMessage::deserialize(*stored);
+  CHECK(m.batch.size() == 1);
+  CHECK(m.batch[0] == honest);
+  CHECK(mp->tx_verifier()->verified() == 1);
+  CHECK(mp->tx_verifier()->forged() == 1);
+  for (auto& t : threads) t.join();
   mp->stop();
 }
 

@@ -22,6 +22,10 @@
 #      ring wedge-recovery drill — a forced wedge mid-cadence must drop
 #      the ring back to the staged engine through the ladder with
 #      bit-identical masks and no double reply.
+#   4. The graftingress signed-tx lane (tests/test_ingress_tier.py,
+#      plus the tx-frame fuzz corpus inside test_fuzz.py) is pure
+#      python-side work: frame/key derivation, parser accounting and
+#      the small-population users probe — a few seconds total.
 #
 # GUARD_GATE_BUDGET_S overrides the window; the gate FAILS (rc 124) if
 # the budget is exceeded, so a supervisor-latency regression is a loud
@@ -40,7 +44,7 @@ start=$(date +%s)
 rc=0
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu HOTSTUFF_TPU_SLOW_TESTS=1 \
     python -m pytest "$ROOT/tests/test_fuzz.py" "$ROOT/tests/test_guard.py" \
-    "$ROOT/tests/test_ring.py" \
+    "$ROOT/tests/test_ring.py" "$ROOT/tests/test_ingress_tier.py" \
     -q -p no:cacheprovider "$@" || rc=$?
 if [ "$rc" -ne 0 ]; then
   if [ "$rc" -eq 124 ]; then

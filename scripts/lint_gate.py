@@ -227,7 +227,17 @@ if __name__ == "__main__":
                     # the no-unverified-bytes proof silently stops
                     # covering the paths it exists for.
                     "taint:native/src/consensus/core.cpp",
-                    "taint:hotstuff_tpu/sidecar/protocol.py"):
+                    "taint:hotstuff_tpu/sidecar/protocol.py",
+                    # graftingress: the admission-verify stage and the
+                    # signed-tx codec twins must stay inside the taint
+                    # and cxxsync scans — the tx-signature gate proof
+                    # and the frame-constant cross-check both die
+                    # silently if either side drops out.
+                    "taint:native/src/mempool/tx_verify.cpp",
+                    "taint:native/src/mempool/tx_verify.hpp",
+                    "taint:hotstuff_tpu/crypto/txsign.py",
+                    "cxxsync:native/src/mempool/tx_verify.hpp",
+                    "cxxsync:native/src/mempool/tx_verify.cpp"):
             argv += ["--must-cover", pin]
     rc = main(argv)
     budget_rc = check_suppression_budget(REPO)

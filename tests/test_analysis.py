@@ -267,7 +267,8 @@ def test_scan_and_shard_map_bodies_are_hot():
 
 WIRE_FILES = (wirecheck.PROTOCOL, wirecheck.SIDECAR_CLIENT,
               wirecheck.CRYPTO_HPP, wirecheck.FIELD25519,
-              wirecheck.INTMATH, wirecheck.FIELD381, wirecheck.BLS12381)
+              wirecheck.INTMATH, wirecheck.FIELD381, wirecheck.BLS12381,
+              wirecheck.TXSIGN, wirecheck.TX_FRAME_HPP)
 
 
 @pytest.fixture()

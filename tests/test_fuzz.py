@@ -169,6 +169,130 @@ def test_ctx_alias_boundary_is_exact():
             proto.decode_request(payload)
 
 
+# -- graftingress signed-tx frame corpus ----------------------------------
+#
+# The admission path feeds raw client bytes into the signed-frame parser
+# on both sides (txsign.parse_signed_tx here, tx_frame.hpp's
+# parse_signed_tx in native/tests/test_mempool.cpp).  Contract: every
+# malformed frame raises TxFrameError with a named reason — truncation,
+# lying payload lengths, and pubkey/sig boundary aliasing can NEVER
+# mis-slice — and a forged-signature frame with valid structure parses
+# cleanly and dies at verify, never at parse.
+
+def _tx_keypair(user: int = 0):
+    from hotstuff_tpu.crypto import txsign
+    return txsign.derive_user_keypair(5, user)
+
+
+def tx_corpus(seed: int = SEED) -> list:
+    """Seeded malformed signed-tx frames: ``(label, frame_bytes)``."""
+    from hotstuff_tpu.crypto import txsign
+
+    rng = random.Random(seed)
+    kp = _tx_keypair()
+    payload = txsign.build_payload(txsign.TX_MARKER_FILLER, 42, size=32)
+    good = txsign.build_signed_tx(kp, nonce=9, payload=payload)
+    out = []
+    # Truncations: every cut inside the header, seeded cuts mid-payload
+    # and mid-signature.
+    for k in range(txsign.TX_FRAME_HEADER_LEN):
+        out.append((f"tx-truncated-header-{k}", good[:k]))
+    for _ in range(6):
+        k = rng.randrange(txsign.TX_FRAME_HEADER_LEN, len(good) - 1)
+        out.append((f"tx-truncated-{k}", good[:k]))
+    # Lying payload_len: declared length disagrees with the frame (short
+    # and long), including the pubkey/sig boundary aliasing attempts —
+    # a length off by ±1/±32/±64 would slide the signature window over
+    # payload bytes (or padding) if the parser trusted it.
+    for delta in (-64, -32, -1, 1, 32, 64):
+        lying = bytearray(good)
+        plen = len(payload) + delta
+        if plen < 0:
+            continue
+        lying[41:45] = plen.to_bytes(4, "big")
+        out.append((f"tx-lying-len{delta:+d}", bytes(lying)))
+    # Same aliasing from the other side: frame padded/cut while the
+    # declared length stays honest.
+    for delta in (-64, -1, 1, 64):
+        if delta < 0:
+            out.append((f"tx-frame-cut{delta:+d}", good[:delta]))
+        else:
+            out.append((f"tx-frame-pad{delta:+d}",
+                        good + bytes(rng.randbytes(delta))))
+    # Oversized: declared payload_len beyond TX_MAX_PAYLOAD (the 1 MiB
+    # admission bound), and below TX_MIN_PAYLOAD.
+    for plen in (txsign.TX_MAX_PAYLOAD + 1, 0xFFFFFFFF, 0,
+                 txsign.TX_MIN_PAYLOAD - 1):
+        lying = bytearray(good)
+        lying[41:45] = plen.to_bytes(4, "big")
+        out.append((f"tx-payload-len-{plen}", bytes(lying)))
+    # Wrong version byte: legacy markers and seeded non-version values
+    # must be classified not-signed, never parsed as signed frames.
+    for v in [0, 1] + sorted(rng.sample(range(3, 256), 4)):
+        wrong = bytes([v]) + good[1:]
+        out.append((f"tx-version-{v}", wrong))
+    out.append(("tx-empty", b""))
+    # Pure noise at seeded lengths.
+    for i, size in enumerate((1, 45, 109, 118, 500)):
+        out.append((f"tx-noise-{i}", bytes(rng.randbytes(size))))
+    return out
+
+
+def test_tx_corpus_is_seeded_and_stable():
+    a = [(label, bytes(b)) for label, b in tx_corpus()]
+    b = [(label, bytes(b)) for label, b in tx_corpus()]
+    assert a == b
+    assert len(a) > 40
+
+
+def test_tx_parse_never_crashes_or_misparses():
+    """parse_signed_tx over the whole corpus: TxFrameError with a named
+    reason, or (for the rare structurally-valid mutant) a parse whose
+    slices are exact — no other exception, no mis-slicing, and nothing
+    malformed survives to verify as authentic."""
+    from hotstuff_tpu.crypto import txsign
+
+    reasons = {"not-signed", "truncated", "bad-payload-len"}
+    for label, frame in tx_corpus():
+        try:
+            tx = txsign.parse_signed_tx(frame)
+        except txsign.TxFrameError as e:
+            assert e.reason in reasons, label
+            continue
+        except Exception as e:  # noqa: BLE001 — the assertion
+            raise AssertionError(f"{label}: parse leaked {e!r}")
+        # Structurally valid (e.g. padding absorbed into a longer
+        # declared payload): slices must be exact and the signature
+        # must NOT verify — a mutant can parse but never authenticate.
+        assert len(tx.pk) == txsign.TX_PK_LEN, label
+        assert len(tx.sig) == txsign.TX_SIG_LEN, label
+        assert not txsign.verify_tx(frame), label
+
+
+def test_tx_forged_signature_dies_at_verify_not_parse():
+    """The seeded forgery mix's contract: a flipped-signature frame is
+    structurally INDISTINGUISHABLE from an honest one (same parse, same
+    slices) and fails only signature verification."""
+    from hotstuff_tpu.crypto import txsign
+
+    kp = _tx_keypair(3)
+    payload = txsign.build_payload(txsign.TX_MARKER_FORGED, 7)
+    honest = txsign.build_signed_tx(kp, nonce=1, payload=payload)
+    forged = txsign.build_signed_tx(kp, nonce=1, payload=payload,
+                                    flip_sig_bit=True)
+    h, f = txsign.parse_signed_tx(honest), txsign.parse_signed_tx(forged)
+    assert h.pk == f.pk and h.payload == f.payload and h.nonce == f.nonce
+    assert h.sig != f.sig
+    assert txsign.verify_tx(honest)
+    assert not txsign.verify_tx(forged)
+    # The admission record (digest, pk, sig) is identical up to the
+    # signature — the digest covers only the signed prefix, so the
+    # forgery is invisible until the sidecar verdict.
+    dh, pkh, _ = txsign.admission_record(honest)
+    df, pkf, _ = txsign.admission_record(forged)
+    assert dh == df and pkh == pkf
+
+
 @pytest.fixture(scope="module")
 def fuzz_server():
     engine = VerifyEngine(use_host=True)

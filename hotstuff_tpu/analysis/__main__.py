@@ -19,13 +19,13 @@ import sys
 
 CHECKERS = ("hotpath", "wire", "sanitize", "padshape", "timing", "sockets",
             "obsspan", "obsgrammar", "threads", "cxxsync", "ingress",
-            "guard", "ring", "taint")
+            "guard", "ring", "taint", "tenantq")
 
 
 def run_all(root: str, checkers=CHECKERS) -> list:
     from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
-        obsspan, padshape, ringlint, sanitize, sockets, taint, threads, \
-        timing, wirecheck
+        obsspan, padshape, ringlint, sanitize, sockets, taint, \
+        tenantlint, threads, timing, wirecheck
 
     findings = []
     if "hotpath" in checkers:
@@ -58,6 +58,8 @@ def run_all(root: str, checkers=CHECKERS) -> list:
         # CLI runs refresh the wire→gate→sink proof artifact alongside
         # the findings (tests call taint.check() directly, no write)
         findings += taint.check(root, map_out=taint.MAP_OUT)
+    if "tenantq" in checkers:
+        findings += tenantlint.check(root)
     # checkers may anchor the same missing constant from two rule paths
     seen, unique = set(), []
     for f in findings:
@@ -83,7 +85,8 @@ def check_coverage(root: str, must_cover) -> list:
     module and the verifysched modules to hotpath, and the graftchaos
     modules to sockets."""
     from . import cxxsync, guardlint, hotpath, ingress, obsgrammar, \
-        obsspan, padshape, ringlint, sockets, taint, threads, timing
+        obsspan, padshape, ringlint, sockets, taint, tenantlint, \
+        threads, timing
     from .common import Finding
 
     target_sets = {
@@ -99,6 +102,7 @@ def check_coverage(root: str, must_cover) -> list:
         "guard": tuple(guardlint.DEFAULT_TARGETS),
         "ring": tuple(ringlint.DEFAULT_TARGETS),
         "taint": tuple(taint.DEFAULT_TARGETS),
+        "tenantq": tuple(tenantlint.DEFAULT_TARGETS),
     }
     findings = []
     for pin in must_cover:

@@ -173,6 +173,14 @@ class _PyCalls(ast.NodeVisitor):
             if name in ("encode_reply", "encode_reply_raw") and \
                     len(node.args) >= 3 and _is_literal(node.args[2]):
                 exempt = True  # literal mask (PING/CHAOS echo), no verdict
+            if name == "encode_reply_raw" and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id == "OP_HELLO":
+                # Handshake echo (protocol v6): an OP_HELLO-tagged reply
+                # is routed to decode_hello_body, never read as a verify
+                # mask — the body is the server version byte plus the
+                # validated tenant id, not a verdict.
+                exempt = True
             self.calls.append(Call(
                 name, node.lineno * _LINE_POS + node.col_offset,
                 node.lineno, exempt))

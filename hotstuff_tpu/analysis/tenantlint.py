@@ -1,0 +1,159 @@
+"""graftlint tenant-scoped-queue checker: scheduler code must never
+reach around the DRR tenant lanes with raw deque operations.
+
+graftfleet made the tenant id a real scheduling key: every class queue
+is backed by per-tenant FIFO lanes drained in deficit-round-robin order
+(sched/tenantq.py), and the per-tenant admission caps plus the
+``tenant_starvation == 0`` invariant only hold if EVERY queue access
+routes through the lane helpers (``_offer_locked`` / ``head_locked`` /
+``pop_next_locked``).  One ``self.items.popleft()`` in a scheduler
+method would silently collapse the three-key discipline back to a
+single shared FIFO: the code would still look queue-shaped in review,
+and the first greedy tenant would blockade every other tenant's
+latency-class requests.  This rule makes that bypass a lint finding
+instead of a noisy-neighbor incident.
+
+Rule:
+  tenant-unscoped-queue   in a sched/ module OUTSIDE tenantq.py,
+                          (a) a ``.popleft`` / ``.appendleft`` /
+                          ``.rotate`` call whose receiver is a
+                          queue-carrying attribute (``items`` /
+                          ``queue`` / ``queues`` / ``lanes`` /
+                          ``order`` / ``backlog`` / ``pending``), or
+                          (b) a ``self``-rooted subscript of such an
+                          attribute (``self.items[0]`` — peeking past
+                          the DRR head).
+
+Receiver detection is name-based like the bounded-ingress rule: the
+scheduler uses these conventional names for its admission-guarded
+queues, and a rename that dodges the rule is exactly the edit a
+reviewer should see.  Telemetry rings (``_pack_window``, ``_packs``)
+and plain containers on value objects (a launch record's ``items``
+list, read by index for pad accounting) use other names or non-``self``
+receivers and stay out of scope by construction.  tenantq.py itself is
+the audited implementation and is exempt wholesale.  Inline
+``# graftlint: disable=tenant-unscoped-queue`` suppressions follow the
+standard policy (analysis/README.md): only with a worked justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .common import Finding, apply_suppressions, parse_source, \
+    read_source
+
+DEFAULT_TARGETS = (
+    "hotstuff_tpu/sidecar/sched",
+)
+
+# The audited lane implementation: raw deque ops ARE its job.
+_EXEMPT_FILES = ("tenantq.py",)
+
+_RAW_OPS = {"popleft", "appendleft", "rotate"}
+_QUEUE_NAMES = {"items", "queue", "queues", "lanes", "order", "backlog",
+                "pending"}
+# Subscripts police only the deque-shaped attributes: ``self.items[0]``
+# peeks past the DRR head, while ``self._queues[cls]`` merely SELECTS a
+# class queue object (a dict lookup, not an ordering decision).
+_DEQUE_NAMES = {"items", "order"}
+
+
+def _queue_attr(node: ast.AST):
+    """Rightmost queue-ish attribute name of a receiver
+    (``self.items.popleft`` -> ``items``), else None.  Attribute
+    receivers only — a local deque is function-private state."""
+    if isinstance(node, ast.Attribute) and \
+            node.attr.lstrip("_") in _QUEUE_NAMES:
+        return node.attr
+    return None
+
+
+def _self_rooted(node: ast.AST) -> bool:
+    """True when the attribute chain bottoms out at ``self`` — the
+    shared-state access the rule polices (``launch.items[...]`` on a
+    value object is mere data plumbing)."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _check_source(rel: str, source: str) -> list:
+    findings = []
+    tree = parse_source(source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr in _RAW_OPS):
+                continue
+            queue = _queue_attr(fn.value)
+            if queue is None:
+                continue
+            findings.append(Finding(
+                rel, node.lineno, "tenant-unscoped-queue",
+                f"raw .{fn.attr}() on queue attribute {queue!r} bypasses "
+                "the DRR tenant lanes: scheduler queues drain only "
+                "through tenantq's _offer_locked/head_locked/"
+                "pop_next_locked so per-tenant fairness and the "
+                "starvation invariant can never be sidestepped"))
+        elif isinstance(node, ast.Subscript):
+            value = node.value
+            if not (isinstance(value, ast.Attribute)
+                    and value.attr.lstrip("_") in _DEQUE_NAMES
+                    and _self_rooted(value)):
+                continue
+            queue = value.attr
+            findings.append(Finding(
+                rel, node.lineno, "tenant-unscoped-queue",
+                f"subscript of queue attribute {queue!r} peeks past the "
+                "DRR head: the next record to serve is tenantq's "
+                "head_locked()/pop_next_locked() decision, not "
+                "whatever sits at a raw index"))
+    return findings
+
+
+def _iter_targets(root: str, targets):
+    for rel in targets:
+        path = os.path.join(root, rel)
+        if os.path.isfile(path):
+            yield rel, path
+        elif os.path.isdir(path):
+            for dirpath, _dirnames, filenames in os.walk(path):
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        full = os.path.join(dirpath, name)
+                        yield os.path.relpath(full, root), full
+
+
+def check_sources(sources: dict) -> list:
+    """Lint a {path: python source} mapping (unit-test entry point).
+    The exemption follows the tree walk: a tenantq.py entry is the
+    audited lane implementation wherever it sits."""
+    findings = []
+    for rel, source in sources.items():
+        if os.path.basename(rel) in _EXEMPT_FILES:
+            continue
+        findings += _check_source(rel, source)
+    return sorted(apply_suppressions(findings, sources),
+                  key=lambda f: (f.path, f.line))
+
+
+def check(root: str, targets=DEFAULT_TARGETS) -> list:
+    findings = []
+    sources = {}
+    for rel, path in _iter_targets(root, targets):
+        if os.path.basename(rel) in _EXEMPT_FILES:
+            continue
+        try:
+            source = read_source(path)
+        except OSError:
+            continue
+        sources[rel] = source
+        try:
+            findings += _check_source(rel, source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rel, e.lineno or 1, "tenant-unscoped-queue",
+                f"cannot parse module: {e.msg}"))
+    return apply_suppressions(findings, sources)

@@ -56,6 +56,8 @@ _TAG_PAIRS = (
     ("OP_CHAOS", "kOpChaos"),
     # protocol v4 (graftsurge): the reply-only BUSY/retry-after opcode.
     ("OP_BUSY", "kOpBusy"),
+    # protocol v6 (graftfleet): the HELLO tenant/version handshake.
+    ("OP_HELLO", "kOpHello"),
     ("PROTOCOL_VERSION", "kProtocolVersion"),
 )
 

@@ -6,6 +6,13 @@ knowledge; the runner hands each due event to an injector (the harness's
 
 Targets
     ``sidecar``      the verify sidecar process
+    ``sidecar:<i>``  sidecar i of a graftfleet (``--sidecar-fleet k``)
+                     run — same actions as ``sidecar``; index 0 is the
+                     primary every node dials first.  A plan must pick
+                     ONE naming: mixing bare ``sidecar`` with indexed
+                     ``sidecar:<i>`` events is rejected (index 0 and the
+                     bare name are the same process, which the
+                     per-target state machine cannot merge).
     ``node:<i>``     replica i of the local committee (boot order index)
     ``link:<name>``  a directed WAN link by its graftwan spec label
                      (chaos/netem.py) — requires a WAN spec on the run
@@ -75,6 +82,7 @@ LEADER_CASCADE = "leader-cascade"
 _NODE_RE = re.compile(r"^node:(\d+)$")
 _LINK_RE = re.compile(r"^link:(\S+)$")
 _CLIENT_RE = re.compile(r"^client:(\d+)$")
+_SIDECAR_IX_RE = re.compile(r"^sidecar:(\d+)$")
 
 
 def node_index(target: str):
@@ -93,6 +101,15 @@ def link_name(target: str):
 def client_index(target: str):
     """``"client:<i>"`` -> i, else None (graftsurge load targets)."""
     m = _CLIENT_RE.match(target)
+    return int(m.group(1)) if m else None
+
+
+def sidecar_index(target: str):
+    """``"sidecar:<i>"`` -> i (graftfleet indexed sidecar), else None.
+    The bare ``"sidecar"`` target is NOT an index — callers route it
+    via the SIDECAR constant (it aliases fleet index 0 at injection
+    time, and plans may not mix the two namings)."""
+    m = _SIDECAR_IX_RE.match(target)
     return int(m.group(1)) if m else None
 
 
@@ -172,6 +189,16 @@ class FaultPlan:
         out = set()
         for e in self.events:
             i = node_index(e.target)
+            if i is not None:
+                out.add(i)
+        return out
+
+    def sidecar_indices(self) -> set:
+        """Every graftfleet sidecar index the plan faults (validated
+        against the run's fleet size by the harness before boot)."""
+        out = set()
+        for e in self.events:
+            i = sidecar_index(e.target)
             if i is not None:
                 out.add(i)
         return out
@@ -271,7 +298,7 @@ def _validate(events) -> FaultPlan:
         if e.action not in ACTIONS:
             raise PlanError(f"{e.label()}: unknown action (have "
                             f"{', '.join(ACTIONS)})")
-        if e.target == SIDECAR:
+        if e.target == SIDECAR or _SIDECAR_IX_RE.match(e.target):
             allowed = _SIDECAR_ACTIONS
         elif e.target == LEADER_CASCADE:
             allowed = _CASCADE_ACTIONS
@@ -283,8 +310,8 @@ def _validate(events) -> FaultPlan:
             allowed = _CLIENT_ACTIONS
         else:
             raise PlanError(f"{e.label()}: target must be 'sidecar', "
-                            "'leader-cascade', 'node:<i>', 'link:<name>', "
-                            "or 'client:<i>'")
+                            "'sidecar:<i>', 'leader-cascade', 'node:<i>', "
+                            "'link:<name>', or 'client:<i>'")
         if e.action not in allowed:
             raise PlanError(f"{e.label()}: {e.target} does not support "
                             f"{e.action} (allowed: {', '.join(sorted(allowed))})")
@@ -385,6 +412,15 @@ def _validate(events) -> FaultPlan:
             "a plan mixing leader-cascade with node:<i> events cannot "
             "be validated (the cascade's victims are chosen at "
             "runtime); use separate plans")
+    # Bare "sidecar" and indexed "sidecar:0" name the SAME process, but
+    # the state machine above tracked them as independent targets — a
+    # mixed plan could validate and then double-kill at runtime.
+    if any(e.target == SIDECAR for e in ordered) and \
+            any(sidecar_index(e.target) is not None for e in ordered):
+        raise PlanError(
+            "a plan mixing the bare 'sidecar' target with indexed "
+            "'sidecar:<i>' targets cannot be validated (the bare name "
+            "aliases fleet index 0); pick one naming")
     return FaultPlan(tuple(ordered))
 
 

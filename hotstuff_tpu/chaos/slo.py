@@ -25,7 +25,7 @@ import os
 import re
 
 from .plan import LEADER_CASCADE, SIDECAR, client_index, link_name, \
-    node_index
+    node_index, sidecar_index
 
 # class -> max recovery_ms (the table --slo overlays).
 DEFAULT_SLO_MS = {
@@ -41,6 +41,13 @@ DEFAULT_SLO_MS = {
     # immediately, so the budget covers one ladder execution plus the
     # async crash-only reboot's BUSY window, not a breaker timeout.
     "sidecar-wedge": 20_000.0,
+    # graftfleet: killing ONE endpoint of a --sidecar-fleet run must
+    # re-home verify traffic to the next healthy sidecar — an in-flight
+    # resubmit plus at most a breaker trip, nowhere near the
+    # single-sidecar kill's breaker-then-host-path budget.  The parser's
+    # strict companion assertion (zero host-path verifies while a
+    # healthy secondary exists) rides on the same events.
+    "sidecar-failover": 10_000.0,
     "link-partition": 30_000.0,
     "link-heal": 20_000.0,
     # graftsurge: a flash crowd ends at t + for; the system must be back
@@ -78,7 +85,13 @@ def fault_class(event: dict) -> str:
         # The drill IS the view change: one class regardless of action,
         # per the graftview acceptance grammar.
         return "view-change"
-    if target == SIDECAR:
+    if target == SIDECAR or sidecar_index(target) is not None:
+        # graftfleet: a kill aimed at ONE indexed endpoint is judged as
+        # a failover (re-home to the next healthy sidecar), not as the
+        # single-sidecar kill class (breaker-then-host-path budget).
+        if sidecar_index(target) is not None and \
+                event.get("action") == "kill":
+            return "sidecar-failover"
         kind = "sidecar"
     elif node_index(target) is not None:
         kind = "node"

@@ -174,9 +174,17 @@ class NodeParameters:
             inputs += [v]
         if not all(isinstance(x, int) for x in inputs):
             raise ConfigError("Invalid parameters type")
+        # graftfleet: tpu_sidecar is one address string (legacy) or an
+        # ordered list of them (first = primary, the failover ladder).
         sidecar = json_input.get("tpu_sidecar")
-        if sidecar is not None and not isinstance(sidecar, str):
-            raise ConfigError("tpu_sidecar must be an address string")
+        if sidecar is not None and not isinstance(sidecar, str) and not (
+                isinstance(sidecar, list) and sidecar
+                and all(isinstance(a, str) for a in sidecar)):
+            raise ConfigError("tpu_sidecar must be an address string or a "
+                              "non-empty list of address strings")
+        tenant = json_input.get("tpu_tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            raise ConfigError("tpu_tenant must be a string")
         trace = json_input.get("trace")
         if trace is not None and not isinstance(trace, bool):
             raise ConfigError("trace must be a bool")
@@ -192,7 +200,7 @@ class NodeParameters:
             json.dump(self.json, f, indent=4, sort_keys=True)
 
     @classmethod
-    def default(cls, tpu_sidecar=None, scheme=None, chain=2):
+    def default(cls, tpu_sidecar=None, scheme=None, chain=2, tenant=None):
         # grafttrace's node-side "trace" flag is not a kwarg here: the
         # harnesses enable it via json.setdefault("trace", True) on
         # whatever parameters the caller built (local.py / remote.py).
@@ -210,6 +218,8 @@ class NodeParameters:
             data["consensus"]["chain_depth"] = chain
         if tpu_sidecar:
             data["tpu_sidecar"] = tpu_sidecar
+        if tenant:
+            data["tpu_tenant"] = tenant
         if scheme:
             data["scheme"] = scheme
         return cls(data)
@@ -263,6 +273,9 @@ class BenchParameters:
             self.sidecar_warm_rlc = bool(
                 json_input.get("sidecar_warm_rlc", False))
             self.sidecar_mesh = int(json_input.get("sidecar_mesh", 0))
+            # graftfleet: boot k sidecars and hand every node the ordered
+            # endpoint list (0 or 1 = the single legacy sidecar).
+            self.sidecar_fleet = int(json_input.get("sidecar_fleet", 0))
             self.scheme = str(json_input.get("scheme", "ed25519"))
             # graftchaos: a fault-plan spec (path / inline DSL string /
             # event list); parsed + validated by LocalBench.
@@ -291,6 +304,16 @@ class BenchParameters:
             raise ConfigError("There should be more nodes than faults")
         if self.client_shards < 1:
             raise ConfigError("client_shards must be >= 1")
+        if self.sidecar_fleet < 0:
+            raise ConfigError("sidecar_fleet must be >= 0")
+        if self.sidecar_fleet > 1 and not (
+                self.tpu_sidecar or self.sidecar_host_crypto
+                or self.scheme == "bls"):
+            # A fleet of sidecars nobody dials is a silent misconfig.
+            # host-crypto and bls runs boot a sidecar too, so they may
+            # fleet it (LocalBench flips tpu_sidecar on for both).
+            raise ConfigError("sidecar_fleet requires tpu_sidecar (or "
+                              "sidecar_host_crypto / scheme=bls)")
         if not 0.0 <= self.forge_pct <= 100.0:
             raise ConfigError("forge_pct must be within [0, 100]")
         if self.forge_pct and not self.verify_ingress:

@@ -38,7 +38,7 @@ import signal
 import threading
 
 from ..chaos.plan import LEADER_CASCADE, SIDECAR, FaultEvent, cascade_k, \
-    client_index, link_name, node_index
+    client_index, link_name, node_index, sidecar_index
 
 
 class InjectionError(RuntimeError):
@@ -55,9 +55,12 @@ class LocalFaultInjector:
         self._surges: list = []
 
     def apply(self, event: FaultEvent):
-        if event.target == SIDECAR:
+        # graftfleet: the bare "sidecar" target aliases fleet index 0;
+        # "sidecar:<i>" picks endpoint i of a --sidecar-fleet run.
+        six = 0 if event.target == SIDECAR else sidecar_index(event.target)
+        if six is not None:
             fn = getattr(self, f"_sidecar_{event.action}")
-            fn(**event.params)
+            fn(six, **event.params)
             return
         if event.target == LEADER_CASCADE:
             self._cascade_kill(cascade_k(event.params))
@@ -207,45 +210,63 @@ class LocalFaultInjector:
 
     # -- sidecar ------------------------------------------------------------
 
-    def _sidecar_kill(self):
-        proc = self._bench._sidecar_proc
+    def _sidecar_proc_of(self, ix: int):
+        """Fleet-aware lookup: the per-index dict when the bench keeps
+        one, else the legacy single-sidecar attribute for index 0."""
+        procs = getattr(self._bench, "_sidecar_procs", None)
+        if procs is not None and ix in procs:
+            return procs[ix]
+        if ix == 0:
+            return getattr(self._bench, "_sidecar_proc", None)
+        return None
+
+    def _sidecar_kill(self, ix: int = 0):
+        proc = self._sidecar_proc_of(ix)
         if proc is None:
-            raise InjectionError("no sidecar process to kill")
+            raise InjectionError(f"no sidecar process {ix} to kill")
         try:
             os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
             proc.wait(timeout=10)
         except (ProcessLookupError, PermissionError) as e:
-            raise InjectionError(f"sidecar SIGKILL failed: {e}")
+            raise InjectionError(f"sidecar {ix} SIGKILL failed: {e}")
 
-    def _sidecar_restart(self):
-        cmd, log = self._bench._sidecar_cmd
-        self._bench._sidecar_proc = self._bench._background_run(
-            cmd, log, append=True)
+    def _sidecar_restart(self, ix: int = 0):
+        cmds = getattr(self._bench, "_sidecar_cmds", None)
+        if cmds is not None and ix in cmds:
+            cmd, log = cmds[ix]
+            proc = self._bench._background_run(cmd, log, append=True)
+            self._bench._sidecar_procs[ix] = proc
+            if ix == 0:
+                self._bench._sidecar_proc = proc
+        else:
+            cmd, log = self._bench._sidecar_cmd
+            self._bench._sidecar_proc = self._bench._background_run(
+                cmd, log, append=True)
         # No readiness wait here: the node-side circuit breaker re-attaches
         # on its next probe once the socket binds, and blocking the runner
         # thread would delay every later plan event by a warmup.
 
-    def _sidecar_degrade(self, **params):
+    def _sidecar_degrade(self, ix: int = 0, **params):
         from ..sidecar.client import SidecarClient
 
         try:
-            with SidecarClient(port=self._bench.SIDECAR_PORT,
+            with SidecarClient(port=self._bench.SIDECAR_PORT + ix,
                                timeout=10.0) as client:
                 applied = client.chaos(**params)
         except (OSError, ConnectionError) as e:
-            raise InjectionError(f"sidecar chaos RPC failed: {e}")
+            raise InjectionError(f"sidecar {ix} chaos RPC failed: {e}")
         if not applied:
             raise InjectionError(
                 "sidecar is running without --chaos; the plan's degrade "
                 "event cannot be expressed")
 
-    def _sidecar_wedge(self, n: int = 1):
+    def _sidecar_wedge(self, ix: int = 0, n: int = 1):
         """graftguard drill: the next ``n`` device launches hang past
         their guard deadline (ChaosState's ``wedge`` knob over the same
         OP_CHAOS RPC as degrade) — the in-sidecar supervisor must answer
         the wedged batch from the host path, quarantine it, and
         crash-only-reboot the engine; same --chaos refusal contract."""
-        self._sidecar_degrade(wedge=int(n))
+        self._sidecar_degrade(ix, wedge=int(n))
 
     # -- graftsurge client surges -------------------------------------------
 
@@ -386,6 +407,13 @@ class RemoteFaultInjector:
         if event.target == SIDECAR:
             getattr(self, f"_sidecar_{event.action}")(**event.params)
             return
+        if sidecar_index(event.target) is not None:
+            # graftfleet is local-harness only for now: the remote bench
+            # records one sidecar host/boot, so indexed targets cannot
+            # be expressed against a fleet it never booted.
+            raise InjectionError(
+                "sidecar:<i> fleet targets are local-harness only (the "
+                "remote bench tracks a single sidecar host)")
         if event.target == LEADER_CASCADE:
             # Pre-flight (remote._check_fault_plan) rejects cascade plans
             # before boot; this is the belt for hand-driven injectors

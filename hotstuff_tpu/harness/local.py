@@ -43,6 +43,12 @@ class LocalBench:
         self.faults = bench_parameters.faults
         self.duration = bench_parameters.duration
         self.tpu_sidecar = getattr(bench_parameters, "tpu_sidecar", False)
+        # graftfleet: sidecar_fleet k > 1 boots k sidecars on consecutive
+        # ports (SIDECAR_PORT + i) and hands every node the ORDERED
+        # endpoint list — the C++ TpuVerifier's failover ladder.  0/1 is
+        # the legacy single-sidecar run, byte-identical artifacts.
+        self.sidecar_fleet = int(getattr(
+            bench_parameters, "sidecar_fleet", 0) or 0)
         self.sidecar_host_crypto = getattr(
             bench_parameters, "sidecar_host_crypto", False)
         self.sidecar_warm_rlc = getattr(
@@ -62,10 +68,20 @@ class LocalBench:
             getattr(bench_parameters, "forge_pct", 0.0) or 0.0)
         self.client_shards = max(1, int(
             getattr(bench_parameters, "client_shards", 1) or 1))
+        # graftfleet: a fleet run hands nodes the ordered endpoint list
+        # (primary first) plus a tenant id for the protocol-v6 HELLO;
+        # the single-sidecar run keeps the legacy one-address string.
+        if self.tpu_sidecar and self.sidecar_fleet > 1:
+            sidecar_addr = [f"127.0.0.1:{self.SIDECAR_PORT + i}"
+                            for i in range(self.sidecar_fleet)]
+        elif self.tpu_sidecar:
+            sidecar_addr = f"127.0.0.1:{self.SIDECAR_PORT}"
+        else:
+            sidecar_addr = None
         self.node_parameters = node_parameters or NodeParameters.default(
-            tpu_sidecar=(f"127.0.0.1:{self.SIDECAR_PORT}"
-                         if self.tpu_sidecar else None),
-            scheme=self.scheme if self.scheme != "ed25519" else None)
+            tpu_sidecar=sidecar_addr,
+            scheme=self.scheme if self.scheme != "ed25519" else None,
+            tenant="node" if self.sidecar_fleet > 1 else None)
         if self.verify_ingress:
             # The node-side admission-verify stage rides the mempool
             # parameters straight into the C++ from_json reader;
@@ -87,6 +103,11 @@ class LocalBench:
         self._node_cmds = {}
         self._sidecar_proc = None
         self._sidecar_cmd = None
+        # graftfleet: per-index boot info ({ix: proc} / {ix: (cmd, log)});
+        # index 0 is mirrored into the legacy attributes above so the
+        # single-sidecar injector/test surface stays byte-compatible.
+        self._sidecar_procs = {}
+        self._sidecar_cmds = {}
         # graftsurge: {i: (address, tx_size, rate_share)} for the booted
         # clients, so a plan's client:<i> surge event can boot an extra
         # generator at a multiple of the baseline (harness/faults.py).
@@ -136,6 +157,16 @@ class LocalBench:
                     "WAN spec shapes the sidecar link but this run "
                     "boots no sidecar (pass --tpu-sidecar / "
                     "--sidecar-host-crypto)", None)
+            if self.sidecar_fleet > 1:
+                # The fleet binds consecutive ports from SIDECAR_PORT,
+                # so sidecar 1 lands exactly on the shared proxy port
+                # (WAN_SIDECAR_PORT = SIDECAR_PORT + 1) — and one proxy
+                # cannot front an ordered endpoint LIST anyway.
+                raise BenchError(
+                    "WAN sidecar links are single-sidecar only: the "
+                    "fleet's consecutive ports collide with the shared "
+                    "proxy port (shape fleet links on the remote "
+                    "harness)", None)
             # Nodes reach the sidecar THROUGH the proxy: the link's
             # shape applies to every verify RPC, and a link:<name>
             # partition event black-holes the accelerator service.
@@ -168,25 +199,28 @@ class LocalBench:
         self._procs.append((name, proc))
         return proc
 
-    def _wait_sidecar_ready(self, deadline_s=300):
+    def _wait_sidecar_ready(self, deadline_s=300, index=None):
         """Block until the sidecar answers a PING (it binds post-warmup, so
-        the first accepted connection implies the jit cache is hot)."""
+        the first accepted connection implies the jit cache is hot).
+        graftfleet: ``index`` picks fleet member i (port SIDECAR_PORT+i,
+        per-index log file); None is the legacy single sidecar."""
         from ..sidecar.client import SidecarClient
 
+        port = self.SIDECAR_PORT + (index or 0)
+        who = "Sidecar" if index is None else f"Sidecar {index}"
         start = monotonic()
         while True:
             try:
-                with SidecarClient(port=self.SIDECAR_PORT,
-                                   timeout=5.0) as client:
+                with SidecarClient(port=port, timeout=5.0) as client:
                     client.ping()
-                Print.info(f"Sidecar ready after "
+                Print.info(f"{who} ready after "
                            f"{monotonic() - start:.0f}s (warmup done)")
                 return
             except (OSError, ConnectionError):
                 if monotonic() - start > deadline_s:
                     raise BenchError(
-                        "TPU sidecar failed to become ready; see "
-                        f"{PathMaker.sidecar_log_file()}",
+                        f"TPU sidecar failed to become ready; see "
+                        f"{PathMaker.sidecar_log_file(index)}",
                         TimeoutError(f"{deadline_s}s elapsed"))
                 sleep(0.5)
 
@@ -204,6 +238,7 @@ class LocalBench:
         self._procs = []
         self._node_procs = {}
         self._sidecar_proc = None
+        self._sidecar_procs = {}
         self._twin_proc = None
         # Stale-state discipline (benchmark/local.py:31-37): also sweep by
         # pattern for processes from previous runs this harness no longer
@@ -217,13 +252,28 @@ class LocalBench:
                      ["pkill", "-9", "-f", r"hotstuff_tpu\.sidecar"]):
             subprocess.run(args, check=False, capture_output=True)
 
-    def _boot_sidecar(self, host_crypto: bool):
+    def _sidecar_deadline_s(self, host_crypto: bool) -> int:
+        """Readiness budget: the BLS pairing program is a multi-minute
+        first compile on the device (cached across restarts via the XLA
+        compilation cache); host-crypto warmup compiles nothing."""
+        if host_crypto:
+            return 120
+        return 900 if self.scheme == "bls" else 300
+
+    def _boot_sidecar(self, host_crypto: bool, index=None):
         """Boot the verify sidecar and wait for readiness.  If the device
         path never comes up (wedged TPU tunnel: jit warmup blocks forever),
         kill it and degrade to a --host-crypto sidecar with a loud warning
-        — a host-mode result beats a dead bench."""
+        — a host-mode result beats a dead bench.
+
+        graftfleet: ``index=i`` boots fleet member i on SIDECAR_PORT+i
+        with a per-index log file and does NOT wait or degrade — the
+        fleet wrapper (:meth:`_boot_sidecars`) waits on every member and
+        degrades the whole fleet together (a half-host fleet would hand
+        the failover ladder asymmetric masks)."""
         mode = " (HOST crypto)" if host_crypto else ""
-        Print.info(f"Booting TPU verify sidecar...{mode}")
+        who = "" if index is None else f" {index}"
+        Print.info(f"Booting TPU verify sidecar{who}...{mode}")
         warm_bls = ""
         if self.scheme == "bls":
             # Warm both BLS shapes: the 2-pairing QC check and the
@@ -259,24 +309,29 @@ class LocalBench:
         # grafttrace: sidecar stage spans ride a JSONL file next to the
         # logs (appended across chaos restarts, like the log itself).
         trace = f" --trace {PathMaker.sidecar_spans_file()}"
+        port = self.SIDECAR_PORT + (index or 0)
+        log = PathMaker.sidecar_log_file(index)
         cmd = (f"python -m hotstuff_tpu.sidecar "
-               f"--port {self.SIDECAR_PORT}"
+               f"--port {port}"
                f" --committee {self.nodes} --client-rate {self.rate}"
                f"{warm_bls}{warm_rlc}{mesh}{hc}{chaos}{trace}")
         # The degraded reboot appends to the log: the dead device
         # sidecar's output is the evidence needed to diagnose the wedge.
-        self._sidecar_cmd = (cmd, PathMaker.sidecar_log_file())
-        self._sidecar_proc = self._background_run(
-            cmd, PathMaker.sidecar_log_file(), append=self._degraded)
-        # The BLS pairing program is a multi-minute first compile on the
-        # device (cached across restarts via the XLA compilation cache);
-        # host-crypto warmup compiles nothing.
-        if host_crypto:
-            deadline = 120
-        else:
-            deadline = 900 if self.scheme == "bls" else 300
+        proc = self._background_run(cmd, log, append=self._degraded)
+        ix = 0 if index is None else index
+        if not isinstance(getattr(self, "_sidecar_procs", None), dict):
+            self._sidecar_procs = {}
+            self._sidecar_cmds = {}
+        self._sidecar_cmds[ix] = (cmd, log)
+        self._sidecar_procs[ix] = proc
+        if ix == 0:
+            self._sidecar_cmd = (cmd, log)
+            self._sidecar_proc = proc
+        if index is not None:
+            return  # the fleet wrapper waits on the whole fleet
         try:
-            self._wait_sidecar_ready(deadline_s=deadline)
+            self._wait_sidecar_ready(
+                deadline_s=self._sidecar_deadline_s(host_crypto))
         except BenchError:
             self._kill_nodes()
             if host_crypto:
@@ -287,6 +342,37 @@ class LocalBench:
                 "measure the device verify path.")
             self._degraded = True
             self._boot_sidecar(host_crypto=True)
+
+    def _boot_sidecars(self, host_crypto: bool):
+        """Boot the sidecar fleet (sidecar_fleet members on consecutive
+        ports) and wait for every member; degrade the WHOLE fleet to
+        host-crypto if any member wedges.  Fleet size <= 1 is the legacy
+        single-sidecar boot, unchanged."""
+        k = self.sidecar_fleet
+        if k <= 1:
+            self._boot_sidecar(host_crypto=host_crypto)
+            return
+        Print.info(f"Booting sidecar fleet ({k} endpoints)...")
+        for i in range(k):
+            self._boot_sidecar(host_crypto, index=i)
+        try:
+            # Warmup compiles overlap (the processes boot concurrently;
+            # the persistent XLA cache dedups the work), so one budget
+            # covers each member's wait in turn.
+            deadline = self._sidecar_deadline_s(host_crypto)
+            for i in range(k):
+                self._wait_sidecar_ready(deadline_s=deadline, index=i)
+        except BenchError:
+            self._kill_nodes()
+            if host_crypto:
+                raise
+            Print.warn(
+                "A fleet sidecar never became ready (wedged device "
+                "tunnel?); DEGRADING the whole fleet to host-crypto "
+                "sidecars. This run will NOT measure the device verify "
+                "path.")
+            self._degraded = True
+            self._boot_sidecars(host_crypto=True)
 
     def _start_metrics_sampler(self):
         """Poll OP_STATS at a fixed interval for the whole run window
@@ -304,9 +390,24 @@ class LocalBench:
         from ..obs.sampler import persistent_fetch
         from ..sidecar.client import SidecarClient
 
+        if self.sidecar_fleet > 1:
+            # graftfleet: one persistent connection per endpoint; every
+            # sample carries its endpoint tag so a kill of sidecar i
+            # shows as ok-false ticks for THAT endpoint while the rest
+            # of the fleet's series keeps flowing.
+            fetches = []
+            for i in range(self.sidecar_fleet):
+                port = self.SIDECAR_PORT + i
+                fetches.append((
+                    f"127.0.0.1:{port}",
+                    persistent_fetch(
+                        lambda p=port: SidecarClient(port=p, timeout=5.0))))
+            fetch = fetches
+        else:
+            fetch = persistent_fetch(
+                lambda: SidecarClient(port=self.SIDECAR_PORT, timeout=5.0))
         self._sampler = MetricsSampler(
-            persistent_fetch(
-                lambda: SidecarClient(port=self.SIDECAR_PORT, timeout=5.0)),
+            fetch,
             PathMaker.metrics_file(),
             interval_s=self.METRICS_INTERVAL_S)
         return self._sampler.start()
@@ -321,21 +422,31 @@ class LocalBench:
 
         from ..sidecar.client import SidecarClient
 
-        try:
-            with SidecarClient(port=self.SIDECAR_PORT,
-                               timeout=10.0) as client:
-                stats = client.stats()
-        except (OSError, ConnectionError, ValueError) as e:
-            sampler = getattr(self, "_sampler", None)
-            if sampler is None or sampler.last is None:
-                Print.warn(f"Could not fetch sidecar scheduler stats: {e}")
-                return
-            sampled_at, snap = sampler.last
-            Print.warn(f"Sidecar stats fetch failed ({e}); falling back "
-                       "to the last periodic sample")
-            stats = dict(snap, _from_sample_at=sampled_at)
-        with open(PathMaker.sidecar_stats_file(), "w") as f:
-            json.dump(stats, f)
+        k = max(1, int(getattr(self, "sidecar_fleet", 0) or 0))
+        for i in range(k):
+            port = self.SIDECAR_PORT + i
+            index = None if k == 1 else i
+            endpoint = f"127.0.0.1:{port}"
+            try:
+                with SidecarClient(port=port, timeout=10.0) as client:
+                    stats = client.stats()
+            except (OSError, ConnectionError, ValueError) as e:
+                sampler = getattr(self, "_sampler", None)
+                last = None if sampler is None else (
+                    sampler.last if k == 1
+                    else sampler.last_by_endpoint.get(endpoint))
+                if last is None:
+                    Print.warn(f"Could not fetch sidecar scheduler stats "
+                               f"({endpoint}): {e}")
+                    continue
+                sampled_at, snap = last
+                Print.warn(f"Sidecar stats fetch failed ({endpoint}: {e}); "
+                           "falling back to the last periodic sample")
+                stats = dict(snap, _from_sample_at=sampled_at)
+            if index is not None:
+                stats = dict(stats, _endpoint=endpoint)
+            with open(PathMaker.sidecar_stats_file(index), "w") as f:
+                json.dump(stats, f)
 
     def _check_fault_plan(self):
         """Reject an unexecutable plan BEFORE anything boots: every input
@@ -403,11 +514,24 @@ class LocalBench:
             raise BenchError(
                 f"fault plan surges client(s) {bad_clients} but only "
                 f"{alive} clients will be booted (one per alive replica)")
-        if any(e.target == "sidecar" for e in self.fault_plan.events) \
-                and not self.tpu_sidecar:
+        from ..chaos.plan import sidecar_index
+
+        if any(e.target == "sidecar"
+               or sidecar_index(e.target) is not None
+               for e in self.fault_plan.events) and not self.tpu_sidecar:
             raise BenchError(
                 "fault plan targets the sidecar but this run boots none "
                 "(pass --tpu-sidecar / --sidecar-host-crypto)")
+        # graftfleet: an indexed sidecar:<i> target must name a fleet
+        # member that will actually be booted.
+        booted = max(1, self.sidecar_fleet) if self.tpu_sidecar else 0
+        bad_sidecars = [i for i in self.fault_plan.sidecar_indices()
+                        if i >= booted]
+        if bad_sidecars:
+            raise BenchError(
+                f"fault plan targets sidecar(s) {bad_sidecars} but only "
+                f"{booted} sidecar(s) will be booted (raise "
+                "sidecar_fleet)")
         missing = [name for name in self.fault_plan.link_names()
                    if self.wan is None or self.wan.by_name(name) is None]
         if missing:
@@ -612,7 +736,7 @@ class LocalBench:
             # node booted earlier would merely fall back to host verify, but
             # the whole point of this mode is to measure the device path.
             if self.tpu_sidecar:
-                self._boot_sidecar(host_crypto=self.sidecar_host_crypto)
+                self._boot_sidecars(host_crypto=self.sidecar_host_crypto)
 
             # Do not boot faulty nodes (crash faults, local.py:75-76 in the
             # reference); clients only target alive nodes and split the rate

@@ -114,6 +114,13 @@ class LogParser:
         # graftingress: the OP_STATS ``ingress`` bulk-lane feed mix
         # (ingress-fed vs offchain-fed), machine-readable for bench.py.
         self.sidecar_ingress = None
+        # graftfleet: cross-tenant verdict-cache dedup, the per-tenant
+        # scheduler section, the node-side failover evidence, and the
+        # greedy-flood verdict — all machine-readable for bench.py.
+        self.sidecar_dedup = None
+        self.sidecar_tenants = None
+        self.failover = None
+        self.tenant_flood = None
         if self.malformed_lines:
             self.notes.append(
                 f"Parser: skipped {self.malformed_lines} torn/malformed "
@@ -209,6 +216,39 @@ class LogParser:
             self.notes.append(
                 f"Sidecar circuit breaker: {opens} open / "
                 f"{closes} re-attach transition(s)")
+
+        # graftfleet failover evidence (native/crypto/sidecar_client
+        # fleet ladder): sticky-endpoint re-homes, in-flight resubmits,
+        # and the protocol-v6 HELLO accepts per endpoint.  Surfaced so a
+        # run that survived a fleet-member kill reads as exactly that;
+        # machine-readable on self.failover for the strict drill check
+        # in note_chaos_events and bench.py's round trip.
+        rehomes = sum(len(findall(
+            r"sidecar failover: endpoint \d+ unhealthy, "
+            r"re-homed to endpoint \d+", log)) for log in nodes)
+        resubmits = sum(len(findall(
+            r"sidecar failover: endpoint \d+ failed in flight, "
+            r"resubmitting to endpoint \d+", log)) for log in nodes)
+        hellos = [(int(ix), tenant) for log in nodes for ix, tenant in
+                  findall(r"HELLO accepted by endpoint (\d+): "
+                          r"tenant (\S+) \(protocol v\d+\)", log)]
+        if rehomes or resubmits or hellos:
+            self.failover = {
+                "rehomes": rehomes,
+                "resubmits": resubmits,
+                "hello_accepts": len(hellos),
+                "endpoints": sorted({ix for ix, _ in hellos}),
+                "tenants": sorted({t for _, t in hellos}),
+            }
+            parts = [f"{rehomes} re-home(s)", f"{resubmits} in-flight "
+                     "resubmit(s)"]
+            if hellos:
+                parts.append(
+                    f"{len(hellos)} HELLO accept(s) across endpoint(s) "
+                    + ", ".join(str(i) for i in self.failover["endpoints"])
+                    + " (tenant "
+                    + ", ".join(self.failover["tenants"]) + ")")
+            self.notes.append("Sidecar fleet: " + "; ".join(parts))
 
         # graftsurge overload evidence: the node's bounded ingress logs
         # watermark crossings, and clients log (rate-limited) BUSY
@@ -681,7 +721,19 @@ class LogParser:
                     f"surge fairness violated: {violations:g} bulk "
                     "request(s) admitted while the latency class was "
                     "shedding (bulk-before-latency)")
+            # graftfleet: the DRR rotation's strict invariant — a
+            # backlogged tenant passed over a full quantum rotation is
+            # a scheduler bug, never weather.
+            starvation = surge.get("tenant_starvation")
+            if isinstance(starvation, (int, float)) and starvation:
+                raise ParseError(
+                    f"tenant fairness violated: {starvation:g} tenant "
+                    "starvation event(s) (a backlogged tenant was "
+                    "passed over a full DRR rotation)")
         lines = []
+        # graftfleet: a per-endpoint snapshot (sidecar-stats-<i>.json)
+        # prefixes its lines so a fleet teardown reads per member.
+        endpoint = stats.get("_endpoint")
         # grafttrace fallback marker: the harness could not reach the
         # sidecar at teardown (chaos-killed before the final fetch) and
         # substituted the periodic sampler's last good snapshot — say
@@ -780,6 +832,34 @@ class LogParser:
             if any(full.values()):
                 lines.append("Sidecar queue-full sheds: " + ", ".join(
                     f"{k}={v:,}" for k, v in sorted(full.items())))
+            # graftfleet: cross-tenant verdict-cache dedup — a record
+            # fanned out by two tenants' replicas is device-verified
+            # once; the hit rate is the headline the fleet bench cites.
+            dd = stats.get("dedup")
+            if isinstance(dd, dict) and (dd.get("cache_hits")
+                                         or dd.get("inbatch_hits")
+                                         or dd.get("misses")):
+                self.sidecar_dedup = dd
+                lines.append(
+                    f"Sidecar dedup: {dd.get('cache_hits', 0):,} cache "
+                    f"hit(s) + {dd.get('inbatch_hits', 0):,} in-batch, "
+                    f"{dd.get('misses', 0):,} miss(es) "
+                    f"(hit rate {dd.get('hit_rate', 0.0):.0%})")
+            # graftfleet: the per-tenant scheduler section — noted only
+            # when the run was actually multi-tenant, so single-tenant
+            # (default-only) summaries stay byte-stable.
+            tns = stats.get("tenants")
+            if isinstance(tns, dict) and tns and (
+                    len(tns) > 1 or set(tns) != {"default"}):
+                self.sidecar_tenants = tns
+                parts = []
+                for tenant, rec in sorted(tns.items()):
+                    admitted = sum((rec.get("admitted") or {}).values())
+                    shed = sum((rec.get("shed") or {}).values())
+                    parts.append(f"{tenant} admitted {admitted:,}"
+                                 + (f" / shed {shed:,}" if shed else ""))
+                lines.append(f"Sidecar tenants ({len(tns)}): "
+                             + "; ".join(parts))
             surge = stats.get("surge")
             if isinstance(surge, dict):
                 lines.extend(self._surge_lines(surge))
@@ -822,7 +902,67 @@ class LogParser:
                     f"p99 {wait.get('p99_ms', 0)} ms")
         except (TypeError, ValueError, AttributeError):
             return
+        if isinstance(endpoint, str) and endpoint:
+            lines = [f"[{endpoint}] {line}" for line in lines]
         self.notes.extend(lines)
+
+    # graftfleet: the greedy-flood latency bound — the victim tenant's
+    # latency-class queue-wait p99 may grow at most this factor across
+    # the flood window before strict mode calls it an isolation failure.
+    TENANT_FLOOD_WAIT_FACTOR = 2.0
+
+    def note_tenant_flood(self, pre: dict, post: dict, victim: str,
+                          strict: bool = False):
+        """graftfleet greedy-tenant flood verdict: compare the victim
+        tenant's latency-class queue-wait p99 between the pre-flood and
+        post-flood OP_STATS snapshots, and hold the starvation
+        invariant.  Strict mode (the scripted drill) raises ParseError
+        when isolation failed; otherwise the verdict is a note.  The
+        machine-readable verdict lands on ``self.tenant_flood``."""
+        def _p99(stats):
+            rec = (stats.get("tenants") or {}).get(victim) or {}
+            wait = (rec.get("queue_wait") or {}).get("latency") or {}
+            return wait.get("p99_ms"), wait.get("n", 0)
+
+        try:
+            starvation = (post.get("surge") or {}).get(
+                "tenant_starvation", 0) or 0
+            pre_p99, pre_n = _p99(pre)
+            post_p99, post_n = _p99(post)
+        except (TypeError, ValueError, AttributeError):
+            return
+        verdict = {"victim": victim, "starvation": starvation,
+                   "pre_p99_ms": pre_p99, "post_p99_ms": post_p99,
+                   "judged": bool(pre_n and post_n
+                                  and isinstance(pre_p99, (int, float))
+                                  and isinstance(post_p99, (int, float))
+                                  and pre_p99 > 0),
+                   "ok": True}
+        if starvation:
+            verdict["ok"] = False
+            verdict["reason"] = (f"{starvation:g} tenant starvation "
+                                 "event(s)")
+        elif verdict["judged"] and \
+                post_p99 > self.TENANT_FLOOD_WAIT_FACTOR * pre_p99:
+            verdict["ok"] = False
+            verdict["reason"] = (
+                f"victim queue-wait p99 {post_p99:g} ms exceeds "
+                f"{self.TENANT_FLOOD_WAIT_FACTOR:g}x pre-flood "
+                f"{pre_p99:g} ms")
+        self.tenant_flood = verdict
+        if verdict["ok"]:
+            bound = (f"p99 {post_p99:g} ms vs pre-flood {pre_p99:g} ms"
+                     if verdict["judged"] else "not judged (no samples)")
+            self.notes.append(
+                f"Tenant flood: victim {victim} isolated ({bound}; "
+                "0 starvation events)")
+        else:
+            self.notes.append(
+                f"Tenant flood: isolation FAILED ({verdict['reason']})")
+            if strict:
+                raise ParseError(
+                    "tenant isolation violated under greedy flood: "
+                    + verdict["reason"])
 
     @staticmethod
     def _surge_lines(surge: dict) -> list:
@@ -841,6 +981,13 @@ class LogParser:
                 + "; shed "
                 + ", ".join(f"{k}={v:,}" for k, v in sorted(shed.items()))
                 + f" ({fair})")
+        if surge.get("tenant_starvation"):
+            # Should never fire (strict mode already raised); the note
+            # keeps a non-strict re-parse honest about it.
+            lines.append(
+                f"Sidecar tenant starvation: "
+                f"{surge['tenant_starvation']:,} event(s) — DRR "
+                "invariant VIOLATED")
         derate = surge.get("derate", {})
         if derate.get("engagements"):
             lines.append(
@@ -1138,6 +1285,20 @@ class LogParser:
                     "leader cascade executed but no TC formed and no "
                     "TC round transition was logged: the view-change "
                     "drill produced no view change")
+            # graftfleet: a fleet-member kill that no node re-homed
+            # away from means the failover ladder never engaged — the
+            # drill drilled nothing (same idiom as the cascade check).
+            from ..chaos.plan import sidecar_index
+
+            fleet_kills = [
+                e for e in summary["events"]
+                if e.get("action") == "kill" and e.get("ok")
+                and sidecar_index(str(e.get("target", ""))) is not None]
+            if fleet_kills and not (self.failover or {}).get("rehomes"):
+                raise ParseError(
+                    "fleet sidecar kill executed but no node logged a "
+                    "failover re-home: the endpoint ladder never "
+                    "engaged")
 
     def print(self, filename):
         assert isinstance(filename, str)
@@ -1198,6 +1359,17 @@ class LogParser:
                 parser.note_sidecar_stats(json.load(f))
         except (OSError, ValueError):
             pass
+        # graftfleet: per-endpoint snapshots (sidecar-stats-<i>.json);
+        # each folds independently — the strict fairness/starvation
+        # assertions hold for EVERY fleet member, and the _endpoint tag
+        # the harness stamped prefixes that member's note lines.
+        for filename in sorted(glob(join(directory,
+                                         "sidecar-stats-*.json"))):
+            try:
+                with open(filename) as f:
+                    parser.note_sidecar_stats(json.load(f))
+            except (OSError, ValueError):
+                continue
         # grafttrace: merge the run's spans (node TRACE lines + sidecar
         # JSONL + clock offsets) into the Perfetto-loadable trace.json
         # artifact and the commit critical-path notes, and fold the

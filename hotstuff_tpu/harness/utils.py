@@ -79,13 +79,23 @@ class PathMaker:
         return join(PathMaker.logs_path(), f"surge-client-{i}.log")
 
     @staticmethod
-    def sidecar_log_file():
-        return join(PathMaker.logs_path(), "sidecar.log")
+    def sidecar_log_file(i=None):
+        """graftfleet: sidecar i of a fleet logs to sidecar-<i>.log; the
+        single-sidecar run keeps the legacy un-indexed name so existing
+        tooling and result diffs stay comparable."""
+        if i is None:
+            return join(PathMaker.logs_path(), "sidecar.log")
+        assert isinstance(i, int) and i >= 0
+        return join(PathMaker.logs_path(), f"sidecar-{i}.log")
 
     @staticmethod
-    def sidecar_stats_file():
-        """verifysched OP_STATS snapshot, fetched at teardown (JSON)."""
-        return join(PathMaker.logs_path(), "sidecar-stats.json")
+    def sidecar_stats_file(i=None):
+        """verifysched OP_STATS snapshot, fetched at teardown (JSON);
+        per-endpoint sidecar-stats-<i>.json under graftfleet."""
+        if i is None:
+            return join(PathMaker.logs_path(), "sidecar-stats.json")
+        assert isinstance(i, int) and i >= 0
+        return join(PathMaker.logs_path(), f"sidecar-stats-{i}.json")
 
     @staticmethod
     def sidecar_spans_file():

@@ -99,8 +99,20 @@ class MetricsSampler:
         raise OSError/ConnectionError/ValueError on a dead or garbled
         sidecar); ``wait(seconds) -> bool`` returns True when the
         sampler should stop (default: the stop event's own ``wait``,
-        which a test replaces with a virtual clock)."""
-        self._fetch = fetch
+        which a test replaces with a virtual clock).
+
+        graftfleet: ``fetch`` may instead be a LIST of ``(endpoint,
+        fetch)`` pairs — one per fleet sidecar.  Each tick then writes
+        one record per endpoint, tagged ``"endpoint": "<host:port>"``,
+        so a kill of sidecar i reads as ok-false ticks on that endpoint
+        while the rest of the fleet's series keeps flowing.  ``last``
+        still tracks the newest good sample overall; ``last_by_endpoint``
+        keeps the per-endpoint fallback teardown needs."""
+        if isinstance(fetch, list):
+            self._fetches = list(fetch)
+        else:
+            self._fetches = [(None, fetch)]
+        self._fetch = fetch  # kept for the stop()-time closer probe
         self._path = path
         self._interval_s = interval_s
         self._wall = wall
@@ -112,25 +124,37 @@ class MetricsSampler:
         self.samples = 0
         self.ok_samples = 0
         self.last = None  # (wall_ts, snapshot) of the last GOOD sample
+        self.last_by_endpoint = {}  # endpoint -> (wall_ts, snapshot)
 
     # -- one tick (the unit tests drive this directly) -----------------------
 
     def sample_once(self):
-        """Fetch + record one sample; returns the record written (or
-        None once the sink failed — telemetry never raises)."""
-        t = self._wall()
-        try:
-            snap = self._fetch()
-            if not isinstance(snap, dict):
-                raise ValueError(f"snapshot is {type(snap).__name__}, "
-                                 "not a dict")
-            rec = {"t": t, "ok": True, "stats": snap}
-            self.last = (t, snap)
-            self.ok_samples += 1
-        except (OSError, ConnectionError, ValueError, RuntimeError) as e:
-            rec = {"t": t, "ok": False, "error": f"{e!r:.200}"}
-        self.samples += 1
-        return rec if self._write(rec) else None
+        """Fetch + record one sample per endpoint; returns the record
+        written (single-fetch sampler, the legacy contract) or the list
+        of records (endpoint list).  None / None entries mean the sink
+        failed — telemetry never raises."""
+        records = []
+        for endpoint, fetch in self._fetches:
+            t = self._wall()
+            try:
+                snap = fetch()
+                if not isinstance(snap, dict):
+                    raise ValueError(f"snapshot is {type(snap).__name__}, "
+                                     "not a dict")
+                rec = {"t": t, "ok": True, "stats": snap}
+                self.last = (t, snap)
+                if endpoint is not None:
+                    self.last_by_endpoint[endpoint] = (t, snap)
+                self.ok_samples += 1
+            except (OSError, ConnectionError, ValueError, RuntimeError) as e:
+                rec = {"t": t, "ok": False, "error": f"{e!r:.200}"}
+            if endpoint is not None:
+                rec["endpoint"] = endpoint
+            self.samples += 1
+            records.append(rec if self._write(rec) else None)
+        if len(self._fetches) == 1 and self._fetches[0][0] is None:
+            return records[0]
+        return records
 
     def _write(self, rec: dict) -> bool:
         with self._lock:
@@ -162,12 +186,13 @@ class MetricsSampler:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
-        closer = getattr(self._fetch, "close", None)
-        if closer is not None:
-            try:
-                closer()
-            except (OSError, ValueError):
-                pass
+        for _, fetch in self._fetches:
+            closer = getattr(fetch, "close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except (OSError, ValueError):
+                    pass
         with self._lock:
             if self._file is not None:
                 try:

@@ -25,7 +25,8 @@ class SidecarClient:
     """Blocking, thread-safe client with request pipelining."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 7100,
-                 timeout: float | None = 60.0):
+                 timeout: float | None = 60.0,
+                 tenant: str | None = None):
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._send_lock = threading.Lock()
@@ -34,6 +35,21 @@ class SidecarClient:
         self._results: dict[int, list] = {}
         self._abandoned: set[int] = set()
         self._cond = threading.Condition()
+        self.server_version: int | None = None
+        if tenant is not None:
+            self.hello(tenant)
+
+    def hello(self, tenant: str) -> str:
+        """graftfleet HELLO (protocol v6): register this connection's
+        scheduling tenant.  Returns the tenant the server accepted and
+        records the server's protocol version in ``server_version``;
+        connections that never HELLO schedule under the default tenant."""
+        rid = self._send(
+            lambda r: proto.encode_hello_request(r, tenant))
+        body = bytes(self._await(rid))
+        version, accepted = proto.decode_hello_body(body)
+        self.server_version = version
+        return accepted
 
     def close(self):
         try:

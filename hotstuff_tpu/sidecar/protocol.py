@@ -80,15 +80,36 @@ OP_CHAOS = 9
 # SidecarOverloaded with retry_after_ms; the C++ node falls back to host
 # verify, its in-flight AIMD already pacing resubmission).
 OP_BUSY = 10
+# Protocol v6 (graftfleet): optional session HELLO.  A client that wants
+# a tenant identity (a node in a shared sidecar fleet) sends OP_HELLO
+# once after connecting: count carries the CLIENT's protocol version,
+# msg_len the tenant-id byte length, body the tenant id (UTF-8,
+# [A-Za-z0-9._-], 1..TENANT_MAX_LEN bytes).  The reply echoes the
+# SERVER's protocol version (one byte) followed by the accepted tenant
+# id, so a version-skewed pair is visible at session start instead of
+# mid-verify.  HELLO is OPTIONAL: a connection that never sends one is
+# mapped to DEFAULT_TENANT and behaves exactly like a v5 client — every
+# pre-fleet client and test stays valid without a flag day.
+OP_HELLO = 11
 
 # Version of this wire protocol, bumped when the opcode set or any frame
 # layout changes (v2: OP_VERIFY_BULK + OP_STATS; v3: OP_CHAOS; v4:
-# OP_BUSY retry-after replies; v5: the graftscope context tag below).
+# OP_BUSY retry-after replies; v5: the graftscope context tag below; v6:
+# the graftfleet OP_HELLO tenant handshake).
 # Mirrored by the C++ client's kProtocolVersion; graftlint's wire
 # cross-checker pins the pair.  Replies an unknown-opcode ValueError on
 # older peers rather than desyncing, so the constant is documentation +
-# lint anchor, not a handshake.
-PROTOCOL_VERSION = 5
+# lint anchor, not a handshake — OP_HELLO echoes it for visibility but
+# no version is rejected.
+PROTOCOL_VERSION = 6
+
+# graftfleet tenant identity: connections that never send OP_HELLO — the
+# unix-era single-node clients — act under this tenant, so the fairness
+# layer sees exactly one tenant and scheduling is unchanged.
+DEFAULT_TENANT = "default"
+TENANT_MAX_LEN = 64
+_TENANT_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
 
 # Protocol v5 (graftscope): OP_VERIFY_BATCH / OP_VERIFY_BULK — and, since
 # the BLS trace-parity work, OP_BLS_VERIFY_VOTES / OP_BLS_VERIFY_MULTI —
@@ -188,6 +209,33 @@ class ChaosRequest:
     spec: dict            # fault knobs (service.ChaosState.configure)
 
 
+@dataclass
+class HelloRequest:
+    request_id: int
+    version: int          # the CLIENT's protocol version (informational)
+    tenant: str           # validated tenant id ([A-Za-z0-9._-]{1,64})
+
+
+def validate_tenant(raw) -> str:
+    """Tenant-id validation shared by the codec and the server: UTF-8
+    (or str), 1..TENANT_MAX_LEN bytes, charset [A-Za-z0-9._-].  Raises
+    ValueError on anything else — a tenant id keys scheduler lanes and
+    telemetry dicts, so garbage must die at the frame boundary."""
+    if isinstance(raw, bytes):
+        try:
+            tenant = raw.decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise ValueError(f"bad tenant id: {e}")
+    else:
+        tenant = raw
+    if not tenant or len(tenant.encode("utf-8")) > TENANT_MAX_LEN:
+        raise ValueError(
+            f"bad tenant id length: 1..{TENANT_MAX_LEN} bytes required")
+    if not set(tenant) <= _TENANT_OK:
+        raise ValueError("bad tenant id: charset is [A-Za-z0-9._-]")
+    return tenant
+
+
 def encode_request(request_id: int, msgs, pks, sigs,
                    opcode: int = OP_VERIFY_BATCH,
                    ctx: bytes | None = None) -> bytes:
@@ -260,6 +308,31 @@ def decode_busy_body(body: bytes) -> int:
     return _BUSY_BODY.unpack(body)[0]
 
 
+def encode_hello_request(request_id: int, tenant: str,
+                         version: int = PROTOCOL_VERSION) -> bytes:
+    """Session HELLO (protocol v6): tenant id in the body, the client's
+    protocol version riding the count field (header-only otherwise)."""
+    body = validate_tenant(tenant).encode("utf-8")
+    payload = _HDR.pack(OP_HELLO, request_id, version, len(body)) + body
+    return struct.pack(">I", len(payload)) + payload
+
+
+def encode_hello_reply(request_id: int, tenant: str) -> bytes:
+    """HELLO ack: one byte of SERVER protocol version, then the accepted
+    tenant id — the version echo that makes wire skew visible at session
+    start."""
+    body = bytes([PROTOCOL_VERSION]) + tenant.encode("utf-8")
+    return encode_reply_raw(OP_HELLO, request_id, body)
+
+
+def decode_hello_body(body: bytes):
+    """HELLO reply body -> (server protocol version, tenant id);
+    ValueError on garbage."""
+    if not body:
+        raise ValueError("empty hello reply body")
+    return body[0], validate_tenant(body[1:])
+
+
 def encode_chaos_request(request_id: int, spec: dict) -> bytes:
     """Chaos-hook configuration -> request frame (UTF-8 JSON body riding
     the count field as its byte length, like the OP_STATS reply)."""
@@ -329,10 +402,20 @@ def decode_request(payload: bytes):
         raise ValueError(f"short frame: {e}")
     if opcode not in (OP_VERIFY_BATCH, OP_VERIFY_BULK, OP_PING, OP_STATS,
                       OP_BLS_VERIFY_AGG, OP_BLS_SIGN, OP_BLS_VERIFY_VOTES,
-                      OP_BLS_VERIFY_MULTI, OP_CHAOS):
+                      OP_BLS_VERIFY_MULTI, OP_CHAOS, OP_HELLO):
         raise ValueError(f"unknown opcode {opcode}")
     if opcode in (OP_PING, OP_STATS):
         return opcode, VerifyRequest(request_id, [], [], [])
+    if opcode == OP_HELLO:
+        # count = client protocol version, msg_len = tenant byte length;
+        # a trailing-garbage or truncated body is malformed like any
+        # other frame (never a silent partial tenant id).
+        body = payload[_HDR.size:]
+        if len(body) != msg_len:
+            raise ValueError(
+                f"bad hello frame: {len(body)} body byte(s), "
+                f"msg_len {msg_len}")
+        return opcode, HelloRequest(request_id, n, validate_tenant(body))
     if opcode == OP_CHAOS:
         import json
 

@@ -44,16 +44,22 @@ def class_of_opcode(opcode: int) -> str:
 
 class Pending:
     """One admitted request: the decoded dataclass, its reply callback,
-    its class, and the admission timestamp (queue-wait telemetry)."""
+    its class, its tenant (graftfleet: the third scheduling key; the
+    connection's HELLO identity or the default), and the admission
+    timestamp (queue-wait telemetry)."""
 
-    __slots__ = ("request", "reply_fn", "cls", "enqueued_at", "is_bls")
+    __slots__ = ("request", "reply_fn", "cls", "enqueued_at", "is_bls",
+                 "tenant")
 
     def __init__(self, request, reply_fn, cls: str = LATENCY,
-                 is_bls: bool = False):
+                 is_bls: bool = False, tenant: str | None = None):
+        from .tenantq import DEFAULT_TENANT
+
         self.request = request
         self.reply_fn = reply_fn
         self.cls = cls
         self.is_bls = is_bls
+        self.tenant = DEFAULT_TENANT if tenant is None else tenant
         self.enqueued_at = monotonic()
 
     def __len__(self):
@@ -89,7 +95,8 @@ class Launch:
 
 
 class ClassQueue:
-    """Bounded FIFO for one class, counted in signature records.
+    """Bounded queue for one class, counted in signature records, with
+    per-tenant lanes (graftfleet) drained in deficit round-robin order.
 
     ``offer`` is called from connection threads and never blocks: a full
     queue returns False and the caller replies queue-full immediately —
@@ -97,18 +104,40 @@ class ClassQueue:
     wedging every connection thread behind one blocking ``put``.  The
     engine thread is the only consumer.  A lock (shared with the
     scheduler, which needs cross-queue atomicity when assembling) guards
-    the deque + the signature count.
+    the lanes + the signature count.
+
+    Two caps govern admission: the CLASS cap (total records queued, as
+    before) and the per-TENANT cap — one tenant's lane may hold at most
+    ``tenant_cap_sigs`` records, so a flooding tenant saturates its own
+    share and sheds while every other tenant keeps admitting.  A single
+    tenant (the pre-fleet topology) therefore sees exactly the old
+    behavior when its cap equals the class cap.  ``last_refusal``
+    records why the most recent ``_offer_locked`` said no
+    (``"tenant-cap"`` vs ``"class-cap"``), valid until the lock is
+    released — the scheduler reads it to attribute sheds for the
+    tenant-starvation invariant.
     """
 
-    __slots__ = ("items", "cap_sigs", "sigs", "_lock")
+    __slots__ = ("lanes", "cap_sigs", "tenant_cap_sigs", "last_refusal",
+                 "_lock")
 
-    def __init__(self, cap_sigs: int, lock: threading.Condition):
-        from collections import deque
+    def __init__(self, cap_sigs: int, lock: threading.Condition,
+                 tenant_cap_sigs: int | None = None,
+                 quantum_sigs: int | None = None):
+        from .tenantq import DRR_QUANTUM_SIGS, TenantLanes
 
-        self.items: "deque[Pending]" = deque()
+        self.lanes = TenantLanes(
+            DRR_QUANTUM_SIGS if quantum_sigs is None else quantum_sigs)
         self.cap_sigs = cap_sigs
-        self.sigs = 0
+        self.tenant_cap_sigs = cap_sigs if tenant_cap_sigs is None \
+            else min(tenant_cap_sigs, cap_sigs)
+        self.last_refusal = None
         self._lock = lock
+
+    @property
+    def sigs(self) -> int:
+        """Total queued signature records (the lanes own the count)."""
+        return self.lanes.sigs
 
     def offer(self, pending: Pending) -> bool:
         with self._lock:
@@ -118,25 +147,44 @@ class ClassQueue:
             -> bool:
         # A request is admitted whole or not at all; a single request
         # bigger than the whole cap is still admitted when the queue
-        # is empty (it slices inside the engine) so a legal client
-        # can never be starved by its own size.  ``cap_sigs`` lets the
-        # scheduler admit against a DERATED cap (graftsurge) without the
-        # queue itself knowing about admission policy.
+        # (respectively its own lane) is empty — it slices inside the
+        # engine — so a legal client can never be starved by its own
+        # size.  ``cap_sigs`` lets the scheduler admit against a DERATED
+        # cap (graftsurge) without the queue itself knowing about
+        # admission policy.  The TENANT share is checked first: a
+        # flooding tenant must shed on its own cap while the class still
+        # has room for everyone else.
+        self.last_refusal = None
         cap = self.cap_sigs if cap_sigs is None else cap_sigs
-        if self.sigs and self.sigs + len(pending) > cap:
+        lane_sigs = self.lanes.tenant_sigs_locked(pending.tenant)
+        # The tenant share engages only once a SECOND tenant has been
+        # seen: with one tenant (the pre-fleet topology) the class cap
+        # is the whole policy and behavior is byte-identical to v5.
+        multi_tenant = len(self.lanes.lanes) >= 2 or (
+            self.lanes.lanes and pending.tenant not in self.lanes.lanes)
+        tenant_cap = min(self.tenant_cap_sigs, cap)
+        if multi_tenant and lane_sigs and \
+                lane_sigs + len(pending) > tenant_cap:
+            self.last_refusal = "tenant-cap"
             return False
-        self.items.append(pending)
-        self.sigs += len(pending)
+        if self.lanes.sigs and self.lanes.sigs + len(pending) > cap:
+            self.last_refusal = "class-cap"
+            return False
+        self.lanes._offer_locked(pending)
         self._lock.notify()
         return True
 
+    def _head_locked(self) -> Pending | None:
+        """The DRR-selected next item (None when empty) — the only legal
+        way to inspect drain order; raw lane access bypasses the tenant
+        key (graftlint: tenant-unscoped-queue)."""
+        return self.lanes.head_locked()
+
     def _pop_locked(self) -> Pending:
-        p = self.items.popleft()
-        self.sigs -= len(p)
-        return p
+        return self.lanes.pop_next_locked()
 
     def __bool__(self):
-        return bool(self.items)
+        return bool(self.lanes)
 
     def __len__(self):
-        return len(self.items)
+        return len(self.lanes)

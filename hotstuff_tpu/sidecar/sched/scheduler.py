@@ -118,6 +118,30 @@ def size_queue_caps(committee: int | None = None,
     return lat, blk
 
 
+def size_tenant_caps(latency_cap_sigs: int, bulk_cap_sigs: int,
+                     committee: int | None = None):
+    """``(latency_tenant_cap_sigs, bulk_tenant_cap_sigs)`` — one
+    tenant's admission share of each class queue (graftfleet).
+
+    The latency share is sized off the committee exactly like the class
+    cap itself (one committee's worst-case pipelined QC burst), so a
+    single-committee tenant never notices the share — while a tenant
+    flooding past its own committee's plausible demand sheds on its
+    share with the rest of the class cap still open to other tenants.
+    The bulk share is half the class cap: bulk is best-effort by
+    definition, and half leaves a second tenant's worth of admission
+    room under any flood.  Shares only ENGAGE once a second tenant has
+    been seen (ClassQueue._offer_locked), so pre-fleet deployments are
+    byte-identical."""
+    if committee and committee > 1:
+        quorum = 2 * committee // 3 + 1
+        lat = _clamp(committee * quorum * _INFLIGHT_PER_REPLICA,
+                     latency_cap_sigs // 4, latency_cap_sigs)
+    else:
+        lat = latency_cap_sigs
+    return lat, max(1, bulk_cap_sigs // 2)
+
+
 # Back-compat module constants (env-aware at import): the parameterless
 # Scheduler() and older embedders read these.
 LATENCY_QUEUE_CAP_SIGS, BULK_QUEUE_CAP_SIGS = size_queue_caps()
@@ -128,7 +152,8 @@ class Scheduler:
                  stats: SchedStats | None = None,
                  latency_cap_sigs: int = LATENCY_QUEUE_CAP_SIGS,
                  bulk_cap_sigs: int = BULK_QUEUE_CAP_SIGS,
-                 admission: AdmissionController | None = None):
+                 admission: AdmissionController | None = None,
+                 committee: int | None = None):
         self.shapes = shapes if shapes is not None else ShapeRegistry()
         self.stats = stats if stats is not None else SchedStats()
         # graftsurge: the pack-side admission controller (sched/surge.py)
@@ -140,15 +165,22 @@ class Scheduler:
             else AdmissionController()
         self.stats.surge = self.admission
         self._cond = threading.Condition()
+        # graftfleet: per-tenant admission shares sized off the
+        # committee (they only engage once a second tenant appears —
+        # see ClassQueue._offer_locked).
+        lat_share, blk_share = size_tenant_caps(
+            latency_cap_sigs, bulk_cap_sigs, committee)
         self._queues = {
-            LATENCY: ClassQueue(latency_cap_sigs, self._cond),
-            BULK: ClassQueue(bulk_cap_sigs, self._cond),
+            LATENCY: ClassQueue(latency_cap_sigs, self._cond,
+                                tenant_cap_sigs=lat_share),
+            BULK: ClassQueue(bulk_cap_sigs, self._cond,
+                             tenant_cap_sigs=blk_share),
         }
 
     # -- admission (connection threads) -------------------------------------
 
     def offer(self, request, reply_fn, cls: str = LATENCY,
-              is_bls: bool = False) -> bool:
+              is_bls: bool = False, tenant: str | None = None) -> bool:
         """Admit one request; False means queue-full (the caller must
         reply explicitly — nothing was retained; ``retry_after_ms``
         gives the hint the BUSY reply should carry).
@@ -162,8 +194,18 @@ class Scheduler:
         worker cannot drain).  All checks run under the one admission
         lock, so a bulk request can never be admitted concurrently with
         a latency shed — the fairness guarantee the strict parser mode
-        asserts."""
-        pending = Pending(request, reply_fn, cls, is_bls=is_bls)
+        asserts.
+
+        graftfleet adds the tenant key: ``tenant`` (the connection's
+        HELLO identity, default for legacy clients) selects the lane,
+        the per-tenant share is enforced inside the queue, and a
+        latency shed is audited for STARVATION — a refusal at the class
+        cap while another tenant sits above its own share would mean a
+        flooding tenant displaced this one, which per-lane admission
+        makes unreachable; ``tenant_starvation`` is the proof counter
+        the strict parser reads."""
+        pending = Pending(request, reply_fn, cls, is_bls=is_bls,
+                          tenant=tenant)
         adm = self.admission
         with self._cond:
             if cls == BULK:
@@ -172,21 +214,31 @@ class Scheduler:
                         lat.sigs and lat.sigs >= lat.cap_sigs):
                     adm.note_shed(BULK, before_latency=True)
                     self.stats.note_queue_full(cls)
+                    self.stats.note_tenant_shed(pending.tenant, cls)
                     return False
                 cap = int(self._queues[BULK].cap_sigs * adm.bulk_derate())
                 if not self._queues[BULK]._offer_locked(pending,
                                                         cap_sigs=cap):
                     adm.note_shed(BULK)
                     self.stats.note_queue_full(cls)
+                    self.stats.note_tenant_shed(pending.tenant, cls)
                     return False
             elif not self._queues[cls]._offer_locked(pending):
                 if cls == LATENCY:
                     adm.note_latency_shed()
+                    q = self._queues[LATENCY]
+                    if q.last_refusal == "class-cap" and \
+                            q.lanes.any_over_cap_locked(
+                                q.tenant_cap_sigs,
+                                exclude=pending.tenant):
+                        adm.note_tenant_starvation()
                 adm.note_shed(cls)
                 self.stats.note_queue_full(cls)
+                self.stats.note_tenant_shed(pending.tenant, cls)
                 return False
             adm.note_admitted(cls)
             self.stats.note_admitted(cls)
+            self.stats.note_tenant_admitted(pending.tenant, cls)
             return True
 
     def retry_after_ms(self, cls: str) -> int:
@@ -205,6 +257,17 @@ class Scheduler:
     def queue_caps(self) -> dict:
         """Admission caps per class (OP_STATS telemetry)."""
         return {cls: q.cap_sigs for cls, q in self._queues.items()}
+
+    def tenant_caps(self) -> dict:
+        """Per-tenant admission shares per class (OP_STATS telemetry)."""
+        return {cls: q.tenant_cap_sigs for cls, q in self._queues.items()}
+
+    def tenant_occupancy(self) -> dict:
+        """{class: {tenant: queued sig records}} — the live lane view
+        the fleet OP_STATS section exposes (graftfleet)."""
+        with self._cond:
+            return {cls: q.lanes.occupancy_locked()
+                    for cls, q in self._queues.items()}
 
     # -- assembly (engine thread) -------------------------------------------
 
@@ -245,7 +308,7 @@ class Scheduler:
     def _assemble_locked(self, cap: int | None = None) -> Launch | None:
         lat, blk = self._queues[LATENCY], self._queues[BULK]
         if lat:
-            if lat.items[0].is_bls:
+            if lat._head_locked().is_bls:
                 launch = Launch("bls", [lat._pop_locked()], LATENCY)
                 # BLS runs one request per launch (nothing coalesces);
                 # capacity 1 keeps pad-waste at zero while the launch
@@ -276,7 +339,7 @@ class Scheduler:
             # record while holding the admission lock, so the common
             # pure-consensus case must not pay it per launch.
             fill = []
-            if blk.items and total <= MAX_SUBBATCH:
+            if blk and total <= MAX_SUBBATCH:
                 uniq = len({rec for p in items
                             for rec in zip(p.request.msgs, p.request.pks,
                                            p.request.sigs)})
@@ -314,8 +377,8 @@ class Scheduler:
             else min(cap, self.shapes.launch_cap)
         items = [q._pop_locked()]
         total = len(items[0])
-        while q.items and not q.items[0].is_bls:
-            nxt_len = len(q.items[0])
+        while (nxt := q._head_locked()) is not None and not nxt.is_bls:
+            nxt_len = len(nxt)
             if total + nxt_len > cap:
                 self.stats.note_carry(items[0].cls)
                 break
@@ -326,7 +389,10 @@ class Scheduler:
     def _fill_locked(self, blk: ClassQueue, room: int):
         """Whole bulk requests that fit the latency launch's pad slots."""
         fill = []
-        while room > 0 and blk.items and len(blk.items[0]) <= room:
+        while room > 0:
+            h = blk._head_locked()
+            if h is None or h.is_bls or len(h) > room:
+                break
             p = blk._pop_locked()
             fill.append(p)
             room -= len(p)

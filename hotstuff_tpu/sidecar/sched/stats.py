@@ -53,6 +53,15 @@ class SchedStats:
     # grow without limit (newest samples win — the interesting tail).
     WAIT_SAMPLES_CAP = 4096
 
+    # graftfleet: distinct tenants tracked in the per-tenant section.
+    # A fleet serves committees, not the open internet — 64 is an order
+    # of magnitude past any plausible local deployment, and the bound
+    # keeps a tenant-id fuzzer from growing the stats dict without
+    # limit (overflow tenants fold into "~other").
+    TENANT_STATS_CAP = 64
+    TENANT_WAIT_SAMPLES_CAP = 1024
+    OVERFLOW_TENANT = "~other"
+
     def __init__(self, clock=monotonic):
         from collections import deque
 
@@ -104,6 +113,12 @@ class SchedStats:
         self._pack_window = deque(maxlen=PIPE_WINDOW)  # (t, dur, hidden)
         self._waits = {c: deque(maxlen=self.WAIT_SAMPLES_CAP)
                        for c in ("latency", "bulk")}
+        # graftfleet per-tenant section: admissions/sheds per class and
+        # a bounded queue-wait reservoir per (tenant, class) — the
+        # numbers the fairness invariant is judged on (a victim tenant's
+        # latency p99 under a neighboring flood).  Bounded by
+        # TENANT_STATS_CAP distinct tenants; see _tenant_locked.
+        self._tenants: dict[str, dict] = {}
         # graftsurge: the admission controller (sched/surge.py), attached
         # by the Scheduler.  note_pack/note_launch forward the engine's
         # observations into it (outside this object's lock — the nesting
@@ -112,6 +127,36 @@ class SchedStats:
         self.surge = None
 
     # -- recording ----------------------------------------------------------
+
+    def _tenant_locked(self, tenant: str) -> dict:
+        """The per-tenant record, creating it under the cap (overflow
+        tenants share one "~other" bucket so the dict stays bounded)."""
+        from collections import deque
+
+        rec = self._tenants.get(tenant)
+        if rec is None:
+            if len(self._tenants) >= self.TENANT_STATS_CAP:
+                tenant = self.OVERFLOW_TENANT
+                rec = self._tenants.get(tenant)
+            if rec is None:
+                rec = self._tenants[tenant] = {
+                    "admitted": {},
+                    "shed": {},
+                    "waits": {c: deque(
+                        maxlen=self.TENANT_WAIT_SAMPLES_CAP)
+                        for c in ("latency", "bulk")},
+                }
+        return rec
+
+    def note_tenant_admitted(self, tenant: str, cls: str):
+        with self._lock:
+            adm = self._tenant_locked(tenant)["admitted"]
+            adm[cls] = adm.get(cls, 0) + 1
+
+    def note_tenant_shed(self, tenant: str, cls: str):
+        with self._lock:
+            shed = self._tenant_locked(tenant)["shed"]
+            shed[cls] = shed.get(cls, 0) + 1
 
     def note_admitted(self, cls: str):
         with self._lock:
@@ -145,6 +190,10 @@ class SchedStats:
                 waits = self._waits.get(p.cls)
                 if waits is not None:
                     waits.append(now - p.enqueued_at)
+                tw = self._tenant_locked(
+                    getattr(p, "tenant", None) or "default")["waits"]
+                if p.cls in tw:
+                    tw[p.cls].append(now - p.enqueued_at)
 
     def note_bulk_source(self, ingress: bool, sigs: int):
         """One offered bulk-lane request, split by feed: ingress-fed
@@ -270,6 +319,24 @@ class SchedStats:
                     "slices_avoided": self.scan_slices_avoided,
                 },
                 "pipeline": self._pipeline_locked(),
+                "tenants": {
+                    tenant: {
+                        "admitted": dict(rec["admitted"]),
+                        "shed": dict(rec["shed"]),
+                        "queue_wait": {
+                            cls: {
+                                "n": len(v),
+                                "p50_ms": round(
+                                    _percentile(v, 0.50) * 1e3, 3),
+                                "p99_ms": round(
+                                    _percentile(v, 0.99) * 1e3, 3),
+                            }
+                            for cls, samples in rec["waits"].items()
+                            if (v := sorted(samples))
+                        },
+                    }
+                    for tenant, rec in sorted(self._tenants.items())
+                },
                 "ingress": {
                     "bulk_requests": self.ingress_bulk_requests,
                     "bulk_sigs": self.ingress_bulk_sigs,

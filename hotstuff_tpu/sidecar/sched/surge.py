@@ -124,6 +124,14 @@ class AdmissionController:
         self.busy_replies = {LATENCY: 0, BULK: 0}
         self.bulk_before_latency_sheds = 0
         self.fairness_violations = 0
+        # graftfleet: a latency refusal at the CLASS cap while another
+        # tenant sits above its own per-tenant share — i.e. a flooding
+        # neighbor displaced this tenant's consensus work.  Per-lane
+        # admission (ClassQueue._offer_locked checks the tenant share
+        # first) makes this unreachable by construction; like
+        # fairness_violations, non-zero is a policy regression the
+        # LogParser's strict mode fails the run on.
+        self.tenant_starvation = 0
         self.derate_engagements = 0
 
     # -- pipeline evidence (engine / pack threads) --------------------------
@@ -226,6 +234,12 @@ class AdmissionController:
                 # the proof the LogParser's strict fairness check reads.
                 self.fairness_violations += 1
 
+    def note_tenant_starvation(self):
+        """graftfleet: see ``tenant_starvation`` above (should never
+        fire; the scheduler audits every latency class-cap refusal)."""
+        with self._lock:
+            self.tenant_starvation += 1
+
     def note_shed(self, cls: str, before_latency: bool = False,
                   busy_reply: bool = True):
         with self._lock:
@@ -269,6 +283,7 @@ class AdmissionController:
                 "busy_replies": dict(self.busy_replies),
                 "bulk_before_latency_sheds": self.bulk_before_latency_sheds,
                 "fairness_violations": self.fairness_violations,
+                "tenant_starvation": self.tenant_starvation,
                 "derate": {
                     "factor": round(self._derate_factor_locked(), 3),
                     "engaged": self._derate_engaged,

@@ -1,0 +1,151 @@
+"""graftfleet tenant-keyed queue lanes: the third scheduling key.
+
+Under a shared sidecar fleet, one class queue no longer serves one node:
+every replica of every tenant funnels into the same two class queues, so
+a single greedy tenant could fill a class cap and starve everyone else's
+requests — the classic noisy-neighbor failure shared accelerator
+services hit first.  This module makes the tenant id (protocol v6
+OP_HELLO; ``DEFAULT_TENANT`` for legacy connections) a real scheduling
+key under each class:
+
+Per-tenant FIFO lanes.
+    Each tenant owns a private FIFO inside the class queue.  Arrival
+    order is preserved WITHIN a tenant (the carry-over fairness token
+    the two-class scheduler is built on), while cross-tenant order is
+    policy, not arrival luck.
+
+Deficit-round-robin drain.
+    ``pop_next_locked`` serves the active-tenant ring in deficit
+    round-robin order: each tenant may drain up to ``quantum_sigs``
+    signature records per round before the ring rotates, so a tenant
+    with a deep backlog interleaves with — never blockades — the others.
+    With exactly one tenant queued (the pre-fleet topology, and every
+    legacy test) the ring never rotates and the lane IS the old FIFO,
+    byte-for-byte.
+
+Per-tenant admission share.
+    ``ClassQueue`` checks the offering tenant's lane occupancy against a
+    per-tenant cap BEFORE the class cap, so a flooding tenant saturates
+    its own share and sheds while other tenants keep admitting — the
+    mechanism behind the ``tenant_starvation == 0`` invariant the strict
+    parser mode asserts.
+
+Every queue/coalesce operation in the scheduler routes through these
+helpers; graftlint's ``tenant-unscoped-queue`` rule (analysis/
+tenantlint.py) fails the gate on any raw deque access that would bypass
+the tenant key.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+# Re-exported wire-side default so scheduler code has one import site.
+from ..protocol import DEFAULT_TENANT  # noqa: F401  (part of the API)
+
+# DRR quantum: signature records one tenant may drain per ring round.
+# One device sub-batch is the natural unit — a tenant can fill a launch
+# it has the backlog for, but cannot hold the ring across launches.
+DRR_QUANTUM_SIGS = 2048
+
+
+class _Lane:
+    """One tenant's private FIFO inside a class queue."""
+
+    __slots__ = ("items", "sigs", "deficit")
+
+    def __init__(self):
+        self.items = deque()
+        self.sigs = 0
+        self.deficit = 0
+
+
+class TenantLanes:
+    """All per-tenant lanes of ONE class queue + the DRR drain ring.
+
+    Not locked itself: every method is ``*_locked`` and runs under the
+    owning scheduler's condition (the same discipline ClassQueue always
+    had).  ``order`` holds the tenants with queued items, in ring order;
+    ``order[0]`` is the tenant DRR currently serves.
+    """
+
+    __slots__ = ("lanes", "order", "sigs", "quantum_sigs")
+
+    def __init__(self, quantum_sigs: int = DRR_QUANTUM_SIGS):
+        self.lanes: dict[str, _Lane] = {}
+        self.order: deque[str] = deque()
+        self.sigs = 0
+        self.quantum_sigs = max(1, quantum_sigs)
+
+    # -- admission (via ClassQueue._offer_locked) ---------------------------
+
+    def _offer_locked(self, pending) -> None:
+        """Append to the offering tenant's lane (admission checks are the
+        ClassQueue's job; this helper only keeps the lanes coherent)."""
+        lane = self.lanes.get(pending.tenant)
+        if lane is None:
+            lane = self.lanes[pending.tenant] = _Lane()
+        if not lane.items:
+            self.order.append(pending.tenant)
+        lane.items.append(pending)
+        lane.sigs += len(pending)
+        self.sigs += len(pending)
+
+    # -- drain (engine thread, DRR order) -----------------------------------
+
+    def head_locked(self):
+        """The next Pending DRR will serve, or None when empty.  Grants
+        the serving tenant its quantum lazily on first peek of a round."""
+        if not self.order:
+            return None
+        lane = self.lanes[self.order[0]]
+        if lane.deficit <= 0:
+            lane.deficit = self.quantum_sigs
+        return lane.items[0]
+
+    def pop_next_locked(self):
+        """Pop the DRR-selected head.  Rotates the ring once the serving
+        tenant's deficit is spent (and other tenants are waiting), so a
+        deep backlog interleaves instead of blockading."""
+        head = self.head_locked()  # grants the quantum if fresh
+        if head is None:
+            raise IndexError("pop from empty tenant lanes")
+        tenant = self.order[0]
+        lane = self.lanes[tenant]
+        p = lane.items.popleft()
+        lane.sigs -= len(p)
+        lane.deficit -= len(p)
+        self.sigs -= len(p)
+        if not lane.items:
+            self.order.popleft()
+            lane.deficit = 0
+        elif lane.deficit <= 0 and len(self.order) > 1:
+            self.order.rotate(-1)
+            lane.deficit = 0
+        return p
+
+    # -- introspection ------------------------------------------------------
+
+    def tenant_sigs_locked(self, tenant: str) -> int:
+        lane = self.lanes.get(tenant)
+        return lane.sigs if lane is not None else 0
+
+    def any_over_cap_locked(self, tenant_cap_sigs: int,
+                            exclude: str | None = None) -> bool:
+        """True if any tenant other than ``exclude`` occupies more than
+        the per-tenant cap — the condition a real starvation event
+        requires and per-lane admission makes unreachable."""
+        return any(lane.sigs > tenant_cap_sigs
+                   for tenant, lane in self.lanes.items()
+                   if tenant != exclude)
+
+    def occupancy_locked(self) -> dict:
+        """tenant -> queued signature records (telemetry snapshot)."""
+        return {t: lane.sigs for t, lane in self.lanes.items()
+                if lane.sigs}
+
+    def __len__(self):
+        return sum(len(lane.items) for lane in self.lanes.values())
+
+    def __bool__(self):
+        return bool(self.order)

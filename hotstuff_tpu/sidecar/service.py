@@ -215,7 +215,8 @@ class VerifyEngine:
             committee=committee, client_rate=client_rate)
         self._sched = vsched.Scheduler(shapes=self._shapes,
                                        latency_cap_sigs=lat_cap,
-                                       bulk_cap_sigs=bulk_cap)
+                                       bulk_cap_sigs=bulk_cap,
+                                       committee=committee)
         self._use_host = use_host
         # grafttrace: span emission through every engine stage (admit ->
         # queue -> pack -> dispatch -> device -> reply), tagged with the
@@ -237,6 +238,18 @@ class VerifyEngine:
         # (msg, pk, sig) -> bool verdict; see _cache_verdict.
         self._verdicts: dict = {}
         self._verdicts_lock = threading.Lock()
+        # graftfleet dedup accounting: the verdict cache is keyed on
+        # record BYTES, so under a shared fleet a QC gossiped to N
+        # tenants' replicas is device-verified once and answered from
+        # cache for everyone else.  cache_hits counts records answered
+        # from the cross-request cache (connection fast path + pack
+        # lookups), inbatch_hits records deduped within one coalesced
+        # batch, misses records that actually rode a verify path.  The
+        # hit-rate rides OP_STATS (``dedup``) and the strict parser
+        # asserts it is non-zero under the greedy-flood drill.
+        self._dedup_cache_hits = 0
+        self._dedup_inbatch_hits = 0
+        self._dedup_misses = 0
         # graftguard: the launch supervisor (sidecar/guard.py).  When
         # attached (serve() always attaches one; direct embedders and
         # legacy tests may run bare), every staged dispatch/fetch wait
@@ -298,7 +311,7 @@ class VerifyEngine:
         self._thread.start()
 
     def submit(self, request, reply_fn, cls: str = vsched.LATENCY,
-               is_bls: bool = False) -> bool:
+               is_bls: bool = False, tenant: str | None = None) -> bool:
         """Admit one request into its class queue.  Returns False on
         queue-full — nothing was retained and the CALLER must reply
         (the handler sends the explicit empty-mask backpressure reply);
@@ -322,7 +335,8 @@ class VerifyEngine:
             if self._guard is not None:
                 self._guard.stats.note_busy()
             return False
-        ok = self._sched.offer(request, reply_fn, cls=cls, is_bls=is_bls)
+        ok = self._sched.offer(request, reply_fn, cls=cls, is_bls=is_bls,
+                               tenant=tenant)
         if self._tracer.enabled:
             tags = {}
             ctx = _ctx_tag(request)
@@ -346,6 +360,19 @@ class VerifyEngine:
         snap["shapes"] = self._shapes.snapshot()
         snap["queue_caps"] = self._sched.queue_caps()
         snap["verdict_cache_entries"] = len(self._verdicts)
+        with self._verdicts_lock:
+            hits = self._dedup_cache_hits + self._dedup_inbatch_hits
+            seen = hits + self._dedup_misses
+            snap["dedup"] = {
+                "cache_hits": self._dedup_cache_hits,
+                "inbatch_hits": self._dedup_inbatch_hits,
+                "misses": self._dedup_misses,
+                "hit_rate": round(hits / seen, 4) if seen else 0.0,
+            }
+        snap["tenant_caps"] = self._sched.tenant_caps()
+        occupancy = self._sched.tenant_occupancy()
+        if any(occupancy.values()):
+            snap["tenant_occupancy"] = occupancy
         if self.compile_tracker is not None:
             snap["compile"] = self.compile_tracker.snapshot()
         if self._guard is not None:
@@ -373,6 +400,9 @@ class VerifyEngine:
             if v is None:
                 return None
             out.append(v)
+        if out:
+            with self._verdicts_lock:
+                self._dedup_cache_hits += len(out)
         return out
 
     @staticmethod
@@ -932,6 +962,13 @@ class VerifyEngine:
             if c is None:
                 uniq.setdefault(records[i], []).append(i)
         uniq_records = list(uniq.keys())
+        n_cached = sum(1 for c in cached if c is not None)
+        if records:
+            with self._verdicts_lock:
+                self._dedup_cache_hits += n_cached
+                self._dedup_inbatch_hits += \
+                    len(records) - n_cached - len(uniq_records)
+                self._dedup_misses += len(uniq_records)
         # graftguard poison lane: records the bisection confirmed poison
         # are split OUT of the device launch and verified on host right
         # here (pure host work on the pack worker) — a cursed record is
@@ -1353,6 +1390,11 @@ class _Handler(socketserver.BaseRequestHandler):
         wt = threading.Thread(target=writer, daemon=True,
                               name="sidecar-conn-writer")
         wt.start()
+        # graftfleet: the connection's scheduling tenant.  Set once by a
+        # HELLO frame (protocol v6); connections that never HELLO — every
+        # pre-v6 client — schedule under the default tenant, so the
+        # single-tenant topology behaves exactly as before.
+        tenant = proto.DEFAULT_TENANT
         try:
             while True:
                 try:
@@ -1364,6 +1406,23 @@ class _Handler(socketserver.BaseRequestHandler):
                 except Exception:
                     log.exception("bad frame; closing connection")
                     return
+                if opcode == proto.OP_HELLO:
+                    # Tenant registration.  The reply echoes the server's
+                    # protocol version + the accepted tenant id, so the
+                    # client can fail fast on a version skew.  Distinct
+                    # tenants are bounded server-side: past the cap the
+                    # connection is refused (clean close, never a hang)
+                    # so a tenant-id fuzzer cannot grow the scheduler's
+                    # lane map without limit.
+                    if not self.server.register_tenant(req.tenant):
+                        log.warning(
+                            "HELLO refused: tenant registry full "
+                            "(tenant %r); closing connection", req.tenant)
+                        return
+                    tenant = req.tenant
+                    outbox.put(proto.encode_hello_reply(
+                        req.request_id, tenant))
+                    continue
                 if opcode == proto.OP_PING:
                     outbox.put(proto.encode_reply(
                         proto.OP_PING, req.request_id, []))
@@ -1478,7 +1537,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 # no connection thread ever blocks on a saturated
                 # engine.
                 cls = vsched.class_of_opcode(opcode)
-                if not engine.submit(req, reply, cls=cls, is_bls=is_bls):
+                if not engine.submit(req, reply, cls=cls, is_bls=is_bls,
+                                     tenant=tenant):
                     outbox.put(proto.encode_busy_reply(
                         req.request_id, engine.retry_after_ms(cls)))
         finally:
@@ -1489,11 +1549,31 @@ class SidecarServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
+    # graftfleet: distinct tenant ids one server process will register
+    # over its lifetime.  A fleet fronts committees, not the open
+    # internet; the bound keeps a HELLO fuzzer from growing the
+    # scheduler's lane map and the stats dict without limit.
+    TENANT_REGISTRY_CAP = 256
+
     def __init__(self, addr, engine: VerifyEngine,
                  chaos: ChaosState | None = None):
         super().__init__(addr, _Handler)
         self.engine = engine
         self.chaos = chaos
+        self._tenants_seen: set = set()
+        self._tenants_lock = threading.Lock()
+
+    def register_tenant(self, tenant: str) -> bool:
+        """Accept a HELLO tenant id; False once the registry is full
+        (re-HELLOs of a known tenant always succeed — a tenant id
+        COLLISION is by design: both connections share one lane)."""
+        with self._tenants_lock:
+            if tenant in self._tenants_seen:
+                return True
+            if len(self._tenants_seen) >= self.TENANT_REGISTRY_CAP:
+                return False
+            self._tenants_seen.add(tenant)
+            return True
 
 
 def serve(host: str = "127.0.0.1", port: int = 7100,
@@ -1505,7 +1585,8 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
           chaos: bool = False,
           committee: int | None = None, client_rate: int | None = None,
           trace_path: str | None = None,
-          cadence: bool | None = None):
+          cadence: bool | None = None,
+          tcp: str | None = None):
     # graftcadence opt-in: --cadence wins, then HOTSTUFF_TPU_CADENCE;
     # the staged engine stays the default (ring.cadence_enabled).
     from .ring import RingDepth, cadence_enabled
@@ -1622,6 +1703,25 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         engine._rewarm_fn = _rewarm
     server = SidecarServer((host, port), engine, chaos=chaos_state)
     log.info("sidecar listening on %s:%d", host, server.server_address[1])
+    # graftfleet: --tcp HOST:PORT binds a SECOND listener next to the
+    # primary, sharing the same engine, scheduler, verdict cache and
+    # chaos hook — the shape a shared fleet member serves remote tenants
+    # through while local clients keep the loopback socket.  Both
+    # listeners speak the same protocol (HELLO/tenant included); the
+    # tenant registry is per-SERVER, so the two listeners' tenants are
+    # bounded independently but share the scheduler's lanes.
+    tcp_server = None
+    tcp_thread = None
+    if tcp:
+        tcp_host, _, tcp_port = tcp.rpartition(":")
+        tcp_server = SidecarServer((tcp_host or "0.0.0.0", int(tcp_port)),
+                                   engine, chaos=chaos_state)
+        log.info("sidecar fleet listener on %s:%d", tcp_host or "0.0.0.0",
+                 tcp_server.server_address[1])
+        tcp_thread = threading.Thread(
+            target=lambda: tcp_server.serve_forever(poll_interval=0.2),
+            daemon=True, name="sidecar-tcp-listener")
+        tcp_thread.start()
     if ready_event is not None:
         ready_event.set()
     try:
@@ -1630,6 +1730,9 @@ def serve(host: str = "127.0.0.1", port: int = 7100,
         engine.stop()
         guard.close()
         server.server_close()
+        if tcp_server is not None:
+            tcp_server.shutdown()
+            tcp_server.server_close()
         if tracer is not None:
             tracer.close()
     return server
@@ -1945,6 +2048,13 @@ def main(argv=None):
                          "coalesced batches of %d+ signatures route "
                          "through the sharded combined check"
                          % vsched.RLC_MIN_LAUNCH)
+    ap.add_argument("--tcp", default=None, metavar="HOST:PORT",
+                    help="graftfleet: bind a second listener (same "
+                         "engine and scheduler) on HOST:PORT for remote "
+                         "tenants — fleet members serve shared traffic "
+                         "here while local clients keep the primary "
+                         "socket; protocol v6 HELLO frames carry the "
+                         "tenant id on either listener")
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="append grafttrace JSONL spans (admit/queue/"
                          "pack/dispatch/device/reply, tagged rid + "
@@ -1983,7 +2093,8 @@ def main(argv=None):
           chaos=args.chaos, committee=args.committee or None,
           client_rate=args.client_rate or None,
           trace_path=args.trace,
-          cadence=True if args.cadence else None)
+          cadence=True if args.cadence else None,
+          tcp=args.tcp)
 
 
 if __name__ == "__main__":

@@ -40,7 +40,14 @@ constexpr uint8_t kOpBusy = 10;
 // the sidecar's stage spans can be joined to the block's node-side
 // trace.  Frame length discriminates tagged from legacy frames.
 constexpr size_t kCtxLen = 32;
-constexpr uint8_t kProtocolVersion = 5;  // NOLINT (lint anchor; no handshake)
+// Protocol v6 (graftfleet): HELLO tenant registration.  The request
+// rides the standard header — the count field carries the CLIENT
+// protocol version and msg_len carries the tenant byte length, with the
+// tenant id as the body; the reply echoes the server version (1 byte) +
+// the accepted tenant.  Connections that never HELLO schedule under the
+// sidecar's default tenant, so the frame is strictly additive.
+constexpr uint8_t kOpHello = 11;
+constexpr uint8_t kProtocolVersion = 6;  // NOLINT (lint anchor; HELLO echo)
 constexpr size_t kBlsPkLen = 96;
 constexpr size_t kBlsSigLen = 192;
 constexpr size_t kBlsSkLen = 48;
@@ -48,6 +55,16 @@ constexpr size_t kBlsSkLen = 48;
 // DIGEST_LEN; graftlint cross-checks the two).
 constexpr size_t kDigestLen = 32;
 std::unique_ptr<TpuVerifier> g_instance;
+// Request ids are allocated process-wide (graftfleet): a failover
+// resubmits the identical frame bytes to another endpoint, so rids must
+// be unique across every endpoint's pending map, not per-connection.
+std::atomic<uint32_t> g_next_rid{0};
+
+uint32_t next_rid() {
+  // relaxed: only uniqueness is needed; frame bytes publish via the
+  // per-endpoint socket write under the inner mutex.
+  return g_next_rid.fetch_add(1, std::memory_order_relaxed);
+}
 
 void write_header(Writer* w, uint8_t opcode, uint32_t rid, uint32_t count) {
   w->u8(opcode);
@@ -59,27 +76,43 @@ void write_header(Writer* w, uint8_t opcode, uint32_t rid, uint32_t count) {
 }  // namespace
 
 TpuVerifier::TpuVerifier(const Address& addr)
-    : addr_(addr), inner_(std::make_shared<Inner>()) {
-  // Construction precedes every reader/probe thread (ensure_connected_
-  // locked_ spawns the first one later); the thread-start edge is the
-  // happens-before, so this one pre-publication write needs no lock.
-  // graftlint: disable=guarded-member-unlocked
-  inner_->addr = addr;
+    : TpuVerifier(std::vector<Address>{addr}, std::string()) {}
+
+TpuVerifier::TpuVerifier(std::vector<Address> addrs, std::string tenant)
+    : addr_(addrs.empty() ? Address{} : addrs.front()) {
+  if (addrs.empty()) addrs.push_back(Address{});
+  inners_.reserve(addrs.size());
+  for (size_t i = 0; i < addrs.size(); i++) {
+    auto inner = std::make_shared<Inner>();
+    // Construction precedes every reader/probe thread (ensure_connected_
+    // locked_ spawns the first one later); the thread-start edge is the
+    // happens-before, so these pre-publication writes need no lock.
+    // graftlint: disable=guarded-member-unlocked (pre-publication write; thread-start edge below is the happens-before)
+    inner->addr = addrs[i];
+    // graftlint: disable=guarded-member-unlocked (pre-publication write; thread-start edge below is the happens-before)
+    inner->ix = i;
+    // graftlint: disable=guarded-member-unlocked (pre-publication write; thread-start edge below is the happens-before)
+    inner->tenant = tenant;
+    inners_.push_back(std::move(inner));
+  }
+  inner_ = inners_.front();
 }
 
 TpuVerifier::~TpuVerifier() {
   std::vector<FrameCallback> cbs;
-  {
-    std::lock_guard<std::mutex> lk(inner_->m);
-    inner_->closing = true;  // probes exit; no new probe may start
-    inner_->gen++;  // stale readers exit without touching the socket
-    for (auto& [rid, p] : inner_->pending) cbs.push_back(std::move(p.cb));
-    inner_->pending.clear();
-    // Wakes a reader blocked in poll/read; the Socket fd itself is closed
-    // by ~Inner once the last reader drops its shared_ptr.
-    inner_->sock.shutdown();
+  for (const auto& inner : inners_) {
+    {
+      std::lock_guard<std::mutex> lk(inner->m);
+      inner->closing = true;  // probes exit; no new probe may start
+      inner->gen++;  // stale readers exit without touching the socket
+      for (auto& [rid, p] : inner->pending) cbs.push_back(std::move(p.cb));
+      inner->pending.clear();
+      // Wakes a reader blocked in poll/read; the Socket fd itself is
+      // closed by ~Inner once the last reader drops its shared_ptr.
+      inner->sock.shutdown();
+    }
+    inner->cv.notify_all();  // wakes a probe sleeping out its backoff
   }
-  inner_->cv.notify_all();  // wakes a probe sleeping out its backoff
   for (auto& cb : cbs) cb(std::nullopt);
 }
 
@@ -90,13 +123,19 @@ void TpuVerifier::install(std::unique_ptr<TpuVerifier> v) {
 }
 
 bool TpuVerifier::connected() {
-  std::lock_guard<std::mutex> lk(inner_->m);
-  return ensure_connected_locked_();
+  size_t ix = 0;
+  auto inner = pick_inner_(&ix);
+  std::lock_guard<std::mutex> lk(inner->m);
+  return ensure_connected_locked_(inner);
 }
 
 size_t TpuVerifier::inflight() const {
-  std::lock_guard<std::mutex> lk(inner_->m);
-  return inner_->pending.size();
+  size_t total = 0;
+  for (const auto& inner : inners_) {
+    std::lock_guard<std::mutex> lk(inner->m);
+    total += inner->pending.size();
+  }
+  return total;
 }
 
 TpuVerifier::BreakerState TpuVerifier::breaker_state() const {
@@ -104,9 +143,25 @@ TpuVerifier::BreakerState TpuVerifier::breaker_state() const {
   return inner_->breaker;
 }
 
+TpuVerifier::BreakerState TpuVerifier::breaker_state(size_t ix) const {
+  const auto& inner = inners_.at(ix);
+  std::lock_guard<std::mutex> lk(inner->m);
+  return inner->breaker;
+}
+
+size_t TpuVerifier::endpoint_count() const { return inners_.size(); }
+
+size_t TpuVerifier::active_endpoint() const {
+  // relaxed: an advisory index; endpoint state is read under its mutex.
+  return active_ix_.load(std::memory_order_relaxed);
+}
+
 int TpuVerifier::inflight_budget() const {
-  std::lock_guard<std::mutex> lk(inner_->m);
-  return inner_->inflight_budget;
+  // relaxed: any endpoint's budget is an acceptable answer mid-failover;
+  // the budget itself is read under that inner's mutex.
+  const auto& inner = inners_[active_ix_.load(std::memory_order_relaxed)];
+  std::lock_guard<std::mutex> lk(inner->m);
+  return inner->inflight_budget;
 }
 
 int TpuVerifier::adapt_budget(int current, double p99_ms) {
@@ -125,31 +180,72 @@ int TpuVerifier::adapt_budget(int current, double p99_ms) {
 }
 
 void TpuVerifier::set_backoff_for_test(int base_ms, int max_ms) {
-  std::lock_guard<std::mutex> lk(inner_->m);
-  inner_->backoff_base_ms = base_ms;
-  inner_->backoff_ms = base_ms;
-  inner_->backoff_max_ms = max_ms;
-  inner_->backoff_until = {};
+  for (const auto& inner : inners_) {
+    std::lock_guard<std::mutex> lk(inner->m);
+    inner->backoff_base_ms = base_ms;
+    inner->backoff_ms = base_ms;
+    inner->backoff_max_ms = max_ms;
+    inner->backoff_until = {};
+  }
 }
 
-bool TpuVerifier::ensure_connected_locked_() {
-  Inner& in = *inner_;
+std::shared_ptr<TpuVerifier::Inner> TpuVerifier::pick_inner_(
+    size_t* ix_out) {
+  // relaxed: a stale index only costs one extra breaker check below —
+  // every Inner field is read under its own mutex.
+  size_t active = active_ix_.load(std::memory_order_relaxed);
+  {
+    const auto& inner = inners_[active];
+    std::lock_guard<std::mutex> lk(inner->m);
+    if (inner->breaker == BreakerState::kClosed) {
+      *ix_out = active;
+      return inner;
+    }
+  }
+  // Active endpoint's breaker is open: re-home to the first healthy
+  // endpoint scanning from 0 — a recovered PRIMARY (its probe closed
+  // the breaker) is preferred over a later fallback, so the fleet
+  // drifts back to its configured order after an outage.
+  for (size_t i = 0; i < inners_.size(); i++) {
+    if (i == active) continue;
+    const auto& inner = inners_[i];
+    std::lock_guard<std::mutex> lk(inner->m);
+    if (inner->breaker == BreakerState::kClosed) {
+      active_ix_.store(i, std::memory_order_relaxed);  // advisory index
+      LOG_WARN("crypto::sidecar")
+          << "sidecar failover: endpoint " << active
+          << " unhealthy, re-homed to endpoint " << i << " ("
+          << inner->addr.str() << ")";
+      *ix_out = i;
+      return inner;
+    }
+  }
+  // No healthy endpoint: stay with the active one — its terminal
+  // failure routes the caller to the host path, the LAST rung.
+  *ix_out = active;
+  return inners_[active];
+}
+
+bool TpuVerifier::ensure_connected_locked_(
+    const std::shared_ptr<Inner>& inner) {
+  Inner& in = *inner;
+  if (in.closing) return false;
   if (in.sock.valid()) return true;
   if (in.breaker != BreakerState::kClosed) {
     // Open (or probing): the host path answers immediately; reconnection
     // is the probe thread's job, never a verify's.
-    start_probe_locked_(inner_);
+    start_probe_locked_(inner);
     return false;
   }
   if (std::chrono::steady_clock::now() < in.backoff_until) return false;
-  auto s = Socket::connect(addr_, kConnectTimeoutMs);
+  auto s = Socket::connect(in.addr, kConnectTimeoutMs);
   if (!s) {
     if (in.ever_connected) {
       LOG_WARN("crypto::sidecar") << "lost connection to verify sidecar "
-                                  << addr_.str();
+                                  << in.addr.str();
       in.ever_connected = false;
     }
-    note_failure_locked_(inner_, "connect failed");
+    note_failure_locked_(inner, "connect failed");
     return false;
   }
   in.sock = std::move(*s);
@@ -162,11 +258,61 @@ bool TpuVerifier::ensure_connected_locked_() {
   in.backoff_ms = in.backoff_base_ms;
   if (!in.ever_connected) {
     LOG_INFO("crypto::sidecar") << "connected to verify sidecar "
-                                << addr_.str();
+                                << in.addr.str();
   }
   in.ever_connected = true;
-  std::thread(reader_loop_, inner_, in.gen, in.sock.fd()).detach();
+  std::thread(reader_loop_, inner, in.gen, in.sock.fd()).detach();
+  send_hello_locked_(inner);
   return true;
+}
+
+void TpuVerifier::send_hello_locked_(const std::shared_ptr<Inner>& inner) {
+  Inner& in = *inner;
+  if (in.tenant.empty()) return;
+  uint32_t rid = next_rid();
+  Writer w;
+  w.u8(kOpHello);
+  w.u32(rid);
+  w.u32(kProtocolVersion);  // count field carries the client version
+  w.u8(in.tenant.size() & 0xFF);  // msg_len = tenant byte length
+  w.u8((in.tenant.size() >> 8) & 0xFF);
+  for (char c : in.tenant) w.u8(static_cast<uint8_t>(c));
+  PendingReq req;
+  req.opcode = kOpHello;
+  req.deadline = std::chrono::steady_clock::now() +
+                 std::chrono::milliseconds(kRecvTimeoutMs);
+  std::string tenant = in.tenant;
+  size_t ix = in.ix;
+  req.cb = [tenant, ix](std::optional<Bytes> reply) {
+    if (!reply) return;  // transport failure: the reader handled it
+    try {
+      Reader r(*reply);
+      uint8_t op = r.u8();
+      r.u32();  // rid (already matched by the reader)
+      uint32_t n = r.u32();
+      if (op != kOpHello || n < 1) {
+        LOG_WARN("crypto::sidecar")
+            << "HELLO rejected by sidecar endpoint " << ix << " (tenant "
+            << tenant << ")";
+        return;
+      }
+      uint8_t version = r.u8();
+      if (version != kProtocolVersion) {
+        LOG_WARN("crypto::sidecar")
+            << "sidecar protocol version skew on endpoint " << ix
+            << ": server v" << int(version) << ", client v"
+            << int(kProtocolVersion);
+      } else {
+        LOG_INFO("crypto::sidecar")
+            << "HELLO accepted by endpoint " << ix << ": tenant "
+            << tenant << " (protocol v" << int(version) << ")";
+      }
+    } catch (const SerdeError&) {
+      LOG_WARN("crypto::sidecar") << "malformed HELLO reply";
+    }
+  };
+  in.pending.emplace(rid, std::move(req));
+  if (!in.sock.write_frame(w.out)) in.sock.shutdown();
 }
 
 void TpuVerifier::note_failure_locked_(const std::shared_ptr<Inner>& inner,
@@ -228,6 +374,7 @@ void TpuVerifier::probe_loop_(std::shared_ptr<Inner> inner) {
           << addr.str();
       std::thread(reader_loop_, inner, inner->gen, inner->sock.fd())
           .detach();
+      send_hello_locked_(inner);
       break;
     }
     inner->backoff_ms =
@@ -364,7 +511,7 @@ void TpuVerifier::maybe_poll_stats_(const std::shared_ptr<Inner>& inner,
     return;
   }
   inner->last_stats_tx = now;
-  uint32_t rid = inner->next_id++;
+  uint32_t rid = next_rid();
   Writer w;
   write_header(&w, kOpStats, rid, 0);
   PendingReq req;
@@ -424,12 +571,14 @@ void TpuVerifier::handle_stats_reply_(const std::weak_ptr<Inner>& weak,
   }
 }
 
-void TpuVerifier::submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
-                          int deadline_ms, FrameCallback cb) {
+void TpuVerifier::submit_on_(const std::shared_ptr<Inner>& inner,
+                             uint8_t opcode, const Bytes& frame,
+                             uint32_t rid, int deadline_ms,
+                             FrameCallback cb) {
   bool fail = false;
   {
-    std::lock_guard<std::mutex> lk(inner_->m);
-    if (!ensure_connected_locked_()) {
+    std::lock_guard<std::mutex> lk(inner->m);
+    if (!ensure_connected_locked_(inner)) {
       fail = true;
     } else {
       PendingReq req;
@@ -437,15 +586,71 @@ void TpuVerifier::submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
       req.deadline = std::chrono::steady_clock::now() +
                      std::chrono::milliseconds(deadline_ms);
       req.cb = std::move(cb);
-      inner_->pending.emplace(rid, std::move(req));
-      if (!inner_->sock.write_frame(frame)) {
+      inner->pending.emplace(rid, std::move(req));
+      if (!inner->sock.write_frame(frame)) {
         // The reader owns teardown: wake it and let fail_all_ invoke the
         // callback we just registered (along with any other pendings).
-        inner_->sock.shutdown();
+        inner->sock.shutdown();
       }
     }
   }
   if (fail) cb(std::nullopt);
+}
+
+// graftfleet failover: on a terminal transport failure the identical
+// frame bytes are resubmitted to the next untried healthy endpoint (rids
+// are process-unique, so the frame needs no rewrite).  An OP_BUSY shed
+// arrives as a real reply and never lands here — overload means the
+// endpoint is ALIVE, and re-submitting elsewhere would just migrate the
+// flood.  Only when every endpoint has been tried (or is breaker-open)
+// does the caller see nullopt and take the host path — the last rung of
+// the ladder, behind every healthy fleet member.
+void TpuVerifier::submit_failover_(
+    std::vector<std::shared_ptr<Inner>> endpoints, uint8_t opcode,
+    Bytes frame, uint32_t rid, int deadline_ms, FrameCallback cb,
+    uint32_t tried, size_t ix) {
+  auto inner = endpoints[ix];
+  FrameCallback wrapped =
+      [endpoints = std::move(endpoints), opcode, frame, rid, deadline_ms,
+       cb = std::move(cb), tried, ix](std::optional<Bytes> reply) mutable {
+        if (reply) {
+          cb(std::move(reply));
+          return;
+        }
+        for (size_t j = 0; j < endpoints.size(); j++) {
+          if (tried & (1u << (j & 31))) continue;
+          {
+            std::lock_guard<std::mutex> lk(endpoints[j]->m);
+            if (endpoints[j]->closing ||
+                endpoints[j]->breaker != BreakerState::kClosed) {
+              continue;
+            }
+          }
+          LOG_WARN("crypto::sidecar")
+              << "sidecar failover: endpoint " << ix
+              << " failed in flight, resubmitting to endpoint " << j;
+          submit_failover_(std::move(endpoints), opcode, std::move(frame),
+                           rid, deadline_ms, std::move(cb),
+                           tried | (1u << (j & 31)), j);
+          return;
+        }
+        cb(std::nullopt);
+      };
+  submit_on_(inner, opcode, frame, rid, deadline_ms, std::move(wrapped));
+}
+
+void TpuVerifier::submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
+                          int deadline_ms, FrameCallback cb) {
+  if (inners_.size() == 1) {
+    // Single-endpoint topology: no failover ladder to walk — the
+    // pre-fleet behavior, byte for byte.
+    submit_on_(inner_, opcode, frame, rid, deadline_ms, std::move(cb));
+    return;
+  }
+  size_t ix = 0;
+  pick_inner_(&ix);
+  submit_failover_(inners_, opcode, frame, rid, deadline_ms, std::move(cb),
+                   1u << (ix & 31), ix);
 }
 
 // -- Ed25519 ---------------------------------------------------------------
@@ -468,11 +673,7 @@ void TpuVerifier::verify_batch_multi_async_ex(
   // callers (offchain sweeps, mempool-style batches) must say so.
   const uint8_t opcode = bulk ? kOpVerifyBulk : kOpVerifyBatch;
   Writer w;
-  uint32_t rid;
-  {
-    std::lock_guard<std::mutex> lk(inner_->m);
-    rid = inner_->next_id++;
-  }
+  uint32_t rid = next_rid();
   write_header(&w, opcode, rid, static_cast<uint32_t>(items.size()));
   // Protocol v5 context tag, written ONLY when a block context exists:
   // the tag rides between header and records and the sidecar
@@ -611,11 +812,7 @@ std::optional<Bytes> TpuVerifier::bls_sign(const Digest& digest,
                                            const Bytes& sk48) {
   if (sk48.size() != kBlsSkLen) return std::nullopt;
   Writer w;
-  uint32_t rid;
-  {
-    std::lock_guard<std::mutex> lk(inner_->m);
-    rid = inner_->next_id++;
-  }
+  uint32_t rid = next_rid();
   write_header(&w, kOpBlsSign, rid, 1);
   w.fixed(digest.data);
   w.out.insert(w.out.end(), sk48.begin(), sk48.end());
@@ -655,11 +852,7 @@ void TpuVerifier::bls_verify_votes_async(
     return;
   }
   Writer w;
-  uint32_t rid;
-  {
-    std::lock_guard<std::mutex> lk(inner_->m);
-    rid = inner_->next_id++;
-  }
+  uint32_t rid = next_rid();
   write_header(&w, kOpBlsVerifyVotes, rid,
                static_cast<uint32_t>(votes.size()));
   // v5 context tag: same slot (between header and body) and same
@@ -702,11 +895,7 @@ void TpuVerifier::bls_verify_multi_async(
     return;
   }
   Writer w;
-  uint32_t rid;
-  {
-    std::lock_guard<std::mutex> lk(inner_->m);
-    rid = inner_->next_id++;
-  }
+  uint32_t rid = next_rid();
   write_header(&w, kOpBlsVerifyMulti, rid,
                static_cast<uint32_t>(items.size()));
   if (ctx != nullptr) {

@@ -13,6 +13,7 @@
 // QC::verify at consensus/src/messages.rs:180-198).
 #pragma once
 
+#include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -20,6 +21,7 @@
 #include <memory>
 #include <mutex>
 #include <optional>
+#include <string>
 #include <tuple>
 #include <unordered_map>
 #include <utility>
@@ -38,6 +40,16 @@ class Writer;
 class TpuVerifier {
  public:
   explicit TpuVerifier(const Address& addr);
+  // graftfleet: an ORDERED sidecar endpoint list (first = primary) plus
+  // an optional tenant id.  Every endpoint keeps its own circuit
+  // breaker/backoff/probe state; requests ride the active endpoint
+  // (sticky until unhealthy) and fail over to the first healthy
+  // alternative — scanning from index 0, so a recovered primary is
+  // preferred as soon as the current endpoint falters — before the
+  // host path is ever used.  A non-empty tenant is announced with a
+  // protocol v6 HELLO frame on every (re)connect, keying the sidecar's
+  // per-tenant fair scheduling.
+  TpuVerifier(std::vector<Address> addrs, std::string tenant);
   ~TpuVerifier();
 
   // Process-wide instance used by Signature::verify_batch. Install once at
@@ -58,6 +70,11 @@ class TpuVerifier {
   // harness LogParser folds into the run summary.
   enum class BreakerState { kClosed, kOpen, kHalfOpen };
   BreakerState breaker_state() const;
+  // graftfleet: per-endpoint breaker view + the active (sticky)
+  // endpoint requests currently ride.
+  BreakerState breaker_state(size_t ix) const;
+  size_t endpoint_count() const;
+  size_t active_endpoint() const;
 
   // Adaptive async pipeline bound: the reader polls the sidecar's
   // OP_STATS latency-class queue-wait p99 every kStatsIntervalMs and
@@ -179,8 +196,9 @@ class TpuVerifier {
     FrameCallback cb;
   };
 
-  // Connection state shared with (detached) reader/probe threads, so a
-  // thread draining a dead socket can never touch a destroyed client.
+  // Per-ENDPOINT connection state (graftfleet: one Inner per fleet
+  // member), shared with (detached) reader/probe threads, so a thread
+  // draining a dead socket can never touch a destroyed client.
   // Every member below is guarded by `m` (analysis/cxxsync.py enforces
   // the annotations; *_locked_ helpers document caller-held locking).
   struct Inner {
@@ -189,10 +207,13 @@ class TpuVerifier {
                        // one worked suppression (it is the sole reader)
     Address addr;      // GUARDED_BY(m) — dial target; written pre-thread
                        // in the ctor, re-read by the probe under m
+    size_t ix = 0;     // GUARDED_BY(m) — endpoint index (log labels);
+                       // written once pre-thread in the ctor
+    std::string tenant;  // GUARDED_BY(m) — HELLO id; written pre-thread
+                         // in the ctor, read on (re)connect under m
     uint64_t gen = 0;  // GUARDED_BY(m) — bumped per socket lifetime;
                        // stale readers exit
     std::unordered_map<uint32_t, PendingReq> pending;  // GUARDED_BY(m)
-    uint32_t next_id = 0;                              // GUARDED_BY(m)
     bool ever_connected = false;                       // GUARDED_BY(m)
     std::chrono::steady_clock::time_point backoff_until{};  // GUARDED_BY(m)
     std::chrono::steady_clock::time_point last_rx{};        // GUARDED_BY(m)
@@ -227,17 +248,42 @@ class TpuVerifier {
                                 uint64_t gen);
   static void handle_stats_reply_(const std::weak_ptr<Inner>& weak,
                                   uint32_t rid, std::optional<Bytes> reply);
-  bool ensure_connected_locked_();
-  // Registers cb and writes the frame; on any failure invokes cb(nullopt)
-  // before returning. Thread-safe; never blocks on the sidecar's reply.
+  static bool ensure_connected_locked_(const std::shared_ptr<Inner>& inner);
+  // graftfleet HELLO: announce the endpoint's tenant id on a fresh
+  // connection (protocol v6); the reply echoes the server version.
+  // Called with the endpoint lock held, right after the reader starts.
+  static void send_hello_locked_(const std::shared_ptr<Inner>& inner);
+  // The sticky endpoint selector: the active endpoint while its breaker
+  // is closed, else the first healthy endpoint scanning from 0 (the
+  // re-home is logged for the harness); falls back to the active one
+  // when no endpoint is healthy (its failure routes to the host path).
+  std::shared_ptr<Inner> pick_inner_(size_t* ix_out);
+  // Registers cb and writes the frame to ONE endpoint; on any failure
+  // invokes cb(nullopt) before returning. Thread-safe; never blocks on
+  // the sidecar's reply.
+  static void submit_on_(const std::shared_ptr<Inner>& inner,
+                         uint8_t opcode, const Bytes& frame, uint32_t rid,
+                         int deadline_ms, FrameCallback cb);
+  // Failover form: submits to the chosen endpoint and, on a TERMINAL
+  // transport failure (never on OP_BUSY — overload is not an outage),
+  // resubmits the identical frame to the next untried healthy endpoint
+  // before ever failing the caller to the host path.
   void submit_(uint8_t opcode, const Bytes& frame, uint32_t rid,
                int deadline_ms, FrameCallback cb);
+  static void submit_failover_(
+      std::vector<std::shared_ptr<Inner>> endpoints, uint8_t opcode,
+      Bytes frame, uint32_t rid, int deadline_ms, FrameCallback cb,
+      uint32_t tried, size_t ix);
   bool append_bls_record_(BlsContext* bls, Writer* w, const PublicKey& pk,
                           const Signature& sig);
 
   Address addr_;                  // SHARED_OK(immutable after ctor)
-  std::shared_ptr<Inner> inner_;  // SHARED_OK(the pointer is immutable
-                                  // after ctor; the pointee locks m)
+  std::vector<std::shared_ptr<Inner>> inners_;  // SHARED_OK(immutable
+                                                // after ctor; pointees
+                                                // lock their own m)
+  std::shared_ptr<Inner> inner_;  // SHARED_OK(immutable after ctor:
+                                  // alias of inners_[0], the primary)
+  std::atomic<size_t> active_ix_{0};  // SHARED_OK(atomic)
 };
 
 }  // namespace hotstuff

@@ -63,7 +63,20 @@ Parameters Parameters::from_json(const Json& j) {
   if (auto* v = j.find("tpu_sidecar")) {
     if (v->type() == Json::Type::kString) {
       p.tpu_sidecar = Address::parse(v->as_string());
+      if (p.tpu_sidecar) p.tpu_sidecars.push_back(*p.tpu_sidecar);
+    } else if (v->type() == Json::Type::kArray) {
+      // graftfleet: ordered endpoint list; a malformed entry is a config
+      // error (silently skipping one would re-order the failover ladder).
+      for (const auto& e : v->items()) {
+        auto a = Address::parse(e.as_string());
+        if (!a) throw JsonError("bad tpu_sidecar address: " + e.as_string());
+        p.tpu_sidecars.push_back(*a);
+      }
+      if (!p.tpu_sidecars.empty()) p.tpu_sidecar = p.tpu_sidecars.front();
     }
+  }
+  if (auto* v = j.find("tpu_tenant")) {
+    p.tpu_tenant = v->as_string();
   }
   if (auto* v = j.find("scheme")) {
     p.scheme = v->as_string();

@@ -7,6 +7,7 @@
 
 #include <optional>
 #include <string>
+#include <vector>
 
 #include "consensus/config.hpp"
 #include "crypto/crypto.hpp"
@@ -37,6 +38,14 @@ struct Parameters {
   consensus::Parameters consensus;
   mempool::Parameters mempool;
   std::optional<Address> tpu_sidecar;
+  // graftfleet: ordered sidecar endpoint list (first = primary).  The
+  // JSON "tpu_sidecar" key accepts a single address string (legacy) or
+  // a list of them; tpu_sidecar above always mirrors the first entry so
+  // pre-fleet call sites keep working.
+  std::vector<Address> tpu_sidecars;
+  // graftfleet: tenant id announced on each sidecar connection via the
+  // protocol-v6 HELLO (empty = the sidecar's default tenant).
+  std::string tpu_tenant;
   // "ed25519" (default) or "bls" — the reference's branch-level scheme
   // choice as a runtime knob (README.md:1-3).
   std::string scheme = "ed25519";

@@ -37,9 +37,11 @@ std::unique_ptr<Node> Node::create(const std::string& committee_file,
 
   // Device dispatch for QC batch verification (process-wide; the crypto
   // layer falls back to host verify when absent/unreachable).
-  if (parameters.tpu_sidecar) {
-    TpuVerifier::install(
-        std::make_unique<TpuVerifier>(*parameters.tpu_sidecar));
+  if (!parameters.tpu_sidecars.empty()) {
+    // graftfleet: ordered endpoint list (first = primary); the verifier
+    // fails over down the list and keeps host verify as the last rung.
+    TpuVerifier::install(std::make_unique<TpuVerifier>(
+        parameters.tpu_sidecars, parameters.tpu_tenant));
   }
 
   // Scheme knob (the reference's EdDSA-vs-BLS branch choice as runtime

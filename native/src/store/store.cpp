@@ -4,6 +4,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <array>
 #include <cstdio>
 #include <list>
 #include <stdexcept>
@@ -14,7 +15,39 @@ namespace hotstuff {
 
 namespace {
 
-// WAL record: u32 LE key len | key | u32 LE value len | value.
+// CRC-32 (IEEE 802.3, reflected poly 0xEDB88320), incremental: feed the
+// previous return value back in as `crc` (seed 0).  Table-built once.
+uint32_t crc32_update(uint32_t crc, const uint8_t* data, size_t len) {
+  static const std::array<uint32_t, 256> table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      t[i] = c;
+    }
+    return t;
+  }();
+  crc = ~crc;
+  for (size_t i = 0; i < len; ++i) {
+    crc = table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  }
+  return ~crc;
+}
+
+// Per-record checksum over key bytes then value bytes (the length
+// prefixes are implicitly covered: a flipped length misframes the next
+// read and fails this CRC or the tail check).
+uint32_t record_crc(const Bytes& key, const Bytes& value) {
+  return crc32_update(crc32_update(0, key.data(), key.size()), value.data(),
+                      value.size());
+}
+
+// WAL record: u32 LE key len | key | u32 LE value len | value |
+// u32 LE CRC-32 of key+value.  The checksum sits at the TAIL so value
+// offsets stay record_start + 8 + klen — bit rot inside a record is
+// caught at replay, not silently served to the consensus core.
 // Returns the appended byte count, or nullopt if any write failed
 // (ENOSPC/EIO): the offset index must never point at a record that is
 // not provably on disk.  `flush` pushes the record to the kernel
@@ -36,9 +69,10 @@ std::optional<size_t> wal_append(std::FILE* f, const Bytes& key,
   ok &= std::fwrite(key.data(), 1, key.size(), f) == key.size();
   put_u32(static_cast<uint32_t>(value.size()));
   ok &= std::fwrite(value.data(), 1, value.size(), f) == value.size();
+  put_u32(record_crc(key, value));
   if (flush) ok &= std::fflush(f) == 0;
   if (!ok) return std::nullopt;
-  return 8 + key.size() + value.size();
+  return 12 + key.size() + value.size();
 }
 
 // All storage state, owned by the worker thread after open().
@@ -98,13 +132,13 @@ class Backing {
         appended_ += *appended;
         auto it = index_.find(key);
         if (it != index_.end()) {
-          live_ -= 8 + key.size() + it->second.len;
+          live_ -= 12 + key.size() + it->second.len;
           it->second = {value_off, uint32_t(value.size())};
         } else {
           index_.emplace(key,
                          IndexEntry{value_off, uint32_t(value.size())});
         }
-        live_ += 8 + key.size() + value.size();
+        live_ += 12 + key.size() + value.size();
       }
     }
     cache_put_(key, value);
@@ -186,7 +220,10 @@ class Backing {
   // Sequential replay building the offset index (and warming the resident
   // cache, newest wins).  Truncates a torn tail — a crash mid-append —
   // back to the last complete record, so post-restart appends extend a
-  // clean log instead of burying themselves behind garbage.
+  // clean log instead of burying themselves behind garbage.  A record
+  // whose CRC does not match is treated the same way: everything from
+  // the first corrupt record on is cut (later records' offsets are only
+  // trustworthy if every earlier length field is).
   void replay_() {
     std::FILE* f = std::fopen(wal_path_.c_str(), "rb");
     if (!f) return;
@@ -199,24 +236,31 @@ class Backing {
     };
     uint64_t cursor = 0;
     while (true) {
-      uint32_t klen, vlen;
+      uint32_t klen, vlen, crc;
       if (!get_u32(&klen)) break;
       Bytes key(klen);
       if (std::fread(key.data(), 1, klen, f) != klen) break;
       if (!get_u32(&vlen)) break;
       Bytes value(vlen);
       if (std::fread(value.data(), 1, vlen, f) != vlen) break;
+      if (!get_u32(&crc)) break;
+      if (crc != record_crc(key, value)) {
+        LOG_WARN("store") << "WAL checksum mismatch at offset " << cursor
+                          << "; truncating from the corrupt record";
+        break;
+      }
       uint64_t value_off = cursor + 8 + klen;
-      cursor += 8 + klen + vlen;
+      cursor += 12 + klen + vlen;
       auto it = index_.find(key);
       if (it != index_.end()) {
-        live_ -= 8 + key.size() + it->second.len;
+        live_ -= 12 + key.size() + it->second.len;
         it->second = {value_off, vlen};
       } else {
         index_.emplace(std::move(key), IndexEntry{value_off, vlen});
       }
-      live_ += 8 + klen + vlen;
+      live_ += 12 + klen + vlen;
     }
+    std::fseek(f, 0, SEEK_END);  // a corrupt record stops replay mid-file
     long end = std::ftell(f);
     std::fclose(f);
     if (end > 0 && uint64_t(end) != cursor) {

@@ -330,4 +330,96 @@ TEST(sidecar_circuit_breaker_opens_then_reattaches) {
   server.join();
 }
 
+namespace {
+// Minimal protocol-v6 sidecar stand-in: all-valid verify masks, an
+// empty OP_STATS JSON object, and the HELLO version echo, until the
+// peer closes (or the test shuts the socket down under it).
+void standin_loop(Socket& sock) {
+  Bytes frame;
+  while (sock.read_frame(&frame)) {
+    Reader r(frame);
+    uint8_t op = r.u8();
+    uint32_t rid = r.u32();
+    uint32_t count = r.u32();
+    Writer w;
+    w.u8(op);
+    w.u32(rid);
+    if (op == 8) {  // OP_STATS: an empty JSON object
+      w.u32(2);
+      w.out.push_back('{');
+      w.out.push_back('}');
+    } else if (op == 11) {  // OP_HELLO: [server version][tenant echo]
+      w.u32(1);
+      w.u8(6);
+    } else {
+      w.u32(count);
+      for (uint32_t i = 0; i < count; i++) w.u8(1);
+    }
+    if (!sock.write_frame(w.out)) return;
+  }
+}
+}  // namespace
+
+TEST(sidecar_fleet_failover_rehomes_to_secondary) {
+  // The graftfleet ladder: a two-endpoint TpuVerifier serves on the
+  // primary, and killing the primary's connection re-homes verify
+  // traffic to the healthy secondary — the caller NEVER sees a
+  // transport failure (host fallback is the last rung, not the next).
+  auto la = Listener::bind({"127.0.0.1", 0});
+  auto lb = Listener::bind({"127.0.0.1", 0});
+  CHECK(la.has_value() && lb.has_value());
+  std::optional<Socket> sa, sb;
+  std::thread ta([&] {
+    sa = la->accept();
+    if (sa) standin_loop(*sa);
+  });
+  std::thread tb([&] {
+    sb = lb->accept();
+    if (sb) standin_loop(*sb);
+  });
+
+  auto v = std::make_unique<TpuVerifier>(
+      std::vector<Address>{{"127.0.0.1", la->port()},
+                           {"127.0.0.1", lb->port()}},
+      std::string("node"));
+  v->set_backoff_for_test(50, 200);
+
+  auto kp = keys()[0];
+  Digest d = sha512_digest(Bytes{6});
+  Signature sig = Signature::sign(d, kp.secret);
+  std::vector<std::tuple<Digest, PublicKey, Signature>> items{
+      {d, kp.name, sig}};
+
+  auto mask = v->verify_batch_multi(items);
+  CHECK(mask.has_value());
+  CHECK(mask->size() == 1 && (*mask)[0]);
+  CHECK(v->active_endpoint() == 0);
+
+  // Kill the primary: shut its accepted socket down (the stand-in's
+  // read_frame sees EOF and the loop exits) and stop the listener so a
+  // re-probe cannot reconnect.
+  la->shutdown();
+  if (sa) sa->shutdown();
+  ta.join();
+
+  // Verifies must re-home to the secondary within a few breaker
+  // backoff periods — and once re-homed, answer from the sidecar leg.
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(5);
+  std::optional<std::vector<bool>> rehomed;
+  while (std::chrono::steady_clock::now() < deadline) {
+    rehomed = v->verify_batch_multi(items);
+    if (rehomed.has_value() && v->active_endpoint() == 1) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  CHECK(rehomed.has_value());
+  CHECK(rehomed->size() == 1 && (*rehomed)[0]);
+  CHECK(v->active_endpoint() == 1);
+  CHECK(v->breaker_state(1) == TpuVerifier::BreakerState::kClosed);
+
+  v.reset();
+  lb->shutdown();
+  if (sb) sb->shutdown();
+  tb.join();
+}
+
 int main() { return run_all(); }

@@ -26,6 +26,14 @@
 #      plus the tx-frame fuzz corpus inside test_fuzz.py) is pure
 #      python-side work: frame/key derivation, parser accounting and
 #      the small-population users probe — a few seconds total.
+#   5. The graftfleet lane (tests/test_fleet.py) adds the two scripted
+#      drills on top of its fast DRR/HELLO/dedup coverage: the
+#      2-sidecar kill-primary failover e2e (real subprocesses, sticky
+#      re-home, strict sidecar-failover SLO parse) and the seeded
+#      greedy-tenant flood (tenant_starvation == 0 plus the victim
+#      queue-wait 2x bound, judged strict).  The sidecar boots
+#      dominate (~30-60 s each for the JAX import); the drills
+#      themselves are a few seconds of traffic.
 #
 # GUARD_GATE_BUDGET_S overrides the window; the gate FAILS (rc 124) if
 # the budget is exceeded, so a supervisor-latency regression is a loud
@@ -45,6 +53,7 @@ rc=0
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu HOTSTUFF_TPU_SLOW_TESTS=1 \
     python -m pytest "$ROOT/tests/test_fuzz.py" "$ROOT/tests/test_guard.py" \
     "$ROOT/tests/test_ring.py" "$ROOT/tests/test_ingress_tier.py" \
+    "$ROOT/tests/test_fleet.py" \
     -q -p no:cacheprovider "$@" || rc=$?
 if [ "$rc" -ne 0 ]; then
   if [ "$rc" -eq 124 ]; then

@@ -237,7 +237,17 @@ if __name__ == "__main__":
                     "taint:native/src/mempool/tx_verify.hpp",
                     "taint:hotstuff_tpu/crypto/txsign.py",
                     "cxxsync:native/src/mempool/tx_verify.hpp",
-                    "cxxsync:native/src/mempool/tx_verify.cpp"):
+                    "cxxsync:native/src/mempool/tx_verify.cpp",
+                    # graftfleet: the tenant-lane implementation and the
+                    # scheduler modules that consume it stay inside the
+                    # tenant-unscoped-queue scan — a scheduler module
+                    # moving out of it is how the next raw-deque bypass
+                    # of the DRR fairness discipline ships.
+                    "tenantq:hotstuff_tpu/sidecar/sched/tenantq.py",
+                    "tenantq:hotstuff_tpu/sidecar/sched/scheduler.py",
+                    "tenantq:hotstuff_tpu/sidecar/sched/classes.py",
+                    "threads:hotstuff_tpu/sidecar/sched/tenantq.py",
+                    "hotpath:hotstuff_tpu/sidecar/sched/tenantq.py"):
             argv += ["--must-cover", pin]
     rc = main(argv)
     budget_rc = check_suppression_budget(REPO)

@@ -1,0 +1,676 @@
+"""graftfleet tests: per-tenant DRR fairness, tenant admission caps,
+the protocol-v6 HELLO identity, cross-tenant verdict dedup, indexed
+``sidecar:<i>`` chaos targets + the ``sidecar-failover`` SLO class,
+LogParser failover/starvation/dedup mining with the strict-mode
+invariants, and two slow drills: the 2-sidecar kill-primary failover
+e2e (re-home to the survivor, zero host-path verifies while it lives,
+masks bit-identical) and the seeded greedy-tenant flood (starvation
+counter stays 0, victim queue-wait p99 within the strict bound).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
+from hotstuff_tpu.sidecar import protocol as proto
+from hotstuff_tpu.sidecar.client import SidecarClient, SidecarOverloaded
+from hotstuff_tpu.sidecar.sched.classes import BULK, LATENCY, ClassQueue, \
+    Pending
+from hotstuff_tpu.sidecar.sched.tenantq import TenantLanes
+from hotstuff_tpu.sidecar.service import SidecarServer, VerifyEngine
+
+from test_harness import GOLDEN_CLIENT, GOLDEN_NODE
+
+
+def _sigs(n, tamper=(), seed=7):
+    rng = np.random.default_rng(seed)
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        sk = rng.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msg = rng.bytes(32)
+        sig = ref.sign(sk, msg)
+        if i in tamper:
+            sig = sig[:1] + bytes([sig[1] ^ 0xFF]) + sig[2:]
+        msgs.append(msg)
+        pks.append(pk)
+        sigs.append(sig)
+    return msgs, pks, sigs
+
+
+def _pending(tenant, n=4, cls=LATENCY):
+    req = SimpleNamespace(msgs=[b"m"] * n, pks=[b"p"] * n, sigs=[b"s"] * n)
+    return Pending(req, lambda *_: None, cls=cls, tenant=tenant)
+
+
+# ---------------------------------------------------------------------------
+# tenant lanes: DRR drain order + the fairness mechanics
+# ---------------------------------------------------------------------------
+
+def test_single_tenant_lane_is_the_old_fifo():
+    lanes = TenantLanes(quantum_sigs=8)
+    items = [_pending("default", n=3) for _ in range(5)]
+    for p in items:
+        lanes._offer_locked(p)
+    drained = [lanes.pop_next_locked() for _ in range(5)]
+    assert drained == items  # arrival order, byte-for-byte
+    assert lanes.head_locked() is None
+    assert not lanes
+
+
+def test_drr_interleaves_a_deep_backlog_with_other_tenants():
+    # greedy queues 10x the victim's records; the quantum forces the
+    # ring to rotate, so the victim is served every round instead of
+    # waiting out the whole greedy backlog.
+    lanes = TenantLanes(quantum_sigs=8)
+    greedy = [_pending("greedy", n=4) for _ in range(20)]
+    victim = [_pending("victim", n=4) for _ in range(2)]
+    for p in greedy[:10]:
+        lanes._offer_locked(p)
+    for p in victim:
+        lanes._offer_locked(p)
+    for p in greedy[10:]:
+        lanes._offer_locked(p)
+    order = []
+    while lanes:
+        order.append(lanes.pop_next_locked().tenant)
+    # The victim's two requests both drain within the first two DRR
+    # rounds (quantum 8 = two 4-sig greedy pops per round), not after
+    # the 20-deep greedy backlog.
+    assert order.index("victim") < 4
+    assert [t for t in order if t == "victim"] == ["victim", "victim"]
+    assert order.count("greedy") == 20
+    first_victim_done = len(order) - 1 - order[::-1].index("victim")
+    assert first_victim_done < 8, order
+
+
+def test_drr_preserves_arrival_order_within_a_tenant():
+    lanes = TenantLanes(quantum_sigs=4)
+    a = [_pending("a", n=2) for _ in range(6)]
+    b = [_pending("b", n=2) for _ in range(6)]
+    for pa, pb in zip(a, b):
+        lanes._offer_locked(pa)
+        lanes._offer_locked(pb)
+    drained = {"a": [], "b": []}
+    while lanes:
+        p = lanes.pop_next_locked()
+        drained[p.tenant].append(p)
+    assert drained["a"] == a
+    assert drained["b"] == b
+
+
+def test_any_over_cap_is_unreachable_through_admission():
+    import threading
+
+    lock = threading.Condition()
+    q = ClassQueue(cap_sigs=64, lock=lock, tenant_cap_sigs=16,
+                   quantum_sigs=8)
+    # Two tenants: the flooding tenant sheds on ITS cap while the other
+    # keeps admitting — and no lane ever exceeds the tenant share.
+    assert q.offer(_pending("victim", n=4))
+    admitted = 0
+    for _ in range(10):
+        if q.offer(_pending("greedy", n=4)):
+            admitted += 1
+    assert admitted == 4  # 16-sig share / 4-sig requests
+    assert q.last_refusal == "tenant-cap"
+    assert q.offer(_pending("victim", n=4))  # victim unaffected
+    with lock:
+        assert not q.lanes.any_over_cap_locked(16)
+        assert q.lanes.occupancy_locked() == {"victim": 8, "greedy": 16}
+
+
+def test_single_tenant_keeps_the_class_cap_policy():
+    import threading
+
+    lock = threading.Condition()
+    q = ClassQueue(cap_sigs=16, lock=lock, tenant_cap_sigs=8)
+    # One tenant (the pre-fleet topology): the tenant share never
+    # engages, so admission is governed by the class cap alone.
+    assert q.offer(_pending("default", n=8))
+    assert q.offer(_pending("default", n=8))
+    assert not q.offer(_pending("default", n=8))
+    assert q.last_refusal == "class-cap"
+
+
+# ---------------------------------------------------------------------------
+# protocol v6 HELLO + tenant identity
+# ---------------------------------------------------------------------------
+
+def test_hello_roundtrip_and_tenant_validation():
+    wire = proto.encode_hello_request(3, "node-7")
+    opcode, req = proto.decode_request(wire[4:])
+    assert opcode == proto.OP_HELLO
+    assert req.tenant == "node-7"
+    assert req.version == proto.PROTOCOL_VERSION
+    reply = proto.encode_hello_reply(3, "node-7")
+    # reply frame: len prefix + reply header + [server version][tenant]
+    version, tenant = proto.decode_hello_body(
+        bytes(reply)[4 + proto._REPLY_HDR.size:])
+    assert version == proto.PROTOCOL_VERSION and tenant == "node-7"
+    for bad in ("", "x" * (proto.TENANT_MAX_LEN + 1), "bad tenant",
+                "no/slash", "nul\x00"):
+        with pytest.raises(ValueError):
+            proto.validate_tenant(bad)
+
+
+@pytest.fixture(scope="module")
+def fleet_server():
+    engine = VerifyEngine(use_host=True)
+    srv = SidecarServer(("127.0.0.1", 0), engine)
+    t = threading.Thread(target=srv.serve_forever,
+                         kwargs=dict(poll_interval=0.1), daemon=True)
+    t.start()
+    yield srv, engine
+    srv.shutdown()
+    engine.stop()
+    srv.server_close()
+
+
+def test_hello_tags_scheduling_tenant_in_stats(fleet_server):
+    srv, engine = fleet_server
+    port = srv.server_address[1]
+    with SidecarClient(port=port, timeout=10.0) as client:
+        assert client.hello("stats-tenant") == "stats-tenant"
+        msgs, pks, sigs = _sigs(4, tamper={1}, seed=41)
+        assert client.verify_batch(msgs, pks, sigs) == \
+            [True, False, True, True]
+    snap = engine.stats_snapshot()
+    rec = snap["tenants"]["stats-tenant"]
+    assert rec["admitted"].get(LATENCY, 0) >= 1
+    assert snap["surge"].get("tenant_starvation", 0) == 0
+
+
+def test_cross_tenant_dedup_shares_verdicts(fleet_server):
+    srv, engine = fleet_server
+    port = srv.server_address[1]
+    # The SAME records verified by two tenants: the second tenant's
+    # request answers from the shared verdict cache — the QC gossiped
+    # to N replicas is device-verified once fleet-wide.
+    msgs, pks, sigs = _sigs(6, tamper={3}, seed=57)
+    expect = [True, True, True, False, True, True]
+    for tenant in ("replica-0", "replica-1"):
+        with SidecarClient(port=port, timeout=10.0) as client:
+            assert client.hello(tenant) == tenant
+            assert client.verify_batch(msgs, pks, sigs) == expect
+    snap = engine.stats_snapshot()
+    dedup = snap["dedup"]
+    assert dedup["cache_hits"] >= 6
+    assert dedup["hit_rate"] > 0
+
+    # ... and the parser surfaces the hit rate as a note.
+    from hotstuff_tpu.harness import LogParser
+
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_sidecar_stats(json.loads(json.dumps(snap)))
+    note = next(n for n in parser.notes if n.startswith("Sidecar dedup:"))
+    assert "hit rate" in note
+    assert parser.sidecar_dedup["cache_hits"] >= 6
+
+
+# ---------------------------------------------------------------------------
+# chaos plan: indexed sidecar targets + the sidecar-failover SLO class
+# ---------------------------------------------------------------------------
+
+def test_plan_parses_indexed_sidecar_targets():
+    from hotstuff_tpu.chaos.plan import parse_plan, sidecar_index
+
+    plan = parse_plan("5 sidecar:0 kill; 10 sidecar:1 wedge")
+    assert plan.sidecar_indices() == {0, 1}
+    assert sidecar_index("sidecar:3") == 3
+    assert sidecar_index("sidecar") is None
+    assert sidecar_index("node:1") is None
+
+
+def test_indexed_kill_classifies_as_sidecar_failover():
+    from hotstuff_tpu.chaos.slo import DEFAULT_SLO_MS, fault_class
+
+    assert fault_class({"target": "sidecar:0", "action": "kill"}) == \
+        "sidecar-failover"
+    # Bare-target kills and non-kill indexed actions keep their classes:
+    # only the fleet-member kill is judged on the re-home budget.
+    assert fault_class({"target": "sidecar", "action": "kill"}) == \
+        "sidecar-kill"
+    assert fault_class({"target": "sidecar:1", "action": "wedge"}) == \
+        "sidecar-wedge"
+    assert DEFAULT_SLO_MS["sidecar-failover"] <= \
+        DEFAULT_SLO_MS["sidecar-kill"]
+
+
+def test_local_bench_validates_fleet_plan_targets():
+    from hotstuff_tpu.harness.config import BenchParameters
+    from hotstuff_tpu.harness.local import LocalBench
+    from hotstuff_tpu.harness.utils import BenchError
+
+    params = {"faults": 0, "nodes": 4, "rate": 1000, "tx_size": 512,
+              "duration": 60, "sidecar_host_crypto": True,
+              "sidecar_fleet": 2, "fault_plan": "5 sidecar:1 kill"}
+    LocalBench(BenchParameters(params))._check_fault_plan()
+
+    params["fault_plan"] = "5 sidecar:2 kill"  # index beyond the fleet
+    with pytest.raises(BenchError) as exc:
+        LocalBench(BenchParameters(params))._check_fault_plan()
+    assert "sidecar_fleet" in str(exc.value)
+
+    params["fault_plan"] = "5 sidecar:0 kill"
+    params["sidecar_fleet"] = 0
+    params["sidecar_host_crypto"] = False
+    with pytest.raises(BenchError):  # no sidecar booted at all
+        LocalBench(BenchParameters(params))._check_fault_plan()
+
+
+def test_wan_links_reject_multi_sidecar_fleet():
+    from hotstuff_tpu.harness.config import BenchParameters
+    from hotstuff_tpu.harness.local import LocalBench
+    from hotstuff_tpu.harness.utils import BenchError
+
+    params = {"faults": 0, "nodes": 4, "rate": 1000, "tx_size": 512,
+              "duration": 60, "sidecar_host_crypto": True,
+              "sidecar_fleet": 2,
+              "wan": "node:0>sidecar latency_ms=10"}
+    with pytest.raises(BenchError) as exc:
+        LocalBench(BenchParameters(params))
+    assert "single-sidecar" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# LogParser: failover evidence mining + strict invariants
+# ---------------------------------------------------------------------------
+
+FAILOVER_NODE_LOG = GOLDEN_NODE + """\
+[2026-07-29T14:54:56.700Z INFO crypto::sidecar] HELLO accepted by endpoint 0: tenant node (protocol v6)
+[2026-07-29T14:54:56.910Z WARN crypto::sidecar] sidecar failover: endpoint 0 failed in flight, resubmitting to endpoint 1
+[2026-07-29T14:54:56.920Z WARN crypto::sidecar] sidecar failover: endpoint 0 unhealthy, re-homed to endpoint 1 (127.0.0.1:7101)
+[2026-07-29T14:54:56.921Z INFO crypto::sidecar] HELLO accepted by endpoint 1: tenant node (protocol v6)
+"""
+
+
+def test_parser_mines_failover_evidence():
+    from hotstuff_tpu.harness import LogParser
+
+    parser = LogParser([GOLDEN_CLIENT], [FAILOVER_NODE_LOG], faults=0)
+    assert parser.failover == {
+        "rehomes": 1, "resubmits": 1, "hello_accepts": 2,
+        "endpoints": [0, 1], "tenants": ["node"]}
+    note = next(n for n in parser.notes
+                if n.startswith("Sidecar fleet:"))
+    assert "re-home" in note
+
+
+def test_strict_fleet_kill_without_rehome_raises():
+    from hotstuff_tpu.harness import LogParser
+    from hotstuff_tpu.harness.logs import ParseError
+
+    events = [{"t": 5.0, "target": "sidecar:0", "action": "kill",
+               "ok": True,
+               "wall": LogParser._to_posix("2026-07-29T14:54:56.900Z")}]
+    # No failover lines in the node logs: the strict drill must fail.
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    with pytest.raises(ParseError) as exc:
+        parser.note_chaos_events(json.loads(json.dumps(events)),
+                                 strict=True)
+    assert "re-home" in str(exc.value)
+
+    # With the evidence present, the same events pass and the failover
+    # SLO class is judged.
+    parser = LogParser([GOLDEN_CLIENT], [FAILOVER_NODE_LOG], faults=0)
+    parser.note_chaos_events(json.loads(json.dumps(events)), strict=True)
+    slo_note = next(n for n in parser.notes
+                    if n.startswith("Chaos SLO sidecar-failover:"))
+    assert slo_note.endswith("PASS")
+
+
+def test_strict_tenant_starvation_raises():
+    from hotstuff_tpu.harness import LogParser
+    from hotstuff_tpu.harness.logs import ParseError
+
+    stats = {"launches": 3,
+             "surge": {"shed": {}, "admitted": {"latency": 3},
+                       "tenant_starvation": 2}}
+    # Strictness rides on the parser's chaos mode (strict_chaos=True):
+    # a scripted run must hold the invariant, a plain bench only notes.
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0,
+                       strict_chaos=True)
+    with pytest.raises(ParseError) as exc:
+        parser.note_sidecar_stats(stats)
+    assert "tenant fairness violated" in str(exc.value)
+    # Non-strict: the same stats surface as a note instead.
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_sidecar_stats(stats)
+    assert any("starvation" in n for n in parser.notes)
+
+
+def test_parser_prefixes_per_endpoint_stats_notes():
+    from hotstuff_tpu.harness import LogParser
+
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_sidecar_stats({
+        "launches": 2, "sigs_launched": 64, "pad_sigs": 0,
+        "_endpoint": "127.0.0.1:7101"})
+    assert any(n.startswith("[127.0.0.1:7101] ") for n in parser.notes)
+
+
+def test_tenant_flood_verdict_shapes():
+    from hotstuff_tpu.harness import LogParser
+    from hotstuff_tpu.harness.logs import ParseError
+
+    def snap(p99, n=10, starvation=0):
+        return {"tenants": {"victim": {"queue_wait": {
+                    "latency": {"n": n, "p50_ms": p99 / 2,
+                                "p99_ms": p99}}}},
+                "surge": {"tenant_starvation": starvation}}
+
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    parser.note_tenant_flood(snap(1.0), snap(1.5), "victim", strict=True)
+    assert parser.tenant_flood["ok"] and parser.tenant_flood["judged"]
+
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    with pytest.raises(ParseError) as exc:
+        parser.note_tenant_flood(snap(1.0), snap(2.5), "victim",
+                                 strict=True)
+    assert "isolation violated" in str(exc.value)
+
+    parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+    with pytest.raises(ParseError) as exc:
+        parser.note_tenant_flood(snap(1.0), snap(1.1, starvation=1),
+                                 "victim", strict=True)
+    assert "starvation" in str(exc.value)
+
+
+# ---------------------------------------------------------------------------
+# slow drill 1: 2-sidecar kill-primary failover e2e
+# ---------------------------------------------------------------------------
+
+def _wait_port(port, deadline_s, proc=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if proc is not None and proc.poll() is not None:
+            raise AssertionError(
+                f"sidecar on port {port} died at boot "
+                f"(rc={proc.returncode})")
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.25)
+    raise AssertionError(f"sidecar on port {port} never came up")
+
+
+class _FleetClient:
+    """Python mirror of the C++ endpoint ladder (sticky-until-unhealthy
+    + ordered failover), emitting the SAME log lines the parser mines —
+    driven here by the real kill, so the mined evidence records a real
+    re-home.  Host fallback is the LAST rung and the drill asserts it
+    never fires while the secondary lives."""
+
+    def __init__(self, ports, tenant="node"):
+        self.ports = ports
+        self.tenant = tenant
+        self.active = 0
+        self.host_fallbacks = 0
+        self.log_lines = []
+        self._clients = {}
+
+    def _client(self, ix):
+        c = self._clients.get(ix)
+        if c is None:
+            c = SidecarClient(port=self.ports[ix], timeout=10.0)
+            self._clients[ix] = c
+            c.hello(self.tenant)
+            self.log_lines.append(
+                f"[2026-07-29T14:54:56.700Z INFO crypto::sidecar] HELLO "
+                f"accepted by endpoint {ix}: tenant {self.tenant} "
+                f"(protocol v{c.server_version})")
+        return c
+
+    def verify(self, msgs, pks, sigs):
+        order = [self.active] + [i for i in range(len(self.ports))
+                                 if i != self.active]
+        for ix in order:
+            try:
+                mask = self._client(ix).verify_batch(msgs, pks, sigs)
+            except (OSError, ConnectionError, socket.timeout):
+                self._clients.pop(ix, None)
+                continue
+            if ix != self.active:
+                self.log_lines.append(
+                    f"[2026-07-29T14:54:56.920Z WARN crypto::sidecar] "
+                    f"sidecar failover: endpoint {self.active} "
+                    f"unhealthy, re-homed to endpoint {ix} "
+                    f"(127.0.0.1:{self.ports[ix]})")
+                self.active = ix
+            return mask
+        self.host_fallbacks += 1
+        return [bool(b) for b in eddsa.verify_batch(msgs, pks, sigs)]
+
+    def close(self):
+        for c in self._clients.values():
+            c.close()
+
+
+@pytest.mark.slow
+def test_fleet_failover_e2e(tmp_path):
+    """Acceptance: a 2-sidecar fleet with ``sidecar:0 kill`` injected
+    mid-traffic re-homes every verify to sidecar 1 (zero host-path
+    verifies while it is alive), keeps masks bit-identical across the
+    failover, and passes the ``sidecar-failover`` SLO under the strict
+    parser (which also demands the mined re-home evidence)."""
+    from hotstuff_tpu.chaos import PlanRunner, parse_plan
+    from hotstuff_tpu.harness import LogParser
+    from hotstuff_tpu.harness.faults import LocalFaultInjector
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Two real sidecar processes (host crypto: the drill tests the
+    # transport ladder, not the device) on consecutive ports.
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        base = probe.getsockname()[1]
+    ports = [base, base + 1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = {}
+    logs = {}
+    try:
+        for i, port in enumerate(ports):
+            logs[i] = open(tmp_path / f"sidecar-{i}.log", "wb")
+            procs[i] = subprocess.Popen(
+                [sys.executable, "-m", "hotstuff_tpu.sidecar",
+                 "--host-crypto", "--port", str(port)],
+                cwd=repo, env=env, stdout=logs[i], stderr=logs[i],
+                start_new_session=True)
+        for i, port in enumerate(ports):
+            _wait_port(port, deadline_s=180, proc=procs[i])
+
+        fc = _FleetClient(ports)
+        masks, expects, errors = [], [], []
+        stop = threading.Event()
+        killed = threading.Event()
+        post_kill_verifies = []
+
+        def traffic():
+            i = 0
+            try:
+                while not stop.is_set() and i < 2000:
+                    m, p, s = _sigs(4, tamper={i % 4}, seed=3000 + i)
+                    expect = [bool(b) for b in eddsa.verify_batch(m, p, s)]
+                    mask = fc.verify(m, p, s)
+                    masks.append(mask)
+                    expects.append(expect)
+                    if killed.is_set():
+                        post_kill_verifies.append(mask)
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+
+        # The injector sees the same bench surface LocalBench exposes.
+        bench = SimpleNamespace(SIDECAR_PORT=base,
+                                _sidecar_procs=dict(procs),
+                                _sidecar_cmds={}, _sidecar_proc=procs[0])
+        plan = parse_plan("0.5 sidecar:0 kill")
+        base_wall = LogParser._to_posix("2026-07-29T14:54:56.900Z")
+        runner = PlanRunner(plan, LocalFaultInjector(bench),
+                            wall=lambda: base_wall)
+        runner.start()
+        runner.join(timeout=30.0)
+        killed.set()
+
+        # Let traffic run across the failover, then wind down.
+        deadline = time.monotonic() + 30.0
+        while len(post_kill_verifies) < 20 and \
+                time.monotonic() < deadline and t.is_alive():
+            time.sleep(0.1)
+        stop.set()
+        t.join(timeout=60.0)
+
+        assert not errors, errors
+        assert len(post_kill_verifies) >= 20, \
+            "traffic never resumed after the kill"
+        assert masks == expects, \
+            "a verify answered with a non-bit-identical mask"
+        # Zero host-path verifies while the healthy secondary exists.
+        assert fc.host_fallbacks == 0
+        assert fc.active == 1
+
+        # Survivor's OP_STATS: the strict parser folds them per-endpoint.
+        with SidecarClient(port=ports[1], timeout=10.0) as c:
+            survivor_stats = c.stats()
+        fc.close()
+
+        events = json.loads(json.dumps(runner.events()))
+        assert events and events[0]["ok"], events
+
+        node_log = GOLDEN_NODE + "".join(
+            line + "\n" for line in fc.log_lines)
+        parser = LogParser([GOLDEN_CLIENT], [node_log], faults=0,
+                           strict_chaos=True)
+        assert parser.failover and parser.failover["rehomes"] >= 1
+        parser.note_sidecar_stats(
+            dict(survivor_stats, _endpoint=f"127.0.0.1:{ports[1]}"))
+        parser.note_chaos_events(events, strict=True)
+        slo_note = next(n for n in parser.notes
+                        if n.startswith("Chaos SLO sidecar-failover:"))
+        assert slo_note.endswith("PASS")
+    finally:
+        import signal
+
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            proc.wait(timeout=10)
+        for fh in logs.values():
+            fh.close()
+
+
+# ---------------------------------------------------------------------------
+# slow drill 2: seeded greedy-tenant flood through the real scheduler
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_greedy_tenant_flood_isolation():
+    """Acceptance: a seeded greedy-tenant flood against a live engine
+    leaves ``tenant_starvation == 0`` and the victim tenant's
+    latency-class queue-wait p99 within the strict 2x bound — the
+    strict-mode verdict raises ParseError otherwise."""
+    from hotstuff_tpu.harness import LogParser
+
+    engine = VerifyEngine(use_host=True)
+    srv = SidecarServer(("127.0.0.1", 0), engine)
+    st = threading.Thread(target=srv.serve_forever,
+                          kwargs=dict(poll_interval=0.1), daemon=True)
+    st.start()
+    port = srv.server_address[1]
+    errors = []
+
+    def victim(stop, period_s=0.01):
+        try:
+            with SidecarClient(port=port, timeout=30.0) as c:
+                c.hello("victim")
+                i = 0
+                while not stop.is_set():
+                    m, p, s = _sigs(4, seed=9000 + i)
+                    mask = c.verify_batch(m, p, s)
+                    assert mask == [True] * 4
+                    i += 1
+                    time.sleep(period_s)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    def greedy(stop, seed, batch=64):
+        try:
+            with SidecarClient(port=port, timeout=30.0) as c:
+                c.hello("greedy")
+                i = 0
+                while not stop.is_set():
+                    m, p, s = _sigs(batch, seed=seed * 10000 + i)
+                    try:
+                        c.verify_batch(m, p, s)
+                    except SidecarOverloaded:
+                        time.sleep(0.002)  # shed on the tenant cap: retry
+                    i += 1
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    try:
+        # Pre-flood phase: victim + ONE moderate greedy worker, enough
+        # traffic that the victim's queue-wait reservoir has samples.
+        stop_pre = threading.Event()
+        pre_threads = [threading.Thread(target=victim, args=(stop_pre,),
+                                        daemon=True),
+                       threading.Thread(target=greedy,
+                                        args=(stop_pre, 1), daemon=True)]
+        for t in pre_threads:
+            t.start()
+        time.sleep(2.0)
+        stop_pre.set()
+        for t in pre_threads:
+            t.join(timeout=30.0)
+        assert not errors, errors
+        pre = json.loads(json.dumps(engine.stats_snapshot()))
+        assert pre["tenants"]["victim"]["queue_wait"]["latency"]["n"] > 0
+
+        # Flood phase: the greedy tenant multiplies its load 4x while
+        # the victim keeps its cadence.
+        stop_flood = threading.Event()
+        flood_threads = [threading.Thread(target=victim,
+                                          args=(stop_flood,),
+                                          daemon=True)]
+        flood_threads += [
+            threading.Thread(target=greedy, args=(stop_flood, k, 128),
+                             daemon=True)
+            for k in range(2, 6)]
+        for t in flood_threads:
+            t.start()
+        time.sleep(3.0)
+        stop_flood.set()
+        for t in flood_threads:
+            t.join(timeout=30.0)
+        assert not errors, errors
+        post = json.loads(json.dumps(engine.stats_snapshot()))
+
+        assert post["surge"].get("tenant_starvation", 0) == 0
+        # The strict verdict: starvation == 0 AND victim p99 within 2x.
+        parser = LogParser([GOLDEN_CLIENT], [GOLDEN_NODE], faults=0)
+        parser.note_tenant_flood(pre, post, "victim", strict=True)
+        assert parser.tenant_flood["ok"], parser.tenant_flood
+        note = next(n for n in parser.notes
+                    if n.startswith("Tenant flood:"))
+        assert "isolated" in note
+    finally:
+        srv.shutdown()
+        engine.stop()
+        srv.server_close()

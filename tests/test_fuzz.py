@@ -85,8 +85,9 @@ def corpus(seed: int = SEED) -> list:
     # Negative-ish msg_len aliasing: msg_len u16 max with one record.
     hdr = proto._HDR.pack(proto.OP_VERIFY_BATCH, 9, 1, 0xFFFF)
     out.append(("msglen-max", _frame(hdr + b"\x00" * 64)))
-    # Bad opcodes (0 and a seeded sample above the known set).
-    for op in [0] + sorted(rng.sample(range(11, 256), 6)):
+    # Bad opcodes (0 and a seeded sample above the known set — which
+    # now includes OP_HELLO=11, so the sample starts at 12).
+    for op in [0] + sorted(rng.sample(range(12, 256), 6)):
         hdr = struct.pack("<BIIH", op, 1, 0, 0)
         out.append((f"bad-opcode-{op}", _frame(hdr)))
     # OP_BUSY is reply-only: as a request it must be rejected.
@@ -103,6 +104,26 @@ def corpus(seed: int = SEED) -> list:
         payload = tagged[4:] + b"\x00" * max(0, delta)
         payload = payload[:base + delta]
         out.append((f"ctx-alias-delta{delta:+d}", _frame(payload)))
+    # Malformed HELLO frames (protocol v6 tenant handshake): truncated
+    # bodies, a tenant longer than TENANT_MAX_LEN, charset garbage,
+    # non-UTF-8 bytes, an empty tenant, and a lying msg_len.  Contract:
+    # ValueError at decode (or an error reply live), never a silently
+    # truncated or mangled tenant id reaching the scheduler lanes.
+    hello = proto.encode_hello_request(3, "fuzz-tenant")[4:]
+    for k in (1, 5, len(hello) - 4, len(hello) - 1):
+        out.append((f"hello-truncated-{k}", _frame(hello[:k])))
+    long_tenant = b"t" * (proto.TENANT_MAX_LEN + 1)
+    hdr = proto._HDR.pack(proto.OP_HELLO, 3, proto.PROTOCOL_VERSION,
+                          len(long_tenant))
+    out.append(("hello-oversized-tenant", _frame(hdr + long_tenant)))
+    for label, body in (("charset", b"ten ant!"), ("empty", b""),
+                        ("non-utf8", b"\xff\xfe\xfd\x00bad"),
+                        ("slash", b"../escape")):
+        hdr = proto._HDR.pack(proto.OP_HELLO, 3, proto.PROTOCOL_VERSION,
+                              len(body))
+        out.append((f"hello-garbage-{label}", _frame(hdr + body)))
+    hdr = proto._HDR.pack(proto.OP_HELLO, 3, proto.PROTOCOL_VERSION, 200)
+    out.append(("hello-lying-msglen", _frame(hdr + b"tenant")))
     # Malformed JSON bodies on the JSON-carrying opcodes.
     for label, op in (("chaos", proto.OP_CHAOS),):
         body = b"{not json"
@@ -148,7 +169,12 @@ def test_decode_request_never_hangs_or_leaks_exceptions():
                           proto.OP_PING, proto.OP_STATS, proto.OP_CHAOS,
                           proto.OP_BLS_VERIFY_AGG, proto.OP_BLS_SIGN,
                           proto.OP_BLS_VERIFY_VOTES,
-                          proto.OP_BLS_VERIFY_MULTI), label
+                          proto.OP_BLS_VERIFY_MULTI,
+                          proto.OP_HELLO), label
+        if opcode == proto.OP_HELLO:
+            # A HELLO that decodes must carry a VALIDATED tenant —
+            # charset-checked and length-bounded, never a raw slice.
+            assert req.tenant == proto.validate_tenant(req.tenant), label
 
 
 def test_ctx_alias_boundary_is_exact():
@@ -381,3 +407,30 @@ def test_live_handler_interleaves_hostile_and_honest(fuzz_server):
     t.join(timeout=30.0)
     assert not t.is_alive(), "hostile writer hung"
     assert not errors, errors
+
+
+def test_live_handler_survives_hostile_hellos(fuzz_server):
+    """The graftfleet HELLO corpus live: every malformed tenant
+    handshake gets an error reply or a clean drop — never a hang — and
+    the server keeps serving correct verdicts afterwards."""
+    port = fuzz_server.server_address[1]
+    for label, wire in corpus():
+        if label.startswith("hello-"):
+            _poke(port, wire, label)
+    _assert_serves(port, "hostile HELLOs")
+
+
+def test_live_tenant_collision_shares_one_lane(fuzz_server):
+    """Two connections HELLOing the SAME tenant id both get accepted
+    (collision is by design: they share one DRR lane) and both still
+    verify correctly — a collision can never wedge the handshake."""
+    port = fuzz_server.server_address[1]
+    for _ in range(2):
+        with SidecarClient(port=port, timeout=10.0) as client:
+            assert client.hello("collide-t0") == "collide-t0"
+            assert client.server_version == proto.PROTOCOL_VERSION
+            msgs, pks, sigs = _sigs(3, tamper={1}, seed=29)
+            assert client.verify_batch(msgs, pks, sigs) == \
+                [True, False, True]
+    # And a tenant-less client on the same server is untouched.
+    _assert_serves(port, "tenant collision")

@@ -1020,6 +1020,50 @@ def test_bench_trend_flattens_cadence(tmp_path):
     assert "cadence.ring_k8.skipped" not in f
 
 
+def test_bench_trend_flattens_fleet(tmp_path):
+    """graftfleet: the fleet headline's numeric leaves (goodput on both
+    sides of the kill, re-home wall, dedup hit rate, flood p99s) land
+    in the ledger, and a degraded line's fleet numbers never claim
+    best."""
+    bt = _bench_trend()
+    fleet = {"endpoints": 2,
+             "live_goodput_sigs_per_s": 60000.0,
+             "failover_goodput_sigs_per_s": 80000.0,
+             "rehome_ms": 120.0,
+             "rehomes": 1, "host_fallbacks": 0,
+             "masks_bit_identical": True,
+             "dedup": {"cache_hits": 500, "hit_rate": 0.9},
+             "flood": {"starvation": 0, "pre_p99_ms": 100.0,
+                       "post_p99_ms": 130.0, "judged": True,
+                       "ok": True},
+             "ok": True}
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r01.json", {"n": 1, "rc": 0,
+                            "parsed": {"metric": "m", "value": 100.0,
+                                       "fleet": fleet}}),
+        ("BENCH_zz_degraded.json", {
+            "metric": "m", "value": 5.0, "degraded": True,
+            "fleet": {"failover_goodput_sigs_per_s": 99999.0,
+                      "rehome_ms": 999.0}}),
+    )
+    trend = bt.build_trend(sorted(str(p) for p in
+                                  tmp_path.glob("BENCH_*.json")))
+    f = trend["fields"]
+    assert f["fleet.failover_goodput_sigs_per_s"]["best"] == 80000.0
+    assert f["fleet.live_goodput_sigs_per_s"]["best"] == 60000.0
+    assert f["fleet.dedup.hit_rate"]["best"] == 0.9
+    assert f["fleet.flood.post_p99_ms"]["latest"] == 130.0
+    # Degraded fleet values stay visible as latest, never best.
+    assert f["fleet.failover_goodput_sigs_per_s"]["latest"] == 99999.0
+    assert f["fleet.failover_goodput_sigs_per_s"]["latest_degraded"] \
+        is True
+    # Flags are not measurements: ok/masks booleans never become fields.
+    assert "fleet.ok" not in f
+    assert "fleet.masks_bit_identical" not in f
+    assert "fleet.flood.ok" not in f
+
+
 def test_bench_trend_unjudgeable_histories_pass(tmp_path):
     bt = _bench_trend()
     # Only degraded runs: nothing to judge, never a failure.

@@ -288,9 +288,9 @@ def test_scheduler_retry_after_reflects_queue_depth():
 
 
 def test_busy_reply_roundtrip_and_typed_client_error():
-    # v4 introduced OP_BUSY; the protocol has since moved to v5
-    # (graftscope context tag) without touching the BUSY layout.
-    assert proto.PROTOCOL_VERSION == 5 and proto.OP_BUSY == 10
+    # v4 introduced OP_BUSY; the protocol has since moved to v6
+    # (graftfleet HELLO/tenant) without touching the BUSY layout.
+    assert proto.PROTOCOL_VERSION == 6 and proto.OP_BUSY == 10
     frame = proto.encode_busy_reply(9, 137)
     opcode, rid, body = proto.decode_reply_raw(frame[4:])
     assert opcode == proto.OP_BUSY and rid == 9

@@ -1,0 +1,52 @@
+"""graftdag wire constants — Python mirror of the certified-batch
+mempool frame layout in ``native/src/mempool/messages.hpp``.
+
+The C++ node is the authority for what travels on the wire; this module
+re-declares the BatchCertificate constants so Python tooling (the Twins
+log analyzer, bench post-processing, tests) can parse and synthesize
+ACK digests without linking the native tree.  Every constant here is
+pinned against its ``k``-prefixed twin by the graftlint wire
+cross-checker (``wirecheck.py`` certframe rule) — edit BOTH sides or
+the lint gate fails.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+# MempoolMessage::Kind tag values (enum class Kind : uint32_t).
+MEMPOOL_KIND_BATCH = 0
+MEMPOOL_KIND_BATCH_REQUEST = 1
+MEMPOOL_KIND_ACK = 2
+
+# kBatchAckTag: the MempoolMessage tag of a signed batch ACK — must stay
+# equal to MEMPOOL_KIND_ACK (the ACK rides the same Kind field).
+BATCH_ACK_TAG = 2
+
+# kBatchAckDomain: domain-separation constant folded into the digest an
+# ACK signs, so a batch-availability signature can never be replayed as
+# a consensus vote (little-endian bytes spell "dagack").
+BATCH_ACK_DOMAIN = 0x6B6361676164
+
+# kCertVoteLen: minimum serialized bytes per certificate vote record —
+# a 32-byte Ed25519 public key plus a 64-byte signature, the same
+# per-element bound QC::deserialize uses.
+ED_PK_LEN = 32
+ED_SIG_LEN = 64
+CERT_VOTE_LEN = ED_PK_LEN + ED_SIG_LEN
+
+DIGEST_LEN = 32
+
+
+def ack_digest(batch_digest: bytes) -> bytes:
+    """The 32-byte digest every batch ACK signs: SHA-512 truncated to
+    32 bytes over ``batch_digest || BATCH_ACK_DOMAIN`` (8-byte LE) —
+    bit-identical to ``BatchAck digest`` assembly in messages.hpp."""
+    if len(batch_digest) != DIGEST_LEN:
+        raise ValueError(
+            f"batch digest must be {DIGEST_LEN} bytes, "
+            f"got {len(batch_digest)}")
+    h = hashlib.sha512()
+    h.update(batch_digest)
+    h.update(BATCH_ACK_DOMAIN.to_bytes(8, "little"))
+    return h.digest()[:DIGEST_LEN]

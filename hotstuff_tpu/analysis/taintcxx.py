@@ -29,9 +29,13 @@ CXX_TARGETS = (
     "native/src/consensus/consensus.cpp",
     "native/src/consensus/messages.cpp",
     "native/src/consensus/aggregator.cpp",
+    "native/src/consensus/mempool_driver.cpp",
     "native/src/mempool/mempool.cpp",
+    "native/src/mempool/messages.cpp",
     "native/src/mempool/processor.hpp",
     "native/src/mempool/processor.cpp",
+    "native/src/mempool/quorum_waiter.cpp",
+    "native/src/mempool/synchronizer.cpp",
     "native/src/mempool/ingress.hpp",
     "native/src/mempool/tx_verify.hpp",
     "native/src/mempool/tx_verify.cpp",
@@ -66,6 +70,16 @@ CXX_SINKS = {
     # under the tx-signature gate — a forged frame reaching this sink
     # unverified is exactly the bug class the tier exists to kill.
     "forward_admitted": ("store-write", frozenset({"tx-signature"})),
+    # graftdag: the cert-driven background payload fetch.  A block's
+    # certificates name the replicas the fetch targets, so prefetch may
+    # only fire for a block whose certificate signatures were verified —
+    # the batch-certificate gate (host path via Block::check), the
+    # device verdict (async sidecar path), or the block gate that
+    # contains both.  An unverified block reaching this sink would let a
+    # forged certificate aim Synchronize traffic at arbitrary peers.
+    "prefetch": ("cert-fetch",
+                 frozenset({"batch-certificate", "device-verdict",
+                            "block"})),
 }
 
 _VERIFIES_RE = re.compile(r"//\s*VERIFIES\(([\w\-]+)\)")

@@ -30,6 +30,16 @@ Rules:
                           bounds, markers) or the domain-separator /
                           ingress-ctx tag strings disagree — one side
                           signs preimages the other cannot verify
+  certframe-mismatch      the graftdag BatchCertificate frame drifted
+                          between analysis/dagwire.py and
+                          native/src/mempool/messages.hpp: the ACK tag,
+                          the "dagack" signing domain, the per-vote
+                          byte bound, or the MempoolMessage::Kind enum
+                          values disagree — Python tooling would parse
+                          (or forge in tests) ACKs the node rejects, or
+                          the ACK digest recipe stops folding the
+                          domain separator and batch ACKs become
+                          replayable as consensus votes
 """
 
 from __future__ import annotations
@@ -93,11 +103,26 @@ _TXFRAME_STR_PAIRS = (
     ("INGRESS_CTX", "kTxIngressCtxTag"),
 )
 
+# graftdag: (python constant in analysis/dagwire.py, C++ constant in
+# mempool/messages.hpp) — the BatchCertificate frame, pinned both sides.
+_CERTFRAME_INT_PAIRS = (
+    ("BATCH_ACK_TAG", "kBatchAckTag"),
+    ("BATCH_ACK_DOMAIN", "kBatchAckDomain"),
+    ("CERT_VOTE_LEN", "kCertVoteLen"),
+)
+_CERTFRAME_KIND_PAIRS = (
+    ("MEMPOOL_KIND_BATCH", "kBatch"),
+    ("MEMPOOL_KIND_BATCH_REQUEST", "kBatchRequest"),
+    ("MEMPOOL_KIND_ACK", "kAck"),
+)
+
 PROTOCOL = "hotstuff_tpu/sidecar/protocol.py"
 SIDECAR_CLIENT = "native/src/crypto/sidecar_client.cpp"
 CRYPTO_HPP = "native/src/crypto/crypto.hpp"
 TXSIGN = "hotstuff_tpu/crypto/txsign.py"
 TX_FRAME_HPP = "native/src/mempool/tx_frame.hpp"
+DAGWIRE = "hotstuff_tpu/analysis/dagwire.py"
+MEMPOOL_MSG_HPP = "native/src/mempool/messages.hpp"
 FIELD25519 = "hotstuff_tpu/ops/field25519.py"
 INTMATH = "hotstuff_tpu/utils/intmath.py"
 FIELD381 = "hotstuff_tpu/ops/field381.py"
@@ -183,6 +208,27 @@ def py_bytes_constants(source: str) -> dict:
                 and isinstance(node.value.value, bytes):
             out[node.targets[0].id] = node.value.value.decode(
                 "latin-1")
+    return out
+
+
+def cpp_typed_enum_constants(source: str, enum: str) -> dict:
+    """``enum class <enum> : <type> { kA = 0, kB = 1, ... };`` ->
+    {name: value}.  Only explicitly typed enums match (messages.hpp has
+    an untyped ConsensusMempoolMessage::Kind the rule must not grab);
+    enumerators without an explicit value are numbered from the
+    previous one."""
+    m = re.search(r"enum\s+class\s+%s\s*:\s*\w+\s*\{([^}]*)\}"
+                  % re.escape(enum), source)
+    if not m:
+        return {}
+    out, nxt = {}, 0
+    for part in m.group(1).split(","):
+        em = re.match(r"\s*(k\w+)\s*(?:=\s*(\d+))?", part)
+        if not em:
+            continue
+        val = int(em.group(2)) if em.group(2) else nxt
+        out[em.group(1)] = val
+        nxt = val + 1
     return out
 
 
@@ -504,4 +550,51 @@ def check(root: str) -> list:
                 f"{py_name}={tx_py_str[py_name]!r}: domain-separated "
                 "preimages (or the ingress ctx tag) diverge — one side "
                 "signs what the other cannot verify"))
+
+    # -- graftdag BatchCertificate frame -----------------------------------
+    dag_src = _read(root, DAGWIRE)
+    mmsg_src = _read(root, MEMPOOL_MSG_HPP)
+    if dag_src is None or mmsg_src is None:
+        for rel, src in ((DAGWIRE, dag_src), (MEMPOOL_MSG_HPP, mmsg_src)):
+            if src is None:
+                miss(rel, "certframe-mismatch", "source file")
+        return findings
+    dag_py = module_int_constants(dag_src, DAGWIRE)
+    dag_cpp = cpp_int_constants(mmsg_src)
+    dag_cpp.update(cpp_typed_enum_constants(mmsg_src, "Kind"))
+    for py_name, cpp_name in (_CERTFRAME_INT_PAIRS
+                              + _CERTFRAME_KIND_PAIRS):
+        if py_name not in dag_py:
+            miss(DAGWIRE, "certframe-mismatch", f"constant {py_name}")
+        elif cpp_name not in dag_cpp:
+            miss(MEMPOOL_MSG_HPP, "certframe-mismatch",
+                 f"constant {cpp_name}")
+        elif dag_py[py_name] != dag_cpp[cpp_name]:
+            findings.append(Finding(
+                MEMPOOL_MSG_HPP, _line_of(mmsg_src, cpp_name),
+                "certframe-mismatch",
+                f"{cpp_name}={dag_cpp[cpp_name]} but {DAGWIRE} "
+                f"{py_name}={dag_py[py_name]}: certificate frames "
+                "desync between the node and Python tooling"))
+    # The ACK rides the MempoolMessage Kind field: the standalone tag
+    # constant must stay equal to the enum value it aliases.
+    if {"kBatchAckTag", "kAck"} <= dag_cpp.keys() \
+            and dag_cpp["kBatchAckTag"] != dag_cpp["kAck"]:
+        findings.append(Finding(
+            MEMPOOL_MSG_HPP, _line_of(mmsg_src, "kBatchAckTag"),
+            "certframe-mismatch",
+            f"kBatchAckTag={dag_cpp['kBatchAckTag']} but "
+            f"MempoolMessage::Kind::kAck={dag_cpp['kAck']}: the signed "
+            "ACK no longer rides the Kind tag it claims to"))
+    # Semantic pin: make_ack must still fold the domain separator into
+    # the signed digest — without it a batch ACK is a signature over a
+    # bare batch digest and becomes replayable in other contexts.
+    if not re.search(r"update_u64_le\(\s*kBatchAckDomain\s*\)", mmsg_src):
+        findings.append(Finding(
+            MEMPOOL_MSG_HPP, _line_of(mmsg_src, "kBatchAckDomain"),
+            "certframe-mismatch",
+            "no update_u64_le(kBatchAckDomain) in the ACK digest "
+            "assembly: the domain separator is declared but no longer "
+            "folded into what ACKs sign — dagwire.ack_digest() and the "
+            "node now disagree on the preimage"))
     return findings

@@ -39,7 +39,7 @@ def cmd_local(args):
         tpu_sidecar=(f"127.0.0.1:{LocalBench.SIDECAR_PORT}"
                      if use_sidecar else None),
         scheme=args.scheme if args.scheme != "ed25519" else None,
-        chain=args.chain)
+        chain=args.chain, dag=args.dag)
     node_params.json["mempool"]["batch_size"] = args.batch_size
     node_params.json["mempool"]["max_batch_delay"] = args.batch_delay
     node_params.json["consensus"]["timeout_delay"] = args.timeout
@@ -245,8 +245,15 @@ def main(argv=None):
                         "shapes so coalesced batches route through the "
                         "combined check (adds boot-time compiles, cached "
                         "across restarts)")
-    p.add_argument("--chain", type=int, choices=[2, 3], default=2,
-                   help="commit-rule depth: 2-chain (default) or 3-chain")
+    p.add_argument("--chain", type=int, choices=range(2, 9), default=2,
+                   metavar="K",
+                   help="commit-rule depth: k-chain in [2, 8] (default 2)")
+    p.add_argument("--dag", action="store_true",
+                   help="graftdag certified-batch mempool: proposals carry "
+                        "availability certificates (2f+1 signed batch "
+                        "ACKs) instead of relying on payload sync, and "
+                        "the leader pipelines rounds without waiting for "
+                        "broadcast ACKs")
     p.add_argument("--scheme", choices=["ed25519", "bls"],
                    default="ed25519",
                    help="signature scheme (bls implies --tpu-sidecar)")
@@ -308,8 +315,9 @@ def main(argv=None):
     p.add_argument("--tx-size", type=int, default=512)
     p.add_argument("--duration", type=int, default=30)
     p.add_argument("--runs", type=int, default=1)
-    p.add_argument("--chain", type=int, choices=[2, 3], default=2,
-                   help="commit-rule depth: 2-chain (default) or 3-chain")
+    p.add_argument("--chain", type=int, choices=range(2, 9), default=2,
+                   metavar="K",
+                   help="commit-rule depth: k-chain in [2, 8] (default 2)")
     p.add_argument("--install", action="store_true",
                    help="install toolchain on hosts first")
     p.add_argument("--update", action="store_true",

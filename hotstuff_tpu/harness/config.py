@@ -188,9 +188,24 @@ class NodeParameters:
         trace = json_input.get("trace")
         if trace is not None and not isinstance(trace, bool):
             raise ConfigError("trace must be a bool")
+        # graftdag generalizes the commit walk to any k-chain in [2, 8]
+        # (the C++ reader enforces the same range).
         chain = json_input["consensus"].get("chain_depth", 2)
-        if chain not in (2, 3):
-            raise ConfigError("chain_depth must be 2 or 3")
+        if not isinstance(chain, int) or isinstance(chain, bool) \
+                or not 2 <= chain <= 8:
+            raise ConfigError("chain_depth must be an int in [2, 8]")
+        # graftdag certified-batch mode: ONE harness knob that must land
+        # on BOTH sides of the node (the consensus proposer carries certs
+        # and skips the broadcast-ACK wait; the mempool signs availability
+        # ACKs and assembles certificates) — a half-set knob would wedge
+        # every proposal, so the harness writes/checks them in lockstep.
+        dag_c = json_input["consensus"].get("dag", False)
+        dag_m = json_input["mempool"].get("dag", False)
+        if not isinstance(dag_c, bool) or not isinstance(dag_m, bool):
+            raise ConfigError("dag must be a bool")
+        if dag_c != dag_m:
+            raise ConfigError(
+                "dag must be set on both consensus and mempool (lockstep)")
         self.timeout_delay = json_input["consensus"]["timeout_delay"]
         self.json = json_input
 
@@ -200,7 +215,8 @@ class NodeParameters:
             json.dump(self.json, f, indent=4, sort_keys=True)
 
     @classmethod
-    def default(cls, tpu_sidecar=None, scheme=None, chain=2, tenant=None):
+    def default(cls, tpu_sidecar=None, scheme=None, chain=2, tenant=None,
+                dag=False):
         # grafttrace's node-side "trace" flag is not a kwarg here: the
         # harnesses enable it via json.setdefault("trace", True) on
         # whatever parameters the caller built (local.py / remote.py).
@@ -216,6 +232,9 @@ class NodeParameters:
         }
         if chain != 2:
             data["consensus"]["chain_depth"] = chain
+        if dag:
+            data["consensus"]["dag"] = True
+            data["mempool"]["dag"] = True
         if tpu_sidecar:
             data["tpu_sidecar"] = tpu_sidecar
         if tenant:

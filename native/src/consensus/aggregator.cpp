@@ -143,5 +143,17 @@ void Aggregator::cleanup(Round round) {
                               timeouts_aggregators_.lower_bound(round));
 }
 
+size_t Aggregator::gc_committed(Round last_committed) {
+  // upper_bound: state AT the committed round is dead too (its QC/TC, if
+  // any, already exists — that is what committed it or its descendant).
+  auto ve = votes_aggregators_.upper_bound(last_committed);
+  auto te = timeouts_aggregators_.upper_bound(last_committed);
+  size_t dropped = size_t(std::distance(votes_aggregators_.begin(), ve)) +
+                   size_t(std::distance(timeouts_aggregators_.begin(), te));
+  votes_aggregators_.erase(votes_aggregators_.begin(), ve);
+  timeouts_aggregators_.erase(timeouts_aggregators_.begin(), te);
+  return dropped;
+}
+
 }  // namespace consensus
 }  // namespace hotstuff

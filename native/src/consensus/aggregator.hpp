@@ -77,6 +77,16 @@ class Aggregator {
   // Drop aggregation state for rounds < round.
   void cleanup(Round round);
 
+  // graftdag: drop aggregation state for rounds <= last_committed — a
+  // committed round can never need another QC or TC, whatever the local
+  // round says.  With pipelined chained rounds (chain_depth > 2) commits
+  // land generations behind the proposal front, so this GC is keyed on
+  // the COMMIT watermark rather than the round clock: it holds even on
+  // paths where the round does not advance (catch-up commit walks), and
+  // documents the invariant cleanup() only covers incidentally.  Returns
+  // the number of rounds whose state was dropped (telemetry).
+  size_t gc_committed(Round last_committed);
+
   // Total timeout entries ejected by failed batch verdicts (telemetry;
   // the Core logs it with the round that ejected).
   uint64_t ejected_total() const { return ejected_total_; }

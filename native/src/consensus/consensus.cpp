@@ -10,8 +10,8 @@ namespace consensus {
 
 std::unique_ptr<Consensus> Consensus::spawn(
     PublicKey name, Committee committee, Parameters parameters,
-    SignatureService signature_service, Store store,
-    ChannelPtr<Digest> rx_mempool,
+    SignatureService signature_service, Store store, Store batch_store,
+    ChannelPtr<mempool::PayloadRef> rx_mempool,
     ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
     ChannelPtr<Block> tx_commit) {
   parameters.log();
@@ -68,7 +68,7 @@ std::unique_ptr<Consensus> Consensus::spawn(
 
   auto leader_elector = std::make_shared<LeaderElector>(committee);
   auto mempool_driver =
-      std::make_shared<MempoolDriver>(store, tx_mempool, tx_core);
+      std::make_shared<MempoolDriver>(batch_store, tx_mempool, tx_core);
   auto synchronizer = std::make_shared<Synchronizer>(
       name, committee, store, tx_core, parameters.sync_retry_delay);
 
@@ -87,7 +87,8 @@ std::unique_ptr<Consensus> Consensus::spawn(
       tx_commit));
 
   c->threads_.push_back(Proposer::spawn(name, committee, signature_service,
-                                        rx_mempool, tx_proposer_cmd, tx_core,
+                                        parameters.dag, rx_mempool,
+                                        tx_proposer_cmd, tx_core,
                                         c->stop_flag_));
 
   c->threads_.push_back(Helper::spawn(committee, store, tx_helper));

@@ -21,13 +21,20 @@ namespace consensus {
 
 class Consensus {
  public:
-  // rx_mempool: batch digests from the mempool processors;
+  // rx_mempool: payload refs (batch digest + optional availability
+  // certificate, graftdag) from the mempool processors;
   // tx_mempool: Synchronize/Cleanup commands to the mempool;
   // tx_commit: committed blocks out to the application layer.
+  // store holds consensus metadata (blocks, last-vote state); batch_store
+  // holds mempool batch payloads.  They are separate actors so a commit
+  // walk or state flush never queues behind ~500 KB batch writes
+  // (graftdag: the payload store is the write-heavy one by 2-3 orders of
+  // magnitude, and sharing one single-threaded store actor let batch
+  // traffic wedge the core's blocking metadata round trips).
   static std::unique_ptr<Consensus> spawn(
       PublicKey name, Committee committee, Parameters parameters,
-      SignatureService signature_service, Store store,
-      ChannelPtr<Digest> rx_mempool,
+      SignatureService signature_service, Store store, Store batch_store,
+      ChannelPtr<mempool::PayloadRef> rx_mempool,
       ChannelPtr<mempool::ConsensusMempoolMessage> tx_mempool,
       ChannelPtr<Block> tx_commit);
 

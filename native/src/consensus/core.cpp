@@ -2,7 +2,9 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <deque>
+#include <functional>
 #include <map>
 #include <random>
 #include <set>
@@ -74,6 +76,7 @@ class CoreImpl {
         flush_state();
         continue;
       }
+      auto ev_start = std::chrono::steady_clock::now();
       VerifyResult result = VerifyResult::good();
       if (event.kind == CoreEvent::Kind::kLoopback) {
         // Loopback blocks re-enter after handle_proposal fully verified
@@ -105,6 +108,15 @@ class CoreImpl {
         }
       }
       flush_state();
+      auto ev_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       std::chrono::steady_clock::now() - ev_start)
+                       .count();
+      if (ev_ms > 500) {
+        LOG_WARN("consensus::core")
+            << "SLOW event kind=" << int(event.kind)
+            << " msg_kind=" << int(event.message.kind) << " took " << ev_ms
+            << " ms";
+      }
       if (!result.ok()) {
         LOG_WARN("consensus::core") << result.error;
       }
@@ -205,6 +217,15 @@ class CoreImpl {
     last_committed_round_ = block.round;
     state_dirty_ = true;
     note_progress();
+    // Commit-keyed aggregator GC (graftdag): vote/timeout state at or
+    // below the committed round is dead regardless of the round clock —
+    // advance_round's cleanup misses it on catch-up commit walks.
+    size_t gc = aggregator_.gc_committed(last_committed_round_);
+    if (gc > 0) {
+      LOG_DEBUG("consensus::core")
+          << "Garbage-collected aggregation state for " << gc
+          << " committed round(s)";
+    }
 
     for (const Block& b : to_commit) {
       trace_stage("commit", b);
@@ -663,28 +684,37 @@ class CoreImpl {
     store_block(block);
     cleanup_proposer(b0, b1, block);
 
-    // Commit rule (core.rs:363-366). 2-chain: b0 commits once its direct
-    // descendant b1 is certified in the next round (block.qc certifies b1,
-    // so this processing event is the earliest proof). 3-chain (upstream
-    // HotStuff; the variant behind the reference's benchmark/data/3-chain
-    // results): commit requires THREE consecutive certified rounds
-    // g0 <- b0 <- b1, so the candidate is one generation older and lands
-    // one round later than 2-chain.
-    if (chain_depth_ == 3) {
-      if (b0.round + 1 == b1.round) {
-        auto g0 = synchronizer_->get_parent_block(b0);
+    // Commit rule (core.rs:363-366), generalized to a k-chain (graftdag).
+    // 2-chain: b0 commits once its direct descendant b1 is certified in
+    // the next round (block.qc certifies b1, so this processing event is
+    // the earliest proof).  3-chain (upstream HotStuff; the variant
+    // behind the reference's benchmark/data/3-chain results) requires
+    // THREE consecutive certified rounds g0 <- b0 <- b1.  Any k >= 2
+    // walks k-2 further generations below b0, requiring consecutive
+    // rounds the whole way; deeper pipelines trade commit latency for
+    // leaders never waiting on their own chain's commit to propose.
+    if (b0.round + 1 == b1.round) {
+      std::optional<Block> candidate = b0;
+      for (uint32_t depth = 2; candidate && depth < chain_depth_; depth++) {
+        if (candidate->round == 0) {
+          candidate.reset();  // genesis has no parent to walk
+          break;
+        }
+        auto parent = synchronizer_->get_parent_block(*candidate);
         // nullopt fires a sync request; the commit() catch-up walk of a
-        // later block commits g0 once it arrives.
-        if (g0 && g0->round + 1 == b0.round) {
-          mempool_driver_->cleanup(g0->round);
-          VerifyResult r = commit(*g0);
-          if (!r.ok()) return r;
+        // later block commits the ancestor once it arrives.  A round gap
+        // (view change inside the window) breaks the chain: no commit.
+        if (parent && parent->round + 1 == candidate->round) {
+          candidate = std::move(*parent);
+        } else {
+          candidate.reset();
         }
       }
-    } else if (b0.round + 1 == b1.round) {
-      mempool_driver_->cleanup(b0.round);
-      VerifyResult r = commit(b0);
-      if (!r.ok()) return r;
+      if (candidate) {
+        mempool_driver_->cleanup(candidate->round);
+        VerifyResult r = commit(*candidate);
+        if (!r.ok()) return r;
+      }
     }
 
     // Bad leaders could send blocks from the far future.
@@ -746,11 +776,73 @@ class CoreImpl {
     return r;
   }
 
+  // graftdag: synchronous availability-certificate verification through
+  // the same content-digest cache the QC/TC arms use (structure was
+  // already checked by handle_proposal's Block::check_certs).
+  // VERIFIES(batch-certificate)
+  VerifyResult verify_cert_cached(const mempool::BatchCertificate& cert) {
+    Digest d = cert.content_digest();
+    if (cert_cached(d)) return VerifyResult::good();
+    if (!Signature::verify_batch(cert.ack_digest(), cert.votes)) {
+      return VerifyResult::bad("invalid signature in batch certificate " +
+                               cert.digest.to_base64());
+    }
+    cert_insert(d);
+    return VerifyResult::good();
+  }
+
+  // Join state for a proposal whose verification spans MULTIPLE async
+  // ops (BLS QC+TC, or an Ed25519 QC/TC batch alongside a cert batch).
+  //
+  // graftsync: the atomics are the synchronization (acq_rel on the
+  // decrement publishes all_ok/transport_fail to the last callback); ch
+  // and block are written before any callback is registered and only
+  // READ afterwards — the thread-start/submit edge is the
+  // happens-before.
+  struct VerdictJoin {
+    std::atomic<int> remaining;      // SHARED_OK(atomic join counter)
+    std::atomic<bool> all_ok{true};  // SHARED_OK(atomic)
+    std::atomic<bool> transport_fail{false};  // SHARED_OK(atomic)
+    ChannelPtr<CoreEvent> ch;  // SHARED_OK(written pre-registration)
+    Block block;               // SHARED_OK(written pre-registration)
+  };
+
+  static std::function<void(std::optional<bool>)> join_completion(
+      std::shared_ptr<VerdictJoin> join) {
+    return [join](std::optional<bool> ok) {
+      // A transport failure makes the joint verdict nullopt (unless a
+      // definitive reject already landed): handle_verdict then
+      // re-verifies synchronously instead of rejecting an honest
+      // block because the sidecar died mid-flight.  Ordering: each
+      // callback's relaxed stores are published to the LAST
+      // decrementer through the acq_rel RMW chain on `remaining`
+      // (release on every decrement, acquire on the one that reads
+      // 1), so the final loads may stay relaxed.
+      if (!ok.has_value()) {
+        join->transport_fail.store(true, std::memory_order_relaxed);
+      } else if (!*ok) {
+        join->all_ok.store(false, std::memory_order_relaxed);
+      }
+      if (join->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        bool all_ok = join->all_ok.load(std::memory_order_relaxed);
+        std::optional<bool> verdict(all_ok);
+        if (all_ok &&
+            join->transport_fail.load(std::memory_order_relaxed)) {
+          verdict = std::nullopt;
+        }
+        CoreEvent e = CoreEvent::verdict_of(join->block, verdict);
+        join->ch->try_send(std::move(e));
+      }
+    };
+  }
+
   // Attempts to dispatch the proposal's outstanding certificate signature
   // batches to the device asynchronously.  Returns true if dispatched (the
   // proposal is suspended; a kVerdict event resumes it), false if the
   // caller must verify synchronously.  Structural checks and the block's
   // own (cheap, host) signature were already done by handle_proposal.
+  // `need_certs` lists the block's availability certificates (graftdag)
+  // whose signature batches are not yet cached.
   //
   // The completion callbacks run on the sidecar reply thread: they push
   // the verdict into the Core's own event channel and nothing else.
@@ -758,7 +850,9 @@ class CoreImpl {
   // proposal stays suspended until its pending entry expires — the
   // leader's re-proposal or a sync request then re-verifies, identical to
   // dropping any other message under overload.
-  bool try_dispatch_verify(const Block& block, bool need_qc, bool need_tc) {
+  bool try_dispatch_verify(
+      const Block& block, bool need_qc, bool need_tc,
+      const std::vector<const mempool::BatchCertificate*>& need_certs) {
     if (!Signature::async_available()) return false;
     auto ch = rx_event_;
     if (current_scheme() == Scheme::kBls) {
@@ -769,6 +863,11 @@ class CoreImpl {
       // view-change proposal onto the slow host pairing path.
       TpuVerifier* tpu = TpuVerifier::instance();
       if (!tpu) return false;
+      // Batch ACKs are host-Ed25519 under EVERY scheme (sign_host), so a
+      // cert batch can never ride the BLS opcodes — cert-carrying blocks
+      // take the synchronous path, which verifies the 64-byte records on
+      // the host.
+      if (!need_certs.empty()) return false;
       // Mixed certificates — any 64-byte Ed25519 fallback signature
       // (signed during a peer's sidecar outage, see Signature::sign) —
       // take the synchronous path, which partitions host/device; the
@@ -784,47 +883,11 @@ class CoreImpl {
           if (sig.data.size() == 64) return false;
         }
       }
-      struct Join {
-        // graftsync: the atomics are the synchronization (acq_rel on
-        // the decrement publishes all_ok/transport_fail to the last
-        // callback); ch and block are written before either callback is
-        // registered and only READ afterwards — the thread-start/submit
-        // edge is the happens-before.
-        std::atomic<int> remaining;      // SHARED_OK(atomic join counter)
-        std::atomic<bool> all_ok{true};  // SHARED_OK(atomic)
-        std::atomic<bool> transport_fail{false};  // SHARED_OK(atomic)
-        ChannelPtr<CoreEvent> ch;  // SHARED_OK(written pre-registration)
-        Block block;               // SHARED_OK(written pre-registration)
-      };
-      auto join = std::make_shared<Join>();
+      auto join = std::make_shared<VerdictJoin>();
       join->remaining = (need_qc ? 1 : 0) + (need_tc ? 1 : 0);
       join->ch = ch;
       join->block = block;
-      auto complete = [join](std::optional<bool> ok) {
-        // A transport failure makes the joint verdict nullopt (unless a
-        // definitive reject already landed): handle_verdict then
-        // re-verifies synchronously instead of rejecting an honest
-        // block because the sidecar died mid-flight.  Ordering: each
-        // callback's relaxed stores are published to the LAST
-        // decrementer through the acq_rel RMW chain on `remaining`
-        // (release on every decrement, acquire on the one that reads
-        // 1), so the final loads may stay relaxed.
-        if (!ok.has_value()) {
-          join->transport_fail.store(true, std::memory_order_relaxed);
-        } else if (!*ok) {
-          join->all_ok.store(false, std::memory_order_relaxed);
-        }
-        if (join->remaining.fetch_sub(1, std::memory_order_acq_rel) == 1) {
-          bool all_ok = join->all_ok.load(std::memory_order_relaxed);
-          std::optional<bool> verdict(all_ok);
-          if (all_ok &&
-              join->transport_fail.load(std::memory_order_relaxed)) {
-            verdict = std::nullopt;
-          }
-          CoreEvent e = CoreEvent::verdict_of(join->block, verdict);
-          join->ch->try_send(std::move(e));
-        }
-      };
+      auto complete = join_completion(join);
       // graftscope: the block digest rides both BLS verify RPCs as the
       // protocol v5 context tag (EdDSA parity, ROADMAP item 2), so
       // scheme=bls stage spans join this block's trace segment too.
@@ -840,8 +903,8 @@ class CoreImpl {
       }
       return true;
     }
-    // Ed25519: one combined multi-digest batch (padded power-of-two
-    // buckets; every shape is pre-warmed).
+    // Ed25519: QC/TC votes ride one combined multi-digest batch (padded
+    // power-of-two buckets; every shape is pre-warmed).
     std::vector<std::tuple<Digest, PublicKey, Signature>> items;
     if (need_qc) {
       auto qi = block.qc.vote_items();
@@ -851,20 +914,47 @@ class CoreImpl {
       auto ti = block.tc->vote_items();
       items.insert(items.end(), ti.begin(), ti.end());
     }
-    Block copy = block;
     // graftscope: the block digest rides the verify RPC as the protocol
     // v5 context tag, so the sidecar's admit/queue/pack/dispatch/device/
     // reply spans for this batch join this block's verify segment in the
     // merged trace (the frame is built before this call returns, so the
     // stack digest is safe to pass by pointer).
     Digest ctx = block.digest();
-    Signature::verify_batch_multi_async(
-        std::move(items),
-        [ch, copy](std::optional<bool> ok) mutable {
-          CoreEvent e = CoreEvent::verdict_of(std::move(copy), ok);
-          ch->try_send(std::move(e));
-        },
-        /*bulk=*/false, &ctx);
+    if (need_certs.empty()) {
+      Block copy = block;
+      Signature::verify_batch_multi_async(
+          std::move(items),
+          [ch, copy](std::optional<bool> ok) mutable {
+            CoreEvent e = CoreEvent::verdict_of(std::move(copy), ok);
+            ch->try_send(std::move(e));
+          },
+          /*bulk=*/false, &ctx);
+      return true;
+    }
+    // graftdag: the availability-certificate batch goes as a SEPARATE op
+    // under its OWN context tag — the ack-domain derivation of the block
+    // digest — so the sidecar's stage spans for ordering certificates
+    // are distinguishable from the vote batch in the merged trace.  Each
+    // cert is QC-shaped (2f+1 signatures over one common ack digest), so
+    // the batch lands on the warmed RLC verify path.
+    auto join = std::make_shared<VerdictJoin>();
+    join->remaining = (items.empty() ? 0 : 1) + 1;
+    join->ch = ch;
+    join->block = block;
+    auto complete = join_completion(join);
+    if (!items.empty()) {
+      Signature::verify_batch_multi_async(std::move(items), complete,
+                                          /*bulk=*/false, &ctx);
+    }
+    // VERIFIES(batch-certificate)
+    std::vector<std::tuple<Digest, PublicKey, Signature>> cert_items;
+    for (const auto* cert : need_certs) {
+      auto ci = cert->vote_items();
+      cert_items.insert(cert_items.end(), ci.begin(), ci.end());
+    }
+    Digest cert_ctx = mempool::BatchCertificate::ack_digest_of(ctx);
+    Signature::verify_batch_multi_async(std::move(cert_items), complete,
+                                        /*bulk=*/false, &cert_ctx);
     return true;
   }
 
@@ -889,6 +979,9 @@ class CoreImpl {
     // VERIFIES(device-verdict)
     if (!block.qc.is_genesis()) cert_insert(block.qc.content_digest());
     if (block.tc) cert_insert(block.tc->content_digest());
+    for (const auto& cert : block.certs) {
+      cert_insert(cert.content_digest());
+    }
     return proposal_postverify(block);
   }
 
@@ -896,6 +989,17 @@ class CoreImpl {
   VerifyResult proposal_postverify(const Block& block) {
     process_qc(block.qc);
     if (block.tc) advance_round(block.tc->round);
+
+    // graftdag: a cert-carrying block's availability was PROVEN by its
+    // (just verified) certificates — 2f+1 signed for stored bytes, so
+    // f+1 honest replicas can serve every batch.  Vote without
+    // possession; missing bytes are fetched in the background from the
+    // certificate signers instead of suspending the block behind a
+    // payload round trip.
+    if (!block.certs.empty()) {
+      mempool_driver_->prefetch(block);
+      return process_block(block);
+    }
 
     // Payload availability; suspends the block if batches are missing.
     if (!mempool_driver_->verify(block)) {
@@ -947,6 +1051,16 @@ class CoreImpl {
       VerifyResult r = block.tc->verify_structure(committee_);
       if (!r.ok()) return r;
     }
+    // graftdag: availability-certificate shape + stake structure (host
+    // cheap), then collect the certs whose signature batches still need
+    // verification — cached ones (a re-proposal after a view change
+    // re-carries the same certs) skip the device round trip entirely.
+    VerifyResult cr = block.check_certs(committee_);
+    if (!cr.ok()) return cr;
+    std::vector<const mempool::BatchCertificate*> need_certs;
+    for (const auto& cert : block.certs) {
+      if (!cert_cached(cert.content_digest())) need_certs.push_back(&cert);
+    }
 
     // Under scheme=bls the block's own signature is a pairing too — it
     // stays on the synchronous path below (one extra sidecar op per block;
@@ -956,8 +1070,8 @@ class CoreImpl {
       return VerifyResult::bad("invalid block signature");
     }
 
-    if ((need_qc || need_tc) &&
-        try_dispatch_verify(block, need_qc, need_tc)) {
+    if ((need_qc || need_tc || !need_certs.empty()) &&
+        try_dispatch_verify(block, need_qc, need_tc, need_certs)) {
       trace_stage("verify_submit", block);
       // The expiry covers a lost verdict event: transport failures arrive
       // well inside the scheme's sidecar deadline, so anything older is
@@ -980,6 +1094,10 @@ class CoreImpl {
     }
     if (need_tc) {
       VerifyResult r = verify_tc_cached(*block.tc);
+      if (!r.ok()) return r;
+    }
+    for (const auto* cert : need_certs) {
+      VerifyResult r = verify_cert_cached(*cert);
       if (!r.ok()) return r;
     }
     return proposal_postverify(block);

@@ -112,6 +112,31 @@ bool MempoolDriver::verify(const Block& block) {
   return false;
 }
 
+void MempoolDriver::prefetch(const Block& block) {
+  // One Synchronize per certified batch, holders = that batch's own cert
+  // signers — a signer of batch A need not hold batch B, so requests are
+  // not pooled across certificates.  No store read happens here: the
+  // batch store's queue is dominated by ~500 KB writes, and a blocking
+  // read round trip per cert on the CORE thread wedged consensus for
+  // seconds under load.  The mempool synchronizer does the "do we
+  // already hold it" check on its own thread and only then requests from
+  // the network; its pending map dedups re-sent digests and its retry
+  // timer (lucky broadcast) backstops requests that go unanswered.
+  for (size_t i = 0; i < block.certs.size(); i++) {
+    const auto& cert = block.certs[i];
+    mempool::ConsensusMempoolMessage sync;
+    sync.kind = mempool::ConsensusMempoolMessage::Kind::kSynchronize;
+    sync.digests.push_back(cert.digest);
+    sync.target = block.author;
+    sync.holders.reserve(cert.votes.size());
+    for (const auto& [signer, sig] : cert.votes) {
+      (void)sig;
+      sync.holders.push_back(signer);
+    }
+    tx_mempool_->send(std::move(sync));
+  }
+}
+
 void MempoolDriver::cleanup(Round round) {
   mempool::ConsensusMempoolMessage msg;
   msg.kind = mempool::ConsensusMempoolMessage::Kind::kCleanup;

@@ -31,6 +31,13 @@ class MempoolDriver {
   // Called from the core thread: true when all payload batches are local.
   bool verify(const Block& block);
 
+  // graftdag: background fetch for a CERT-CARRYING block — the
+  // certificates already prove availability, so the core votes without
+  // possession and this only starts pulling the missing bytes, targeted
+  // at each certificate's signers (they signed for stored bytes).
+  // Never suspends the block.
+  void prefetch(const Block& block);
+
   void cleanup(Round round);
 
  private:

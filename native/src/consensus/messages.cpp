@@ -229,6 +229,23 @@ Digest Block::digest() const {
   return b.finalize();
 }
 
+// VERIFIES(stake-structure)
+VerifyResult Block::check_certs(const Committee& committee) const {
+  if (certs.empty()) return VerifyResult::good();
+  if (certs.size() != payload.size()) {
+    return VerifyResult::bad("certificate list does not match payload");
+  }
+  for (size_t i = 0; i < certs.size(); i++) {
+    if (certs[i].digest != payload[i]) {
+      return VerifyResult::bad("certificate digest mismatch at index " +
+                               std::to_string(i));
+    }
+    std::string err = certs[i].check(committee);
+    if (!err.empty()) return VerifyResult::bad(std::move(err));
+  }
+  return VerifyResult::good();
+}
+
 // VERIFIES(block)
 VerifyResult Block::verify(const Committee& committee) const {
   if (committee.stake(author) == 0) {
@@ -245,6 +262,16 @@ VerifyResult Block::verify(const Committee& committee) const {
     VerifyResult r = tc->verify(committee);
     if (!r.ok()) return r;
   }
+  // graftdag: synchronous fallback for availability certificates (the hot
+  // path dispatches their signature batches through the Core instead).
+  VerifyResult r = check_certs(committee);
+  if (!r.ok()) return r;
+  // VERIFIES(batch-certificate)
+  for (const auto& cert : certs) {
+    if (!Signature::verify_batch(cert.ack_digest(), cert.votes)) {
+      return VerifyResult::bad("invalid signature in batch certificate");
+    }
+  }
   return VerifyResult::good();
 }
 
@@ -256,6 +283,8 @@ void Block::serialize(Writer* w) const {
   w->u64(round);
   w->u64(payload.size());
   for (const auto& d : payload) d.serialize(w);
+  w->u64(certs.size());
+  for (const auto& c : certs) c.serialize(w);
   signature.serialize(w);
 }
 
@@ -268,6 +297,12 @@ Block Block::deserialize(Reader* r) {
   uint64_t n = r->seq_len(32);
   b.payload.reserve(n);
   for (uint64_t i = 0; i < n; i++) b.payload.push_back(Digest::deserialize(r));
+  // Min serialized certificate: 32-byte digest + 8-byte vote count.
+  uint64_t nc = r->seq_len(40);
+  b.certs.reserve(nc);
+  for (uint64_t i = 0; i < nc; i++) {
+    b.certs.push_back(mempool::BatchCertificate::deserialize(r));
+  }
   b.signature = Signature::deserialize(r);
   return b;
 }

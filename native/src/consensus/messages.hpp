@@ -12,6 +12,7 @@
 #include "common/serde.hpp"
 #include "consensus/config.hpp"
 #include "crypto/crypto.hpp"
+#include "mempool/messages.hpp"
 
 namespace hotstuff {
 namespace consensus {
@@ -86,6 +87,15 @@ struct Block {
   PublicKey author;
   Round round = 0;
   std::vector<Digest> payload;
+  // graftdag: availability certificates for the payload digests.  Either
+  // empty (legacy payload-sync blocks) or EXACTLY parallel to `payload`
+  // (certs[i].digest == payload[i]) — check_certs enforces the shape.  A
+  // cert-carrying proposal is constant-size evidence that every ordered
+  // batch is retrievable from f+1 honest replicas, so replicas can vote
+  // without possessing the bytes.  NOT covered by digest(): the payload
+  // digests are, and the shape invariant ties each cert to its digest, so
+  // two blocks differing only in cert vote sets order the same batches.
+  std::vector<mempool::BatchCertificate> certs;
   Signature signature;
 
   static const Block& genesis();
@@ -93,6 +103,10 @@ struct Block {
   Digest digest() const;
   const Digest& parent() const { return qc.hash; }
   VerifyResult verify(const Committee& committee) const;
+  // Structural certificate checks only — shape invariant plus per-cert
+  // stake/reuse/quorum/minimality — everything but the signature batches,
+  // which the Core dispatches to the verify sidecar asynchronously.
+  VerifyResult check_certs(const Committee& committee) const;
 
   void serialize(Writer* w) const;
   static Block deserialize(Reader* r);

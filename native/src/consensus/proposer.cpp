@@ -3,8 +3,9 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <map>
 #include <mutex>
-#include <set>
+#include <optional>
 #include <thread>
 
 #include "common/log.hpp"
@@ -15,9 +16,12 @@ namespace consensus {
 
 namespace {
 
+using PayloadBuffer =
+    std::map<Digest, std::optional<mempool::BatchCertificate>>;
+
 void make_block(const PublicKey& name, const Committee& committee,
-                const SignatureService& signature_service,
-                ReliableSender* network, std::set<Digest>* buffer,
+                const SignatureService& signature_service, bool dag,
+                ReliableSender* network, PayloadBuffer* buffer,
                 Round round, QC qc, std::optional<TC> tc,
                 Channel<CoreEvent>* tx_loopback,
                 const std::atomic<bool>& stop) {
@@ -26,7 +30,22 @@ void make_block(const PublicKey& name, const Committee& committee,
   block.tc = std::move(tc);
   block.author = name;
   block.round = round;
-  block.payload.assign(buffer->begin(), buffer->end());
+  block.payload.reserve(buffer->size());
+  block.certs.reserve(buffer->size());
+  bool all_certified = true;
+  for (auto& [digest, cert] : *buffer) {
+    block.payload.push_back(digest);
+    if (cert) {
+      block.certs.push_back(std::move(*cert));
+    } else {
+      all_certified = false;
+    }
+  }
+  // A block either certifies its WHOLE payload or none of it (the shape
+  // invariant every verifier enforces, Block::check_certs).  A mixed
+  // buffer — possible only across a dag-knob flip mid-run — degrades to
+  // a legacy payload-sync block rather than an invalid one.
+  if (!all_certified) block.certs.clear();
   buffer->clear();
   block.signature = signature_service.request_signature(block.digest());
 
@@ -40,8 +59,7 @@ void make_block(const PublicKey& name, const Committee& committee,
     }
   }
 
-  // Reliable-broadcast the proposal, loop it back, then wait for 2f+1
-  // cumulative stake of ACKs (proposer.rs:85-121).
+  // Reliable-broadcast the proposal and loop it back (proposer.rs:85-121).
   auto peers = committee.broadcast_addresses(name);
   std::vector<Address> addresses;
   addresses.reserve(peers.size());
@@ -51,6 +69,18 @@ void make_block(const PublicKey& name, const Committee& committee,
 
   tx_loopback->send(CoreEvent::loopback(block));
 
+  // graftdag: the proposal's payload is a list of certified digests —
+  // every batch already has 2f+1 signed availability — so there is
+  // nothing the per-proposal ACK wait still guarantees.  Dropping the
+  // handlers releases the wait (the ReliableSender retransmits un-ACKed
+  // proposals regardless), and the proposer can pipeline the next
+  // round's block immediately instead of serializing rounds behind the
+  // slowest ACK quorum — the leader-bottleneck fix this mode is for.
+  if (dag) return;
+
+  // Legacy: wait for 2f+1 cumulative stake of ACKs — backpressure so a
+  // leader cannot outrun the committee's ability to RECEIVE payloads it
+  // will need bytes for.
   auto m = std::make_shared<std::mutex>();
   auto cv = std::make_shared<std::condition_variable>();
   auto total = std::make_shared<Stake>(committee.stake(name));
@@ -76,23 +106,26 @@ void make_block(const PublicKey& name, const Committee& committee,
 }  // namespace
 
 std::thread Proposer::spawn(PublicKey name, Committee committee,
-                            SignatureService signature_service,
-                            ChannelPtr<Digest> rx_mempool,
+                            SignatureService signature_service, bool dag,
+                            ChannelPtr<mempool::PayloadRef> rx_mempool,
                             ChannelPtr<ProposerMessage> rx_message,
                             ChannelPtr<CoreEvent> tx_loopback,
                             std::shared_ptr<std::atomic<bool>> stop) {
   return std::thread([name, committee = std::move(committee),
-                      signature_service = std::move(signature_service),
+                      signature_service = std::move(signature_service), dag,
                       rx_mempool, rx_message, tx_loopback,
                       stop = std::move(stop)]() mutable {
     set_thread_name("proposer");
     ReliableSender network(stop);
-    std::set<Digest> buffer;
+    PayloadBuffer buffer;
+    auto absorb = [&buffer](mempool::PayloadRef&& ref) {
+      buffer.emplace(ref.digest, std::move(ref.cert));
+    };
     while (true) {
       // Select: block on the command channel, opportunistically draining
-      // the digest flood each iteration; digests are also drained right
+      // the payload-ref flood each iteration; refs are also drained right
       // before a command so Make sees the freshest payload set.  The poll
-      // interval only bounds how long digests sit in the channel while NO
+      // interval only bounds how long refs sit in the channel while NO
       // command arrives (they are consumed exclusively by Make) — at 1 ms
       // it cost 1000 wakeups/s per node, ~25% of a core across a
       // 100-validator single-host committee; 100 ms is behaviorally
@@ -101,8 +134,8 @@ std::thread Proposer::spawn(PublicKey name, Committee committee,
       auto status = rx_message->recv_until(
           &cmd, std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(100));
-      Digest digest;
-      while (rx_mempool->try_recv(&digest)) buffer.insert(digest);
+      mempool::PayloadRef ref;
+      while (rx_mempool->try_recv(&ref)) absorb(std::move(ref));
       if (status == RecvStatus::kClosed) return;
       if (status == RecvStatus::kTimeout) continue;
       if (cmd.kind == ProposerMessage::Kind::kMake) {
@@ -113,23 +146,24 @@ std::thread Proposer::spawn(PublicKey name, Committee committee,
         // races too, but its geo-replicated RTT hides it; on a saturated
         // single host, profiled empty-round racing at a 100-validator
         // committee burned 68% of the core on consensus messaging alone).
-        // Any digest ends the wait immediately, so a loaded committee
-        // never pays it; 400 ms caps empty rounds at ~2.5/s and keeps a
-        // 2.5x margin under the smallest timeout (>= 1 s) a benchmark
-        // configures — do not raise it toward the timeout floor.
+        // Any payload ref ends the wait immediately, so a loaded
+        // committee never pays it; 400 ms caps empty rounds at ~2.5/s
+        // and keeps a 2.5x margin under the smallest timeout (>= 1 s) a
+        // benchmark configures — do not raise it toward the timeout
+        // floor.
         if (buffer.empty()) {
-          Digest digest;
+          mempool::PayloadRef first;
           if (rx_mempool->recv_until(
-                  &digest, std::chrono::steady_clock::now() +
-                               std::chrono::milliseconds(400)) ==
+                  &first, std::chrono::steady_clock::now() +
+                              std::chrono::milliseconds(400)) ==
               RecvStatus::kOk) {
-            buffer.insert(digest);
-            Digest more;
-            while (rx_mempool->try_recv(&more)) buffer.insert(more);
+            absorb(std::move(first));
+            mempool::PayloadRef more;
+            while (rx_mempool->try_recv(&more)) absorb(std::move(more));
           }
         }
-        make_block(name, committee, signature_service, &network, &buffer,
-                   cmd.round, std::move(cmd.qc), std::move(cmd.tc),
+        make_block(name, committee, signature_service, dag, &network,
+                   &buffer, cmd.round, std::move(cmd.qc), std::move(cmd.tc),
                    tx_loopback.get(), *stop);
       } else {
         for (const Digest& d : cmd.digests) buffer.erase(d);

@@ -1,7 +1,16 @@
-// Proposer: buffers payload digests from the mempool; on Make it builds and
-// signs a block, reliably broadcasts it, loops it back to the core, and
-// blocks until 2f+1 stake has ACKed the proposal (the reference's control
-// system, consensus/src/proposer.rs:19-143).
+// Proposer: buffers payload refs (digest + optional availability
+// certificate) from the mempool; on Make it builds and signs a block,
+// reliably broadcasts it, loops it back to the core, and blocks until
+// 2f+1 stake has ACKed the proposal (the reference's control system,
+// consensus/src/proposer.rs:19-143).
+//
+// graftdag: in dag mode a proposal carries the payload's availability
+// CERTIFICATES — constant-size proof the batches are retrievable — and
+// the blocking per-proposal ACK wait is skipped entirely: the
+// ReliableSender keeps retransmitting un-ACKed proposals, and the votes
+// the block gathers are the delivery proof that matters.  The proposer
+// thread is then free to pipeline round r+1's block while round r's is
+// still in flight.
 #pragma once
 
 #include <atomic>
@@ -17,7 +26,7 @@ namespace consensus {
 class Proposer {
  public:
   // Two independent inputs, as in the reference (proposer.rs:125-141):
-  // rx_mempool carries the payload-digest flood from the processors and may
+  // rx_mempool carries the payload-ref flood from the processors and may
   // back-pressure them; rx_message carries the core's Make/Cleanup commands
   // and must never be wedged behind digests (sharing one queue deadlocks
   // the whole committee under load: core blocked on proposer, proposer
@@ -25,8 +34,8 @@ class Proposer {
   // Returns the actor thread; exits when rx_message is closed. `stop`
   // breaks an in-progress 2f+1 ACK wait at teardown.
   static std::thread spawn(PublicKey name, Committee committee,
-                           SignatureService signature_service,
-                           ChannelPtr<Digest> rx_mempool,
+                           SignatureService signature_service, bool dag,
+                           ChannelPtr<mempool::PayloadRef> rx_mempool,
                            ChannelPtr<ProposerMessage> rx_message,
                            ChannelPtr<CoreEvent> tx_loopback,
                            std::shared_ptr<std::atomic<bool>> stop);

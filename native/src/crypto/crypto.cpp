@@ -105,6 +105,22 @@ struct CtxGuard {
 
 }  // namespace
 
+Signature Signature::sign_host(const Digest& digest, const SecretKey& sk) {
+  PkeyGuard key{EVP_PKEY_new_raw_private_key(kEvpPkeyEd25519, nullptr,
+                                             sk.seed(), 32)};
+  if (!key.p) throw std::runtime_error("bad secret key");
+  CtxGuard ctx{EVP_MD_CTX_new()};
+  Signature sig;
+  size_t siglen = sig.data.size();
+  if (EVP_DigestSignInit(ctx.c, nullptr, nullptr, nullptr, key.p) != 1 ||
+      EVP_DigestSign(ctx.c, sig.data.data(), &siglen, digest.data.data(),
+                     digest.data.size()) != 1 ||
+      siglen != 64) {
+    throw std::runtime_error("ed25519 sign failed");
+  }
+  return sig;
+}
+
 Signature Signature::sign(const Digest& digest, const SecretKey& sk) {
   if (current_scheme() == Scheme::kBls) {
     TpuVerifier* tpu = TpuVerifier::instance();
@@ -142,19 +158,7 @@ Signature Signature::sign(const Digest& digest, const SecretKey& sk) {
     LOG_ERROR("crypto") << "BLS signing unavailable; falling back to the "
                            "host Ed25519 identity key";
   }
-  PkeyGuard key{EVP_PKEY_new_raw_private_key(kEvpPkeyEd25519, nullptr,
-                                             sk.seed(), 32)};
-  if (!key.p) throw std::runtime_error("bad secret key");
-  CtxGuard ctx{EVP_MD_CTX_new()};
-  Signature sig;
-  size_t siglen = sig.data.size();
-  if (EVP_DigestSignInit(ctx.c, nullptr, nullptr, nullptr, key.p) != 1 ||
-      EVP_DigestSign(ctx.c, sig.data.data(), &siglen, digest.data.data(),
-                     digest.data.size()) != 1 ||
-      siglen != 64) {
-    throw std::runtime_error("ed25519 sign failed");
-  }
-  return sig;
+  return sign_host(digest, sk);
 }
 
 namespace {

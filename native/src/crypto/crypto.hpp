@@ -157,6 +157,13 @@ struct Signature {
   // changes stay live instead of stalling on invalid BLS bytes.
   static Signature sign(const Digest& digest, const SecretKey& sk);
 
+  // Host-forced Ed25519 signing, regardless of the scheme knob.  The dag
+  // mempool's batch ACKs go through here: availability certificates are
+  // Ed25519 under BOTH schemes (every committee entry carries the Ed25519
+  // identity key, and the verify path dispatches on signature length), so
+  // cert assembly never blocks on a sidecar round-trip per ACK.
+  static Signature sign_host(const Digest& digest, const SecretKey& sk);
+
   // Under scheme=bls, 64-byte signatures take the HOST Ed25519 path —
   // they are the sidecar-down fallback above, verified against the
   // signer's Ed25519 identity key; only 192-byte G2 signatures ride the

@@ -38,6 +38,15 @@ struct Parameters {
   size_t verify_batch = 64;           // records per admission launch
   uint64_t verify_max_delay = 20;     // ms; seal a partial verify batch
   size_t verify_queue_budget = 4096;  // txs queued ahead of verify
+  // graftdag certified-batch mempool (Narwhal-style availability
+  // separation): peers reply to each broadcast batch with an Ed25519
+  // SIGNED ack, the QuorumWaiter assembles 2f+1 of them into a
+  // BatchCertificate, and only the PRODUCER proposes its batch (as
+  // digest + certificate) — peers store payload bytes without feeding
+  // their own proposer, so dissemination scales with committee size
+  // instead of funneling every digest through every leader.  false
+  // keeps the legacy transport-ACK eventloop path for A/B measurement.
+  bool dag = false;
 
   static Parameters from_json(const Json& j) {
     Parameters p;
@@ -64,6 +73,7 @@ struct Parameters {
     if (auto* v = j.find("verify_queue_budget")) {
       p.verify_queue_budget = size_t(v->as_u64());
     }
+    if (auto* v = j.find("dag")) p.dag = v->as_bool();
     return p;
   }
 
@@ -89,6 +99,10 @@ struct Parameters {
       LOG_INFO("mempool::config")
           << "Ingress signature verification enabled with batch "
           << verify_batch << " txs";
+    }
+    // Optional line (same contract): absent on legacy eventloop runs.
+    if (dag) {
+      LOG_INFO("mempool::config") << "Dag certified batches enabled";
     }
   }
 };

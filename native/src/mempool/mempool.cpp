@@ -13,10 +13,12 @@ namespace hotstuff {
 namespace mempool {
 
 std::unique_ptr<Mempool> Mempool::spawn(
-    PublicKey name, Committee committee, Parameters parameters, Store store,
+    PublicKey name, SecretKey secret, Committee committee,
+    Parameters parameters, Store store,
     ChannelPtr<ConsensusMempoolMessage> rx_consensus,
-    ChannelPtr<Digest> tx_consensus) {
+    ChannelPtr<PayloadRef> tx_consensus) {
   parameters.log();
+  const bool dag = parameters.dag;
 
   auto mp = std::unique_ptr<Mempool>(new Mempool());
 
@@ -27,7 +29,7 @@ std::unique_ptr<Mempool> Mempool::spawn(
   auto tx_batch_maker =
       make_channel<Transaction>(parameters.ingress_tx_budget + 64);
   auto tx_quorum_waiter = make_channel<QuorumWaiterMessage>();
-  auto tx_processor = make_channel<Bytes>();       // our own acked batches
+  auto tx_processor = make_channel<ProcessorMessage>();  // own acked batches
   auto tx_helper =
       make_channel<std::pair<std::vector<Digest>, PublicKey>>();
 
@@ -149,7 +151,7 @@ std::unique_ptr<Mempool> Mempool::spawn(
                         committee.broadcast_addresses(name),
                         mp->stop_flag_, mp->ingress_gate_));
 
-  mp->threads_.push_back(QuorumWaiter::spawn(committee, committee.stake(name),
+  mp->threads_.push_back(QuorumWaiter::spawn(committee, name, secret, dag,
                                              tx_quorum_waiter, tx_processor,
                                              mp->stop_flag_));
 
@@ -164,54 +166,96 @@ std::unique_ptr<Mempool> Mempool::spawn(
   mp->threads_.push_back(Processor::spawn(store, tx_processor, tx_consensus));
 
   // Peer ingress (:mempool). ACK every message, then route by type
-  // (mempool.rs:225-243).
+  // (mempool.rs:225-243).  graftdag: batches are acked with a SIGNED
+  // kAck over the batch's ack digest — the availability vote the
+  // producer's QuorumWaiter assembles into a BatchCertificate — and
+  // their digests do NOT feed our proposer (only the producer proposes
+  // its own certified batch).
   auto peer_address = committee.mempool_address(name);
   if (!mp->peer_receiver_.spawn(
           *peer_address,
-          [store, tx_consensus, tx_processor,
-           tx_helper](ConnectionWriter& writer, Bytes msg) mutable {
-            writer.send(std::string("Ack"));
+          [store, tx_consensus, tx_processor, tx_helper, dag, name,
+           secret](ConnectionWriter& writer, Bytes msg) mutable {
             // Reactor-thread handler: blocking channel sends would stall
             // the whole process's data plane; drop under overload (the
             // sender's ReliableSender retransmits un-ACKed batches, the
             // payload synchronizer re-fetches missing batches, and sync
             // requests are re-issued on a timer).
+            if (!dag) writer.send(std::string("Ack"));
             try {
               MempoolMessage m = MempoolMessage::deserialize(msg);
               if (m.kind == MempoolMessage::Kind::kBatch) {
                 // Inline peer-batch processing (store + digest to
                 // consensus); ~25 us of SHA-512 on the reactor thread.
                 Digest digest = Processor::digest_of(msg);
+                bool accepted;
                 if (store.try_write(digest.to_bytes(), &msg)) {
-                  // Once stored, the batch bytes are consumed and the
-                  // sender saw an ACK — the digest MUST reach consensus
-                  // or this node can never propose the batch.  The node
-                  // wires this channel unbounded (node.cpp; digests are
-                  // 32 B), so this send never blocks there; a caller
-                  // that mis-wires a bounded channel gets reactor
-                  // backpressure instead of silent digest loss, and a
-                  // false return means the channel closed at shutdown.
-                  if (!tx_consensus->send(digest)) {
-                    LOG_WARN("mempool::mempool")
-                        << "consensus digest channel closed; dropping "
-                           "digest during shutdown";
+                  accepted = true;
+                  if (!dag) {
+                    // Once stored, the batch bytes are consumed and the
+                    // sender saw an ACK — the digest MUST reach consensus
+                    // or this node can never propose the batch.  The node
+                    // wires this channel unbounded (node.cpp; refs are
+                    // small), so this send never blocks there; a caller
+                    // that mis-wires a bounded channel gets reactor
+                    // backpressure instead of silent digest loss, and a
+                    // false return means the channel closed at shutdown.
+                    if (!tx_consensus->send(
+                            PayloadRef{digest, std::nullopt})) {
+                      LOG_WARN("mempool::mempool")
+                          << "consensus digest channel closed; dropping "
+                             "digest during shutdown";
+                    }
                   }
-                } else if (!tx_processor->try_send(std::move(msg))) {
+                } else {
                   // Overflow lane: a stalled store worker (WAL compaction
                   // rewrites the whole log synchronously) must not cost
                   // every peer's batches for the stall duration — the
                   // processor actor absorbs up to a channel of them and
                   // BLOCKS in store.write off-reactor, the pre-inline
                   // behavior.  Only both-full drops (recovered via batch
-                  // sync).
-                  LOG_WARN("mempool::mempool")
-                      << "processor overloaded; dropping batch";
+                  // sync).  Dag mode stores WITHOUT forwarding: the
+                  // producer, not us, proposes this batch.
+                  ProcessorMessage overflow;
+                  overflow.batch = std::move(msg);
+                  overflow.forward = !dag;
+                  accepted = tx_processor->try_send(std::move(overflow));
+                  if (!accepted) {
+                    LOG_WARN("mempool::mempool")
+                        << "processor overloaded; dropping batch";
+                  }
                 }
-              } else {
+                // graftdag: the availability vote is signed only for
+                // bytes that are stored (or queued for the store
+                // worker) — a signed ack over dropped bytes would let a
+                // certificate form for a batch we cannot serve to
+                // syncing peers.  Legacy mode already transport-acked
+                // above, before the store.
+                if (dag) {
+                  if (accepted) {
+                    writer.send(
+                        MempoolMessage::make_ack(
+                            digest, name,
+                            Signature::sign_host(
+                                BatchCertificate::ack_digest_of(digest),
+                                secret))
+                            .serialize());
+                  } else {
+                    // The sender's ReliableSender pairs replies to sends
+                    // FIFO per connection, so even a dropped batch must
+                    // be answered — a transport-only "Ack" that carries
+                    // no availability vote (the QuorumWaiter skips it).
+                    writer.send(std::string("Ack"));
+                  }
+                }
+              } else if (m.kind == MempoolMessage::Kind::kBatchRequest) {
+                if (dag) writer.send(std::string("Ack"));
                 if (!tx_helper->try_send({std::move(m.missing), m.origin})) {
                   LOG_WARN("mempool::mempool")
                       << "helper overloaded; dropping sync request";
                 }
+              } else if (dag) {
+                writer.send(std::string("Ack"));
               }
             } catch (const std::exception& e) {
               // Parse errors on peer bytes must not escape the connection

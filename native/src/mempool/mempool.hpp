@@ -23,12 +23,16 @@ namespace mempool {
 
 class Mempool {
  public:
-  // tx_consensus carries batch digests into the consensus proposer;
-  // rx_consensus carries Synchronize/Cleanup commands back.
+  // tx_consensus carries proposable payload refs (batch digest, plus the
+  // availability certificate in dag mode) into the consensus proposer;
+  // rx_consensus carries Synchronize/Cleanup commands back.  `secret`
+  // signs batch ACKs and our own certificate votes in dag mode (host
+  // Ed25519 under either scheme knob).
   static std::unique_ptr<Mempool> spawn(
-      PublicKey name, Committee committee, Parameters parameters, Store store,
+      PublicKey name, SecretKey secret, Committee committee,
+      Parameters parameters, Store store,
       ChannelPtr<ConsensusMempoolMessage> rx_consensus,
-      ChannelPtr<Digest> tx_consensus);
+      ChannelPtr<PayloadRef> tx_consensus);
 
   // Orderly teardown: set the stop flag, close every channel (waking any
   // actor blocked in send/recv), stop the receivers, join all actor

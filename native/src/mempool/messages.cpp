@@ -18,6 +18,11 @@ Bytes MempoolMessage::serialize() const {
       for (const auto& d : missing) d.serialize(&w);
       origin.serialize(&w);
       break;
+    case Kind::kAck:
+      ack_digest.serialize(&w);
+      ack_author.serialize(&w);
+      ack_signature.serialize(&w);
+      break;
   }
   return std::move(w.out);
 }
@@ -44,10 +49,39 @@ MempoolMessage MempoolMessage::deserialize(const Bytes& data) {
       m.origin = PublicKey::deserialize(&r);
       break;
     }
+    case kBatchAckTag: {
+      m.kind = Kind::kAck;
+      m.ack_digest = Digest::deserialize(&r);
+      m.ack_author = PublicKey::deserialize(&r);
+      m.ack_signature = Signature::deserialize(&r);
+      break;
+    }
     default:
       throw SerdeError("bad MempoolMessage tag");
   }
   return m;
+}
+
+void BatchCertificate::serialize(Writer* w) const {
+  digest.serialize(w);
+  w->u64(votes.size());
+  for (const auto& [pk, sig] : votes) {
+    pk.serialize(w);
+    sig.serialize(w);
+  }
+}
+
+BatchCertificate BatchCertificate::deserialize(Reader* r) {
+  BatchCertificate cert;
+  cert.digest = Digest::deserialize(r);
+  uint64_t n = r->seq_len(kCertVoteLen);
+  cert.votes.reserve(n);
+  for (uint64_t i = 0; i < n; i++) {
+    PublicKey pk = PublicKey::deserialize(r);
+    Signature sig = Signature::deserialize(r);
+    cert.votes.emplace_back(pk, std::move(sig));
+  }
+  return cert;
 }
 
 Json Committee::to_json() const {

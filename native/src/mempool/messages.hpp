@@ -2,6 +2,9 @@
 // (mempool/src/mempool.rs:29-42 in the reference).
 #pragma once
 
+#include <optional>
+#include <set>
+#include <string>
 #include <vector>
 
 #include "common/serde.hpp"
@@ -13,13 +16,34 @@ namespace mempool {
 using Transaction = Bytes;
 using Batch = std::vector<Transaction>;
 
+// graftdag wire constants, pinned against hotstuff_tpu/analysis/dagwire.py
+// by the graftlint wire cross-checker (wirecheck.py certframe rule) — edit
+// BOTH sides or the gate fails.
+//
+// kBatchAckTag: the MempoolMessage tag value of a signed batch ACK.
+// kBatchAckDomain: domain-separation constant folded into the digest an
+// ACK signs (ack digest = SHA-512/32 of batch digest || kBatchAckDomain
+// LE) so a batch-availability signature can never be replayed as a vote,
+// timeout, or tx-frame signature (all of which sign other derivations of
+// 32-byte digests).
+// kCertVoteLen: minimum serialized bytes per certificate vote record
+// (32-byte public key + 64-byte Ed25519 signature, the same per-element
+// bound QC::deserialize uses) — the deserializer's guard against hostile
+// length fields.
+constexpr uint32_t kBatchAckTag = 2;
+constexpr uint64_t kBatchAckDomain = 0x6b6361676164;  // "dagack" LE
+constexpr size_t kCertVoteLen = 96;
+
 struct MempoolMessage {
-  enum class Kind : uint32_t { kBatch = 0, kBatchRequest = 1 };
+  enum class Kind : uint32_t { kBatch = 0, kBatchRequest = 1, kAck = 2 };
 
   Kind kind;
   Batch batch;                   // kBatch
   std::vector<Digest> missing;   // kBatchRequest
   PublicKey origin;              // kBatchRequest
+  Digest ack_digest;             // kAck: the batch digest being certified
+  PublicKey ack_author;          // kAck
+  Signature ack_signature;       // kAck: Ed25519 over the ack digest
 
   static MempoolMessage make_batch(Batch b) {
     MempoolMessage m;
@@ -37,8 +61,119 @@ struct MempoolMessage {
     return m;
   }
 
+  static MempoolMessage make_ack(const Digest& batch_digest,
+                                 const PublicKey& author,
+                                 Signature signature) {
+    MempoolMessage m;
+    m.kind = Kind::kAck;
+    m.ack_digest = batch_digest;
+    m.ack_author = author;
+    m.ack_signature = std::move(signature);
+    return m;
+  }
+
   Bytes serialize() const;
   static MempoolMessage deserialize(const Bytes& data);
+};
+
+// graftdag availability certificate: a batch digest plus 2f+1 stake of
+// Ed25519 ACK signatures over its ack digest.  Possession of a valid
+// certificate proves the batch is retrievable from at least f+1 honest
+// replicas, so consensus can order the digest WITHOUT the payload bytes —
+// the Narwhal separation of availability from ordering.  QC-shaped by
+// construction (a vote quorum over ONE common digest), so its signature
+// batch rides the warmed sidecar RLC verify path.
+struct BatchCertificate {
+  Digest digest;  // the certified batch's digest (store key)
+  std::vector<std::pair<PublicKey, Signature>> votes;
+
+  // The digest every ACK signs: batch digest || kBatchAckDomain LE,
+  // SHA-512/32.  Exposed statically because the signer (peer receiver),
+  // the assembler (QuorumWaiter) and the verifier (consensus Core) must
+  // agree byte-for-byte.
+  static Digest ack_digest_of(const Digest& batch_digest) {
+    return DigestBuilder()
+        .update(batch_digest.data)
+        .update_u64_le(kBatchAckDomain)
+        .finalize();
+  }
+  Digest ack_digest() const { return ack_digest_of(digest); }
+
+  // The (digest, pk, sig) records a signature batch must verify — all
+  // votes share this certificate's ack digest (QC shape).
+  std::vector<std::tuple<Digest, PublicKey, Signature>> vote_items() const {
+    Digest d = ack_digest();
+    std::vector<std::tuple<Digest, PublicKey, Signature>> items;
+    items.reserve(votes.size());
+    for (const auto& [pk, sig] : votes) items.emplace_back(d, pk, sig);
+    return items;
+  }
+
+  // Hash over the full serialized certificate — the consensus Core's
+  // verified-certificate cache key (any tampered byte misses the cache
+  // and re-verifies; see QC::content_digest for the rationale).
+  Digest content_digest() const {
+    Writer w;
+    serialize(&w);
+    return DigestBuilder().update(w.out).finalize();
+  }
+
+  // Structural (stake/reuse/quorum/minimality) checks — everything but
+  // the signature batch; returns an error string, empty = ok.  Templated
+  // on the committee so both the mempool's and consensus's address books
+  // (same names, stakes and quorum rule) can gate a certificate.
+  // Mirrors check_vote_stakes in consensus/messages.cpp, including the
+  // equal-stakes minimality guard: a padded certificate is a shape the
+  // verify sidecar never warmed, so it is refused outright.
+  template <typename CommitteeT>
+  std::string check(const CommitteeT& committee) const {
+    using StakeT = decltype(committee.stake(PublicKey{}));
+    StakeT weight = 0;
+    StakeT min_stake = 0;
+    bool equal_stakes = true;
+    std::set<PublicKey> used;
+    for (const auto& [name, sig] : votes) {
+      (void)sig;
+      if (used.count(name)) {
+        return "authority reuse in batch certificate: " + name.to_base64();
+      }
+      StakeT stake = committee.stake(name);
+      if (stake == 0) {
+        return "unknown authority in batch certificate: " + name.to_base64();
+      }
+      used.insert(name);
+      weight += stake;
+      if (min_stake == 0) {
+        min_stake = stake;
+      } else if (stake != min_stake) {
+        equal_stakes = false;
+      }
+    }
+    if (weight < committee.quorum_threshold()) {
+      return "batch certificate requires a quorum";
+    }
+    if (equal_stakes && min_stake > 0 &&
+        weight - min_stake >= committee.quorum_threshold()) {
+      return "batch certificate carries more votes than a quorum";
+    }
+    return std::string();
+  }
+
+  void serialize(Writer* w) const;
+  static BatchCertificate deserialize(Reader* r);
+  Bytes to_bytes() const {
+    Writer w;
+    serialize(&w);
+    return std::move(w.out);
+  }
+};
+
+// What the mempool hands the consensus proposer per proposable batch: the
+// digest, plus (dag mode) the availability certificate the block will
+// carry in place of the payload bytes.
+struct PayloadRef {
+  Digest digest;
+  std::optional<BatchCertificate> cert;
 };
 
 // Commands the consensus sends to its mempool (Synchronize / Cleanup).
@@ -48,6 +183,11 @@ struct ConsensusMempoolMessage {
   Kind kind;
   std::vector<Digest> digests;  // kSynchronize
   PublicKey target;             // kSynchronize
+  // kSynchronize, graftdag: certificate signers known to HOLD the batch
+  // (they signed its availability ACK).  When non-empty the synchronizer
+  // fans the request across them instead of betting on the block author
+  // alone — cert-driven fetch.
+  std::vector<PublicKey> holders;
   uint64_t round = 0;           // kCleanup
 };
 

@@ -7,14 +7,16 @@
 namespace hotstuff {
 namespace mempool {
 
-std::thread Processor::spawn(Store store, ChannelPtr<Bytes> rx_batch,
-                      ChannelPtr<Digest> tx_digest) {
+std::thread Processor::spawn(Store store, ChannelPtr<ProcessorMessage> rx_batch,
+                      ChannelPtr<PayloadRef> tx_digest) {
   return std::thread([store, rx_batch, tx_digest]() mutable {
     set_thread_name("mp-processor");
-    while (auto batch = rx_batch->recv()) {
-      Digest digest = Processor::digest_of(*batch);
-      store.write(digest.to_bytes(), *batch);
-      tx_digest->send(digest);
+    while (auto msg = rx_batch->recv()) {
+      Digest digest = Processor::digest_of(msg->batch);
+      store.write(digest.to_bytes(), msg->batch);
+      if (msg->forward) {
+        tx_digest->send(PayloadRef{digest, std::move(msg->cert)});
+      }
     }
   });
 }

@@ -3,20 +3,33 @@
 // (mempool/src/processor.rs:16-39 in the reference).
 #pragma once
 
+#include <optional>
 #include <thread>
 
 #include "common/channel.hpp"
 #include "crypto/crypto.hpp"
+#include "mempool/messages.hpp"
 #include "store/store.hpp"
 
 namespace hotstuff {
 namespace mempool {
 
+// One batch headed for the store: our own quorum-acked batches (with the
+// assembled availability certificate in dag mode), or a peer batch off
+// the receiver's overflow lane.  `forward` false stores WITHOUT feeding
+// the proposer — dag mode's peer batches, where only the producer
+// proposes its own certified batch.
+struct ProcessorMessage {
+  Bytes batch;
+  std::optional<BatchCertificate> cert;
+  bool forward = true;
+};
+
 class Processor {
  public:
   // Returns the actor thread; exits when rx_batch is closed and drained.
-  static std::thread spawn(Store store, ChannelPtr<Bytes> rx_batch,
-                    ChannelPtr<Digest> tx_digest);
+  static std::thread spawn(Store store, ChannelPtr<ProcessorMessage> rx_batch,
+                    ChannelPtr<PayloadRef> tx_digest);
 
   // ONE source of truth for batch identity, shared by this actor and the
   // reactor-inlined peer path (mempool.cpp): the digest of the FULL

@@ -5,48 +5,153 @@
 #include <chrono>
 #include <condition_variable>
 #include <mutex>
+#include <set>
 #include <thread>
+#include <utility>
+#include <vector>
 
 namespace hotstuff {
 namespace mempool {
 
-std::thread QuorumWaiter::spawn(Committee committee, Stake my_stake,
+namespace {
+
+// Legacy (eventloop) wait: bare transport ACKs, stake counted per reply.
+bool wait_transport_acks(const Committee& committee, Stake my_stake,
+                         QuorumWaiterMessage* msg,
+                         const std::atomic<bool>& stop) {
+  // Stake accumulates as ACKs arrive in any order (the reference's
+  // FuturesUnordered wait, quorum_waiter.rs:60-86): each handler's
+  // on_ready callback bumps a shared counter; we sleep until quorum.
+  auto m = std::make_shared<std::mutex>();
+  auto cv = std::make_shared<std::condition_variable>();
+  auto total = std::make_shared<Stake>(my_stake);
+  for (const auto& [name, handler] : msg->handlers) {
+    Stake stake = committee.stake(name);
+    handler.on_ready([m, cv, total, stake](const Bytes& reply) {
+      // Empty bytes mean CANCELLED (teardown or full backlog), not a
+      // peer ACK — counting those would certify batch availability
+      // for peers that never received it.
+      if (reply.empty()) return;
+      std::lock_guard<std::mutex> lk(*m);
+      *total += stake;
+      cv->notify_one();
+    });
+  }
+  Stake quorum = committee.quorum_threshold();
+  std::unique_lock<std::mutex> lk(*m);
+  // Bounded waits so a teardown (stop set, peers gone) can't wedge the
+  // actor; in steady state the notify wakes us immediately.
+  while (*total < quorum && !stop.load(std::memory_order_relaxed)) {
+    cv->wait_for(lk, std::chrono::milliseconds(50));
+  }
+  return *total >= quorum;
+}
+
+// graftdag wait: each reply must be a well-formed kAck whose Ed25519
+// signature covers THIS batch's ack digest.  Replies are collected on
+// the sender's reply thread but parsed and verified HERE, so signature
+// work never stalls the network reactor.  Returns the assembled minimal
+// certificate, or nullopt when stopped before quorum.
+std::optional<BatchCertificate> wait_signed_acks(
+    const Committee& committee, const PublicKey& name,
+    const SecretKey& secret, QuorumWaiterMessage* msg,
+    const std::atomic<bool>& stop) {
+  Digest batch_digest = sha512_digest(msg->batch);
+  Digest ack_digest = BatchCertificate::ack_digest_of(batch_digest);
+
+  auto m = std::make_shared<std::mutex>();
+  auto cv = std::make_shared<std::condition_variable>();
+  auto replies = std::make_shared<std::vector<Bytes>>();
+  for (const auto& [peer, handler] : msg->handlers) {
+    (void)peer;  // attribution comes from the SIGNED author field
+    handler.on_ready([m, cv, replies](const Bytes& reply) {
+      if (reply.empty()) return;  // cancelled, not an ACK
+      std::lock_guard<std::mutex> lk(*m);
+      replies->push_back(reply);
+      cv->notify_one();
+    });
+  }
+
+  // Our own vote first: the producer trivially holds its own batch.
+  BatchCertificate cert;
+  cert.digest = batch_digest;
+  cert.votes.emplace_back(name, Signature::sign_host(ack_digest, secret));
+  Stake verified = committee.stake(name);
+  std::set<PublicKey> used{name};
+
+  Stake quorum = committee.quorum_threshold();
+  size_t consumed = 0;
+  while (verified < quorum && !stop.load(std::memory_order_relaxed)) {
+    Bytes reply;
+    {
+      std::unique_lock<std::mutex> lk(*m);
+      if (consumed == replies->size()) {
+        cv->wait_for(lk, std::chrono::milliseconds(50));
+        if (consumed == replies->size()) continue;
+      }
+      reply = std::move((*replies)[consumed++]);
+    }
+    // A bare transport "Ack" is a peer that received but could not store
+    // the batch (overloaded) — it keeps the sender's FIFO reply pairing
+    // intact but carries no availability vote.
+    if (reply.size() == 3 && reply[0] == 'A' && reply[1] == 'c' &&
+        reply[2] == 'k') {
+      continue;
+    }
+    // Parse + verify with the lock RELEASED (the reply thread only needs
+    // it to append).  Any malformed or mis-signed reply is dropped — the
+    // slot reopens for the honest retransmit.
+    try {
+      MempoolMessage ack = MempoolMessage::deserialize(reply);
+      if (ack.kind != MempoolMessage::Kind::kAck) continue;
+      if (ack.ack_digest != batch_digest) continue;  // stale/foreign ack
+      if (committee.stake(ack.ack_author) == 0) continue;
+      if (used.count(ack.ack_author)) continue;  // duplicate signer
+      if (!ack.ack_signature.verify(ack_digest, ack.ack_author)) {
+        LOG_WARN("mempool::quorum_waiter")
+            << "invalid batch-ack signature from "
+            << ack.ack_author.to_base64();
+        continue;
+      }
+      used.insert(ack.ack_author);
+      cert.votes.emplace_back(ack.ack_author, std::move(ack.ack_signature));
+      verified += committee.stake(ack.ack_author);
+    } catch (const std::exception& e) {
+      LOG_WARN("mempool::quorum_waiter")
+          << "Serialization failure on batch ack: " << e.what();
+    }
+  }
+  if (verified < quorum) return std::nullopt;  // stopped mid-wait
+  LOG_DEBUG("mempool::quorum_waiter")
+      << "Certified batch " << batch_digest.to_base64() << " with "
+      << cert.votes.size() << " signed acks";
+  return cert;
+}
+
+}  // namespace
+
+std::thread QuorumWaiter::spawn(Committee committee, PublicKey name,
+                                SecretKey secret, bool dag,
                                 ChannelPtr<QuorumWaiterMessage> rx_message,
-                                ChannelPtr<Bytes> tx_batch,
+                                ChannelPtr<ProcessorMessage> tx_batch,
                                 std::shared_ptr<std::atomic<bool>> stop) {
-  return std::thread([committee = std::move(committee), my_stake, rx_message,
-                      tx_batch, stop] {
+  return std::thread([committee = std::move(committee), name, secret, dag,
+                      rx_message, tx_batch, stop] {
     set_thread_name("quorum-wait");
     while (auto msg = rx_message->recv()) {
-      // Stake accumulates as ACKs arrive in any order (the reference's
-      // FuturesUnordered wait, quorum_waiter.rs:60-86): each handler's
-      // on_ready callback bumps a shared counter; we sleep until quorum.
-      auto m = std::make_shared<std::mutex>();
-      auto cv = std::make_shared<std::condition_variable>();
-      auto total = std::make_shared<Stake>(my_stake);
-      for (const auto& [name, handler] : msg->handlers) {
-        Stake stake = committee.stake(name);
-        handler.on_ready([m, cv, total, stake](const Bytes& reply) {
-          // Empty bytes mean CANCELLED (teardown or full backlog), not a
-          // peer ACK — counting those would certify batch availability
-          // for peers that never received it.
-          if (reply.empty()) return;
-          std::lock_guard<std::mutex> lk(*m);
-          *total += stake;
-          cv->notify_one();
-        });
+      ProcessorMessage out;
+      if (dag) {
+        auto cert = wait_signed_acks(committee, name, secret, &*msg, *stop);
+        if (!cert) break;  // stopped mid-wait
+        out.cert = std::move(*cert);
+      } else {
+        if (!wait_transport_acks(committee, committee.stake(name), &*msg,
+                                 *stop)) {
+          break;  // stopped mid-wait
+        }
       }
-      Stake quorum = committee.quorum_threshold();
-      std::unique_lock<std::mutex> lk(*m);
-      // Bounded waits so a teardown (stop set, peers gone) can't wedge the
-      // actor; in steady state the notify wakes us immediately.
-      while (*total < quorum &&
-             !stop->load(std::memory_order_relaxed)) {
-        cv->wait_for(lk, std::chrono::milliseconds(50));
-      }
-      if (*total < quorum) break;  // stopped mid-wait
-      lk.unlock();
-      tx_batch->send(std::move(msg->batch));
+      out.batch = std::move(msg->batch);
+      tx_batch->send(std::move(out));
     }
   });
 }

@@ -1,6 +1,14 @@
 // QuorumWaiter: holds each sealed batch until peers with 2f+1 cumulative
 // stake (including our own) have ACKed the broadcast, then releases it for
 // processing (mempool/src/quorum_waiter.rs:22-88 in the reference).
+//
+// graftdag: in dag mode the ACKs are Ed25519 SIGNATURES over the batch's
+// ack digest (see BatchCertificate) rather than bare transport ACKs.  The
+// waiter parses each signed reply, verifies it on THIS thread (never the
+// sender's reactor), accumulates verified stake, and releases the batch
+// together with the assembled availability certificate — minimal (exactly
+// a quorum under equal stakes), so it passes the structural over-quorum
+// guard every verifier applies.
 #pragma once
 
 #include <atomic>
@@ -10,6 +18,7 @@
 #include "common/channel.hpp"
 #include "mempool/batch_maker.hpp"
 #include "mempool/config.hpp"
+#include "mempool/processor.hpp"
 
 namespace hotstuff {
 namespace mempool {
@@ -18,10 +27,13 @@ class QuorumWaiter {
  public:
   // Returns the actor thread; exits when rx_message is closed and drained.
   // `stop` breaks an in-progress stake wait at teardown (the ACKs it is
-  // waiting for may never arrive once peers shut down).
-  static std::thread spawn(Committee committee, Stake my_stake,
+  // waiting for may never arrive once peers shut down).  `secret` signs
+  // our own certificate vote in dag mode (host Ed25519, scheme-agnostic);
+  // legacy mode ignores it.
+  static std::thread spawn(Committee committee, PublicKey name,
+                           SecretKey secret, bool dag,
                            ChannelPtr<QuorumWaiterMessage> rx_message,
-                           ChannelPtr<Bytes> tx_batch,
+                           ChannelPtr<ProcessorMessage> tx_batch,
                            std::shared_ptr<std::atomic<bool>> stop);
 };
 

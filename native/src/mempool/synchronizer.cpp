@@ -83,6 +83,14 @@ std::thread Synchronizer::spawn(PublicKey name, Committee committee, Store store
           std::vector<Digest> missing;
           for (const auto& digest : msg.digests) {
             if (pending.count(digest)) continue;
+            // graftdag: consensus prefetch no longer reads the store on
+            // the core thread — the possession check lives here instead.
+            // A blocking read on this thread only delays background sync,
+            // never block processing.  Skipping present digests entirely
+            // (no pending entry, no network request) keeps already-held
+            // certified batches free: any waiter's own notify_read fires
+            // immediately for existing keys.
+            if (store.read(digest.to_bytes())) continue;
             missing.push_back(digest);
             LOG_DEBUG("mempool::synchronizer")
                 << "Requesting sync for batch " << digest.to_base64();
@@ -93,6 +101,27 @@ std::thread Synchronizer::spawn(PublicKey name, Committee committee, Store store
                 });
           }
           if (missing.empty()) break;
+          Bytes serialized =
+              MempoolMessage::make_batch_request(missing, name).serialize();
+          // graftdag: when consensus knows WHO certified the batch (the
+          // certificate's signers), fan the first request across up to
+          // sync_retry_nodes of them — every holder signed for stored
+          // bytes, so any one honest signer can serve us, and we no
+          // longer depend on the (possibly crashed) block author alone.
+          if (!msg.holders.empty()) {
+            size_t fan = sync_retry_nodes ? sync_retry_nodes : 1;
+            size_t sent = 0;
+            for (const auto& holder : msg.holders) {
+              if (sent >= fan) break;
+              if (holder == name) continue;  // we already know it's missing
+              auto addr = committee.mempool_address(holder);
+              if (!addr) continue;
+              network.send(*addr, Bytes(serialized));
+              ++sent;
+            }
+            if (sent > 0) break;
+            // Every holder unknown/self: fall through to the author.
+          }
           auto address = committee.mempool_address(msg.target);
           if (!address) {
             LOG_ERROR("mempool::synchronizer")
@@ -100,8 +129,6 @@ std::thread Synchronizer::spawn(PublicKey name, Committee committee, Store store
                 << msg.target.to_base64();
             break;
           }
-          Bytes serialized =
-              MempoolMessage::make_batch_request(missing, name).serialize();
           network.send(*address, std::move(serialized));
           break;
         }

@@ -20,6 +20,15 @@ std::unique_ptr<Node> Node::create(const std::string& committee_file,
   auto node = std::unique_ptr<Node>(new Node());
   node->name_ = secret.name;
   node->store_ = Store::open(store_path);
+  // Batches get their own store actor (graftdag).  A store is a single
+  // worker thread behind a bounded command queue, and Store::read is a
+  // blocking round trip through that queue: with one shared store, the
+  // core's small metadata reads (parent blocks on the commit walk, state
+  // flushes) sat behind a firehose of ~500 KB batch writes and stretched
+  // to seconds under load, cascading into consensus timeouts.  Splitting
+  // the WALs keeps the consensus critical path off the bulk-data queue.
+  node->batch_store_ = Store::open(
+      store_path.empty() ? store_path : store_path + "-batches");
   node->commit_ = make_channel<consensus::Block>();
 
   // grafttrace: span lines are opt-in per deployment; the harness turns
@@ -73,22 +82,23 @@ std::unique_ptr<Node> Node::create(const std::string& committee_file,
   SignatureService signature_service(secret.secret);
 
   // Effectively unbounded (like the mempool synchronizer's payload-waiter
-  // channel): a digest is 32 bytes, and the mempool's inlined peer-batch
-  // path try_sends here AFTER the batch is stored and ACKed — a bounded
-  // channel would drop the digest under a consensus backlog and the
-  // stored batch could never be proposed by this node (round-5 ADVICE.md).
-  auto tx_mempool_to_consensus = make_channel<Digest>(SIZE_MAX);
+  // channel): a payload ref is small (digest + cert handle), and the
+  // mempool's inlined peer-batch path try_sends here AFTER the batch is
+  // stored and ACKed — a bounded channel would drop the ref under a
+  // consensus backlog and the stored batch could never be proposed by
+  // this node (round-5 ADVICE.md).
+  auto tx_mempool_to_consensus = make_channel<mempool::PayloadRef>(SIZE_MAX);
   auto tx_consensus_to_mempool =
       make_channel<mempool::ConsensusMempoolMessage>();
 
   node->mempool_ = mempool::Mempool::spawn(
-      secret.name, committee.mempool, parameters.mempool, node->store_,
-      tx_consensus_to_mempool, tx_mempool_to_consensus);
+      secret.name, secret.secret, committee.mempool, parameters.mempool,
+      node->batch_store_, tx_consensus_to_mempool, tx_mempool_to_consensus);
 
   node->consensus_ = consensus::Consensus::spawn(
       secret.name, committee.consensus, parameters.consensus,
-      signature_service, node->store_, tx_mempool_to_consensus,
-      tx_consensus_to_mempool, node->commit_);
+      signature_service, node->store_, node->batch_store_,
+      tx_mempool_to_consensus, tx_consensus_to_mempool, node->commit_);
 
   LOG_INFO("node::node")
       << "Node " << secret.name.to_base64() << " successfully booted";

@@ -36,7 +36,8 @@ class Node {
   Node() = default;
 
   PublicKey name_;
-  Store store_;
+  Store store_;        // consensus metadata (blocks, vote state)
+  Store batch_store_;  // mempool batch payloads (write-heavy)
   ChannelPtr<consensus::Block> commit_;
   std::unique_ptr<mempool::Mempool> mempool_;
   std::unique_ptr<consensus::Consensus> consensus_;

@@ -5,6 +5,7 @@
 #include <unistd.h>
 
 #include <array>
+#include <chrono>
 #include <cstdio>
 #include <list>
 #include <stdexcept>
@@ -451,7 +452,14 @@ void Store::write(const Bytes& key, const Bytes& value) {
   cmd.kind = Command::Kind::kWrite;
   cmd.key = key;
   cmd.value = value;
+  auto start = std::chrono::steady_clock::now();
   ch_->send(std::move(cmd));
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::steady_clock::now() - start)
+                .count();
+  if (ms > 200) {
+    LOG_WARN("store") << "SLOW write enqueue blocked " << ms << " ms";
+  }
 }
 
 bool Store::try_write(const Bytes& key, Bytes* value) {
@@ -472,8 +480,16 @@ std::optional<Bytes> Store::read(const Bytes& key) {
   cmd.kind = Command::Kind::kRead;
   cmd.key = key;
   auto reply = cmd.read_reply;
+  auto start = std::chrono::steady_clock::now();
   if (!ch_->send(std::move(cmd))) return std::nullopt;
-  return reply.wait();
+  auto result = reply.wait();
+  auto ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                std::chrono::steady_clock::now() - start)
+                .count();
+  if (ms > 200) {
+    LOG_WARN("store") << "SLOW read round-trip " << ms << " ms";
+  }
+  return result;
 }
 
 Oneshot<Bytes> Store::notify_read(const Bytes& key) {

@@ -260,6 +260,186 @@ TEST(core_commits_three_chain_one_round_later) {
   CHECK(committed->digest() == chain[0].digest());
 }
 
+TEST(core_commits_four_chain_two_rounds_later) {
+  // The generalized k-chain walk at chain_depth=4: FOUR consecutive
+  // certified rounds are needed, so blocks 1..4 commit nothing (3-chain
+  // would have committed B1 at block 4) and block 5 commits block 1.
+  auto committee = consensus_committee(8710);
+  CoreFixture fx;
+  auto ks = keys();
+  auto sorted = committee.sorted_keys();
+  auto key_for = [&](const PublicKey& name) -> const KeyPair& {
+    for (const auto& kp : ks) {
+      if (kp.name == name) return kp;
+    }
+    throw std::runtime_error("unknown leader");
+  };
+  std::vector<Block> chain;
+  QC qc;
+  for (uint64_t round = 1; round <= 5; round++) {
+    Bytes payload_bytes{uint8_t(round)};
+    Digest payload = sha512_digest(payload_bytes);
+    fx.store.write(payload.to_bytes(), payload_bytes);
+    Block b = make_block(qc, key_for(sorted[round % sorted.size()]), round,
+                         {payload});
+    qc = make_qc(b.digest(), b.round);
+    chain.push_back(std::move(b));
+  }
+  fx.spawn_core(0, committee, /*timeout_delay=*/60'000, /*chain_depth=*/4);
+  for (size_t i = 0; i < 4; i++) {
+    fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+        ConsensusMessage::propose(chain[i]))));
+  }
+  Block none;
+  auto status = fx.tx_commit->recv_until(
+      &none, std::chrono::steady_clock::now() + std::chrono::milliseconds(500));
+  CHECK(status == RecvStatus::kTimeout);
+  fx.tx_core->send(CoreEvent::msg(ConsensusMessage::deserialize(
+      ConsensusMessage::propose(chain[4]))));
+  auto committed = fx.tx_commit->recv();
+  CHECK(committed.has_value());
+  CHECK(committed->round == 1);
+  CHECK(committed->digest() == chain[0].digest());
+}
+
+// -- graftdag: certificate-carrying blocks ----------------------------------
+
+namespace {
+
+// 2f+1 signed availability ACKs over `batch_digest` from the first 3
+// fixture keys (the mempool QuorumWaiter's output, rebuilt by hand).
+mempool::BatchCertificate make_cert(const Digest& batch_digest) {
+  mempool::BatchCertificate cert;
+  cert.digest = batch_digest;
+  Digest ack = cert.ack_digest();
+  auto ks = keys();
+  for (size_t i = 0; i < 3; i++) {
+    cert.votes.emplace_back(ks[i].name,
+                            Signature::sign_host(ack, ks[i].secret));
+  }
+  return cert;
+}
+
+}  // namespace
+
+TEST(block_with_certs_serde_and_shape_checks) {
+  auto committee = consensus_committee(8730);
+  auto ks = keys();
+  auto sorted = committee.sorted_keys();
+  auto key_for = [&](const PublicKey& name) -> const KeyPair& {
+    for (const auto& kp : ks) {
+      if (kp.name == name) return kp;
+    }
+    throw std::runtime_error("unknown leader");
+  };
+  Digest payload = sha512_digest(Bytes{1, 2, 3});
+  Block b = make_block(QC{}, key_for(sorted[1 % sorted.size()]), 1, {payload});
+  b.certs.push_back(make_cert(payload));
+  // Certs are NOT covered by digest(): attaching one after signing must
+  // not invalidate the author signature (two blocks differing only in
+  // cert vote sets order the same batches).
+  CHECK(b.signature.verify(b.digest(), b.author));
+  CHECK(b.check_certs(committee).ok());
+  CHECK(b.verify(committee).ok());
+
+  // Serde round trip carries the certificate byte-for-byte.
+  Block rt = Block::from_bytes(b.to_bytes());
+  CHECK(rt.digest() == b.digest());
+  CHECK(rt.certs.size() == 1);
+  CHECK(rt.certs[0].digest == payload);
+  CHECK(rt.certs[0].content_digest() == b.certs[0].content_digest());
+  CHECK(rt.verify(committee).ok());
+
+  // Shape violations: cert over the WRONG digest, and a cert count that
+  // does not match the payload list.
+  Block wrong = b;
+  wrong.certs[0] = make_cert(sha512_digest(Bytes{4, 5, 6}));
+  CHECK(!wrong.check_certs(committee).ok());
+  Block extra = b;
+  extra.certs.push_back(make_cert(payload));
+  CHECK(!extra.check_certs(committee).ok());
+  // A padded (over-quorum) certificate fails the structural check too.
+  Block padded = b;
+  padded.certs[0].votes.emplace_back(
+      ks[3].name,
+      Signature::sign_host(padded.certs[0].ack_digest(), ks[3].secret));
+  CHECK(!padded.check_certs(committee).ok());
+}
+
+TEST(core_votes_on_certified_proposal_without_payload) {
+  // Vote-without-possession: the payload bytes are NOT in our store, but
+  // the block carries an availability certificate — the core must vote
+  // anyway (the cert proves retrievability) and fire a cert-driven
+  // prefetch naming the signers as holders, never suspending the round.
+  auto committee = consensus_committee(8740);
+  auto chain = make_chain(1, committee);
+  Block block = chain[0];
+  Digest payload = sha512_digest(Bytes{7, 7, 7});
+  block.payload = {payload};
+  block.certs = {make_cert(payload)};
+  auto ks = keys();
+  auto sorted = committee.sorted_keys();
+  auto key_for = [&](const PublicKey& name) -> const KeyPair& {
+    for (const auto& kp : ks) {
+      if (kp.name == name) return kp;
+    }
+    throw std::runtime_error("unknown leader");
+  };
+  block.signature =
+      Signature::sign(block.digest(), key_for(block.author).secret);
+
+  PublicKey next_leader = sorted[2 % sorted.size()];
+  size_t us = 0;
+  while (keys()[us].name == next_leader) us++;
+  auto l = Listener::bind(*committee.address(next_leader));
+  CHECK(l.has_value());
+  auto delivered = make_channel<Bytes>();
+  auto t = listener(std::move(*l),
+                    [delivered](Bytes b) { delivered->send(std::move(b)); });
+
+  CoreFixture fx;
+  fx.spawn_core(us, committee);
+  fx.tx_core->send(CoreEvent::msg(
+      ConsensusMessage::deserialize(ConsensusMessage::propose(block))));
+
+  // The prefetch goes out BEFORE the block is processed: one Synchronize
+  // per missing certified digest, holders = the cert's signers.
+  auto sync = fx.tx_mempool->recv();
+  CHECK(sync.has_value());
+  CHECK(sync->kind == mempool::ConsensusMempoolMessage::Kind::kSynchronize);
+  CHECK(sync->digests.size() == 1);
+  CHECK(sync->digests[0] == payload);
+  CHECK(sync->target == block.author);
+  CHECK(sync->holders.size() == 3);
+  CHECK(sync->holders[0] == block.certs[0].votes[0].first);
+
+  auto got = delivered->recv();
+  CHECK(got.has_value());
+  auto msg = ConsensusMessage::deserialize(*got);
+  CHECK(msg.kind == ConsensusMessage::Kind::kVote);
+  CHECK(msg.vote.hash == block.digest());
+  CHECK(msg.vote.verify(committee).ok());
+  t.join();
+}
+
+TEST(aggregator_gc_committed_drops_dead_rounds) {
+  // Commit-keyed GC: everything at or below the committed round dies
+  // (its QC already exists), later rounds keep aggregating.
+  auto committee = consensus_committee(8750);  // address book only
+  Aggregator aggregator(committee);
+  auto chain = make_chain(3, committee);
+  auto ks = keys();
+  aggregator.add_vote(make_vote(chain[0], ks[0]));  // round 1
+  aggregator.add_vote(make_vote(chain[1], ks[0]));  // round 2
+  aggregator.add_vote(make_vote(chain[2], ks[0]));  // round 3
+  CHECK(aggregator.gc_committed(2) == 2);  // rounds 1 and 2 dropped
+  CHECK(aggregator.gc_committed(2) == 0);  // idempotent
+  // Round 1's state is gone: the same vote admits cleanly again.
+  CHECK(aggregator.add_vote(make_vote(chain[0], ks[0])).error.empty());
+  // Round 3 survived: its duplicate-author guard still remembers ks[0].
+  CHECK(!aggregator.add_vote(make_vote(chain[2], ks[0])).error.empty());
+}
+
 TEST(core_broadcasts_timeout_on_timer) {
   // Timer fires -> Timeout broadcast to all peers (core_tests.rs:162-192).
   auto committee = consensus_committee(8600);

@@ -94,10 +94,10 @@ TEST(quorum_waiter_waits_for_stake) {
   auto committee = mempool_committee(7300);
   auto myself = keys()[0].name;
   auto rx_msg = make_channel<QuorumWaiterMessage>();
-  auto tx_batch = make_channel<Bytes>();
+  auto tx_batch = make_channel<ProcessorMessage>();
   auto stop = std::make_shared<std::atomic<bool>>(false);
-  auto actor = QuorumWaiter::spawn(committee, committee.stake(myself), rx_msg,
-                                   tx_batch, stop);
+  auto actor = QuorumWaiter::spawn(committee, myself, keys()[0].secret,
+                                   /*dag=*/false, rx_msg, tx_batch, stop);
 
   QuorumWaiterMessage msg;
   msg.batch = Bytes{1, 2, 3};
@@ -110,7 +110,7 @@ TEST(quorum_waiter_waits_for_stake) {
   rx_msg->send(std::move(msg));
 
   // With only our stake (1) nothing is delivered yet; two ACKs reach 2f+1=3.
-  Bytes out;
+  ProcessorMessage out;
   CHECK(tx_batch->recv_until(&out, std::chrono::steady_clock::now() +
                                        std::chrono::milliseconds(100)) ==
         RecvStatus::kTimeout);
@@ -118,7 +118,9 @@ TEST(quorum_waiter_waits_for_stake) {
   handlers[1].set(to_bytes("Ack"));
   auto got = tx_batch->recv();
   CHECK(got.has_value());
-  CHECK(*got == (Bytes{1, 2, 3}));
+  CHECK(got->batch == (Bytes{1, 2, 3}));
+  CHECK(!got->cert.has_value());  // legacy mode: no certificate
+  CHECK(got->forward);
   rx_msg->close();
   tx_batch->close();
   actor.join();
@@ -131,10 +133,10 @@ TEST(quorum_waiter_ignores_cancelled_acks) {
   auto committee = mempool_committee(7320);
   auto myself = keys()[0].name;
   auto rx_msg = make_channel<QuorumWaiterMessage>();
-  auto tx_batch = make_channel<Bytes>();
+  auto tx_batch = make_channel<ProcessorMessage>();
   auto stop = std::make_shared<std::atomic<bool>>(false);
-  auto actor = QuorumWaiter::spawn(committee, committee.stake(myself), rx_msg,
-                                   tx_batch, stop);
+  auto actor = QuorumWaiter::spawn(committee, myself, keys()[0].secret,
+                                   /*dag=*/false, rx_msg, tx_batch, stop);
 
   QuorumWaiterMessage msg;
   msg.batch = Bytes{9, 9};
@@ -151,7 +153,7 @@ TEST(quorum_waiter_ignores_cancelled_acks) {
   CHECK(handlers.size() == 3);  // 4-node committee: 3 peers
   handlers[0].set(Bytes{});
   handlers[1].set(to_bytes("Ack"));
-  Bytes out;
+  ProcessorMessage out;
   CHECK(tx_batch->recv_until(&out, std::chrono::steady_clock::now() +
                                        std::chrono::milliseconds(200)) ==
         RecvStatus::kTimeout);
@@ -159,7 +161,7 @@ TEST(quorum_waiter_ignores_cancelled_acks) {
   handlers[2].set(to_bytes("Ack"));
   auto got = tx_batch->recv();
   CHECK(got.has_value());
-  CHECK(*got == (Bytes{9, 9}));
+  CHECK(got->batch == (Bytes{9, 9}));
   rx_msg->close();
   tx_batch->close();
   actor.join();
@@ -167,17 +169,47 @@ TEST(quorum_waiter_ignores_cancelled_acks) {
 
 TEST(processor_hashes_and_stores) {
   Store store = Store::open("");
-  auto rx_batch = make_channel<Bytes>();
-  auto tx_digest = make_channel<Digest>();
+  auto rx_batch = make_channel<ProcessorMessage>();
+  auto tx_digest = make_channel<PayloadRef>();
   auto actor = Processor::spawn(store, rx_batch, tx_digest);
   Bytes batch{7, 7, 7, 7};
-  rx_batch->send(batch);
-  auto digest = tx_digest->recv();
-  CHECK(digest.has_value());
-  CHECK(*digest == sha512_digest(batch));
-  auto stored = store.read(digest->to_bytes());
+  ProcessorMessage pm;
+  pm.batch = batch;
+  rx_batch->send(std::move(pm));
+  auto ref = tx_digest->recv();
+  CHECK(ref.has_value());
+  CHECK(ref->digest == sha512_digest(batch));
+  CHECK(!ref->cert.has_value());
+  auto stored = store.read(ref->digest.to_bytes());
   CHECK(stored.has_value());
   CHECK(*stored == batch);
+  rx_batch->close();
+  tx_digest->close();
+  actor.join();
+}
+
+TEST(processor_forward_false_stores_without_digest) {
+  // graftdag peer lane: a cert-mode peer batch is stored for availability
+  // but must NOT feed this node's proposer (only the producer proposes
+  // its own certified batches).
+  Store store = Store::open("");
+  auto rx_batch = make_channel<ProcessorMessage>();
+  auto tx_digest = make_channel<PayloadRef>();
+  auto actor = Processor::spawn(store, rx_batch, tx_digest);
+  Bytes peer_batch{5, 5, 5};
+  ProcessorMessage pm;
+  pm.batch = peer_batch;
+  pm.forward = false;
+  rx_batch->send(std::move(pm));
+  // A forwarded batch after it proves the first was processed (FIFO).
+  Bytes own_batch{6, 6};
+  ProcessorMessage own;
+  own.batch = own_batch;
+  rx_batch->send(std::move(own));
+  auto ref = tx_digest->recv();
+  CHECK(ref.has_value());
+  CHECK(ref->digest == sha512_digest(own_batch));  // peer digest skipped
+  CHECK(store.read(sha512_digest(peer_batch).to_bytes()).has_value());
   rx_batch->close();
   tx_digest->close();
   actor.join();
@@ -255,9 +287,9 @@ TEST(mempool_pipeline_end_to_end) {
   params.batch_size = 20;  // tiny: one tx seals a batch
   params.max_batch_delay = 10'000;
   auto rx_consensus = make_channel<ConsensusMempoolMessage>();
-  auto tx_consensus = make_channel<Digest>();
-  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
-                           tx_consensus);
+  auto tx_consensus = make_channel<PayloadRef>();
+  auto mp = Mempool::spawn(myself, keys()[0].secret, committee, params, store,
+                           rx_consensus, tx_consensus);
 
   // Send a client transaction to the :front address.
   auto sock = Socket::connect(*committee.transactions_address(myself));
@@ -265,9 +297,10 @@ TEST(mempool_pipeline_end_to_end) {
   Bytes tx(32, 9);
   CHECK(sock->write_frame(tx));
 
-  auto digest = tx_consensus->recv();
-  CHECK(digest.has_value());
-  auto stored = store.read(digest->to_bytes());
+  auto ref = tx_consensus->recv();
+  CHECK(ref.has_value());
+  CHECK(!ref->cert.has_value());  // legacy mode: digest only
+  auto stored = store.read(ref->digest.to_bytes());
   CHECK(stored.has_value());
   auto m = MempoolMessage::deserialize(*stored);
   CHECK(m.batch.size() == 1);
@@ -345,9 +378,9 @@ TEST(mempool_bounded_ingress_replies_busy) {
   params.max_batch_delay = 60'000;
   params.ingress_tx_budget = 16;
   auto rx_consensus = make_channel<ConsensusMempoolMessage>();
-  auto tx_consensus = make_channel<Digest>();
-  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
-                           tx_consensus);
+  auto tx_consensus = make_channel<PayloadRef>();
+  auto mp = Mempool::spawn(myself, keys()[0].secret, committee, params, store,
+                           rx_consensus, tx_consensus);
 
   auto sock = Socket::connect(*committee.transactions_address(myself));
   CHECK(sock.has_value());
@@ -384,9 +417,9 @@ TEST(peer_batch_digest_survives_consensus_backlog) {
   params.batch_size = 1'000'000;  // nothing seals: only peer batches flow
   params.max_batch_delay = 60'000;
   auto rx_consensus = make_channel<ConsensusMempoolMessage>();
-  auto tx_consensus = make_channel<Digest>(SIZE_MAX);  // the node wiring
-  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
-                           tx_consensus);
+  auto tx_consensus = make_channel<PayloadRef>(SIZE_MAX);  // the node wiring
+  auto mp = Mempool::spawn(myself, keys()[0].secret, committee, params, store,
+                           rx_consensus, tx_consensus);
 
   auto sock = Socket::connect(*committee.mempool_address(myself));
   CHECK(sock.has_value());
@@ -402,10 +435,242 @@ TEST(peer_batch_digest_survives_consensus_backlog) {
   }
   // All digests arrived (nothing was dropped) and every batch is stored.
   for (size_t i = 0; i < kBatches; i++) {
-    auto digest = tx_consensus->recv();
-    CHECK(digest.has_value());
-    CHECK(store.read(digest->to_bytes()).has_value());
+    auto ref = tx_consensus->recv();
+    CHECK(ref.has_value());
+    CHECK(store.read(ref->digest.to_bytes()).has_value());
   }
+  mp->stop();
+}
+
+// -- graftdag: signed batch ACKs + availability certificates ----------------
+
+TEST(batch_ack_message_roundtrip) {
+  auto kp = keys()[1];
+  Digest batch_digest = sha512_digest(Bytes{1, 2, 3});
+  Digest ack = BatchCertificate::ack_digest_of(batch_digest);
+  // Domain separation: an availability ACK never signs the raw batch
+  // digest, so it can't be replayed as any other signature kind.
+  CHECK(!(ack == batch_digest));
+  auto msg = MempoolMessage::make_ack(batch_digest, kp.name,
+                                      Signature::sign_host(ack, kp.secret));
+  auto rt = MempoolMessage::deserialize(msg.serialize());
+  CHECK(rt.kind == MempoolMessage::Kind::kAck);
+  CHECK(rt.ack_digest == batch_digest);
+  CHECK(rt.ack_author == kp.name);
+  CHECK(rt.ack_signature.verify(ack, kp.name));
+}
+
+TEST(batch_certificate_roundtrip_and_structural_checks) {
+  auto committee = mempool_committee(8000);  // address book only, no net
+  auto ks = keys();
+  BatchCertificate cert;
+  cert.digest = sha512_digest(Bytes{9, 9, 9});
+  Digest ack = cert.ack_digest();
+  for (size_t i = 0; i < 3; i++) {
+    cert.votes.emplace_back(ks[i].name,
+                            Signature::sign_host(ack, ks[i].secret));
+  }
+  CHECK(cert.check(committee).empty());
+  CHECK(Signature::verify_batch(ack, cert.votes));
+
+  // Serde round trip preserves every byte (content digest is the
+  // consensus Core's verified-cert cache key).
+  Bytes wire = cert.to_bytes();
+  Reader r(wire);
+  auto rt = BatchCertificate::deserialize(&r);
+  CHECK(rt.digest == cert.digest);
+  CHECK(rt.votes.size() == 3);
+  CHECK(rt.content_digest() == cert.content_digest());
+  CHECK(rt.check(committee).empty());
+
+  // Below 2f+1 refused.
+  BatchCertificate small = cert;
+  small.votes.pop_back();
+  CHECK(!small.check(committee).empty());
+  // A duplicate signer must not count twice toward the quorum.
+  BatchCertificate dup = cert;
+  dup.votes[2] = dup.votes[0];
+  CHECK(!dup.check(committee).empty());
+  // Padded past the quorum (equal stakes) refused: a shape the verify
+  // sidecar never warmed.
+  BatchCertificate padded = cert;
+  padded.votes.emplace_back(ks[3].name,
+                            Signature::sign_host(ack, ks[3].secret));
+  CHECK(!padded.check(committee).empty());
+  // A signer outside the committee refused.
+  std::array<uint8_t, 32> seed{};
+  seed[0] = 200;
+  auto stranger = keypair_from_seed(seed);
+  BatchCertificate foreign = cert;
+  foreign.votes[2] = {stranger.name,
+                      Signature::sign_host(ack, stranger.secret)};
+  CHECK(!foreign.check(committee).empty());
+}
+
+TEST(quorum_waiter_dag_assembles_minimal_certificate) {
+  // Signed-ACK collection: transport "Ack"s and forged votes carry no
+  // stake; two honest signed peer votes plus our own reach 2f+1 = 3 and
+  // the released batch carries a minimal, structurally valid certificate.
+  auto committee = mempool_committee(8020);
+  auto ks = keys();
+  auto myself = ks[0].name;
+  auto rx_msg = make_channel<QuorumWaiterMessage>();
+  auto tx_batch = make_channel<ProcessorMessage>();
+  auto stop = std::make_shared<std::atomic<bool>>(false);
+  auto actor = QuorumWaiter::spawn(committee, myself, ks[0].secret,
+                                   /*dag=*/true, rx_msg, tx_batch, stop);
+
+  QuorumWaiterMessage msg;
+  msg.batch = MempoolMessage::make_batch({{1, 2, 3}}).serialize();
+  Digest digest = Processor::digest_of(msg.batch);
+  Digest ack = BatchCertificate::ack_digest_of(digest);
+  std::vector<CancelHandler> handlers;
+  std::vector<PublicKey> peers;
+  for (const auto& [name, _] : committee.broadcast_addresses(myself)) {
+    CancelHandler h;
+    handlers.push_back(h);
+    peers.push_back(name);
+    msg.handlers.emplace_back(name, h);
+  }
+  rx_msg->send(std::move(msg));
+  auto key_for = [&](const PublicKey& name) -> const KeyPair& {
+    for (const auto& kp : ks) {
+      if (kp.name == name) return kp;
+    }
+    throw std::runtime_error("unknown peer");
+  };
+
+  // Slot 0: a FORGED vote claiming peer 1 — signed over the raw batch
+  // digest instead of the domain-separated ack digest.  Dropped; the
+  // author slot stays open (attribution comes from the signed field,
+  // never the reply slot).
+  handlers[0].set(MempoolMessage::make_ack(
+                      digest, peers[1],
+                      Signature::sign_host(digest, key_for(peers[1]).secret))
+                      .serialize());
+  // Slot 1: peer 1's honest vote — verifies and counts (own + 1 = 2).
+  handlers[1].set(MempoolMessage::make_ack(
+                      digest, peers[1],
+                      Signature::sign_host(ack, key_for(peers[1]).secret))
+                      .serialize());
+  ProcessorMessage out;
+  CHECK(tx_batch->recv_until(&out, std::chrono::steady_clock::now() +
+                                       std::chrono::milliseconds(200)) ==
+        RecvStatus::kTimeout);
+  // Slot 2: peer 2's honest vote reaches 2f+1 = 3 and releases the batch.
+  handlers[2].set(MempoolMessage::make_ack(
+                      digest, peers[2],
+                      Signature::sign_host(ack, key_for(peers[2]).secret))
+                      .serialize());
+  auto got = tx_batch->recv();
+  CHECK(got.has_value());
+  CHECK(got->forward);
+  CHECK(got->cert.has_value());
+  const BatchCertificate& cert = *got->cert;
+  CHECK(cert.digest == digest);
+  CHECK(cert.votes.size() == 3);  // minimal: stops exactly at the quorum
+  CHECK(cert.votes[0].first == myself);  // own vote first (we hold it)
+  CHECK(cert.check(committee).empty());
+  CHECK(Signature::verify_batch(cert.ack_digest(), cert.votes));
+  rx_msg->close();
+  tx_batch->close();
+  stop->store(true);
+  actor.join();
+}
+
+TEST(quorum_waiter_dag_skips_transport_acks) {
+  // A dag peer that received but could not store a batch replies a bare
+  // transport "Ack" (FIFO pairing filler).  It must be skipped silently —
+  // counting it would certify availability the peer does not have.
+  auto committee = mempool_committee(8040);
+  auto ks = keys();
+  auto myself = ks[0].name;
+  auto rx_msg = make_channel<QuorumWaiterMessage>();
+  auto tx_batch = make_channel<ProcessorMessage>();
+  auto stop = std::make_shared<std::atomic<bool>>(false);
+  auto actor = QuorumWaiter::spawn(committee, myself, ks[0].secret,
+                                   /*dag=*/true, rx_msg, tx_batch, stop);
+
+  QuorumWaiterMessage msg;
+  msg.batch = MempoolMessage::make_batch({{4, 4}}).serialize();
+  Digest digest = Processor::digest_of(msg.batch);
+  Digest ack = BatchCertificate::ack_digest_of(digest);
+  std::vector<CancelHandler> handlers;
+  std::vector<PublicKey> peers;
+  for (const auto& [name, _] : committee.broadcast_addresses(myself)) {
+    CancelHandler h;
+    handlers.push_back(h);
+    peers.push_back(name);
+    msg.handlers.emplace_back(name, h);
+  }
+  rx_msg->send(std::move(msg));
+  auto key_for = [&](const PublicKey& name) -> const KeyPair& {
+    for (const auto& kp : ks) {
+      if (kp.name == name) return kp;
+    }
+    throw std::runtime_error("unknown peer");
+  };
+
+  handlers[0].set(to_bytes("Ack"));  // overloaded peer: no vote
+  handlers[1].set(MempoolMessage::make_ack(
+                      digest, peers[1],
+                      Signature::sign_host(ack, key_for(peers[1]).secret))
+                      .serialize());
+  ProcessorMessage out;
+  CHECK(tx_batch->recv_until(&out, std::chrono::steady_clock::now() +
+                                       std::chrono::milliseconds(200)) ==
+        RecvStatus::kTimeout);  // own + 1 vote: the "Ack" added nothing
+  handlers[2].set(MempoolMessage::make_ack(
+                      digest, peers[2],
+                      Signature::sign_host(ack, key_for(peers[2]).secret))
+                      .serialize());
+  auto got = tx_batch->recv();
+  CHECK(got.has_value());
+  CHECK(got->cert.has_value());
+  CHECK(got->cert->votes.size() == 3);
+  rx_msg->close();
+  tx_batch->close();
+  stop->store(true);
+  actor.join();
+}
+
+TEST(mempool_dag_peer_replies_signed_ack) {
+  // Peer-receiver dag lane end to end: a peer batch is stored FIRST, then
+  // answered with a signed availability ACK — and it never feeds this
+  // node's proposer (only the producer proposes its own certified batch).
+  auto committee = mempool_committee(8060);
+  auto ks = keys();
+  auto myself = ks[0].name;
+  Store store = Store::open("");
+  Parameters params;
+  params.batch_size = 1'000'000;  // nothing seals: only peer batches flow
+  params.max_batch_delay = 60'000;
+  params.dag = true;
+  auto rx_consensus = make_channel<ConsensusMempoolMessage>();
+  auto tx_consensus = make_channel<PayloadRef>(SIZE_MAX);
+  auto mp = Mempool::spawn(myself, ks[0].secret, committee, params, store,
+                           rx_consensus, tx_consensus);
+
+  auto sock = Socket::connect(*committee.mempool_address(myself));
+  CHECK(sock.has_value());
+  sock->set_recv_timeout(10'000);
+  Bytes frame = MempoolMessage::make_batch({{8, 8, 8}}).serialize();
+  Digest digest = sha512_digest(frame);
+  CHECK(sock->write_frame(frame));
+  Bytes reply;
+  CHECK(sock->read_frame(&reply));
+  auto ackmsg = MempoolMessage::deserialize(reply);
+  CHECK(ackmsg.kind == MempoolMessage::Kind::kAck);
+  CHECK(ackmsg.ack_digest == digest);
+  CHECK(ackmsg.ack_author == myself);
+  CHECK(ackmsg.ack_signature.verify(BatchCertificate::ack_digest_of(digest),
+                                    myself));
+  // Sign-only-after-store: the ACK implies the batch is durably held.
+  CHECK(store.read(digest.to_bytes()).has_value());
+  PayloadRef leak;
+  CHECK(tx_consensus->recv_until(&leak, std::chrono::steady_clock::now() +
+                                            std::chrono::milliseconds(200)) ==
+        RecvStatus::kTimeout);
   mp->stop();
 }
 
@@ -698,9 +963,9 @@ TEST(mempool_signed_ingress_end_to_end) {
   params.verify_batch = 1;  // settle every frame immediately
   params.verify_max_delay = 10;
   auto rx_consensus = make_channel<ConsensusMempoolMessage>();
-  auto tx_consensus = make_channel<Digest>();
-  auto mp = Mempool::spawn(myself, committee, params, store, rx_consensus,
-                           tx_consensus);
+  auto tx_consensus = make_channel<PayloadRef>();
+  auto mp = Mempool::spawn(myself, keys()[0].secret, committee, params, store,
+                           rx_consensus, tx_consensus);
   CHECK(mp->tx_verifier() != nullptr);
 
   auto sock = Socket::connect(*committee.transactions_address(myself));
@@ -717,9 +982,9 @@ TEST(mempool_signed_ingress_end_to_end) {
   // 3. Honest: verifies, seals, broadcasts, quorum-ACKs, commits.
   Bytes honest = signed_tx_frame(ring.get(2), 2, 2);
   CHECK(sock->write_frame(honest));
-  auto digest = tx_consensus->recv();
-  CHECK(digest.has_value());
-  auto stored = store.read(digest->to_bytes());
+  auto ref = tx_consensus->recv();
+  CHECK(ref.has_value());
+  auto stored = store.read(ref->digest.to_bytes());
   CHECK(stored.has_value());
   auto m = MempoolMessage::deserialize(*stored);
   CHECK(m.batch.size() == 1);

@@ -46,6 +46,18 @@ SCHEMA = "bench-trend-v1"
 # The one field --check judges: the headline sigs/sec number every
 # round emits.
 HEADLINE_FIELD = "value"
+# The PRIMARY metric — the one that owns the un-namespaced field lanes
+# and the --check judgement — is whatever the first numbered driver
+# round declares (every committed round emits ``ed25519-batch-verify``;
+# this constant is only the fallback for a history with no metric at
+# all).  Artifacts declaring a DIFFERENT metric — the graftdag
+# consensus-throughput headline (``dag-commit-tps``) is the first —
+# land under ``<metric>:<path>`` lanes instead: their numbers trend
+# with the same best/latest/degraded-excluded-from-best machinery, but
+# a 5k tx/s commit rate can never masquerade as (or regress) a 39k
+# sigs/s verify headline.  Artifacts with no ``metric`` key stay
+# un-namespaced (legacy wedged rounds).
+HEADLINE_METRIC = "ed25519-batch-verify"
 
 
 def flatten_numeric(obj, prefix: str = "") -> dict:
@@ -74,7 +86,7 @@ def parse_artifact(path: str) -> dict:
     become flagged degraded runs with an error note)."""
     name = os.path.basename(path)
     run = {"file": name, "n": None, "rc": None, "degraded": True,
-           "error": None, "fields": {}}
+           "error": None, "metric": None, "fields": {}}
     m = re.search(r"_r(\d+)", name)
     if m:
         run["n"] = int(m.group(1))
@@ -97,6 +109,8 @@ def parse_artifact(path: str) -> dict:
     if not isinstance(parsed, dict) or "value" not in parsed:
         run["error"] = "no parsed headline line (wedged round)"
         return run
+    if isinstance(parsed.get("metric"), str):
+        run["metric"] = parsed["metric"]
     run["fields"] = flatten_numeric(parsed)
     err = parsed.get("error") or parsed.get("note")
     if isinstance(err, str):
@@ -116,9 +130,15 @@ def build_trend(paths) -> dict:
     # artifacts (degraded committed lines) in name order.
     runs.sort(key=lambda r: (r["n"] is None, r["n"] or 0, r["file"]))
     fields: dict = {}
+    primary = next((r["metric"] for r in runs if r["metric"]),
+                   HEADLINE_METRIC)
     for run in runs:
+        # Foreign-metric artifacts get their own field namespace (see
+        # HEADLINE_METRIC).
+        ns = "" if run["metric"] in (None, primary) \
+            else run["metric"] + ":"
         for path, val in run["fields"].items():
-            entry = fields.setdefault(path, {
+            entry = fields.setdefault(ns + path, {
                 "best": None, "best_run": None,
                 "latest": None, "latest_run": None,
                 "latest_live": None, "latest_live_run": None,
@@ -134,6 +154,7 @@ def build_trend(paths) -> dict:
                     entry["best_run"] = run["file"]
     return {
         "schema": SCHEMA,
+        "headline_metric": primary,
         "runs": [{k: v for k, v in r.items() if k != "fields"}
                  | {"value": r["fields"].get(HEADLINE_FIELD)}
                  for r in runs],

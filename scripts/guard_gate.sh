@@ -26,7 +26,15 @@
 #      plus the tx-frame fuzz corpus inside test_fuzz.py) is pure
 #      python-side work: frame/key derivation, parser accounting and
 #      the small-population users probe — a few seconds total.
-#   5. The graftfleet lane (tests/test_fleet.py) adds the two scripted
+#   5. The graftdag lane (tests/test_dag.py) pins the certified-batch
+#      mempool's Python contracts: the dagwire constant mirror against
+#      native/src/mempool/messages.hpp, the dagack domain-separated
+#      preimage, and the full-engine proof that quorum-sized
+#      certificate ACK batches land on the warmed RLC bucket with
+#      verdict masks bit-identical to per-signature verify_batch
+#      (warm-cache: tens of seconds, dominated by the shared RLC
+#      warmup compiles the verifysched lane also pays).
+#   6. The graftfleet lane (tests/test_fleet.py) adds the two scripted
 #      drills on top of its fast DRR/HELLO/dedup coverage: the
 #      2-sidecar kill-primary failover e2e (real subprocesses, sticky
 #      re-home, strict sidecar-failover SLO parse) and the seeded
@@ -53,7 +61,7 @@ rc=0
 timeout -k 10 "$BUDGET" env JAX_PLATFORMS=cpu HOTSTUFF_TPU_SLOW_TESTS=1 \
     python -m pytest "$ROOT/tests/test_fuzz.py" "$ROOT/tests/test_guard.py" \
     "$ROOT/tests/test_ring.py" "$ROOT/tests/test_ingress_tier.py" \
-    "$ROOT/tests/test_fleet.py" \
+    "$ROOT/tests/test_fleet.py" "$ROOT/tests/test_dag.py" \
     -q -p no:cacheprovider "$@" || rc=$?
 if [ "$rc" -ne 0 ]; then
   if [ "$rc" -eq 124 ]; then

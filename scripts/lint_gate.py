@@ -236,6 +236,15 @@ if __name__ == "__main__":
                     "taint:native/src/mempool/tx_verify.cpp",
                     "taint:native/src/mempool/tx_verify.hpp",
                     "taint:hotstuff_tpu/crypto/txsign.py",
+                    # graftdag: the certified-batch mempool modules stay
+                    # inside the taint scan — the batch-certificate gate
+                    # (signed-ACK assembly/verification) and the
+                    # cert-driven prefetch sink both lose their
+                    # provenance proof if any of these drops out.
+                    "taint:native/src/mempool/messages.cpp",
+                    "taint:native/src/mempool/quorum_waiter.cpp",
+                    "taint:native/src/mempool/synchronizer.cpp",
+                    "taint:native/src/consensus/mempool_driver.cpp",
                     "cxxsync:native/src/mempool/tx_verify.hpp",
                     "cxxsync:native/src/mempool/tx_verify.cpp",
                     # graftfleet: the tenant-lane implementation and the

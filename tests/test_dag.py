@@ -1,0 +1,196 @@
+"""graftdag certified-batch mempool: Python-side contracts.
+
+Two halves:
+
+  * wire mirror — ``analysis/dagwire.py`` must agree with the native
+    authority (``native/src/mempool/messages.hpp``) on every
+    BatchCertificate constant, and its ``ack_digest`` helper must
+    reproduce the exact domain-separated preimage the node signs.
+  * engine routing — a certificate's ACK batch is QC-shaped (2f+1
+    signatures over one common ack digest), so a quorum-sized cert
+    batch must land on the warmed RLC one-MSM path of the verify
+    engine with a verdict mask bit-identical to per-signature
+    ``verify_batch`` — including the bisection path when one ACK is a
+    domain-separation replay (a signature over the bare batch digest).
+"""
+
+import hashlib
+import os
+import threading
+
+import pytest
+
+from hotstuff_tpu.analysis import dagwire, wirecheck
+from hotstuff_tpu.crypto import eddsa, ref_ed25519 as ref
+from hotstuff_tpu.sidecar import protocol as proto
+from hotstuff_tpu.sidecar import sched as vsched
+from hotstuff_tpu.sidecar import service
+from hotstuff_tpu.sidecar.sched.shapes import quorum_sigs
+from hotstuff_tpu.sidecar.service import VerifyEngine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Wire mirror: dagwire.py vs native/src/mempool/messages.hpp
+# ---------------------------------------------------------------------------
+
+def _native_constants():
+    with open(os.path.join(REPO, wirecheck.MEMPOOL_MSG_HPP),
+              encoding="utf-8") as fh:
+        src = fh.read()
+    cpp = wirecheck.cpp_int_constants(src)
+    cpp.update(wirecheck.cpp_typed_enum_constants(src, "Kind"))
+    return src, cpp
+
+
+def test_constants_match_native_header():
+    _, cpp = _native_constants()
+    assert cpp["kBatchAckTag"] == dagwire.BATCH_ACK_TAG
+    assert cpp["kBatchAckDomain"] == dagwire.BATCH_ACK_DOMAIN
+    assert cpp["kCertVoteLen"] == dagwire.CERT_VOTE_LEN
+    assert cpp["kBatch"] == dagwire.MEMPOOL_KIND_BATCH
+    assert cpp["kBatchRequest"] == dagwire.MEMPOOL_KIND_BATCH_REQUEST
+    assert cpp["kAck"] == dagwire.MEMPOOL_KIND_ACK
+    # the ACK rides the MempoolMessage Kind field
+    assert dagwire.BATCH_ACK_TAG == dagwire.MEMPOOL_KIND_ACK
+
+
+def test_cert_vote_len_is_pk_plus_sig():
+    assert dagwire.CERT_VOTE_LEN == dagwire.ED_PK_LEN + dagwire.ED_SIG_LEN
+    assert dagwire.CERT_VOTE_LEN == 96
+
+
+def test_ack_domain_spells_dagack_little_endian():
+    raw = dagwire.BATCH_ACK_DOMAIN.to_bytes(8, "little")
+    assert raw.rstrip(b"\x00") == b"dagack"
+
+
+def test_ack_digest_recipe_and_domain_separation():
+    batch_digest = hashlib.sha512(b"graftdag batch").digest()[:32]
+    want = hashlib.sha512(
+        batch_digest
+        + dagwire.BATCH_ACK_DOMAIN.to_bytes(8, "little")).digest()[:32]
+    got = dagwire.ack_digest(batch_digest)
+    assert got == want
+    assert len(got) == dagwire.DIGEST_LEN
+    # the whole point of the domain: an ACK preimage is never the batch
+    # digest itself, so a batch ACK cannot be replayed as another vote
+    assert got != batch_digest
+    with pytest.raises(ValueError):
+        dagwire.ack_digest(b"short")
+
+
+def test_certframe_lint_rule_is_clean():
+    """The graftlint certframe cross-check (the CI pin for these
+    constants) passes on this checkout."""
+    findings = [f for f in wirecheck.check(REPO)
+                if f.rule == "certframe-mismatch"]
+    assert findings == [], [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# Engine routing: quorum-sized cert ACK batches on the warmed RLC path
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def rlc_engine():
+    """Device-path engine (CPU backend) with per-signature and RLC
+    shapes warmed to 32 via the real warmup entry points — the same
+    registry state ``--warm-rlc`` produces, and the same shapes the
+    node's certificate verifies dispatch onto."""
+    engine = VerifyEngine()
+    service._warmup(engine, warm_max=32)
+    service._warmup_rlc(engine, warm_max=32)
+    yield engine
+    engine.stop()
+
+
+def _engine_mask(engine, msgs, pks, sigs):
+    done = []
+    cond = threading.Condition()
+
+    def reply(mask):
+        with cond:
+            done.append(mask)
+            cond.notify()
+
+    assert engine.submit(proto.VerifyRequest(1, msgs, pks, sigs), reply)
+    with cond:
+        assert cond.wait_for(lambda: done, timeout=120.0)
+    return done[0]
+
+
+def _cert_votes(n, seed=77, batch_tag=b"graftdag cert batch"):
+    """n signed ACKs over one certified batch: QC-shaped (one common
+    ack digest), exactly what BatchCertificate::vote_items yields."""
+    batch_digest = hashlib.sha512(batch_tag).digest()[:32]
+    ack = dagwire.ack_digest(batch_digest)
+    import numpy as np
+    r = np.random.default_rng(seed)
+    msgs, pks, sigs = [], [], []
+    for _ in range(n):
+        sk = r.bytes(32)
+        _, pk = ref.generate_keypair(sk)
+        msgs.append(ack)
+        pks.append(pk)
+        sigs.append(ref.sign(sk, ack))
+    return batch_digest, msgs, pks, sigs
+
+
+def test_quorum_cert_batch_routes_onto_warmed_rlc_bucket(rlc_engine):
+    """A 25-replica committee's quorum certificate (2f+1 = 17 ACKs)
+    lands on the warmed RLC bucket through the full engine path, with a
+    verdict mask bit-identical to per-signature verify_batch."""
+    engine = rlc_engine
+    n = quorum_sigs(25)
+    assert n == 17
+    # the routing decision itself: quorum-size is past the RLC floor and
+    # its pow2 bucket (32) was warmed, so the registry routes it to the
+    # one-MSM program — the same decision the node's cert dispatch hits
+    assert engine._shapes.route(n) == vsched.PATH_RLC
+    before = engine.stats_snapshot()["paths"].get("rlc", 0)
+    _, msgs, pks, sigs = _cert_votes(n)
+    got = _engine_mask(engine, msgs, pks, sigs)
+    want = eddsa.verify_batch(msgs, pks, sigs)
+    assert got == [bool(b) for b in want]
+    assert got == [True] * n
+    assert engine.stats_snapshot()["paths"].get("rlc", 0) == before + 1
+
+
+def test_replayed_consensus_sig_pinpointed_by_bisection(rlc_engine):
+    """One 'ACK' signed over the bare batch digest (the replay the
+    dagack domain exists to kill) inside an otherwise-valid quorum
+    batch: the RLC combined check fails, bisection pinpoints exactly
+    the forged slot, and the mask stays bit-identical to
+    per-signature verify_batch."""
+    engine = rlc_engine
+    n = quorum_sigs(25)
+    batch_digest, msgs, pks, sigs = _cert_votes(n, seed=78)
+    import numpy as np
+    r = np.random.default_rng(5)
+    sk = r.bytes(32)
+    _, pk = ref.generate_keypair(sk)
+    forged = 6
+    pks[forged] = pk
+    sigs[forged] = ref.sign(sk, batch_digest)  # wrong preimage: no domain
+    before = engine.stats_snapshot()["paths"].get("rlc_bisect", 0)
+    got = _engine_mask(engine, msgs, pks, sigs)
+    want = eddsa.verify_batch(msgs, pks, sigs)
+    assert got == [bool(b) for b in want]
+    assert got == [i != forged for i in range(n)]
+    assert engine.stats_snapshot()["paths"].get("rlc_bisect", 0) > before
+
+
+def test_small_committee_cert_stays_per_sig_and_bit_identical(rlc_engine):
+    """The 4-replica fixture committee's cert (3 ACKs) is below the RLC
+    launch floor — it takes the per-signature ladder, still through
+    warmed buckets, still bit-identical."""
+    engine = rlc_engine
+    n = quorum_sigs(4)
+    assert n == 3
+    assert engine._shapes.route(n) == vsched.PATH_PER_SIG
+    _, msgs, pks, sigs = _cert_votes(n, seed=79)
+    got = _engine_mask(engine, msgs, pks, sigs)
+    assert got == [bool(b) for b in eddsa.verify_batch(msgs, pks, sigs)]
+    assert got == [True] * n

@@ -1064,6 +1064,77 @@ def test_bench_trend_flattens_fleet(tmp_path):
     assert "fleet.flood.ok" not in f
 
 
+def test_bench_trend_flattens_dag_and_namespaces_foreign_metric(tmp_path):
+    """graftdag: the dag headline declares its OWN metric (consensus
+    tx/s, not verify sigs/s), so its numeric leaves land in the ledger
+    under a ``<metric>:``-prefixed lane — tracked best/latest with
+    degraded-excluded-from-best like every field — while the primary
+    sigs/s headline lane (and the --check judgement) never sees the
+    foreign value."""
+    bt = _bench_trend()
+    dag = {"n4": {"payload_tps": 900.0, "cert_tps": 1600.0},
+           "n10": {"payload_tps": 700.0, "cert_tps": 2500.0,
+                   "eventloop_ceiling_tps": 1000.0},
+           "chain_depth": 4, "ok": True}
+    _write_artifacts(
+        tmp_path,
+        ("BENCH_r01.json", {"n": 1, "rc": 0,
+                            "parsed": {"metric": "m", "value": 100.0}}),
+        ("BENCH_r02.json", {"n": 2, "rc": 0,
+                            "parsed": {"metric": "m", "value": 95.0}}),
+        # a LIVE dag headline with its own metric
+        ("BENCH_dag.json", {"metric": "dag-commit-tps", "value": 2500.0,
+                            "dag": dag}),
+        # a degraded dag line with larger numbers must not claim best
+        # in the dag lane either
+        ("BENCH_dag_degraded.json", {
+            "metric": "dag-commit-tps", "value": 9999.0, "degraded": True,
+            "dag": {"n10": {"cert_tps": 9999.0}}}),
+    )
+    trend = bt.build_trend(sorted(str(p) for p in
+                                  tmp_path.glob("BENCH_*.json")))
+    f = trend["fields"]
+    assert trend["headline_metric"] == "m"
+    # The dag leaves trend in their own namespaced lane.
+    assert f["dag-commit-tps:dag.n10.cert_tps"]["best"] == 2500.0
+    assert f["dag-commit-tps:dag.n4.payload_tps"]["best"] == 900.0
+    assert f["dag-commit-tps:value"]["best"] == 2500.0
+    # Degraded dag values stay visible as latest, never best.
+    assert f["dag-commit-tps:dag.n10.cert_tps"]["latest"] == 9999.0
+    assert f["dag-commit-tps:dag.n10.cert_tps"]["latest_degraded"] is True
+    assert f["dag-commit-tps:value"]["best_run"] == "BENCH_dag.json"
+    # Flags are not measurements.
+    assert "dag-commit-tps:dag.ok" not in f
+    # The PRIMARY headline lane is untouched by the foreign metric: the
+    # 2500 tx/s dag number must neither become the latest live value nor
+    # trip the regression judge against the 100-sigs/s-scale history.
+    v = f["value"]
+    assert v["best"] == 100.0 and v["latest_live"] == 95.0
+    assert v["latest_live_run"] == "BENCH_r02.json"
+    verdict = bt.judge(trend, 0.2)
+    assert verdict["ok"] is True
+    assert verdict["latest"] == 95.0 and verdict["best"] == 100.0
+
+
+def test_bench_trend_committed_history_keeps_sigs_headline():
+    """The committed repo history itself: the graftdag artifacts ride
+    the real BENCH_*.json glob, so pin — against the actual files —
+    that the primary headline lane still belongs to the verify metric
+    and still judges clean."""
+    import os
+
+    from conftest import REPO
+
+    bt = _bench_trend()
+    paths = sorted(
+        os.path.join(REPO, p) for p in os.listdir(REPO)
+        if p.startswith("BENCH_") and p.endswith(".json"))
+    assert paths, "committed BENCH_*.json artifacts missing"
+    trend = bt.build_trend(paths)
+    assert trend["headline_metric"] == "ed25519-batch-verify"
+    assert bt.judge(trend, 0.2)["ok"] is True
+
+
 def test_bench_trend_unjudgeable_histories_pass(tmp_path):
     bt = _bench_trend()
     # Only degraded runs: nothing to judge, never a failure.
